@@ -1,0 +1,16 @@
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, GradientState, PartialState
+from .logging import get_logger
+from .utils import (
+    DataLoaderConfiguration,
+    DeepSpeedPlugin,
+    DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    MegatronLMPlugin,
+    ParallelismConfig,
+    ProjectConfiguration,
+    SequenceParallelPlugin,
+    find_executable_batch_size,
+)

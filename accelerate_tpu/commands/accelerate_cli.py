@@ -1,0 +1,40 @@
+"""`accelerate-tpu` console entry: subcommand dispatch (parity: reference
+commands/accelerate_cli.py:26-46).
+
+Subcommands register themselves via `register_subcommand(parser)`; this module stays a
+thin dispatcher.
+"""
+
+import argparse
+
+
+def get_command_parser():
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu", usage="accelerate-tpu <command> [<args>]", allow_abbrev=False
+    )
+    subparsers = parser.add_subparsers(help="accelerate-tpu command helpers", dest="command")
+
+    # Subcommand modules are imported lazily so `--help` stays fast and optional deps
+    # (yaml, rich) are only touched by the commands that need them.
+    from . import config, env, estimate, launch, test, tpu
+
+    config.register_subcommand(subparsers)
+    env.register_subcommand(subparsers)
+    estimate.register_subcommand(subparsers)
+    launch.register_subcommand(subparsers)
+    test.register_subcommand(subparsers)
+    tpu.register_subcommand(subparsers)
+    return parser
+
+
+def main():
+    parser = get_command_parser()
+    args = parser.parse_args()
+    if not hasattr(args, "func"):
+        parser.print_help()
+        raise SystemExit(1)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""`accelerate-tpu estimate-memory` — model-memory estimator (parity: reference
+commands/estimate.py:63-299).
+
+The reference pulls meta-models from the Hub; this estimator works offline from (a) a
+local HF `config.json`, or (b) a named in-tree model family (`models/` registry), and
+prints the dtype table of total / largest-layer size plus the ≈4× training footprint
+heuristic (reference estimate.py:250-299)."""
+
+import argparse
+import json
+import os
+
+from ..utils.other import convert_bytes
+
+DTYPE_BYTES = {"float32": 4, "bf16": 2, "bfloat16": 2, "float16": 2, "int8": 1, "int4": 0.5}
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("estimate-memory", help="Estimate model memory usage")
+    parser.add_argument("model_name", help="Path to a HF config.json / model dir, or in-tree model name")
+    parser.add_argument("--dtypes", nargs="+", default=["float32", "bf16", "int8", "int4"])
+    parser.set_defaults(func=estimate_command)
+    return parser
+
+
+def estimate_parameters_from_hf_config(cfg: dict) -> tuple:
+    """(total_params, largest_layer_params) from a transformer config.json."""
+    vocab = cfg.get("vocab_size", 32000)
+    hidden = cfg.get("hidden_size", cfg.get("n_embd", cfg.get("d_model", 768)))
+    layers = cfg.get("num_hidden_layers", cfg.get("n_layer", cfg.get("num_layers", 12)))
+    inter = cfg.get("intermediate_size", cfg.get("n_inner") or 4 * hidden)
+    heads = cfg.get("num_attention_heads", cfg.get("n_head", hidden // 64))
+    kv_heads = cfg.get("num_key_value_heads", heads)
+    head_dim = cfg.get("head_dim", hidden // heads)
+    attn = hidden * heads * head_dim + 2 * hidden * kv_heads * head_dim + heads * head_dim * hidden
+    gated = "llama" in str(cfg.get("model_type", "")).lower() or cfg.get("hidden_act", "") in ("silu", "swiglu")
+    mlp = (3 if gated else 2) * hidden * inter
+    per_layer = attn + mlp + 2 * hidden
+    embed = vocab * hidden
+    total = embed + layers * per_layer + hidden
+    if not cfg.get("tie_word_embeddings", True):
+        total += vocab * hidden
+    largest_layer = max(per_layer, embed)
+    return total, largest_layer
+
+
+def gather_data(args):
+    path = args.model_name
+    cfg = None
+    if os.path.isdir(path) and os.path.isfile(os.path.join(path, "config.json")):
+        path = os.path.join(path, "config.json")
+    if os.path.isfile(path):
+        with open(path) as f:
+            cfg = json.load(f)
+    else:
+        from ..models import get_model_config
+
+        cfg = get_model_config(path)
+    total, largest = estimate_parameters_from_hf_config(cfg)
+    rows = []
+    for dtype in args.dtypes:
+        bytes_per = DTYPE_BYTES[dtype]
+        rows.append(
+            {
+                "dtype": dtype,
+                "largest_layer": largest * bytes_per,
+                "total_size": total * bytes_per,
+                # Adam training ≈ params + grads + 2 optimizer moments in fp32 master
+                # (reference uses the 4× heuristic, estimate.py:250-299).
+                "training_size": total * bytes_per * 4,
+            }
+        )
+    return total, rows
+
+
+def estimate_command(args):
+    total, rows = gather_data(args)
+    print(f"Memory usage for loading `{args.model_name}` ({total / 1e9:.2f}B params):")
+    header = f"| {'dtype':8} | {'Largest Layer':>14} | {'Total Size':>12} | {'Training (Adam)':>16} |"
+    print(header)
+    print("|" + "-" * (len(header) - 2) + "|")
+    for row in rows:
+        print(
+            f"| {row['dtype']:8} | {convert_bytes(row['largest_layer']):>14} "
+            f"| {convert_bytes(row['total_size']):>12} | {convert_bytes(row['training_size']):>16} |"
+        )
+    return rows

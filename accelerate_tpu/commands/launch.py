@@ -1,0 +1,118 @@
+"""`accelerate-tpu launch` — run a training script with the env-var protocol
+(parity: reference commands/launch.py:1068-1091 + utils/launch.py env builders).
+
+The launcher serializes everything into `ACCELERATE_TPU_*` env vars and runs the user
+script; `Accelerator()` inside the script reads them back (the same two-sided protocol
+as the reference). Dispatch:
+  - single host → subprocess with env (reference simple_launcher :690)
+  - multi-host pod, this host → env with coordinator vars (reference tpu_launcher :790)
+  - `--tpu_use_cluster` → re-launch this command on every pod worker over gcloud ssh
+    (reference tpu_pod_launcher :821); see commands/tpu.py.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+from .config import load_config_file
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("launch", help="Launch a script with accelerate-tpu", add_help=True)
+    add_launch_args(parser)
+    parser.set_defaults(func=launch_command)
+    return parser
+
+
+def add_launch_args(parser):
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--mixed_precision", default=None, choices=[None, "no", "bf16", "fp16", "fp8"])
+    parser.add_argument("--num_processes", type=int, default=None, help="Number of host processes (pod hosts)")
+    parser.add_argument("--process_id", type=int, default=None, help="This host's rank (multi-host)")
+    parser.add_argument("--coordinator_address", default=None, help="host:port of process 0 (multi-host)")
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    parser.add_argument("--debug", action="store_true", help="Enable collective shape verification")
+    parser.add_argument("--cpu", action="store_true", help="Force host-CPU platform (debug/testing)")
+    parser.add_argument("--num_cpu_devices", type=int, default=None, help="Virtual CPU device count (testing)")
+    parser.add_argument("--profile_dir", default=None, help="Enable jax.profiler traces into this directory")
+    for axis in ("data", "fsdp", "model", "seq", "expert", "stage"):
+        parser.add_argument(f"--mesh_{axis}", type=int, default=None, help=f"Mesh axis size for `{axis}`")
+    parser.add_argument("--tpu_use_cluster", action="store_true", help="Launch on every worker of a TPU pod")
+    parser.add_argument("--tpu_name", default=None)
+    parser.add_argument("--tpu_zone", default=None)
+    parser.add_argument("training_script", type=str, help="The script to launch")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER, help="Script arguments")
+    return parser
+
+
+def build_launch_env(args, config: dict) -> dict:
+    """Merge CLI args over the config file into the env-var protocol (reference
+    utils/launch.py:76-148 prepare_simple_launcher_cmd_env)."""
+    env = os.environ.copy()
+
+    def pick(cli_val, key, default=None):
+        if cli_val is not None:
+            return cli_val
+        return config.get(key, default)
+
+    mp = pick(args.mixed_precision, "mixed_precision")
+    if mp:
+        env["ACCELERATE_TPU_MIXED_PRECISION"] = str(mp)
+    gas = pick(args.gradient_accumulation_steps, "gradient_accumulation_steps")
+    if gas:
+        env["ACCELERATE_TPU_GRADIENT_ACCUMULATION_STEPS"] = str(gas)
+    mesh_cfg = config.get("mesh", {}) or {}
+    for axis in ("data", "fsdp", "model", "seq", "expert", "stage"):
+        val = getattr(args, f"mesh_{axis}")
+        if val is None:
+            val = mesh_cfg.get(axis)
+        if val is not None:
+            env[f"ACCELERATE_TPU_MESH_{axis.upper()}"] = str(val)
+    if args.debug:
+        env["ACCELERATE_TPU_DEBUG_MODE"] = "1"
+    if args.profile_dir:
+        env["ACCELERATE_TPU_PROFILE_DIR"] = args.profile_dir
+
+    num_processes = pick(args.num_processes, "num_processes", 1)
+    coordinator = pick(args.coordinator_address, "coordinator_address")
+    if num_processes and int(num_processes) > 1:
+        if coordinator is None:
+            raise ValueError("--coordinator_address is required when --num_processes > 1")
+        process_id = args.process_id
+        if process_id is None:
+            process_id = int(os.environ.get("ACCELERATE_TPU_PROCESS_ID", "0"))
+        env["ACCELERATE_TPU_COORDINATOR_ADDRESS"] = str(coordinator)
+        env["ACCELERATE_TPU_NUM_PROCESSES"] = str(num_processes)
+        env["ACCELERATE_TPU_PROCESS_ID"] = str(process_id)
+    if args.cpu or args.num_cpu_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        n = args.num_cpu_devices or 8
+        flags = env.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    return env
+
+
+def launch_command(args):
+    config = load_config_file(args.config_file)
+    if args.tpu_use_cluster or config.get("tpu_use_cluster"):
+        from .tpu import pod_launcher
+
+        return pod_launcher(args, config)
+    env = build_launch_env(args, config)
+    cmd = [sys.executable, args.training_script] + list(args.training_script_args)
+    process = subprocess.run(cmd, env=env)
+    if process.returncode != 0:
+        raise SystemExit(process.returncode)
+
+
+def main():
+    parser = argparse.ArgumentParser("accelerate-tpu-launch", allow_abbrev=False)
+    add_launch_args(parser)
+    args = parser.parse_args()
+    launch_command(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""`accelerate-tpu test` — sanity-run the bundled end-to-end script (parity: reference
+commands/test.py:22-55, which launches test_utils/scripts/test_script.py)."""
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("test", help="Run the end-to-end sanity test")
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--cpu", action="store_true", help="Run on the virtual CPU mesh")
+    parser.set_defaults(func=test_command)
+    return parser
+
+
+def test_command(args):
+    import accelerate_tpu.test_utils.scripts as scripts_mod
+
+    script = os.path.join(os.path.dirname(scripts_mod.__file__), "test_script.py")
+    env = os.environ.copy()
+    if args.cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    print("Running:  " + " ".join([sys.executable, script]))
+    result = subprocess.run([sys.executable, script], env=env)
+    if result.returncode == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    else:
+        raise SystemExit(result.returncode)

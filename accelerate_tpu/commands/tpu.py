@@ -1,0 +1,90 @@
+"""`accelerate-tpu tpu-config` + the pod launcher (parity: reference commands/tpu.py:90-150
+and tpu_pod_launcher commands/launch.py:821).
+
+Both work by re-running a command on every worker of a Cloud TPU pod slice over
+`gcloud compute tpus tpu-vm ssh --worker all`. `--dry_run` prints the command instead of
+executing (used by the CLI tests; no gcloud in CI)."""
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("tpu-config", help="Run setup commands on every pod worker")
+    parser.add_argument("--tpu_name", required=False, default=None)
+    parser.add_argument("--tpu_zone", required=False, default=None)
+    parser.add_argument("--command", action="append", default=None, help="Command(s) to run on each worker")
+    parser.add_argument("--command_file", default=None, help="File with one command per line")
+    parser.add_argument("--install_accelerate", action="store_true", help="Install accelerate-tpu on workers first")
+    parser.add_argument("--accelerate_version", default="latest")
+    parser.add_argument("--debug", "--dry_run", dest="dry_run", action="store_true", help="Print, don't run")
+    parser.set_defaults(func=tpu_command_launcher)
+    return parser
+
+
+def build_ssh_command(tpu_name: str, tpu_zone: str, remote_command: str) -> list:
+    return [
+        "gcloud",
+        "compute",
+        "tpus",
+        "tpu-vm",
+        "ssh",
+        tpu_name,
+        "--zone",
+        tpu_zone,
+        "--command",
+        remote_command,
+        "--worker",
+        "all",
+    ]
+
+
+def tpu_command_launcher(args):
+    commands = list(args.command or [])
+    if args.command_file:
+        with open(args.command_file) as f:
+            commands.extend(line.strip() for line in f if line.strip())
+    if args.install_accelerate:
+        version = "" if args.accelerate_version == "latest" else f"=={args.accelerate_version}"
+        commands.insert(0, f"pip install accelerate-tpu{version}")
+    if not commands:
+        raise ValueError("No commands given: pass --command or --command_file")
+    if not args.tpu_name or not args.tpu_zone:
+        raise ValueError("--tpu_name and --tpu_zone are required")
+    remote = "; ".join(commands)
+    cmd = build_ssh_command(args.tpu_name, args.tpu_zone, remote)
+    if args.dry_run:
+        print("Running {}".format(" ".join(cmd)))
+        return cmd
+    print(f"Running {remote} on {args.tpu_name}...")
+    subprocess.run(cmd, check=True)
+    print("Successfully setup pod.")
+
+
+def pod_launcher(args, config: dict):
+    """Re-launch `accelerate-tpu launch` on every pod worker (reference
+    tpu_pod_launcher commands/launch.py:821-878).
+
+    Each worker re-runs the same launch command minus --tpu_use_cluster; JAX's
+    coordination service discovers pod topology from TPU metadata, so no explicit
+    process ids are needed on Cloud TPU."""
+    tpu_name = args.tpu_name or config.get("tpu_name")
+    tpu_zone = args.tpu_zone or config.get("tpu_zone")
+    if not tpu_name or not tpu_zone:
+        raise ValueError("Pod launch needs --tpu_name and --tpu_zone (or config file values)")
+    inner = [
+        "ACCELERATE_TPU_MULTIHOST=1",
+        "python",
+        "-m",
+        "accelerate_tpu.commands.launch",
+        args.training_script,
+        *args.training_script_args,
+    ]
+    remote_command = " ".join(inner)
+    cmd = build_ssh_command(tpu_name, tpu_zone, remote_command)
+    if getattr(args, "dry_run", False):
+        print("Running {}".format(" ".join(cmd)))
+        return cmd
+    subprocess.run(cmd, check=True)

@@ -1,0 +1,63 @@
+"""Multi-process-aware logging (parity: reference logging.py:22-125).
+
+`get_logger(__name__)` returns a `MultiProcessAdapter` whose log methods accept
+`main_process_only=` (default True) and `in_order=` kwargs, so N hosts don't emit N
+copies of every line. Level defaults from `ACCELERATE_TPU_LOG_LEVEL`.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """LoggerAdapter filtering by process rank (parity: reference logging.py:22).
+
+    `main_process_only=True` logs only on global rank 0; `in_order=True` logs on every
+    process, serialized by rank with a barrier between turns (debugging aid; slow).
+    """
+
+    @staticmethod
+    def _should_log(main_process_only):
+        from .state import PartialState
+
+        return not main_process_only or PartialState().is_main_process
+
+    def log(self, level, msg, *args, **kwargs):
+        if os.environ.get("ACCELERATE_TPU_DISABLE_LOGGING", "false").lower() == "true":
+            return
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        kwargs.setdefault("stacklevel", 2)
+
+        if self.isEnabledFor(level):
+            if self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+            elif in_order:
+                from .state import PartialState
+
+                state = PartialState()
+                for i in range(state.num_processes):
+                    if i == state.process_index:
+                        msg, kwargs = self.process(msg, kwargs)
+                        self.logger.log(level, msg, *args, **kwargs)
+                    state.wait_for_everyone()
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        """Emit a warning exactly once per unique message (parity: reference logging.py:71)."""
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    """Returns a process-aware logger (parity: reference logging.py:85)."""
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_TPU_LOG_LEVEL", None)
+    logger = logging.getLogger(name)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

@@ -1,0 +1,1 @@
+from .mesh import build_mesh, get_default_mesh, mesh_axis_size

@@ -1,0 +1,76 @@
+"""Global device-mesh construction.
+
+The single `Mesh` replaces every process-group in the reference (DDP/FSDP/Megatron
+TP/PP/DP groups — reference utils/megatron_lm.py + torch.distributed group creation).
+Axis order follows `constants.MESH_AXIS_NAMES`, laid out so that the innermost axes
+(model/seq) map to the fastest ICI links while the outermost (data) may span DCN on
+multi-slice/multi-host topologies.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..utils.constants import MESH_AXIS_NAMES
+from ..utils.dataclasses import ParallelismConfig
+
+
+def build_mesh(
+    parallelism: Optional[ParallelismConfig] = None,
+    devices: Optional[Sequence] = None,
+    axis_names: Sequence[str] = MESH_AXIS_NAMES,
+):
+    """Build a `jax.sharding.Mesh` from a ParallelismConfig.
+
+    Uses `mesh_utils.create_device_mesh` so the logical mesh is laid out along physical
+    ICI topology (the TPU-native replacement for NCCL ring construction); falls back to a
+    plain reshape on CPU/virtual platforms. Multi-host meshes with a data axis spanning
+    hosts use `create_hybrid_device_mesh` so cross-DCN traffic stays on the data axis.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if parallelism is None:
+        parallelism = ParallelismConfig()
+    if devices is None:
+        devices = jax.devices()
+    sizes = parallelism.resolve(len(devices))
+    shape = tuple(sizes[name] for name in axis_names)
+
+    if jax.process_count() > 1 and sizes.get("data", 1) % jax.process_count() == 0 and sizes.get("data", 1) > 1:
+        try:
+            per_host = list(shape)
+            data_idx = list(axis_names).index("data")
+            per_host[data_idx] = sizes["data"] // jax.process_count()
+            dcn = [1] * len(shape)
+            dcn[data_idx] = jax.process_count()
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                tuple(per_host), tuple(dcn), devices=devices, allow_split_physical_axes=True
+            )
+            return Mesh(device_array, axis_names)
+        except (ValueError, AssertionError, NotImplementedError):
+            pass
+    try:
+        device_array = mesh_utils.create_device_mesh(shape, devices=devices, allow_split_physical_axes=True)
+    except (ValueError, AssertionError, NotImplementedError):
+        device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, axis_names)
+
+
+def get_default_mesh():
+    """The mesh from AcceleratorState (building it on first use)."""
+    from ..state import AcceleratorState
+
+    return AcceleratorState().mesh
+
+
+def mesh_axis_size(mesh, axis) -> int:
+    if isinstance(axis, (tuple, list)):
+        out = 1
+        for a in axis:
+            out *= mesh.shape[a]
+        return out
+    return mesh.shape[axis]

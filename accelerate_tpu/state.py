@@ -1,0 +1,476 @@
+"""Process-state core: the three singletons everything else reads.
+
+TPU-native redesign of the reference's state.py:
+  - `PartialState` (reference state.py:111) — topology discovery + process control. Instead
+    of picking among 8 comm backends and calling `torch.distributed.init_process_group`
+    (state.py:183-257), we initialize the JAX coordination service (`jax.distributed`)
+    when launched multi-host, and read rank/world topology from the JAX runtime. One
+    process drives all local TPU chips (SPMD), so "process" here means *host*, and
+    device-level parallelism is expressed through the mesh, not through processes.
+  - `AcceleratorState` (reference state.py:808) — mixed precision + the resolved
+    parallelism config and the global device `Mesh`. Where the reference re-types itself
+    per plugin (DEEPSPEED/FSDP/MEGATRON at state.py:895-913), every plugin here lowers to
+    mesh axes + sharding rules, so there is a single code path.
+  - `GradientState` (reference state.py:1085) — gradient-accumulation bookkeeping shared
+    between Accelerator, dataloaders, optimizers and schedulers. The reference's
+    `xm.mark_step` fencing (state.py:1179-1188) has no equivalent: jit boundaries are the
+    graph boundaries.
+
+Borg pattern + `_reset_state` hooks mirror the reference so the test-suite singleton
+hygiene (reference test_utils/testing.py:427-438) ports directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from contextlib import contextmanager
+from functools import partial, wraps
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .utils.dataclasses import (
+    DistributedType,
+    GradientAccumulationPlugin,
+    ParallelismConfig,
+    PrecisionType,
+)
+from .utils.environment import parse_flag_from_env
+
+logger = logging.getLogger(__name__)
+
+
+def is_jax_distributed_initialized() -> bool:
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+def _maybe_init_jax_distributed(timeout_seconds: int | None = None):
+    """Initialize the JAX coordination service when launched multi-host.
+
+    Replaces MASTER_ADDR/MASTER_PORT + init_process_group (reference state.py:213-257)
+    with the coordinator-address protocol. Honors both our env-var protocol
+    (ACCELERATE_TPU_*) and JAX's native variables; on Cloud TPU pods
+    `jax.distributed.initialize()` can discover everything from metadata, so we also
+    initialize when ACCELERATE_TPU_MULTIHOST is set without explicit addresses.
+    """
+    import jax
+
+    if is_jax_distributed_initialized():
+        return
+    coord = os.environ.get("ACCELERATE_TPU_COORDINATOR_ADDRESS", os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    nproc = os.environ.get("ACCELERATE_TPU_NUM_PROCESSES", os.environ.get("JAX_NUM_PROCESSES"))
+    pid = os.environ.get("ACCELERATE_TPU_PROCESS_ID", os.environ.get("JAX_PROCESS_ID"))
+    if coord is not None and nproc is not None and pid is not None:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nproc),
+            process_id=int(pid),
+            initialization_timeout=timeout_seconds or 300,
+        )
+    elif parse_flag_from_env("ACCELERATE_TPU_MULTIHOST"):
+        jax.distributed.initialize()
+
+
+# The reference needs a ThreadLocalSharedDict only for torch_xla TPU v2/v3
+# multithreading (state.py:79-107); JAX drives all local cores from a single process, so
+# plain class-level dicts are the Borg storage here.
+SharedDict = dict
+
+
+class PartialState:
+    """Singleton holding topology + process-control primitives (reference state.py:111).
+
+    Attributes:
+        device: the preferred local `jax.Device` for host→device transfers.
+        distributed_type: NO | XLA_SPMD | MULTI_HOST.
+        num_processes: number of *host* processes (JAX process count).
+        process_index / local_process_index: this host's global / node-local rank.
+        num_devices / local_device_count: global / per-host accelerator counts.
+        debug: when True, collectives verify shapes across processes first
+            (reference ACCELERATE_DEBUG_MODE, state.py:172).
+    """
+
+    _shared_state = SharedDict()
+
+    def __init__(self, cpu: bool = False, **kwargs):
+        self.__dict__ = self._shared_state
+        if self.initialized:
+            return
+        import jax
+
+        self.debug = parse_flag_from_env("ACCELERATE_TPU_DEBUG_MODE")
+        timeout = kwargs.pop("timeout", None)
+        timeout_seconds = int(timeout.total_seconds()) if timeout is not None else None
+        if cpu:
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            jax.config.update("jax_platforms", "cpu")
+        _maybe_init_jax_distributed(timeout_seconds)
+
+        self.num_processes = jax.process_count()
+        self.process_index = jax.process_index()
+        # With one process per host, the node-local rank equals 0; honor the launcher's
+        # env override for setups running several processes on one host.
+        self.local_process_index = int(os.environ.get("ACCELERATE_TPU_LOCAL_PROCESS_INDEX", 0))
+        self.local_devices = jax.local_devices()
+        self.num_devices = jax.device_count()
+        self.local_device_count = jax.local_device_count()
+        self.device = self.local_devices[0]
+        self.platform = self.device.platform
+
+        if self.num_processes > 1:
+            self.distributed_type = DistributedType.MULTI_HOST
+        elif self.num_devices > 1:
+            self.distributed_type = DistributedType.XLA_SPMD
+        else:
+            self.distributed_type = DistributedType.NO
+        self.fork_launched = parse_flag_from_env("FORK_LAUNCHED", 0)
+
+    def __repr__(self) -> str:
+        return (
+            f"Distributed environment: {self.distributed_type}\n"
+            f"Num processes (hosts): {self.num_processes}\n"
+            f"Process index: {self.process_index}\n"
+            f"Local devices: {self.local_device_count} / global devices: {self.num_devices}\n"
+            f"Device: {self.device}\n"
+        )
+
+    @staticmethod
+    def _reset_state():
+        """Reset the singleton (test hygiene; reference state.py destroys process groups)."""
+        PartialState._shared_state.clear()
+
+    @property
+    def initialized(self) -> bool:
+        return self._shared_state != {}
+
+    @property
+    def use_distributed(self) -> bool:
+        return self.num_processes > 1 or self.num_devices > 1
+
+    @property
+    def is_main_process(self) -> bool:
+        return self.process_index == 0
+
+    @property
+    def is_local_main_process(self) -> bool:
+        return self.local_process_index == 0
+
+    @property
+    def is_last_process(self) -> bool:
+        return self.process_index == self.num_processes - 1
+
+    def wait_for_everyone(self):
+        """Cross-host barrier (reference state.py:348 → torch.distributed.barrier /
+        xm.rendezvous). Implemented over the JAX coordination service; a no-op
+        single-host since local devices are driven synchronously by one process."""
+        if self.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("accelerate_tpu.wait_for_everyone")
+
+    @contextmanager
+    def main_process_first(self):
+        """Main process runs the body before the others (reference state.py:484)."""
+        if not self.is_main_process:
+            self.wait_for_everyone()
+        yield
+        if self.is_main_process:
+            self.wait_for_everyone()
+
+    @contextmanager
+    def local_main_process_first(self):
+        if not self.is_local_main_process:
+            self.wait_for_everyone()
+        yield
+        if self.is_local_main_process:
+            self.wait_for_everyone()
+
+    def on_main_process(self, function: Callable = None):
+        """Decorator: run only on the main process (reference state.py:525)."""
+        if not self.initialized:
+            raise ValueError("The `PartialState` must be initialized before calling this")
+
+        @wraps(function)
+        def _inner(*args, **kwargs):
+            if self.is_main_process:
+                return function(*args, **kwargs)
+
+        return _inner
+
+    def on_local_main_process(self, function: Callable = None):
+        @wraps(function)
+        def _inner(*args, **kwargs):
+            if self.is_local_main_process:
+                return function(*args, **kwargs)
+
+        return _inner
+
+    def on_last_process(self, function: Callable):
+        @wraps(function)
+        def _inner(*args, **kwargs):
+            if self.is_last_process:
+                return function(*args, **kwargs)
+
+        return _inner
+
+    def on_process(self, function: Callable = None, process_index: int = None):
+        if function is None:
+            return partial(self.on_process, process_index=process_index)
+
+        @wraps(function)
+        def _inner(*args, **kwargs):
+            if self.process_index == process_index:
+                return function(*args, **kwargs)
+
+        return _inner
+
+    def on_local_process(self, function: Callable = None, local_process_index: int = None):
+        if function is None:
+            return partial(self.on_local_process, local_process_index=local_process_index)
+
+        @wraps(function)
+        def _inner(*args, **kwargs):
+            if self.local_process_index == local_process_index:
+                return function(*args, **kwargs)
+
+        return _inner
+
+    def print(self, *args, **kwargs):
+        """Print once (main process only) — reference state.py `print`."""
+        if self.is_main_process:
+            print(*args, **kwargs)
+
+    @contextmanager
+    def split_between_processes(self, inputs, apply_padding: bool = False):
+        """Split `inputs` across host processes, yielding this host's slice
+        (reference state.py:393-483; user-facing at accelerator.py:611).
+
+        Accepts list/tuple/dict-of-splittables/np.ndarray/jax.Array. With
+        `apply_padding=True` the last element is repeated so every process gets the same
+        count (pair with `gather_for_metrics(..)` truncation on the way back).
+        """
+        if self.num_processes == 1:
+            yield inputs
+            return
+
+        import jax
+
+        def _split(obj):
+            length = len(obj)
+            num_samples_per_process, num_extras = divmod(length, self.num_processes)
+            start = self.process_index * num_samples_per_process + min(self.process_index, num_extras)
+            end = start + num_samples_per_process + (1 if self.process_index < num_extras else 0)
+            result = obj[start:end]
+            if apply_padding:
+                target = num_samples_per_process + (1 if num_extras > 0 else 0)
+                while len(result) < target:
+                    if isinstance(result, np.ndarray) or isinstance(result, jax.Array):
+                        result = np.concatenate([np.asarray(result), np.asarray(result[-1:])], axis=0)
+                    else:
+                        result = list(result) + list(result[-1:])
+            return result
+
+        if isinstance(inputs, dict):
+            lengths = {len(v) for v in inputs.values()}
+            if len(lengths) != 1:
+                raise ValueError("All values in a dict passed to `split_between_processes` must be equal length")
+            yield {k: _split(v) for k, v in inputs.items()}
+        else:
+            yield _split(inputs)
+
+    def destroy_process_group(self):
+        """Shut down the coordination service (reference destroys the torch pg)."""
+        import jax
+
+        if is_jax_distributed_initialized():
+            jax.distributed.shutdown()
+        self._reset_state()
+
+
+class AcceleratorState:
+    """Singleton layering precision + mesh + plugins over PartialState
+    (reference state.py:808)."""
+
+    _shared_state = SharedDict()
+
+    def __init__(
+        self,
+        mixed_precision: str = None,
+        cpu: bool = False,
+        parallelism_config: Optional[ParallelismConfig] = None,
+        fsdp_plugin=None,
+        deepspeed_plugin=None,
+        megatron_lm_plugin=None,
+        sequence_parallel_plugin=None,
+        _from_accelerator: bool = False,
+        **kwargs,
+    ):
+        self.__dict__ = self._shared_state
+        if self.initialized:
+            if mixed_precision is not None and mixed_precision != self._mixed_precision:
+                raise ValueError(
+                    "AcceleratorState already initialized with mixed_precision="
+                    f"{self._mixed_precision}; cannot re-init with {mixed_precision}. "
+                    "Call AcceleratorState._reset_state() first (tests) or pass the value once."
+                )
+            return
+
+        self._partial = PartialState(cpu, **kwargs)
+        if mixed_precision is None:
+            mixed_precision = os.environ.get("ACCELERATE_TPU_MIXED_PRECISION", "no")
+        mixed_precision = str(mixed_precision).lower()
+        if mixed_precision not in PrecisionType.list():
+            raise ValueError(f"mixed_precision must be one of {PrecisionType.list()}, got {mixed_precision}")
+        self._mixed_precision = mixed_precision
+
+        # Compatibility shims lower to the two universal primitives (mesh + specs).
+        if megatron_lm_plugin is not None and parallelism_config is None:
+            parallelism_config = megatron_lm_plugin.to_parallelism_config()
+        if deepspeed_plugin is not None and fsdp_plugin is None:
+            fsdp_plugin = deepspeed_plugin.to_fsdp_plugin()
+        self.parallelism_config = parallelism_config or ParallelismConfig.from_env()
+        self.fsdp_plugin = fsdp_plugin
+        self.deepspeed_plugin = deepspeed_plugin
+        self.megatron_lm_plugin = megatron_lm_plugin
+        self.sequence_parallel_plugin = sequence_parallel_plugin
+        self._mesh = None
+
+    # ---- passthroughs to PartialState ------------------------------------------------
+    def __getattr__(self, name):
+        # Only called when normal lookup fails; delegate topology attrs to PartialState.
+        if name in ("_partial", "__dict__"):
+            raise AttributeError(name)
+        partial_state = self.__dict__.get("_partial")
+        if partial_state is not None and hasattr(partial_state, name):
+            return getattr(partial_state, name)
+        raise AttributeError(f"`AcceleratorState` object has no attribute `{name}`")
+
+    @property
+    def initialized(self) -> bool:
+        return self._shared_state != {}
+
+    @staticmethod
+    def _reset_state(reset_partial_state: bool = False):
+        AcceleratorState._shared_state.clear()
+        if reset_partial_state:
+            PartialState._reset_state()
+
+    @property
+    def mixed_precision(self) -> str:
+        return self._mixed_precision
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        return {"no": jnp.float32, "bf16": jnp.bfloat16, "fp16": jnp.float16, "fp8": jnp.bfloat16}[
+            self._mixed_precision
+        ]
+
+    @property
+    def mesh(self):
+        """The global device mesh; built lazily from `parallelism_config`."""
+        if self._mesh is None:
+            from .parallel.mesh import build_mesh
+
+            self._mesh = build_mesh(self.parallelism_config)
+        return self._mesh
+
+    def set_mesh(self, mesh):
+        self._mesh = mesh
+
+    @property
+    def use_fsdp(self) -> bool:
+        return self.fsdp_plugin is not None
+
+    def wait_for_everyone(self):
+        self._partial.wait_for_everyone()
+
+
+class GradientState:
+    """Singleton for gradient-accumulation bookkeeping (reference state.py:1085).
+
+    Shared mutable contract between Accelerator ↔ dataloaders ↔ optimizers ↔ schedulers:
+      - `sync_gradients`: True on step boundaries (apply update) — set by
+        `Accelerator.accumulate` or forced by `end_of_dataloader`.
+      - `end_of_dataloader` / `remainder`: set by the active DataLoaderShard so
+        `gather_for_metrics` can drop duplicated pad samples (reference
+        data_loader.py:377-384 → accelerator.py:2384-2393).
+    """
+
+    _shared_state = SharedDict()
+
+    def __init__(self, gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None):
+        self.__dict__ = self._shared_state
+        if not self.initialized:
+            self.sync_gradients = True
+            self.active_dataloader = None
+            self.dataloader_references = [None]
+            self.plugin_kwargs = (
+                gradient_accumulation_plugin.to_kwargs() if gradient_accumulation_plugin is not None else {}
+            )
+            self._is_xla_gradients_synced = False
+        if gradient_accumulation_plugin is not None and self.plugin_kwargs != gradient_accumulation_plugin.to_kwargs():
+            self.plugin_kwargs = gradient_accumulation_plugin.to_kwargs()
+
+    @property
+    def num_steps(self) -> int:
+        return self.plugin_kwargs.get("num_steps", 1)
+
+    @property
+    def adjust_scheduler(self) -> bool:
+        return self.plugin_kwargs.get("adjust_scheduler", False)
+
+    @property
+    def sync_with_dataloader(self) -> bool:
+        return self.plugin_kwargs.get("sync_with_dataloader", True)
+
+    @property
+    def initialized(self) -> bool:
+        return GradientState._shared_state != {}
+
+    @property
+    def end_of_dataloader(self) -> bool:
+        if not self.in_dataloader:
+            return False
+        return self.active_dataloader.end_of_dataloader
+
+    @property
+    def remainder(self) -> int:
+        if not self.in_dataloader:
+            return -1
+        return self.active_dataloader.remainder
+
+    @property
+    def in_dataloader(self) -> bool:
+        return self.active_dataloader is not None
+
+    def __repr__(self):
+        return (
+            f"Sync Gradients: {self.sync_gradients}\n"
+            f"At end of current dataloader: {self.end_of_dataloader}\n"
+            f"Extra samples added: {self.remainder}\n"
+            f"Gradient accumulation steps: {self.num_steps}\n"
+        )
+
+    def _set_sync_gradients(self, sync_gradients: bool):
+        self.sync_gradients = sync_gradients
+
+    def _add_dataloader(self, dataloader):
+        self.active_dataloader = dataloader
+        self.dataloader_references.append(dataloader)
+
+    def _remove_dataloader(self, dataloader):
+        if dataloader in self.dataloader_references:
+            self.dataloader_references.remove(dataloader)
+        self.active_dataloader = self.dataloader_references[-1]
+
+    @staticmethod
+    def _reset_state():
+        GradientState._shared_state.clear()

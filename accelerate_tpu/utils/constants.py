@@ -1,0 +1,45 @@
+"""Constants shared across the framework.
+
+Parity notes: the reference keeps its constant tables in utils/constants.py (sharding
+strategies at constants.py:33, deepspeed multinode launchers at constants.py:39). Here the
+tables are TPU-shaped: sharding strategies name GSPMD axis policies instead of torch-FSDP
+enum values, and the launcher table names TPU pod mechanisms instead of pdsh/mpirun.
+"""
+
+MODEL_NAME = "model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+RNG_STATE_NAME = "random_states"
+SCALER_NAME = "scaler"
+PARAMS_NAME = "params"
+
+SAFE_WEIGHTS_NAME = "model.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+WEIGHTS_NAME = "model.msgpack"
+WEIGHTS_INDEX_NAME = "model.msgpack.index.json"
+SHARDED_STATE_DIR = "sharded_state"
+
+# GSPMD sharding strategies (the FSDP/ZeRO replacement — reference constants.py:33 lists the
+# five torch-FSDP strategies; these are their mesh-axis equivalents).
+FSDP_SHARDING_STRATEGY = ["FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD", "HYBRID_SHARD_ZERO2"]
+FSDP_STATE_DICT_TYPE = ["FULL_STATE_DICT", "SHARDED_STATE_DICT"]
+FSDP_AUTO_WRAP_POLICY = ["TRANSFORMER_BASED_WRAP", "SIZE_BASED_WRAP", "NO_WRAP"]
+
+# TPU pod launch mechanisms (replaces the deepspeed pdsh/openmpi table, constants.py:39).
+TPU_POD_LAUNCHERS = ["gcloud", "ssh", "manual"]
+
+# Mesh axis names, in canonical (outer→inner, DCN→ICI) order. Data goes on ("data","fsdp"),
+# parameters shard over "fsdp" (ZeRO-3) and "model" (tensor parallel), activations'
+# sequence dim over "seq" (ring attention), experts over "expert", pipeline stages over "stage".
+MESH_AXIS_NAMES = ("data", "fsdp", "model", "seq", "expert", "stage")
+DATA_AXES = ("data", "fsdp")
+
+ELASTIC_LOG_PREFIX = "accelerate_tpu.launch"
+
+# RNG stream names checkpointed per process (reference checkpointing.py:122-151 saves
+# python/numpy/torch/cuda/xla states; JAX needs python/numpy plus the explicit jax key).
+RNG_TYPES = ["python", "numpy", "jax"]
+
+# Environment-variable protocol prefix (reference uses ACCELERATE_* — utils/launch.py:100-148).
+ENV_PREFIX = "ACCELERATE_TPU_"

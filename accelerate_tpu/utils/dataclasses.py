@@ -1,0 +1,459 @@
+"""Plugin and configuration dataclasses.
+
+This is the configuration spine of the framework — the TPU-native counterpart of the
+reference's utils/dataclasses.py. The key design change: the reference routes each
+parallelism strategy to a different backend wrapper (DDP / torch-FSDP / DeepSpeed /
+Megatron — dataclasses.py:739-1464); here EVERY strategy reduces to (a) a mesh shape
+(`ParallelismConfig`) and (b) sharding-spec derivation rules (`FullyShardedDataParallelPlugin`
+et al. in parallel/sharding.py). DeepSpeed/Megatron-shaped plugins are provided as
+compatibility shims that translate themselves into those two primitives, so users of the
+reference can bring their configs unchanged.
+
+Env-var protocol parity: plugins read `ACCELERATE_TPU_*` env vars in __post_init__,
+mirroring the reference's worker-side deserialization (dataclasses.py:659-669,739-830).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import functools
+import os
+import warnings
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Any, Callable, Iterable, Optional
+
+from .constants import FSDP_AUTO_WRAP_POLICY, FSDP_SHARDING_STRATEGY, FSDP_STATE_DICT_TYPE, MESH_AXIS_NAMES
+from .environment import parse_flag_from_env, str_to_bool
+
+
+class KwargsHandler:
+    """Base for kwargs dataclasses; `to_kwargs` diffs against defaults
+    (parity: reference dataclasses.py:39-57)."""
+
+    def to_dict(self):
+        return copy.deepcopy(self.__dict__)
+
+    def to_kwargs(self):
+        default_dict = self.__class__().to_dict()
+        this_dict = self.to_dict()
+        return {k: v for k, v in this_dict.items() if default_dict[k] != v}
+
+
+@dataclass
+class AutocastKwargs(KwargsHandler):
+    """Customize mixed-precision casting behavior (parity: reference AutocastKwargs).
+
+    On TPU this selects the compute dtype policy rather than entering a torch autocast
+    context: `enabled=False` keeps the module in its parameter dtype, `cache_enabled` is
+    accepted for API parity and ignored (XLA caches compiled executables instead).
+    """
+
+    enabled: bool = True
+    cache_enabled: Optional[bool] = None
+
+
+@dataclass
+class GradScalerKwargs(KwargsHandler):
+    """Dynamic loss-scaling knobs for fp16 (parity: reference GradScalerKwargs →
+    torch.cuda.amp.GradScaler). bf16 — the TPU default — needs no scaling; these apply
+    only when mixed_precision='fp16'."""
+
+    init_scale: float = 65536.0
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    enabled: bool = True
+
+
+@dataclass
+class InitProcessGroupKwargs(KwargsHandler):
+    """Multi-host coordination-service init knobs (parity: reference InitProcessGroupKwargs
+    → init_process_group; here they feed jax.distributed.initialize)."""
+
+    backend: Optional[str] = "xla"
+    init_method: Optional[str] = None
+    timeout: Optional[timedelta] = None
+
+    def __post_init__(self):
+        if self.timeout is None:
+            self.timeout = timedelta(seconds=1800)
+
+
+@dataclass
+class DistributedDataParallelKwargs(KwargsHandler):
+    """Accepted for API parity with the reference's DDP kwargs (dataclasses.py:83).
+
+    Under GSPMD there are no gradient buckets or process-group wrappers; the only field
+    with a TPU meaning is `gradient_as_bucket_view` (ignored) and
+    `static_graph` (ignored — jit graphs are always static). Kept so reference scripts
+    run unmodified.
+    """
+
+    dim: int = 0
+    broadcast_buffers: bool = True
+    bucket_cap_mb: int = 25
+    find_unused_parameters: bool = False
+    check_reduction: bool = False
+    gradient_as_bucket_view: bool = False
+    static_graph: bool = False
+
+
+class EnumWithContains(enum.EnumMeta):
+    def __contains__(cls, item):
+        try:
+            cls(item)
+        except ValueError:
+            return False
+        return True
+
+
+class BaseEnum(str, enum.Enum, metaclass=EnumWithContains):
+    def __str__(self):
+        return self.value
+
+    @classmethod
+    def list(cls):
+        return list(map(str, cls))
+
+
+class DistributedType(BaseEnum):
+    """Execution topology (parity: reference DistributedType, dataclasses.py).
+
+    The reference enumerates one value per comm backend (MULTI_GPU/DEEPSPEED/FSDP/XLA/...).
+    Under JAX, the compute data plane is always XLA-SPMD over a mesh, so the only real
+    distinctions are: no acceleration, single-host SPMD, and multi-host SPMD.
+    """
+
+    NO = "NO"
+    XLA_SPMD = "XLA_SPMD"
+    MULTI_HOST = "MULTI_HOST"
+
+
+class PrecisionType(BaseEnum):
+    NO = "no"
+    FP8 = "fp8"
+    FP16 = "fp16"
+    BF16 = "bf16"
+
+
+class RNGType(BaseEnum):
+    PYTHON = "python"
+    NUMPY = "numpy"
+    JAX = "jax"
+    GENERATOR = "generator"
+
+
+class CustomDtype(enum.Enum):
+    """Sub-byte / non-native dtypes for size accounting (parity: reference
+    dataclasses.py:475)."""
+
+    FP8 = "fp8"
+    INT4 = "int4"
+    INT8 = "int8"
+
+
+@dataclass
+class ParallelismConfig:
+    """Mesh shape: one axis size per parallelism kind. The single replacement for the
+    reference's per-backend degree knobs (Megatron tp/pp degrees dataclasses.py:1256-1258,
+    FSDP implicit world sharding, DeepSpeed zero stages).
+
+    Sizes of -1 mean "absorb remaining devices" (at most one axis may be -1; defaults to
+    the data axis). Axis order is DCN-outermost→ICI-innermost as laid out in
+    `constants.MESH_AXIS_NAMES`: ("data", "fsdp", "model", "seq", "expert", "stage").
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+    stage: int = 1
+
+    def __post_init__(self):
+        sizes = self.axis_sizes()
+        if sum(1 for v in sizes.values() if v == -1) > 1:
+            raise ValueError("At most one mesh axis may be -1 (auto), got " f"{sizes}")
+
+    def axis_sizes(self) -> dict:
+        return {name: getattr(self, name) for name in MESH_AXIS_NAMES}
+
+    def resolve(self, num_devices: int) -> dict:
+        """Concretize -1 axes against the device count; validates divisibility."""
+        sizes = self.axis_sizes()
+        fixed = 1
+        auto_axis = None
+        for name, v in sizes.items():
+            if v == -1:
+                auto_axis = name
+            else:
+                if v < 1:
+                    raise ValueError(f"Axis {name} must be >=1 or -1, got {v}")
+                fixed *= v
+        if auto_axis is None:
+            if fixed != num_devices:
+                raise ValueError(f"Mesh of {fixed} devices does not match {num_devices} available devices")
+            return sizes
+        if num_devices % fixed != 0:
+            raise ValueError(f"Fixed axes use {fixed} devices which does not divide {num_devices}")
+        sizes[auto_axis] = num_devices // fixed
+        return sizes
+
+    @classmethod
+    def from_env(cls) -> "ParallelismConfig":
+        kw = {}
+        for name in MESH_AXIS_NAMES:
+            env = os.environ.get(f"ACCELERATE_TPU_MESH_{name.upper()}")
+            if env is not None:
+                kw[name] = int(env)
+        return cls(**kw)
+
+
+@dataclass
+class GradientAccumulationPlugin(KwargsHandler):
+    """Gradient accumulation config (parity: reference GradientAccumulationPlugin)."""
+
+    num_steps: int = 1
+    adjust_scheduler: bool = True
+    sync_with_dataloader: bool = True
+    sync_each_batch: bool = False
+
+
+@dataclass
+class ProjectConfiguration:
+    """Checkpoint/logging directory layout (parity: reference ProjectConfiguration)."""
+
+    project_dir: Optional[str] = None
+    logging_dir: Optional[str] = None
+    automatic_checkpoint_naming: bool = False
+    total_limit: Optional[int] = None
+    iteration: int = 0
+    save_on_each_node: bool = False
+
+    def set_directories(self, project_dir=None):
+        self.project_dir = project_dir
+        if self.logging_dir is None:
+            self.logging_dir = project_dir
+
+    def __post_init__(self):
+        self.set_directories(self.project_dir)
+
+
+@dataclass
+class DataLoaderConfiguration:
+    """Dataloader behavior knobs (parity: reference DataLoaderConfiguration).
+
+    `dispatch_batches`: rank-0-reads-all + broadcast (DataLoaderDispatcher semantics,
+    reference data_loader.py:562). `split_batches`: the loader's batch size is the global
+    batch size and is sliced across processes, instead of each process loading
+    `batch_size` samples. `even_batches`: pad the final global batch so every process
+    receives the same count (required for jit-stable shapes; turning it off implies
+    dropping to per-host ragged iteration). `use_seedable_sampler`: deterministic
+    epoch-keyed shuffling.
+    """
+
+    split_batches: bool = False
+    dispatch_batches: Optional[bool] = None
+    even_batches: bool = True
+    use_seedable_sampler: bool = True
+    non_blocking: bool = True
+    prefetch_size: int = 2
+    drop_last: Optional[bool] = None
+
+
+@dataclass
+class CompilationConfig(KwargsHandler):
+    """XLA compilation options — the TPU-native replacement for TorchDynamoPlugin
+    (reference dataclasses.py:641). jit is always on; these tune it."""
+
+    donate_params: bool = True
+    remat_policy: Optional[str] = None  # None | "full" | "dots" | "dots_saveable" | "nothing_saveable"
+    scan_layers: bool = False
+    cache_dir: Optional[str] = None
+    xla_flags: Optional[str] = None
+
+    def __post_init__(self):
+        if self.cache_dir is None:
+            self.cache_dir = os.environ.get("ACCELERATE_TPU_COMPILATION_CACHE", None)
+
+
+@dataclass
+class FullyShardedDataParallelPlugin:
+    """ZeRO/FSDP as sharding-spec derivation (replaces reference dataclasses.py:1121-1203 +
+    accelerator.py:1431-1545 wrapping).
+
+    Strategies map to GSPMD policies over the "fsdp" mesh axis:
+      - FULL_SHARD (ZeRO-3): params, grads and optimizer state sharded; XLA all-gathers
+        weights per-layer during fwd/bwd and reduce-scatters grads.
+      - SHARD_GRAD_OP (ZeRO-2): params replicated, grads + optimizer state sharded
+        (weight-update sharding / ZeRO-2 equivalent).
+      - NO_SHARD: plain DP.
+      - HYBRID_SHARD: shard over "fsdp" axis, replicate over "data" axis.
+    `min_num_params`-style auto-wrap maps to a size threshold below which tensors stay
+    replicated (small layernorm/bias arrays aren't worth a collective).
+    """
+
+    sharding_strategy: str = "FULL_SHARD"
+    auto_wrap_policy: Optional[str] = None
+    min_num_params: int = 0
+    transformer_cls_names_to_wrap: Optional[list] = None
+    cpu_offload: bool = False
+    state_dict_type: str = "SHARDED_STATE_DICT"
+    activation_checkpointing: bool = False
+    sync_module_states: bool = True
+    param_dtype: Optional[str] = None
+    reduce_dtype: Optional[str] = None
+    use_orig_params: bool = True  # accepted for parity; meaningless under GSPMD
+
+    def __post_init__(self):
+        prefix = "ACCELERATE_TPU_FSDP_"
+        env = os.environ
+        if isinstance(self.sharding_strategy, int):
+            self.sharding_strategy = FSDP_SHARDING_STRATEGY[self.sharding_strategy - 1]
+        self.sharding_strategy = env.get(prefix + "SHARDING_STRATEGY", self.sharding_strategy)
+        if self.sharding_strategy not in FSDP_SHARDING_STRATEGY:
+            raise ValueError(
+                f"sharding_strategy must be one of {FSDP_SHARDING_STRATEGY}, got {self.sharding_strategy}"
+            )
+        if self.auto_wrap_policy is not None and self.auto_wrap_policy not in FSDP_AUTO_WRAP_POLICY:
+            raise ValueError(f"auto_wrap_policy must be one of {FSDP_AUTO_WRAP_POLICY}")
+        self.min_num_params = int(env.get(prefix + "MIN_NUM_PARAMS", self.min_num_params))
+        self.cpu_offload = parse_flag_from_env(prefix + "OFFLOAD_PARAMS", self.cpu_offload)
+        self.state_dict_type = env.get(prefix + "STATE_DICT_TYPE", self.state_dict_type)
+        if self.state_dict_type not in FSDP_STATE_DICT_TYPE:
+            raise ValueError(f"state_dict_type must be one of {FSDP_STATE_DICT_TYPE}")
+        self.activation_checkpointing = parse_flag_from_env(
+            prefix + "ACTIVATION_CHECKPOINTING", self.activation_checkpointing
+        )
+
+    @property
+    def shards_params(self) -> bool:
+        return self.sharding_strategy in ("FULL_SHARD", "HYBRID_SHARD")
+
+    @property
+    def shards_opt_state(self) -> bool:
+        return self.sharding_strategy != "NO_SHARD"
+
+
+@dataclass
+class DeepSpeedPlugin:
+    """Compatibility shim: a DeepSpeed-shaped config that lowers to GSPMD sharding +
+    host offload (replaces reference dataclasses.py:704-1010 + accelerator.py:1563-1785).
+
+    zero_stage 0 → NO_SHARD, 1/2 → SHARD_GRAD_OP (optimizer/gradient sharding), 3 →
+    FULL_SHARD. NVMe offload maps to the disk tier of the big-model planner; CPU offload
+    to pinned-host placement.
+    """
+
+    hf_ds_config: Any = None
+    gradient_accumulation_steps: int = 1
+    gradient_clipping: Optional[float] = None
+    zero_stage: int = 2
+    offload_optimizer_device: Optional[str] = None  # none|cpu|nvme
+    offload_param_device: Optional[str] = None
+    zero3_init_flag: bool = False
+    zero3_save_16bit_model: bool = False
+    train_micro_batch_size_per_gpu: Optional[int] = None
+
+    def __post_init__(self):
+        env = os.environ
+        self.zero_stage = int(env.get("ACCELERATE_TPU_ZERO_STAGE", self.zero_stage))
+        self.gradient_accumulation_steps = int(
+            env.get("ACCELERATE_TPU_GRADIENT_ACCUMULATION_STEPS", self.gradient_accumulation_steps)
+        )
+        if isinstance(self.hf_ds_config, dict):
+            cfg = self.hf_ds_config
+            zero = cfg.get("zero_optimization", {})
+            self.zero_stage = zero.get("stage", self.zero_stage)
+            if "offload_optimizer" in zero:
+                self.offload_optimizer_device = zero["offload_optimizer"].get("device")
+            if "offload_param" in zero:
+                self.offload_param_device = zero["offload_param"].get("device")
+            if "gradient_accumulation_steps" in cfg and cfg["gradient_accumulation_steps"] != "auto":
+                self.gradient_accumulation_steps = cfg["gradient_accumulation_steps"]
+            if "gradient_clipping" in cfg and cfg["gradient_clipping"] != "auto":
+                self.gradient_clipping = cfg["gradient_clipping"]
+
+    def to_fsdp_plugin(self) -> FullyShardedDataParallelPlugin:
+        stage_map = {0: "NO_SHARD", 1: "SHARD_GRAD_OP", 2: "SHARD_GRAD_OP", 3: "FULL_SHARD"}
+        if self.zero_stage not in stage_map:
+            raise ValueError(
+                f"zero_stage must be one of {sorted(stage_map)}, got {self.zero_stage!r} "
+                "(note: 'auto' is not resolvable without a training context; set an explicit stage)"
+            )
+        strategy = stage_map[self.zero_stage]
+        return FullyShardedDataParallelPlugin(
+            sharding_strategy=strategy,
+            cpu_offload=self.offload_param_device in ("cpu", "nvme")
+            or self.offload_optimizer_device in ("cpu", "nvme"),
+        )
+
+
+@dataclass
+class MegatronLMPlugin:
+    """Compatibility shim: Megatron-shaped degrees that lower to a ParallelismConfig
+    (replaces reference dataclasses.py:1230-1464 + utils/megatron_lm.py glue)."""
+
+    tp_degree: int = 1
+    pp_degree: int = 1
+    num_micro_batches: int = 1
+    sequence_parallelism: bool = False
+    sequence_parallel_degree: int = 1
+    expert_parallel_degree: int = 1
+    recompute_activations: bool = False
+
+    def __post_init__(self):
+        env = os.environ
+        self.tp_degree = int(env.get("ACCELERATE_TPU_MEGATRON_TP_DEGREE", self.tp_degree))
+        self.pp_degree = int(env.get("ACCELERATE_TPU_MEGATRON_PP_DEGREE", self.pp_degree))
+        if self.sequence_parallelism and self.sequence_parallel_degree == 1:
+            # Megatron SP shards over the TP group; mirror that default here.
+            self.sequence_parallel_degree = self.tp_degree
+
+    def to_parallelism_config(self) -> ParallelismConfig:
+        return ParallelismConfig(
+            data=-1,
+            model=self.tp_degree,
+            stage=self.pp_degree,
+            seq=self.sequence_parallel_degree if self.sequence_parallelism else 1,
+            expert=self.expert_parallel_degree,
+        )
+
+
+@dataclass
+class SequenceParallelPlugin:
+    """First-class sequence/context parallelism — the capability the reference lacks
+    natively (SURVEY §5: only a Megatron passthrough flag, dataclasses.py:1262-1265).
+
+    `mode="ring"`: ring attention — KV blocks rotate around the "seq" axis via ppermute
+    while queries stay resident (communication overlaps with blockwise attention compute).
+    `mode="allgather"`: all-gather KV (cheaper at short context, more HBM).
+    """
+
+    seq_degree: int = 1
+    mode: str = "ring"
+    block_size: int = 512
+
+    def __post_init__(self):
+        if self.mode not in ("ring", "allgather"):
+            raise ValueError(f"mode must be ring|allgather, got {self.mode}")
+
+
+@dataclass
+class FP8RecipeKwargs(KwargsHandler):
+    """fp8 policy (parity: reference FP8RecipeKwargs → TransformerEngine DelayedScaling).
+    On TPU this selects XLA fp8 dot dtypes (e4m3 fwd / e5m2 bwd) with delayed scaling."""
+
+    margin: int = 0
+    interval: int = 1
+    fp8_format: str = "HYBRID"  # E4M3 | HYBRID
+    amax_history_len: int = 1024
+    amax_compute_algo: str = "most_recent"
+    override_linear_precision: tuple = (False, False, False)
+
+    def __post_init__(self):
+        self.fp8_format = self.fp8_format.upper()
+        if self.fp8_format not in ("E4M3", "HYBRID"):
+            raise ValueError("fp8_format must be E4M3 or HYBRID")

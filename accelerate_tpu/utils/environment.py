@@ -1,0 +1,156 @@
+"""Environment parsing and hardware probing.
+
+Parity: reference utils/environment.py (str_to_bool :58, get_int_from_env :73,
+parse_flag_from_env :82, GPU probing :100-143, NUMA affinity :220-296). The hardware
+probes here are TPU-shaped: ICI mesh topology from the JAX device list instead of
+nvidia-smi, and host memory from /proc instead of pynvml.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+
+def str_to_bool(value: str) -> int:
+    """Convert a string (env-var) truth value to 1/0. Raises on unrecognized values."""
+    value = value.lower()
+    if value in ("y", "yes", "t", "true", "on", "1"):
+        return 1
+    if value in ("n", "no", "f", "false", "off", "0"):
+        return 0
+    raise ValueError(f"invalid truth value {value}")
+
+
+def get_int_from_env(env_keys, default):
+    """Return the first positive int found under any of `env_keys`."""
+    for e in env_keys:
+        val = int(os.environ.get(e, -1))
+        if val >= 0:
+            return val
+    return default
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    value = os.environ.get(key, str(default))
+    return bool(str_to_bool(value))
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    return os.environ.get(key, str(default))
+
+
+def get_host_memory_bytes() -> int:
+    """Total host RAM in bytes (used by the big-model device-map planner)."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+def get_available_host_memory_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return get_host_memory_bytes() // 2
+
+
+@dataclass
+class TpuTopology:
+    """ICI topology discovered from the JAX device list (replaces nvidia-smi probing,
+    reference utils/environment.py:100-143)."""
+
+    num_devices: int
+    num_hosts: int
+    local_device_count: int
+    device_kind: str
+    coords: list | None = None
+
+    @property
+    def devices_per_host(self) -> int:
+        return self.local_device_count
+
+
+def get_tpu_topology() -> TpuTopology:
+    import jax
+
+    devices = jax.devices()
+    coords = [getattr(d, "coords", None) for d in devices]
+    return TpuTopology(
+        num_devices=len(devices),
+        num_hosts=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        device_kind=devices[0].device_kind if devices else "cpu",
+        coords=coords if all(c is not None for c in coords) else None,
+    )
+
+
+# Peak dense-bf16 FLOP/s per chip, by device kind, for MFU accounting. Public numbers from
+# cloud.google.com/tpu/docs (v4: 275e12, v5e: 197e12, v5p: 459e12, v6e "Trillium": 918e12).
+DEVICE_PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v4 lite": 275e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+    "TPU7x": 2307e12,
+}
+
+
+def get_device_peak_flops(device_kind: str, dtype: str = "bf16") -> float:
+    """Best-effort peak FLOP/s for a device kind; 0.0 when unknown (MFU then unreported).
+
+    Longest name first, so "TPU v5 lite" matches its own entry rather than "TPU v5".
+    """
+    kind = device_kind.lower()
+    for k in sorted(DEVICE_PEAK_FLOPS, key=len, reverse=True):
+        if kind.startswith(k.lower()) or k.lower() in kind:
+            return DEVICE_PEAK_FLOPS[k]
+    return 0.0
+
+
+@contextmanager
+def clear_environment():
+    """Temporarily empty os.environ (parity: reference utils/other.py:211)."""
+    _old = os.environ.copy()
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(_old)
+
+
+@contextmanager
+def patch_environment(**kwargs):
+    """Temporarily set env vars (upper-cased keys); restores previous values on exit
+    (parity: reference utils/other.py:246)."""
+    existing = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        if key in os.environ:
+            existing[key] = os.environ[key]
+        os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key in kwargs:
+            key = key.upper()
+            if key in existing:
+                os.environ[key] = existing[key]
+            else:
+                os.environ.pop(key, None)

@@ -1,0 +1,120 @@
+"""Capability probes for optional dependencies.
+
+The reference's plugin system is driven by ~30 import probes (utils/imports.py:49-402);
+here the optional surface is the JAX ecosystem plus tracker/IO backends. Each probe is
+cached and never raises.
+"""
+
+import importlib.util
+import os
+from functools import lru_cache
+
+
+def _is_package_available(pkg_name: str) -> bool:
+    return importlib.util.find_spec(pkg_name) is not None
+
+
+@lru_cache
+def is_flax_available() -> bool:
+    return _is_package_available("flax")
+
+
+@lru_cache
+def is_optax_available() -> bool:
+    return _is_package_available("optax")
+
+
+@lru_cache
+def is_orbax_available() -> bool:
+    return _is_package_available("orbax")
+
+
+@lru_cache
+def is_torch_available() -> bool:
+    """Torch (CPU) is used only as an optional data-loading / checkpoint-ingest frontend."""
+    return _is_package_available("torch")
+
+
+@lru_cache
+def is_safetensors_available() -> bool:
+    return _is_package_available("safetensors")
+
+
+@lru_cache
+def is_transformers_available() -> bool:
+    return _is_package_available("transformers")
+
+
+@lru_cache
+def is_tensorboard_available() -> bool:
+    return _is_package_available("tensorboardX") or _is_package_available("tensorboard")
+
+
+@lru_cache
+def is_wandb_available() -> bool:
+    return _is_package_available("wandb")
+
+
+@lru_cache
+def is_mlflow_available() -> bool:
+    return _is_package_available("mlflow")
+
+
+@lru_cache
+def is_comet_ml_available() -> bool:
+    return _is_package_available("comet_ml")
+
+
+@lru_cache
+def is_aim_available() -> bool:
+    return _is_package_available("aim")
+
+
+@lru_cache
+def is_clearml_available() -> bool:
+    return _is_package_available("clearml")
+
+
+@lru_cache
+def is_dvclive_available() -> bool:
+    return _is_package_available("dvclive")
+
+
+@lru_cache
+def is_rich_available() -> bool:
+    return _is_package_available("rich")
+
+
+@lru_cache
+def is_tqdm_available() -> bool:
+    return _is_package_available("tqdm")
+
+
+@lru_cache
+def is_pandas_available() -> bool:
+    return _is_package_available("pandas")
+
+
+@lru_cache
+def is_datasets_available() -> bool:
+    return _is_package_available("datasets")
+
+
+@lru_cache
+def is_tpu_available() -> bool:
+    """True when the default JAX backend exposes TPU devices.
+
+    Unlike the reference's `is_torch_xla_available(check_is_tpu=True)` (utils/imports.py:153),
+    this initializes the JAX backend, so call it lazily (never at import time).
+    """
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
+
+
+def is_cpu_force_mode() -> bool:
+    """True when tests force the host-CPU multi-device platform."""
+    return os.environ.get("JAX_PLATFORMS", "") == "cpu"

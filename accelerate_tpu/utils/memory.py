@@ -1,0 +1,86 @@
+"""OOM-retry helpers (parity: reference utils/memory.py:29,87-158).
+
+On TPU the OOM signal is an XlaRuntimeError mentioning RESOURCE_EXHAUSTED (HBM OOM at
+compile or run time) rather than torch's CUDA OOM. `find_executable_batch_size` halves
+the batch size until the wrapped function stops OOMing — same decorator contract as the
+reference so training scripts port unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+
+
+def release_memory(*objects):
+    """Drop references and force a GC pass; live jax.Arrays are deleted explicitly.
+
+    Parity: reference utils/memory.py:29 (which calls torch.cuda.empty_cache)."""
+    import jax
+
+    if not isinstance(objects, list):
+        objects = list(objects)
+    for i in range(len(objects)):
+        obj = objects[i]
+        try:
+            leaves = jax.tree_util.tree_leaves(obj)
+            for leaf in leaves:
+                if isinstance(leaf, jax.Array):
+                    leaf.delete()
+        except Exception:
+            pass
+        objects[i] = None
+    gc.collect()
+    return objects
+
+
+def is_oom_exception(exception: Exception) -> bool:
+    """True when an exception is an XLA out-of-memory condition."""
+    msg = str(exception)
+    markers = [
+        "RESOURCE_EXHAUSTED",
+        "Out of memory",
+        "out of memory",
+        "Resource exhausted",
+        "Attempting to reserve",
+        "exceeds the amount of memory available",
+    ]
+    return any(m in msg for m in markers)
+
+
+def find_executable_batch_size(function=None, starting_batch_size: int = 128):
+    """Decorator: retries `function(batch_size, *args, **kwargs)` halving batch_size on
+    HBM OOM (parity: reference utils/memory.py:87-158).
+
+    Example:
+        @find_executable_batch_size(starting_batch_size=512)
+        def train(batch_size, ...): ...
+    """
+    if function is None:
+        return functools.partial(find_executable_batch_size, starting_batch_size=starting_batch_size)
+
+    batch_size = [starting_batch_size]
+
+    @functools.wraps(function)
+    def decorator(*args, **kwargs):
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < (len(args) + 1):
+            arg_str = ", ".join([f"{arg}={value}" for arg, value in zip(params[1:], args[1:])])
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument when called."
+                f"Remove this as the decorator already does so: `{function.__name__}({arg_str})`"
+            )
+        while True:
+            if batch_size[0] == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size[0], *args, **kwargs)
+            except Exception as e:
+                if is_oom_exception(e):
+                    gc.collect()
+                    batch_size[0] //= 2
+                else:
+                    raise
+
+    return decorator

@@ -1,0 +1,475 @@
+"""Pytree-recursive collectives and tensor utilities (L2).
+
+TPU-native redesign of reference utils/operations.py. Two planes:
+
+  - **Data plane** (arrays): across *hosts* via `jax.experimental.multihost_utils`
+    (which compiles to XLA collectives over ICI/DCN — the NCCL replacement,
+    reference operations.py:308-358,727-765). Inside jit, sharded global arrays make
+    most per-rank collectives unnecessary: a "gathered" metric is just the global
+    array fetched to host.
+  - **Object plane** (arbitrary picklables): pickle → uint8 arrays → XLA broadcast /
+    allgather. Notably `gather_object` works here; the reference raises
+    NotImplementedError on XLA (operations.py:462-463).
+
+Debug mode (`ACCELERATE_TPU_DEBUG_MODE=1`) wraps every collective in a cross-process
+shape/dtype verification (parity: reference `verify_operation` operations.py:361-421),
+which catches the classic mismatched-shape distributed hang before it happens.
+"""
+
+from __future__ import annotations
+
+import functools
+import pickle
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+
+class DistributedOperationException(Exception):
+    """Raised when ranks call a collective with mismatched shapes (reference
+    operations.py:30)."""
+
+
+def is_jax_array(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+def is_array_like(x) -> bool:
+    return is_jax_array(x) or isinstance(x, (np.ndarray, np.generic))
+
+
+def honor_type(obj, generator):
+    """Rebuild `obj`'s container type from `generator` (reference operations.py:73)."""
+    try:
+        if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+            return type(obj)(*list(generator))
+        return type(obj)(generator)
+    except TypeError:
+        # Some objects (e.g. flax structs) may not accept a generator; fall back to list.
+        return list(generator)
+
+
+def recursively_apply(
+    func: Callable,
+    data: Any,
+    *args,
+    test_type: Callable = is_array_like,
+    error_on_other_type: bool = False,
+    **kwargs,
+):
+    """Apply `func` to every array leaf of a nested list/tuple/namedtuple/Mapping
+    (reference operations.py:84)."""
+    if isinstance(data, (tuple, list)):
+        return honor_type(
+            data,
+            (
+                recursively_apply(
+                    func, o, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
+                )
+                for o in data
+            ),
+        )
+    elif isinstance(data, Mapping):
+        return type(data)(
+            {
+                k: recursively_apply(
+                    func, v, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
+                )
+                for k, v in data.items()
+            }
+        )
+    elif test_type(data):
+        return func(data, *args, **kwargs)
+    elif error_on_other_type:
+        raise TypeError(
+            f"Unsupported type {type(data)} passed to collective: only nested "
+            "list/tuple/dicts of arrays are supported."
+        )
+    return data
+
+
+def send_to_device(tensor, device=None, non_blocking: bool = True, skip_keys=None):
+    """Recursive host→device transfer (reference operations.py:135).
+
+    `device` may be a jax.Device, a Sharding, or None (default device). Torch tensors
+    are converted through numpy so torch dataloaders feed TPU arrays transparently.
+    """
+    import jax
+
+    if skip_keys is None:
+        skip_keys = []
+    elif isinstance(skip_keys, str):
+        skip_keys = [skip_keys]
+
+    def _to_numpy(t):
+        if hasattr(t, "detach") and hasattr(t, "numpy"):  # torch tensor
+            return t.detach().cpu().numpy()
+        return t
+
+    def _send(t):
+        t = _to_numpy(t)
+        if not is_array_like(t):
+            return t
+        return jax.device_put(t, device)
+
+    if isinstance(tensor, Mapping):
+        return type(tensor)(
+            {k: (v if k in skip_keys else send_to_device(v, device, non_blocking, skip_keys)) for k, v in tensor.items()}
+        )
+    if isinstance(tensor, (tuple, list)):
+        # Recurse through ourselves so skip_keys is honored at any Mapping depth
+        # (reference operations.py:135 recurses the same way).
+        return honor_type(tensor, (send_to_device(t, device, non_blocking, skip_keys) for t in tensor))
+
+    def _test(t):
+        return is_array_like(t) or (hasattr(t, "detach") and hasattr(t, "numpy"))
+
+    return recursively_apply(_send, tensor, test_type=_test)
+
+
+def get_data_structure(data):
+    """Shape/dtype skeleton of a pytree (reference operations.py:174)."""
+
+    def _info(t):
+        return {"shape": tuple(np.shape(t)), "dtype": str(np.asarray(t).dtype) if not is_jax_array(t) else str(t.dtype)}
+
+    return recursively_apply(_info, data)
+
+
+def find_batch_size(data) -> int | None:
+    """First dimension of the first array leaf (reference operations.py:240)."""
+    if isinstance(data, (tuple, list)):
+        for d in data:
+            result = find_batch_size(d)
+            if result is not None:
+                return result
+        return None
+    elif isinstance(data, Mapping):
+        for v in data.values():
+            result = find_batch_size(v)
+            if result is not None:
+                return result
+        return None
+    elif is_array_like(data) and np.ndim(data) > 0:
+        return np.shape(data)[0]
+    return None
+
+
+def listify(data):
+    """Arrays → nested python lists (reference operations.py:257)."""
+
+    def _listify(t):
+        return np.asarray(t).tolist()
+
+    return recursively_apply(_listify, data)
+
+
+def slice_tensors(data, tensor_slice, process_index=None, num_processes=None):
+    """Slice every array leaf (reference operations.py:272)."""
+
+    def _slice(t, s):
+        return t[s]
+
+    return recursively_apply(_slice, data, tensor_slice)
+
+
+def concatenate(data, dim: int = 0):
+    """Concatenate a list of same-structure pytrees leafwise (reference operations.py:600)."""
+    import jax.numpy as jnp
+
+    if isinstance(data[0], (tuple, list)):
+        return honor_type(data[0], (concatenate([d[i] for d in data], dim=dim) for i in range(len(data[0]))))
+    elif isinstance(data[0], Mapping):
+        return type(data[0])({k: concatenate([d[k] for d in data], dim=dim) for k in data[0].keys()})
+    elif not is_array_like(data[0]):
+        raise TypeError(f"Can only concatenate arrays but got {type(data[0])}")
+    if isinstance(data[0], np.ndarray):
+        return np.concatenate(data, axis=dim)
+    return jnp.concatenate(data, axis=dim)
+
+
+# --------------------------------------------------------------------------------------
+# Debug-mode operation verification (reference operations.py:361-421)
+# --------------------------------------------------------------------------------------
+
+
+def verify_operation(function):
+    """Cross-process shape check before a collective when debug mode is on."""
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        from ..state import PartialState
+
+        state = PartialState()
+        if not state.debug or state.num_processes == 1:
+            return function(*args, **kwargs)
+        operation = f"{function.__module__}.{function.__name__}"
+        tensor = kwargs.get("tensor", args[0] if args else None)
+        shapes = get_data_structure(tensor)
+        output = gather_object([shapes])
+        if output[0] is not None and not all(x == output[0] for x in output):
+            process_shape_str = "\n  - ".join([f"Process {i}: {s}" for i, s in enumerate(output)])
+            raise DistributedOperationException(
+                f"Cannot apply desired operation due to shape mismatches. All shapes across devices must be valid.\n\n"
+                f"Operation: `{operation}`\nInput shapes:\n  - {process_shape_str}"
+            )
+        return function(*args, **kwargs)
+
+    return wrapper
+
+
+def chained_operation(function):
+    """Re-raise collective errors with context (reference operations.py:405)."""
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        try:
+            return function(*args, **kwargs)
+        except DistributedOperationException as e:
+            operation = f"{function.__module__}.{function.__name__}"
+            raise DistributedOperationException(
+                f"Error found while calling `{operation}`. Please see the earlier error for more details."
+            ) from e
+
+    return wrapper
+
+
+# --------------------------------------------------------------------------------------
+# Data-plane collectives
+# --------------------------------------------------------------------------------------
+
+
+def _num_processes() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def _fetch_global(t):
+    """Materialize a (possibly sharded) jax.Array on host as numpy.
+
+    For fully-addressable arrays this is a device_get; for multi-host global arrays the
+    non-addressable shards are fetched via an allgather.
+    """
+    import jax
+
+    if is_jax_array(t):
+        if t.is_fully_addressable:
+            return np.asarray(jax.device_get(t))
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(t, tiled=True))
+    return np.asarray(t)
+
+
+@verify_operation
+def gather(tensor):
+    """All-gather along dim 0 across processes (reference operations.py:425).
+
+    Host-local arrays: every process contributes its array; all receive the dim-0
+    concatenation (reference `_tpu_gather`/`_gpu_gather` semantics). Global sharded
+    arrays: returns the full global value (the SPMD equivalent — the array already *is*
+    the gathered batch).
+    """
+
+    def _gather_one(t):
+        if is_jax_array(t) and not t.is_fully_addressable:
+            return _fetch_global(t)
+        if _num_processes() == 1:
+            return _fetch_global(t)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(np.asarray(t), tiled=True))
+
+    return recursively_apply(_gather_one, tensor, error_on_other_type=True)
+
+
+@chained_operation
+def gather_object(object: Any):
+    """Gather arbitrary picklables from all processes into a list (reference
+    operations.py:451 — which is NotImplemented on XLA; supported here via the
+    byte-array object plane)."""
+    if _num_processes() == 1:
+        return list(object) if isinstance(object, list) else [object]
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(object), dtype=np.uint8)
+    sizes = multihost_utils.process_allgather(np.array([payload.size], dtype=np.int64))
+    sizes = np.asarray(sizes).reshape(-1)
+    max_size = int(sizes.max())
+    padded = np.zeros((max_size,), dtype=np.uint8)
+    padded[: payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    out = []
+    for i, size in enumerate(sizes):
+        obj = pickle.loads(gathered[i, :size].tobytes())
+        if isinstance(obj, list):
+            out.extend(obj)
+        else:
+            out.append(obj)
+    return out
+
+
+@verify_operation
+def broadcast(tensor, from_process: int = 0):
+    """Broadcast array pytree from one process (reference operations.py:545).
+
+    XLA's broadcast_one_to_all always sources process 0; for other sources we route
+    through the object plane."""
+
+    def _broadcast_one(t):
+        t = np.asarray(_fetch_global(t))
+        if _num_processes() == 1:
+            return t
+        from jax.experimental import multihost_utils
+
+        if from_process == 0:
+            return np.asarray(multihost_utils.broadcast_one_to_all(t))
+        # Rare path: non-zero source. Object-plane relay via process 0.
+        gathered = gather_object([t])
+        return np.asarray(gathered[from_process])
+
+    return recursively_apply(_broadcast_one, tensor, error_on_other_type=True)
+
+
+@chained_operation
+def broadcast_object_list(object_list: list, from_process: int = 0):
+    """Broadcast a list of picklables from `from_process` (reference operations.py:566)."""
+    if _num_processes() == 1:
+        return object_list
+    from jax.experimental import multihost_utils
+
+    import jax
+
+    if from_process != 0:
+        # gather_object extends lists, so wrap each process's list once more: the result
+        # is one sublist per process, indexed directly by rank.
+        gathered = gather_object([[list(object_list)]])
+        src = gathered[from_process]
+        for i in range(len(object_list)):
+            object_list[i] = src[i]
+        return object_list
+
+    payload = np.frombuffer(pickle.dumps(list(object_list)), dtype=np.uint8)
+    size = multihost_utils.broadcast_one_to_all(np.array([payload.size], dtype=np.int64))
+    buf = np.zeros((int(size[0]),), dtype=np.uint8)
+    if jax.process_index() == from_process:
+        buf[:] = payload
+    buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    result = pickle.loads(buf.tobytes())
+    for i in range(len(object_list)):
+        object_list[i] = result[i]
+    return object_list
+
+
+@verify_operation
+def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
+    """Cross-process reduce (reference operations.py:727-765 with its XLA `scale` arg)."""
+
+    def _reduce_one(t):
+        # A non-addressable global array is already a single cross-host value; summing
+        # per-host copies would over-count by num_processes (gather() has the same branch).
+        if is_jax_array(t) and not t.is_fully_addressable:
+            return _fetch_global(t) * scale
+        arr = _fetch_global(t)
+        if _num_processes() > 1:
+            from jax.experimental import multihost_utils
+
+            stacked = np.asarray(multihost_utils.process_allgather(np.asarray(arr)))
+            arr = stacked.sum(axis=0)
+            if reduction == "mean":
+                arr = arr / _num_processes()
+        arr = arr * scale
+        return arr
+
+    return recursively_apply(_reduce_one, tensor, error_on_other_type=True)
+
+
+@verify_operation
+def pad_across_processes(tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+    """Pad each process's array to the max size along `dim` (reference operations.py:634)."""
+
+    def _pad_one(t):
+        arr = np.asarray(_fetch_global(t))
+        if arr.ndim == 0 or dim >= arr.ndim:
+            return arr
+        size = np.array(arr.shape, dtype=np.int64)
+        if _num_processes() == 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        sizes = np.asarray(multihost_utils.process_allgather(size))
+        max_size = int(sizes[:, dim].max())
+        if max_size == arr.shape[dim]:
+            return arr
+        old_size = arr.shape
+        new_size = list(old_size)
+        new_size[dim] = max_size
+        new_tensor = np.full(new_size, pad_index, dtype=arr.dtype)
+        if pad_first:
+            indices = tuple(
+                slice(max_size - old_size[dim], max_size) if i == dim else slice(None) for i in range(arr.ndim)
+            )
+        else:
+            indices = tuple(slice(0, old_size[dim]) if i == dim else slice(None) for i in range(arr.ndim))
+        new_tensor[indices] = arr
+        return new_tensor
+
+    return recursively_apply(_pad_one, tensor, error_on_other_type=True)
+
+
+def pad_input_tensors(tensor, batch_size: int, num_processes: int, dim: int = 0):
+    """Pad dim 0 so it divides num_processes (reference operations.py:686, used by the
+    batch dispatcher and pipeline inference)."""
+
+    def _pad_one(t):
+        arr = np.asarray(t)
+        remainder = arr.shape[dim] % num_processes
+        if remainder == 0:
+            return arr
+        pad_count = num_processes - remainder
+        pad_block = np.repeat(np.take(arr, [-1], axis=dim), pad_count, axis=dim)
+        return np.concatenate([arr, pad_block], axis=dim)
+
+    return recursively_apply(_pad_one, tensor, error_on_other_type=True)
+
+
+# --------------------------------------------------------------------------------------
+# fp32 output conversion (reference operations.py:768-827)
+# --------------------------------------------------------------------------------------
+
+
+def convert_to_fp32(tensor):
+    """Upcast float16/bfloat16 leaves to float32 (reference operations.py:768)."""
+    import jax.numpy as jnp
+
+    def _convert(t):
+        return t.astype(jnp.float32) if is_jax_array(t) else np.asarray(t, dtype=np.float32)
+
+    def _is_half(t):
+        dt = t.dtype if hasattr(t, "dtype") else np.asarray(t).dtype
+        return str(dt) in ("float16", "bfloat16")
+
+    return recursively_apply(_convert, tensor, test_type=lambda t: is_array_like(t) and _is_half(t))
+
+
+class ConvertOutputsToFp32:
+    """Picklable forward-output fp32 converter (reference operations.py:802)."""
+
+    def __init__(self, model_forward):
+        self.model_forward = model_forward
+        functools.update_wrapper(self, model_forward)
+
+    def __call__(self, *args, **kwargs):
+        return convert_to_fp32(self.model_forward(*args, **kwargs))
+
+    def __getstate__(self):
+        raise pickle.PicklingError(
+            "Cannot pickle a prepared model with automatic mixed precision; unwrap it first with "
+            "`extract_model_from_parallel`."
+        )
+
+
+convert_outputs_to_fp32 = ConvertOutputsToFp32

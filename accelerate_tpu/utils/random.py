@@ -1,0 +1,89 @@
+"""Seeding and cross-process RNG synchronization.
+
+Parity: reference utils/random.py (set_seed :31, synchronize_rng_states :64-124). The JAX
+twist: device-side randomness is explicit (threaded PRNG keys), so "synchronizing RNG"
+means synchronizing the *host-side* generators that drive data order (python/numpy and
+the sampler generator). Device keys are made identical across processes by construction —
+every process folds the same seed — so no broadcast is needed for them.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Optional
+
+import numpy as np
+
+from .dataclasses import RNGType
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False) -> int:
+    """Seed python, numpy, and return a JAX PRNG seed.
+
+    Args:
+        seed: base seed.
+        device_specific: fold in the process index so each host draws different data
+            noise (parity: reference utils/random.py:45-47).
+        deterministic: accepted for parity; XLA is deterministic by default on TPU.
+
+    Returns the (possibly process-adjusted) seed, to be used for `jax.random.key`.
+    """
+    if device_specific:
+        import jax
+
+        seed += jax.process_index()
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    return seed
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None):
+    """Broadcast rank-0's host RNG state to all processes (parity: reference
+    utils/random.py:64-111).
+
+    Host-side generators (python/numpy/sampler) must agree across processes so that every
+    host shards the same global shuffle. States are serialized and broadcast through the
+    object plane (multihost pickle broadcast); on a single host this is a no-op.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    from .operations import broadcast_object_list
+
+    if rng_type == RNGType.PYTHON:
+        state = [random.getstate()]
+        state = broadcast_object_list(state, from_process=0)
+        random.setstate(state[0])
+    elif rng_type == RNGType.NUMPY:
+        state = [np.random.get_state()]
+        state = broadcast_object_list(state, from_process=0)
+        np.random.set_state(state[0])
+    elif rng_type == RNGType.GENERATOR and generator is not None:
+        state = [generator.get_state()]
+        state = broadcast_object_list(state, from_process=0)
+        generator.set_state(state[0])
+    elif rng_type == RNGType.JAX:
+        # JAX keys are value-identical across processes by construction; nothing to sync.
+        return
+
+
+def synchronize_rng_states(rng_types: Iterable[str], generator=None):
+    for rng_type in rng_types:
+        synchronize_rng_state(RNGType(rng_type), generator=generator)
+
+
+class NumpyRNGState:
+    """Checkpointable snapshot of host RNG streams (python+numpy), used by
+    checkpointing.save_accelerator_state (parity: reference checkpointing.py:122-151)."""
+
+    @staticmethod
+    def capture() -> dict:
+        return {"python": random.getstate(), "numpy": np.random.get_state()}
+
+    @staticmethod
+    def restore(state: dict):
+        if "python" in state:
+            random.setstate(state["python"])
+        if "numpy" in state:
+            np.random.set_state(state["numpy"])

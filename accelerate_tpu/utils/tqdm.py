@@ -1,0 +1,17 @@
+"""Main-process-only tqdm wrapper (parity: reference utils/tqdm.py:26)."""
+
+from .imports import is_tqdm_available
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """A tqdm that renders only on the main process by default."""
+    if not is_tqdm_available():
+        raise ImportError("tqdm is required for `accelerate_tpu.utils.tqdm`")
+    import tqdm as _tqdm
+
+    from ..state import PartialState
+
+    disable = kwargs.pop("disable", False)
+    if main_process_only and not disable:
+        disable = PartialState().local_process_index != 0
+    return _tqdm.tqdm(*args, disable=disable, **kwargs)

@@ -1,0 +1,31 @@
+"""Version comparison helpers (parity: reference utils/versions.py:26,46)."""
+
+import importlib.metadata
+import operator
+
+from packaging.version import Version, parse
+
+STR_OPERATION_TO_FUNC = {
+    ">": operator.gt,
+    ">=": operator.ge,
+    "==": operator.eq,
+    "!=": operator.ne,
+    "<=": operator.le,
+    "<": operator.lt,
+}
+
+
+def compare_versions(library_or_version, operation: str, requirement_version: str) -> bool:
+    """Compare a library version (by name or `Version`) against `requirement_version`."""
+    if operation not in STR_OPERATION_TO_FUNC:
+        raise ValueError(f"`operation` must be one of {list(STR_OPERATION_TO_FUNC)}, got {operation}")
+    if isinstance(library_or_version, str):
+        library_or_version = parse(importlib.metadata.version(library_or_version))
+    return STR_OPERATION_TO_FUNC[operation](library_or_version, parse(requirement_version))
+
+
+def is_jax_version(operation: str, version: str) -> bool:
+    """Compare the installed jax version against `version`."""
+    import jax
+
+    return compare_versions(parse(jax.__version__), operation, version)

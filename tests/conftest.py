@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device host-CPU platform (the debug_launcher equivalent —
+SURVEY §4 implication (b)) and reset the Borg singletons around every test (parity:
+reference test_utils/testing.py:427-438 AccelerateTestCase)."""
+
+import os
+
+# Must run before jax initializes its backends.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("ACCELERATE_TPU_TESTING", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_singletons():
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()

@@ -1,0 +1,120 @@
+"""Tests for L2 collectives (parity: reference test_utils/scripts/test_ops.py +
+tests/test_utils.py operations coverage). Single-host: collectives degenerate to
+identities with correct structure handling; sharded-global-array paths exercise the
+SPMD semantics on the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.state import AcceleratorState
+from accelerate_tpu.utils import operations as ops
+
+
+def test_recursively_apply_structure():
+    data = {"a": np.ones(2), "b": [np.zeros(3), (np.ones(1),)], "c": "keep"}
+    out = ops.recursively_apply(lambda t: t + 1, data)
+    assert out["c"] == "keep"
+    np.testing.assert_array_equal(out["a"], np.full(2, 2.0))
+    np.testing.assert_array_equal(out["b"][1][0], np.full(1, 2.0))
+    assert isinstance(out["b"][1], tuple)
+
+
+def test_honor_type_namedtuple():
+    from collections import namedtuple
+
+    Point = namedtuple("Point", ["x", "y"])
+    p = Point(np.ones(2), np.zeros(2))
+    out = ops.recursively_apply(lambda t: t * 2, p)
+    assert isinstance(out, Point)
+    np.testing.assert_array_equal(out.x, np.full(2, 2.0))
+
+
+def test_send_to_device():
+    batch = {"x": np.ones((2, 2)), "y": [np.zeros(3)]}
+    out = ops.send_to_device(batch)
+    assert isinstance(out["x"], jax.Array)
+    assert isinstance(out["y"][0], jax.Array)
+
+
+def test_send_to_device_skip_keys():
+    batch = {"x": np.ones((2, 2)), "meta": np.zeros(1)}
+    out = ops.send_to_device(batch, skip_keys=["meta"])
+    assert isinstance(out["x"], jax.Array)
+    assert isinstance(out["meta"], np.ndarray)
+
+
+def test_gather_single_process():
+    out = ops.gather({"t": np.arange(4)})
+    np.testing.assert_array_equal(out["t"], np.arange(4))
+
+
+def test_gather_global_sharded_array():
+    state = AcceleratorState()
+    mesh = state.mesh
+    x = jnp.arange(16.0).reshape(8, 2)
+    x = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+    out = ops.gather(x)
+    np.testing.assert_array_equal(np.asarray(out), np.arange(16.0).reshape(8, 2))
+
+
+def test_gather_object_single():
+    assert ops.gather_object(["a"]) == ["a"]
+
+
+def test_broadcast_object_list_single():
+    objs = [1, "two", {"three": 3}]
+    out = ops.broadcast_object_list(objs)
+    assert out == [1, "two", {"three": 3}]
+
+
+def test_reduce_mean_sum():
+    x = np.full((2, 2), 4.0)
+    np.testing.assert_array_equal(ops.reduce(x, "sum"), x)
+    np.testing.assert_array_equal(ops.reduce(x, "mean"), x)
+    np.testing.assert_array_equal(ops.reduce(x, "sum", scale=0.5), x / 2)
+
+
+def test_pad_across_processes_noop_single():
+    x = np.ones((3, 2))
+    out = ops.pad_across_processes(x, dim=0)
+    np.testing.assert_array_equal(out, x)
+
+
+def test_pad_input_tensors():
+    batch = {"x": np.arange(10).reshape(5, 2)}
+    out = ops.pad_input_tensors(batch, batch_size=5, num_processes=4)
+    assert out["x"].shape == (8, 2)
+    np.testing.assert_array_equal(out["x"][5], out["x"][4])
+
+
+def test_find_batch_size():
+    assert ops.find_batch_size({"a": [np.ones((7, 2))]}) == 7
+    assert ops.find_batch_size([]) is None
+
+
+def test_concatenate():
+    parts = [{"x": np.ones((2, 3))}, {"x": np.zeros((3, 3))}]
+    out = ops.concatenate(parts)
+    assert out["x"].shape == (5, 3)
+
+
+def test_convert_to_fp32():
+    data = {"h": jnp.ones(2, dtype=jnp.bfloat16), "f": jnp.ones(2, dtype=jnp.float32), "s": "str"}
+    out = ops.convert_to_fp32(data)
+    assert out["h"].dtype == jnp.float32
+    assert out["f"].dtype == jnp.float32
+    assert out["s"] == "str"
+
+
+def test_listify():
+    assert ops.listify({"a": np.arange(3)}) == {"a": [0, 1, 2]}
+
+
+def test_get_data_structure():
+    s = ops.get_data_structure({"a": np.ones((2, 3), dtype=np.float32)})
+    assert s["a"]["shape"] == (2, 3)
+    assert "float32" in s["a"]["dtype"]

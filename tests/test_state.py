@@ -1,0 +1,111 @@
+"""Tests for the L1 state core (parity: reference tests/test_state_checkpointing.py +
+singleton behavior assertions scattered through tests/test_accelerator.py)."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils import DistributedType, GradientAccumulationPlugin, ParallelismConfig
+
+
+def test_partial_state_topology():
+    state = PartialState()
+    assert state.num_processes == 1
+    assert state.process_index == 0
+    assert state.is_main_process
+    assert state.is_local_main_process
+    assert state.num_devices == 8
+    assert state.local_device_count == 8
+    assert state.distributed_type == DistributedType.XLA_SPMD
+
+
+def test_partial_state_is_borg():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+
+
+def test_wait_for_everyone_no_hang():
+    PartialState().wait_for_everyone()
+
+
+def test_split_between_processes_single():
+    state = PartialState()
+    with state.split_between_processes([1, 2, 3]) as inputs:
+        assert inputs == [1, 2, 3]
+
+
+def test_on_main_process_decorator():
+    state = PartialState()
+    calls = []
+
+    @state.on_main_process
+    def fn():
+        calls.append(1)
+
+    fn()
+    assert calls == [1]
+
+
+def test_accelerator_state_mixed_precision():
+    state = AcceleratorState(mixed_precision="bf16")
+    assert state.mixed_precision == "bf16"
+    import jax.numpy as jnp
+
+    assert state.compute_dtype == jnp.bfloat16
+    # Re-init with a conflicting value raises
+    with pytest.raises(ValueError):
+        AcceleratorState(mixed_precision="fp16")
+
+
+def test_accelerator_state_mesh_default():
+    state = AcceleratorState()
+    mesh = state.mesh
+    assert mesh.shape["data"] == 8
+    assert mesh.shape["fsdp"] == 1
+    assert mesh.size == 8
+
+
+def test_accelerator_state_mesh_custom():
+    state = AcceleratorState(parallelism_config=ParallelismConfig(data=2, fsdp=2, model=2))
+    mesh = state.mesh
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["model"] == 2
+
+
+def test_parallelism_config_resolve():
+    cfg = ParallelismConfig(data=-1, model=2)
+    sizes = cfg.resolve(8)
+    assert sizes["data"] == 4 and sizes["model"] == 2
+    with pytest.raises(ValueError):
+        ParallelismConfig(data=3, model=2).resolve(8)
+    with pytest.raises(ValueError):
+        ParallelismConfig(data=-1, model=-1)
+
+
+def test_gradient_state_contract():
+    gs = GradientState(GradientAccumulationPlugin(num_steps=4))
+    assert gs.num_steps == 4
+    assert gs.sync_gradients is True
+    assert gs.end_of_dataloader is False
+    assert gs.remainder == -1
+
+    class FakeDL:
+        end_of_dataloader = True
+        remainder = 3
+
+    dl = FakeDL()
+    gs._add_dataloader(dl)
+    assert gs.in_dataloader
+    assert gs.end_of_dataloader is True
+    assert gs.remainder == 3
+    gs._remove_dataloader(dl)
+    assert not gs.in_dataloader
+
+
+def test_state_reset():
+    PartialState()
+    assert PartialState().initialized
+    PartialState._reset_state()
+    assert PartialState._shared_state == {}
