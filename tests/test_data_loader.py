@@ -1,0 +1,286 @@
+"""Exhaustive shard-math tests for the data pipeline (parity: reference
+tests/test_data_loader.py, which enumerates expected index lists for every
+split/even/drop combination — same strategy here, fresh expectations derived from this
+framework's documented contracts)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from accelerate_tpu.data_loader import (
+    BatchSampler,
+    BatchSamplerShard,
+    DataLoaderDispatcher,
+    DataLoaderShard,
+    IterableDatasetShard,
+    SeedableRandomSampler,
+    SimpleDataLoader,
+    SkipBatchSampler,
+    prepare_data_loader,
+    skip_first_batches,
+)
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+
+def make_batches(n, batch_size, drop_last=False):
+    return BatchSampler(range(n), batch_size, drop_last=drop_last)
+
+
+def shards(n, batch_size, num_processes, **kwargs):
+    sampler = make_batches(n, batch_size, drop_last=kwargs.pop("drop_last", False))
+    return [
+        list(BatchSamplerShard(sampler, num_processes=num_processes, process_index=i, **kwargs))
+        for i in range(num_processes)
+    ]
+
+
+class TestBatchSamplerShardNoSplit:
+    def test_exact_division(self):
+        # 24 samples, batch 4, 2 procs: 6 batches, strided assignment
+        result = shards(24, 4, 2)
+        assert result[0] == [[0, 1, 2, 3], [8, 9, 10, 11], [16, 17, 18, 19]]
+        assert result[1] == [[4, 5, 6, 7], [12, 13, 14, 15], [20, 21, 22, 23]]
+
+    def test_even_batches_pads_short_final_batch(self):
+        # 21 samples, batch 4, 2 procs: batches [..],[..],[..],[..],[..],[20] (short)
+        result = shards(21, 4, 2)
+        # All batches must be size 4 and both procs have equal counts
+        assert all(len(b) == 4 for proc in result for b in proc)
+        assert len(result[0]) == len(result[1]) == 3
+        # Padding cycles from the epoch start
+        assert result[1][-1][0] == 20
+
+    def test_even_batches_pads_missing_process_batch(self):
+        # 20 samples, batch 4, 3 procs: 5 batches -> group of 2 left; proc 2 padded
+        result = shards(20, 4, 3)
+        assert len(result[0]) == len(result[1]) == len(result[2]) == 2
+        assert all(len(b) == 4 for proc in result for b in proc)
+        # proc2's final batch is fabricated from epoch-start samples
+        assert result[2][1] == [0, 1, 2, 3]
+
+    def test_uneven_batches(self):
+        result = shards(20, 4, 3, even_batches=False)
+        # 5 batches: proc0 gets 2, proc1 gets 2, proc2 gets 1
+        assert [len(r) for r in result] == [2, 2, 1]
+        flat = [i for proc in result for batch in proc for i in batch]
+        assert sorted(flat) == list(range(20))
+
+    def test_drop_last(self):
+        # 21 samples, batch 4, 2 procs, drop_last: short batch dropped -> 5 full batches
+        # -> incomplete final group dropped -> 2 steps each
+        result = shards(21, 4, 2, drop_last=True)
+        assert [len(r) for r in result] == [2, 2]
+        assert result[0] == [[0, 1, 2, 3], [8, 9, 10, 11]]
+
+    def test_coverage_union(self):
+        # Every real sample appears somewhere
+        for n in (17, 24, 31):
+            for p in (2, 3, 4):
+                result = shards(n, 4, p)
+                flat = {i for proc in result for batch in proc for i in batch}
+                assert flat == set(range(n)), (n, p)
+
+    def test_len_matches_iteration(self):
+        sampler = make_batches(21, 4)
+        for p in (1, 2, 3):
+            for i in range(p):
+                s = BatchSamplerShard(sampler, num_processes=p, process_index=i)
+                assert len(list(s)) == len(s), (p, i)
+
+
+class TestBatchSamplerShardSplit:
+    def test_exact(self):
+        # global batch 8 split over 2 procs -> each proc gets 4 of every batch
+        result = shards(16, 8, 2, split_batches=True)
+        assert result[0] == [[0, 1, 2, 3], [8, 9, 10, 11]]
+        assert result[1] == [[4, 5, 6, 7], [12, 13, 14, 15]]
+
+    def test_short_final_padded(self):
+        result = shards(18, 8, 2, split_batches=True)
+        assert all(len(b) == 4 for proc in result for b in proc)
+        assert len(result[0]) == 3
+        # final global batch [16,17] padded with epoch-start samples
+        assert result[0][2] == [16, 17, 0, 1]
+        assert result[1][2] == [2, 3, 4, 5]
+
+    def test_batch_size_not_divisible_raises(self):
+        sampler = make_batches(16, 6)
+        with pytest.raises(ValueError):
+            BatchSamplerShard(sampler, num_processes=4, process_index=0, split_batches=True)
+
+
+class TestIterableDatasetShard:
+    def test_even(self):
+        shard0 = list(IterableDatasetShard(range(16), batch_size=2, num_processes=2, process_index=0))
+        shard1 = list(IterableDatasetShard(range(16), batch_size=2, num_processes=2, process_index=1))
+        assert shard0 == [0, 1, 4, 5, 8, 9, 12, 13]
+        assert shard1 == [2, 3, 6, 7, 10, 11, 14, 15]
+
+    def test_tail_padded(self):
+        shard0 = list(IterableDatasetShard(range(5), batch_size=2, num_processes=2, process_index=0))
+        shard1 = list(IterableDatasetShard(range(5), batch_size=2, num_processes=2, process_index=1))
+        assert len(shard0) == len(shard1) == 4
+        union = set(shard0) | set(shard1)
+        assert set(range(5)) <= union
+
+    def test_split_batches_mode(self):
+        # batch_size is global (4); each proc gets 2 per batch
+        shard0 = list(IterableDatasetShard(range(8), batch_size=4, num_processes=2, process_index=0, split_batches=True))
+        assert shard0 == [0, 1, 4, 5]
+
+    def test_drop_last(self):
+        shard0 = list(IterableDatasetShard(range(5), batch_size=2, num_processes=2, process_index=0, drop_last=True))
+        assert shard0 == [0, 1]
+
+
+class TestSeedableSampler:
+    def test_deterministic_and_epoch_varying(self):
+        s1 = SeedableRandomSampler(num_samples=10, seed=42)
+        s2 = SeedableRandomSampler(num_samples=10, seed=42)
+        e0a, e0b = list(s1), list(s2)
+        assert e0a == e0b
+        assert list(s1) == e0a  # standalone: same order until set_epoch
+        s1.set_epoch(1)
+        e1 = list(s1)
+        assert e1 != e0a
+        assert sorted(e1) == list(range(10))
+
+    def test_state_roundtrip(self):
+        s = SeedableRandomSampler(num_samples=10, seed=1, epoch=3)
+        state = s.state_dict()
+        s2 = SeedableRandomSampler(num_samples=10, seed=0)
+        s2.load_state_dict(state)
+        assert list(s2) == list(SeedableRandomSampler(num_samples=10, seed=1, epoch=3))
+
+
+def _toy_dataset(n=24, dim=3):
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(n, dim)).astype(np.float32)
+    ys = (2 * xs.sum(-1) + 3).astype(np.float32)
+    return [{"x": xs[i], "y": ys[i]} for i in range(n)]
+
+
+class TestDataLoaderShard:
+    def test_yields_global_arrays_with_sharding(self):
+        AcceleratorState()
+        data = _toy_dataset(24)
+        loader = SimpleDataLoader(data, BatchSampler(range(24), 8))
+        dl = prepare_data_loader(loader)
+        batches = list(dl)
+        assert len(batches) == 3
+        assert isinstance(batches[0]["x"], jax.Array)
+        assert batches[0]["x"].shape == (8, 3)
+        # sharded over the 8 data-axis devices
+        assert len(batches[0]["x"].sharding.device_set) == 8
+
+    def test_end_of_dataloader_and_remainder(self):
+        AcceleratorState()
+        data = _toy_dataset(20)
+        loader = SimpleDataLoader(data, BatchSampler(range(20), 8))
+        dl = prepare_data_loader(loader)
+        gs = GradientState()
+        ends = []
+        for batch in dl:
+            ends.append(gs.end_of_dataloader)
+        assert ends == [False, False, True]
+        # After iteration finishes the dataloader deregisters
+        assert not gs.in_dataloader
+
+    def test_remainder_value(self):
+        AcceleratorState()
+        data = _toy_dataset(20)
+        loader = SimpleDataLoader(data, BatchSampler(range(20), 8))
+        dl = prepare_data_loader(loader)
+        gs = GradientState()
+        for batch in dl:
+            pass
+        assert dl.remainder == 20 % 8
+
+    def test_device_placement_off(self):
+        data = _toy_dataset(8)
+        loader = SimpleDataLoader(data, BatchSampler(range(8), 4))
+        dl = prepare_data_loader(loader, put_on_device=False)
+        b = next(iter(dl))
+        assert isinstance(b["x"], np.ndarray)
+
+    def test_skip_first_batches(self):
+        AcceleratorState()
+        data = _toy_dataset(24)
+        loader = SimpleDataLoader(data, BatchSampler(range(24), 8))
+        dl = prepare_data_loader(loader)
+        all_batches = [np.asarray(b["x"]) for b in dl]
+        skipped = skip_first_batches(dl, 2)
+        rest = [np.asarray(b["x"]) for b in skipped]
+        assert len(rest) == 1
+        np.testing.assert_array_equal(rest[0], all_batches[2])
+
+    def test_set_epoch_reshuffles(self):
+        data = _toy_dataset(16)
+        sampler = SeedableRandomSampler(num_samples=16, seed=7)
+        loader = SimpleDataLoader(data, BatchSampler(sampler, 8))
+        dl = prepare_data_loader(loader, put_on_device=False)
+        first = [np.asarray(b["x"]) for b in dl]
+        second = [np.asarray(b["x"]) for b in dl]
+        assert not all(np.array_equal(a, b) for a, b in zip(first, second))
+
+
+class TestTorchLoaderIntegration:
+    def test_torch_loader_prepared(self):
+        torch = pytest.importorskip("torch")
+        from torch.utils.data import DataLoader, TensorDataset
+
+        AcceleratorState()
+        xs = torch.arange(48, dtype=torch.float32).reshape(24, 2)
+        ys = torch.arange(24, dtype=torch.float32)
+        dl = DataLoader(TensorDataset(xs, ys), batch_size=8, shuffle=False)
+        prepared = prepare_data_loader(dl)
+        batches = list(prepared)
+        assert len(batches) == 3
+        x0, y0 = batches[0]
+        assert isinstance(x0, jax.Array) and x0.shape == (8, 2)
+        np.testing.assert_array_equal(np.asarray(y0), np.arange(8.0))
+
+    def test_torch_loader_seedable_shuffle_deterministic(self):
+        torch = pytest.importorskip("torch")
+        from torch.utils.data import DataLoader, TensorDataset
+
+        AcceleratorState()
+        xs = torch.arange(16, dtype=torch.float32).reshape(16, 1)
+        ds = TensorDataset(xs)
+        dl1 = prepare_data_loader(DataLoader(ds, batch_size=4, shuffle=True), data_seed=11)
+        dl2 = prepare_data_loader(DataLoader(ds, batch_size=4, shuffle=True), data_seed=11)
+        b1 = [np.asarray(b[0]) for b in dl1]
+        b2 = [np.asarray(b[0]) for b in dl2]
+        for a, b in zip(b1, b2):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestDispatcher:
+    def test_single_process_dispatch_matches_shard(self):
+        AcceleratorState()
+        data = _toy_dataset(16)
+        loader = SimpleDataLoader(data, BatchSampler(range(16), 8))
+        dl = prepare_data_loader(loader, dispatch_batches=True)
+        assert isinstance(dl, DataLoaderDispatcher)
+        batches = list(dl)
+        assert len(batches) == 2
+        assert isinstance(batches[0]["x"], jax.Array)
+        assert batches[0]["x"].shape == (8, 3)
+
+    def test_dispatch_end_of_dataloader(self):
+        AcceleratorState()
+        data = _toy_dataset(16)
+        loader = SimpleDataLoader(data, BatchSampler(range(16), 8))
+        dl = prepare_data_loader(loader, dispatch_batches=True)
+        gs = GradientState()
+        ends = [gs.end_of_dataloader for _ in dl]
+        assert ends == [False, True]
+
+
+class TestSkipBatchSampler:
+    def test_skip(self):
+        sampler = make_batches(24, 4)
+        skipper = SkipBatchSampler(sampler, 2)
+        assert list(skipper) == [[8, 9, 10, 11], [12, 13, 14, 15], [16, 17, 18, 19], [20, 21, 22, 23]]
+        assert len(skipper) == 4
