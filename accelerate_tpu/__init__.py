@@ -1,7 +1,13 @@
 __version__ = "0.1.0"
 
+from .accelerator import Accelerator
 from .state import AcceleratorState, GradientState, PartialState
 from .logging import get_logger
+from .modeling import Model, PreparedModel
+from .optimizer import AcceleratedOptimizer, GradScaler
+from .scheduler import AcceleratedScheduler
+from .data_loader import SimpleDataLoader, prepare_data_loader, skip_first_batches
+from .tracking import GeneralTracker
 from .utils import (
     DataLoaderConfiguration,
     DeepSpeedPlugin,

@@ -543,7 +543,7 @@ class DataLoaderShard(DataLoaderStateMixin):
             self.dataset.set_epoch(epoch)
 
     def __len__(self):
-        return len(self.base_loader) - self.skip_batches
+        return max(0, len(self.base_loader) - self.skip_batches)
 
     def _process_batch(self, batch):
         batch = _to_numpy_batch(batch)
@@ -681,8 +681,8 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
     def __len__(self):
         whole_length = len(self.base_loader)
         if self.split_batches or self.state.num_processes == 1:
-            return whole_length - self.skip_batches
-        return math.ceil(whole_length / self.state.num_processes) - self.skip_batches
+            return max(0, whole_length - self.skip_batches)
+        return max(0, math.ceil(whole_length / self.state.num_processes) - self.skip_batches)
 
     def _read_global_batch(self, iterator):
         """Read one *global* batch from the base loader: with `split_batches` the loader
@@ -741,11 +741,18 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         return True, batch
 
     def _slice_for_process(self, batch):
+        """Pad the global batch to its stable full size FIRST, then slice — a short
+        final batch sliced by observed size would drop tail samples and desync the
+        remainder bookkeeping (the reference pads in _fetch_batches, data_loader.py:645)."""
         from .utils.operations import find_batch_size, slice_tensors
 
         batch_size = find_batch_size(batch)
         if batch_size is None:
             return batch
+        full = self._total_batch_size or batch_size
+        if batch_size < full:
+            batch = pad_batch_to_size(batch, full)
+            batch_size = full
         per_proc = batch_size // self.state.num_processes
         start = self.state.process_index * per_proc
         if self.slice_fn is not None:
@@ -878,7 +885,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
                     yield b
 
         def __len__(self):
-            return len(self.dl) - self.n
+            return max(0, len(self.dl) - self.n)
 
     return _Skipper(dataloader, num_batches)
 

@@ -1,0 +1,192 @@
+"""Model bundles and the prepared-model wrapper (L5 support).
+
+The reference wraps `torch.nn.Module`s in backend wrappers (DDP/FSDP/XLA MpModelWrapper,
+accelerator.py:1414-1550). Under GSPMD there is exactly one wrapper: `PreparedModel`,
+which binds (apply_fn, params) to a mesh with derived parameter shardings and a
+mixed-precision policy. Forward passes are jitted with input/output shardings; parameter
+"wrapping" is just placement.
+
+`Model` is the unprepared bundle users hand to `Accelerator.prepare` — flax modules
+don't carry their parameters, so the bundle is the JAX equivalent of a torch Module's
+(structure + state) pairing.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _cast_floating(tree, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+@dataclass
+class Model:
+    """Unprepared model bundle: apply_fn + params (+ the flax module, when there is one).
+
+    Build with `Model.from_flax(module, params)`, `Model.from_fn(apply_fn, params)`, or
+    via the in-tree `accelerate_tpu.models` constructors. `loss_fn(params, batch)` is
+    optional sugar used by `Accelerator.backward` when the user doesn't pass their own.
+    """
+
+    apply_fn: Callable
+    params: Any
+    module: Any = None
+    loss_fn: Optional[Callable] = None
+    # Sharding hints: pytree-path-regex -> PartitionSpec tuples, consumed by
+    # parallel/sharding.py rule derivation (the TP "module rules" equivalent).
+    sharding_rules: Optional[list] = None
+
+    @classmethod
+    def from_flax(cls, module, params, loss_fn=None, sharding_rules=None) -> "Model":
+        return cls(
+            apply_fn=module.apply,
+            params=params,
+            module=module,
+            loss_fn=loss_fn,
+            sharding_rules=sharding_rules or getattr(module, "sharding_rules", None),
+        )
+
+    @classmethod
+    def from_fn(cls, apply_fn, params, loss_fn=None, sharding_rules=None) -> "Model":
+        return cls(apply_fn=apply_fn, params=params, loss_fn=loss_fn, sharding_rules=sharding_rules)
+
+    def init_weights(self, rng, *sample_args):
+        """(Re)initialize params from the flax module."""
+        if self.module is None:
+            raise ValueError("init_weights requires a flax module")
+        self.params = self.module.init(rng, *sample_args)
+        return self.params
+
+
+class PreparedModel:
+    """A model placed on the mesh (the single GSPMD 'wrapper'; replaces reference
+    DDP/FSDP/MpModelWrapper wrapping accelerator.py:1414-1550).
+
+    - `params` live as global jax.Arrays with derived NamedShardings (replicated for
+      DP, sharded over "fsdp"/"model" axes per plugin/rules).
+    - `__call__` runs the jitted forward under the mixed-precision policy: params and
+      float inputs cast to the compute dtype, float outputs upcast to fp32 (the
+      `convert_outputs_to_fp32` contract, reference accelerator.py:1356-1365).
+    - `state_dict()`/`load_state_dict()` expose a checkpointable view.
+    """
+
+    def __init__(
+        self,
+        model: Model,
+        mesh=None,
+        param_sharding=None,
+        compute_dtype=None,
+        autocast: bool = True,
+    ):
+        import jax
+
+        self.module = model.module
+        self.apply_fn = model.apply_fn
+        self.loss_fn = model.loss_fn
+        self.sharding_rules = model.sharding_rules
+        self.mesh = mesh
+        self.param_sharding = param_sharding
+        self.compute_dtype = compute_dtype
+        self.autocast_enabled = autocast and compute_dtype is not None
+        self._jit_cache: dict = {}
+
+        params = model.params
+        if param_sharding is not None:
+            params = jax.device_put(params, param_sharding)
+        elif mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            params = jax.device_put(params, NamedSharding(mesh, PartitionSpec()))
+        self.params = params
+        self._rng = jax.random.key(np.random.randint(0, 2**31 - 1))
+
+    # -- forward -----------------------------------------------------------------------
+    def _mp_apply(self, params, *args, **kwargs):
+        import jax.numpy as jnp
+
+        if self.autocast_enabled:
+            params = _cast_floating(params, self.compute_dtype)
+            args = _cast_floating(args, self.compute_dtype)
+            out = self.apply_fn(params, *args, **kwargs)
+            return _cast_floating(out, jnp.float32)
+        return self.apply_fn(params, *args, **kwargs)
+
+    @property
+    def jitted_apply(self):
+        import jax
+
+        if "apply" not in self._jit_cache:
+            self._jit_cache["apply"] = jax.jit(self._mp_apply)
+        return self._jit_cache["apply"]
+
+    def __call__(self, *args, **kwargs):
+        return self.jitted_apply(self.params, *args, **kwargs)
+
+    def eval_apply(self, *args, **kwargs):
+        return self(*args, **kwargs)
+
+    def apply(self, params, *args, **kwargs):
+        """Traceable forward under the mixed-precision policy — use inside loss
+        functions and custom jitted steps."""
+        return self._mp_apply(params, *args, **kwargs)
+
+    def loss(self, params, batch):
+        """The bundled loss under this model's precision policy: differentiable
+        `loss(params, batch)`, the canonical argument to `Accelerator.backward`."""
+        if self.loss_fn is None:
+            raise ValueError("This model bundle has no loss_fn; pass your own loss to backward()")
+        return self.loss_fn(params, batch, self._mp_apply)
+
+    # -- rng ---------------------------------------------------------------------------
+    def next_rng_key(self):
+        import jax
+
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # -- checkpoint view ---------------------------------------------------------------
+    def state_dict(self):
+        return self.params
+
+    def load_state_dict(self, params):
+        import jax
+
+        if self.param_sharding is not None:
+            params = jax.device_put(params, self.param_sharding)
+        self.params = params
+
+    # -- introspection -----------------------------------------------------------------
+    @property
+    def num_parameters(self) -> int:
+        import jax
+
+        return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self.params))
+
+    def parameter_bytes(self) -> int:
+        import jax
+
+        return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(self.params))
+
+    def __repr__(self):
+        shard_desc = "custom" if self.param_sharding is not None else "replicated"
+        return (
+            f"PreparedModel(params={self.num_parameters:,}, sharding={shard_desc}, "
+            f"compute_dtype={self.compute_dtype}, mesh={dict(self.mesh.shape) if self.mesh else None})"
+        )

@@ -1,0 +1,56 @@
+"""In-tree model families (flax), each shipping TP sharding rules and a loss.
+
+These cover the reference's benchmark configs (BASELINE.json): BERT (GLUE),
+Llama (FSDP fine-tune + big-model inference), ResNet (cv_example)."""
+
+from .bert import BertConfig, BertForSequenceClassification, bert_base, bert_tiny, create_bert_model
+from .llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    create_llama_model,
+    llama3_8b,
+    llama_1b,
+    llama_tiny,
+)
+
+_CONFIG_REGISTRY = {
+    "bert-base": lambda: _bert_cfg(bert_base()),
+    "bert-tiny": lambda: _bert_cfg(bert_tiny()),
+    "llama-3-8b": lambda: _llama_cfg(llama3_8b()),
+    "llama-1b": lambda: _llama_cfg(llama_1b()),
+    "llama-tiny": lambda: _llama_cfg(llama_tiny()),
+}
+
+
+def _bert_cfg(c: BertConfig) -> dict:
+    return {
+        "model_type": "bert",
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.num_hidden_layers,
+        "num_attention_heads": c.num_attention_heads,
+        "intermediate_size": c.intermediate_size,
+        "tie_word_embeddings": True,
+    }
+
+
+def _llama_cfg(c: LlamaConfig) -> dict:
+    return {
+        "model_type": "llama",
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.num_hidden_layers,
+        "num_attention_heads": c.num_attention_heads,
+        "num_key_value_heads": c.num_key_value_heads,
+        "intermediate_size": c.intermediate_size,
+        "hidden_act": "silu",
+        "tie_word_embeddings": c.tie_word_embeddings,
+    }
+
+
+def get_model_config(name: str) -> dict:
+    """HF-config.json-shaped dict for a named in-tree model (estimate CLI)."""
+    key = name.lower()
+    if key not in _CONFIG_REGISTRY:
+        raise ValueError(f"Unknown model {name!r}; known: {sorted(_CONFIG_REGISTRY)}")
+    return _CONFIG_REGISTRY[key]()

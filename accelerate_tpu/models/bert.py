@@ -1,0 +1,161 @@
+"""BERT-family encoder in flax — the `examples/nlp_example.py` model
+(reference trains HF `bert-base-cased` on GLUE/MRPC; BASELINE.md GLUE-BERT metric).
+
+Fresh flax implementation (not a port): pre-computed additive masks, fused QKV
+projection (one matmul feeding the MXU instead of three), fp32 layernorms under bf16
+compute, and Megatron-style TP sharding rules shipped as path regexes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..modeling import Model
+from ..ops.attention import dot_product_attention
+
+# Megatron-layout TP rules: fused qkv/mlp-up column-parallel, out/mlp-down row-parallel,
+# vocab embedding sharded on the vocab dim. Consumed by parallel/sharding.py.
+BERT_SHARDING_RULES = [
+    (r"qkv/kernel", (None, "model")),
+    (r"attn_out/kernel", ("model", None)),
+    (r"mlp_up/kernel", (None, "model")),
+    (r"mlp_down/kernel", ("model", None)),
+    (r"word_embeddings/embedding", ("model", None)),
+]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+    dtype: Optional[str] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = self.config
+        h, d = cfg.num_attention_heads, cfg.head_dim
+        qkv = nn.Dense(3 * cfg.hidden_size, name="qkv")(hidden)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, s, _ = hidden.shape
+        q = q.reshape(b, s, h, d)
+        k = k.reshape(b, s, h, d)
+        v = v.reshape(b, s, h, d)
+        out = dot_product_attention(q, k, v, mask=mask)
+        out = out.reshape(b, s, cfg.hidden_size)
+        return nn.Dense(cfg.hidden_size, name="attn_out")(out)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = self.config
+        attn = BertSelfAttention(cfg, name="attention")(hidden, mask)
+        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="attn_ln")(hidden + attn)
+        up = nn.Dense(cfg.intermediate_size, name="mlp_up")(hidden)
+        up = nn.gelu(up, approximate=True)
+        down = nn.Dense(cfg.hidden_size, name="mlp_down")(up)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="mlp_ln")(hidden + down)
+
+
+class BertEncoder(nn.Module):
+    """Embeddings + transformer stack; returns (sequence_output, pooled_output)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        cfg = self.config
+        b, s = input_ids.shape
+        words = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_embeddings")(input_ids)
+        positions = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, name="position_embeddings")(
+            jnp.arange(s)[None, :]
+        )
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        types = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, name="token_type_embeddings")(token_type_ids)
+        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="embeddings_ln")(
+            words + positions + types
+        )
+        for i in range(cfg.num_hidden_layers):
+            hidden = BertLayer(cfg, name=f"layer_{i}")(hidden, attention_mask)
+        pooled = nn.tanh(nn.Dense(cfg.hidden_size, name="pooler")(hidden[:, 0]))
+        return hidden, pooled
+
+
+class BertForSequenceClassification(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        _, pooled = BertEncoder(self.config, name="bert")(input_ids, attention_mask, token_type_ids)
+        return nn.Dense(self.config.num_labels, name="classifier")(pooled)
+
+
+def sequence_classification_loss(params, batch, apply_fn):
+    """Mean softmax cross-entropy over the global batch; the per-device mean over a
+    ("data","fsdp")-sharded batch is what makes the gradient psum implicit."""
+    logits = apply_fn(
+        params,
+        batch["input_ids"],
+        batch.get("attention_mask"),
+        batch.get("token_type_ids"),
+    )
+    labels = batch["labels"]
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return nll.mean()
+
+
+def create_bert_model(config: Optional[BertConfig] = None, rng=None, seq_len: int = 128) -> Model:
+    """Initialized Model bundle for sequence classification."""
+    config = config or BertConfig()
+    if rng is None:
+        rng = jax.random.key(0)
+    module = BertForSequenceClassification(config)
+    sample = jnp.zeros((1, seq_len), dtype=jnp.int32)
+    params = module.init(rng, sample)
+    return Model.from_flax(
+        module, params, loss_fn=sequence_classification_loss, sharding_rules=BERT_SHARDING_RULES
+    )
+
+
+def bert_base(num_labels: int = 2) -> BertConfig:
+    return BertConfig(num_labels=num_labels)
+
+
+def bert_tiny(num_labels: int = 2) -> BertConfig:
+    """4-layer test-size config."""
+    return BertConfig(
+        vocab_size=1024,
+        hidden_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        intermediate_size=512,
+        max_position_embeddings=128,
+        num_labels=num_labels,
+    )

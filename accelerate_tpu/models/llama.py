@@ -1,0 +1,196 @@
+"""Llama-family decoder in flax — the flagship FSDP model (BASELINE.json:
+"Llama-3-8B full-shard fine-tune on TPU mesh" / big_model_inference Llama-70B).
+
+Fresh flax implementation: RMSNorm (fp32 accumulation), rotary embeddings, grouped-query
+attention through the shared attention seam, SwiGLU MLP, optional `lax.scan` over layers
+(one compiled layer body — faster compiles for deep stacks), and Megatron-layout TP
+rules + FSDP-friendly shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..modeling import Model
+from ..ops.attention import dot_product_attention
+
+LLAMA_SHARDING_RULES = [
+    (r"(wq|wk|wv)/kernel", (None, "model")),
+    (r"wo/kernel", ("model", None)),
+    (r"(w_gate|w_up)/kernel", (None, "model")),
+    (r"w_down/kernel", ("model", None)),
+    (r"embed_tokens/embedding", ("model", None)),
+    (r"lm_head/kernel", (None, "model")),
+]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    scan_layers: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def rotary_embedding(x, positions, theta: float):
+    """Apply RoPE to [B, S, H, D] given [B, S] positions."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, positions, mask):
+        cfg = self.config
+        b, s, _ = hidden.shape
+        hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        q = nn.Dense(hq * d, use_bias=False, name="wq")(hidden).reshape(b, s, hq, d)
+        k = nn.Dense(hkv * d, use_bias=False, name="wk")(hidden).reshape(b, s, hkv, d)
+        v = nn.Dense(hkv * d, use_bias=False, name="wv")(hidden).reshape(b, s, hkv, d)
+        q = rotary_embedding(q, positions, cfg.rope_theta)
+        k = rotary_embedding(k, positions, cfg.rope_theta)
+        out = dot_product_attention(q, k, v, mask=mask, causal=True)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="wo")(out.reshape(b, s, hq * d))
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False, name="w_gate")(hidden)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False, name="w_up")(hidden)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="w_down")(nn.silu(gate) * up)
+
+
+class LlamaLayer(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, positions, mask):
+        cfg = self.config
+        attn = LlamaAttention(cfg, name="attention")(RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden), positions, mask)
+        hidden = hidden + attn
+        mlp = LlamaMLP(cfg, name="mlp")(RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(hidden))
+        return hidden + mlp
+
+
+class LlamaForCausalLM(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, positions=None):
+        cfg = self.config
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens")(input_ids)
+        if cfg.scan_layers:
+            # One compiled layer body scanned over a stacked param axis — the
+            # compile-time answer to deep stacks (XLA sees a single layer).
+            scan_layer = nn.scan(
+                LlamaLayer,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast),
+                length=cfg.num_hidden_layers,
+            )
+            hidden = scan_layer(cfg, name="layers")(hidden, positions, attention_mask)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                hidden = LlamaLayer(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
+        hidden = RMSNorm(cfg.rms_norm_eps, name="final_norm")(hidden)
+        if cfg.tie_word_embeddings:
+            embed = self.variables["params"]["embed_tokens"]["embedding"]
+            return hidden @ embed.T
+        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(hidden)
+
+
+def causal_lm_loss(params, batch, apply_fn):
+    """Next-token cross-entropy with shift; ignores positions where labels < 0."""
+    logits = apply_fn(params, batch["input_ids"], batch.get("attention_mask"))
+    labels = batch.get("labels", batch["input_ids"])
+    shift_logits = logits[:, :-1].astype(jnp.float32)
+    shift_labels = labels[:, 1:]
+    logp = jax.nn.log_softmax(shift_logits, axis=-1)
+    valid = (shift_labels >= 0).astype(jnp.float32)
+    safe_labels = jnp.maximum(shift_labels, 0)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def create_llama_model(config: Optional[LlamaConfig] = None, rng=None, seq_len: int = 2048) -> Model:
+    config = config or llama_tiny()
+    if rng is None:
+        rng = jax.random.key(0)
+    module = LlamaForCausalLM(config)
+    sample = jnp.zeros((1, min(seq_len, config.max_position_embeddings)), dtype=jnp.int32)
+    params = module.init(rng, sample)
+    return Model.from_flax(module, params, loss_fn=causal_lm_loss, sharding_rules=LLAMA_SHARDING_RULES)
+
+
+def llama3_8b() -> LlamaConfig:
+    return LlamaConfig()
+
+
+def llama_1b() -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_hidden_layers=16,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+    )
+
+
+def llama_tiny() -> LlamaConfig:
+    """Test-size config."""
+    return LlamaConfig(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+    )

