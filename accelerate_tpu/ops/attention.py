@@ -25,6 +25,33 @@ def make_causal_mask(q_len: int, kv_len: int, dtype=None):
     return (j <= i + (kv_len - q_len)).astype(dtype or jnp.bool_)
 
 
+def _auto_sequence_parallel(batch: int, seq_len: int):
+    """(mesh, mode) when an already-built mesh has a real "seq" axis and the shapes
+    divide cleanly — models then get ring attention with zero code changes. None
+    otherwise (no Accelerator yet, module.init's batch-1 trace, tiny eval batches).
+
+    Deliberately side-effect free: inspects the Borg storage directly (constructing
+    AcceleratorState() would *initialize* it) and never builds the mesh lazily — a
+    forward pass must not create global state or raise mesh-shape errors."""
+    from ..state import AcceleratorState
+
+    shared = AcceleratorState._shared_state
+    if not shared:
+        return None
+    mesh = shared.get("_mesh")
+    if mesh is None:
+        return None
+    seq_size = mesh.shape.get("seq", 1)
+    batch_size_div = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    if seq_size <= 1 or seq_len % seq_size != 0 or batch % batch_size_div != 0:
+        return None
+    mode = "ring"
+    sp_plugin = shared.get("sequence_parallel_plugin")
+    if sp_plugin is not None:
+        mode = sp_plugin.mode
+    return mesh, mode
+
+
 def dot_product_attention(
     q,
     k,
@@ -51,9 +78,20 @@ def dot_product_attention(
     _, skv, hkv, _ = k.shape
     if scale is None:
         scale = 1.0 / np.sqrt(d)
+    if hq % hkv != 0:
+        raise ValueError(f"GQA requires query heads ({hq}) divisible by kv heads ({hkv})")
+
+    # Sequence-parallel dispatch happens BEFORE GQA expansion so the ring rotates the
+    # small hkv-sized K/V blocks (expansion is done per-block inside the ring).
+    if implementation is None and mask is None and sq == skv:
+        impl = _auto_sequence_parallel(b, sq)
+        if impl is not None:
+            from ..parallel.ring_attention import sequence_parallel_attention
+
+            mesh, mode = impl
+            return sequence_parallel_attention(q, k, v, mesh=mesh, causal=causal, scale=scale, mode=mode)
+
     if hq != hkv:
-        if hq % hkv != 0:
-            raise ValueError(f"GQA requires query heads ({hq}) divisible by kv heads ({hkv})")
         reps = hq // hkv
         k = jnp.repeat(k, reps, axis=2)
         v = jnp.repeat(v, reps, axis=2)

@@ -1,0 +1,176 @@
+"""Ring attention: first-class sequence/context parallelism.
+
+The reference cannot scale sequence length natively — its only SP surface is a Megatron
+passthrough flag (SURVEY §5; reference dataclasses.py:1262-1265). Here SP is a mesh axis:
+activations are sharded [batch, seq/axis, ...] over "seq", and attention runs as a ring
+(see PAPERS.md: blockwise/ring attention literature):
+
+  each device keeps its Q block resident and its K/V block rotating — at every step the
+  local K/V block hops to the next device over ICI via `lax.ppermute` while the device
+  computes blockwise attention against the block it just received, folding results with
+  a streaming (flash-style) log-sum-exp accumulator. Communication is fully overlapped
+  with the matmuls; HBM never holds more than one remote block.
+
+`ring_attention` is the shard_map-level kernel; `sequence_parallel_attention` wraps it
+in a `shard_map` over the active mesh so jit-level callers (the models' attention seam,
+ops/attention.py) can dispatch to it transparently when mesh.shape["seq"] > 1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+
+def _ring_step_block(q, k, v, m, l, o, q_offset, kv_offset, scale, causal):
+    """Fold one K/V block into the streaming-softmax accumulator.
+
+    q: [B, Sq, H, D]; k/v: [B, Skv, H, D]; m/l: [B, H, Sq]; o: [B, Sq, H, D].
+    Offsets are the blocks' global sequence starts (for causal masking).
+    """
+    import jax.numpy as jnp
+
+    if q.shape[2] != k.shape[2]:
+        # GQA: expand kv heads per block at compute time — the ring rotates the small
+        # hkv-sized blocks; XLA fuses this broadcast into the einsum.
+        reps = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B,H,Sq,Skv]
+    scores = scores.astype(jnp.float32)
+    if causal:
+        sq, skv = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(sq)[:, None]
+        kv_pos = kv_offset + jnp.arange(skv)[None, :]
+        scores = jnp.where((kv_pos <= q_pos)[None, None], scores, -jnp.inf)
+
+    block_max = jnp.max(scores, axis=-1)  # [B,H,Sq]
+    m_new = jnp.maximum(m, block_max)
+    # Guard fully-masked blocks: exp(-inf - -inf) would be NaN.
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(jnp.where(jnp.isneginf(scores), -jnp.inf, scores - safe_m[..., None]))
+    correction = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: str = "seq",
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Shard_map-level ring attention over `axis_name`.
+
+    All of q/k/v are the local sequence blocks [B, S_local, H, D] (same head counts —
+    GQA expansion happens in the caller). Returns [B, S_local, H, D] in q.dtype.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    axis_size = lax.axis_size(axis_name)
+    axis_index = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+
+    m = jnp.full((b, h, sq), -jnp.inf, dtype=jnp.float32)
+    l = jnp.zeros((b, h, sq), dtype=jnp.float32)
+    o = jnp.zeros((b, sq, h, d), dtype=jnp.float32)
+    q_offset = axis_index * sq
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    # axis_size is static inside shard_map, so a python loop fully unrolls the ring —
+    # XLA then overlaps each ppermute (ICI DMA) with the next block's matmuls, since
+    # the rotation is independent of the accumulator chain.
+    k_cur, v_cur = k, v
+    for step in range(axis_size):
+        src = (axis_index - step) % axis_size  # whose block we hold at this step
+        kv_offset = src * skv
+        m, l, o = _ring_step_block(q, k_cur, v_cur, m, l, o, q_offset, kv_offset, scale, causal)
+        if step < axis_size - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def allgather_attention(q, k, v, axis_name: str = "seq", causal: bool = False, scale=None):
+    """All-gather-KV sequence parallelism: cheaper at short context, more HBM
+    (the SequenceParallelPlugin mode="allgather" path)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    axis_index = lax.axis_index(axis_name)
+    sq = q.shape[1]
+    k_full = lax.all_gather(k, axis_name, axis=1, tiled=True)
+    v_full = lax.all_gather(v, axis_name, axis=1, tiled=True)
+    from ..ops.attention import dot_product_attention
+
+    if not causal:
+        return dot_product_attention(q, k_full, v_full, scale=scale, implementation="xla")
+    # Causal with a shifted query block: build the mask from global positions.
+    skv = k_full.shape[1]
+    q_pos = axis_index * sq + jnp.arange(sq)
+    kv_pos = jnp.arange(skv)
+    mask = (kv_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,Sq,Skv]
+    mask = jnp.broadcast_to(mask, (q.shape[0], 1, sq, skv))
+    return dot_product_attention(q, k_full, v_full, mask=mask, scale=scale, implementation="xla")
+
+
+def sequence_parallel_attention(
+    q,
+    k,
+    v,
+    mesh=None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    mode: str = "ring",
+    batch_axes=("data", "fsdp"),
+    seq_axis: str = "seq",
+    head_axis: Optional[str] = "model",
+):
+    """Jit-level wrapper: shard_map the ring over the active mesh.
+
+    Expects q/k/v global [B, S, H, D] with S divisible by the seq-axis size (and H by
+    the model-axis size when TP is active — heads shard over "model", giving 2D
+    (sequence × head) attention parallelism). Composable inside jit.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        mesh = AcceleratorState().mesh
+
+    hq, hkv = q.shape[2], k.shape[2]
+    head_size = mesh.shape.get(head_axis, 1) if head_axis is not None else 1
+    use_heads = head_size > 1 and hq % head_size == 0 and hkv % head_size == 0
+    hspec = head_axis if use_heads else None
+    q_spec = P(batch_axes, seq_axis, hspec, None)
+    kv_spec = P(batch_axes, seq_axis, hspec, None)
+    inner = ring_attention if mode == "ring" else allgather_attention
+
+    fn = shard_map(
+        functools.partial(inner, axis_name=seq_axis, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,119 @@
+"""Numerics tests for sequence-parallel ring attention: the sharded ring must match
+dense single-device attention to float tolerance (causal + bidirectional + GQA), and a
+sequence-parallel training step must run through the Accelerator."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.ops.attention import dot_product_attention
+from accelerate_tpu.parallel.mesh import build_mesh
+from accelerate_tpu.parallel.ring_attention import sequence_parallel_attention
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils import ParallelismConfig, SequenceParallelPlugin
+
+
+def _qkv(b=2, s=32, h=4, hkv=None, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    q = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, s, hkv or h, d)).astype(np.float32)
+    v = rng.normal(size=(b, s, hkv or h, d)).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("mode", ["ring", "allgather"])
+def test_ring_matches_dense(causal, mode):
+    mesh = build_mesh(ParallelismConfig(data=2, seq=4))
+    q, k, v = _qkv()
+    dense = dot_product_attention(q, k, v, causal=causal, implementation="xla")
+    ring = sequence_parallel_attention(q, k, v, mesh=mesh, causal=causal, mode=mode)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_under_jit_and_grad():
+    mesh = build_mesh(ParallelismConfig(data=2, seq=4))
+    q, k, v = _qkv(s=16)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(sequence_parallel_attention(q, k, v, mesh=mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True, implementation="xla") ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring))(q, k, v)
+    g_dense = jax.grad(loss_dense)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_with_tp_heads():
+    """2D attention parallelism: heads over "model", sequence over "seq"."""
+    mesh = build_mesh(ParallelismConfig(data=1, model=2, seq=4))
+    q, k, v = _qkv(h=4)
+    dense = dot_product_attention(q, k, v, causal=True, implementation="xla")
+    ring = sequence_parallel_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+def test_auto_dispatch_via_accelerator_state():
+    """Models get ring attention automatically when the (built) mesh has a seq axis."""
+    state = AcceleratorState(
+        parallelism_config=ParallelismConfig(data=2, seq=4),
+        sequence_parallel_plugin=SequenceParallelPlugin(seq_degree=4),
+    )
+    state.mesh  # dispatch requires the mesh to exist; forwards never build it lazily
+    q, k, v = _qkv()
+    out_auto = dot_product_attention(q, k, v, causal=True)  # should route to ring
+    out_dense = dot_product_attention(q, k, v, causal=True, implementation="xla")
+    np.testing.assert_allclose(np.asarray(out_auto), np.asarray(out_dense), rtol=2e-5, atol=2e-5)
+    # and the routed path really is the ring: the sharded output spec names "seq"
+    from accelerate_tpu.parallel.ring_attention import sequence_parallel_attention
+
+    out = sequence_parallel_attention(q, k, v, mesh=state.mesh, causal=True)
+    assert "seq" in str(out.sharding.spec)
+
+
+def test_no_dispatch_without_built_mesh():
+    """A forward pass must not build the mesh or mutate global state."""
+    assert AcceleratorState._shared_state == {}
+    q, k, v = _qkv()
+    dot_product_attention(q, k, v, causal=True)
+    assert AcceleratorState._shared_state == {}, "attention op must not initialize AcceleratorState"
+
+
+def test_ring_gqa():
+    """GQA: ring rotates hkv-sized blocks; numerics must still match dense."""
+    mesh = build_mesh(ParallelismConfig(data=2, seq=4))
+    q, k, v = _qkv(h=8, hkv=2)
+    dense = dot_product_attention(q, k, v, causal=True, implementation="xla")
+    ring = sequence_parallel_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+def test_sequence_parallel_training_step():
+    """End-to-end: a Llama step with the seq axis active trains through the Accelerator."""
+    import optax
+
+    from accelerate_tpu import Accelerator, SimpleDataLoader
+    from accelerate_tpu.data_loader import BatchSampler
+    from accelerate_tpu.models.llama import create_llama_model, llama_tiny
+
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(data=2, seq=4),
+        sequence_parallel_plugin=SequenceParallelPlugin(seq_degree=4),
+    )
+    assert accelerator.mesh.shape["seq"] == 4
+    model = create_llama_model(llama_tiny(), seq_len=32)
+    rng = np.random.default_rng(0)
+    data = [{"input_ids": rng.integers(1, 500, size=(32,)).astype(np.int32)} for _ in range(8)]
+    dl = SimpleDataLoader(data, BatchSampler(range(8), 8))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.adam(1e-3), dl)
+    for batch in pdl:
+        with accelerator.accumulate(pmodel):
+            loss = accelerator.backward(pmodel.loss, batch)
+            popt.step()
+            popt.zero_grad()
+    assert np.isfinite(float(loss))
