@@ -1,0 +1,303 @@
+"""Big-model machinery: run models larger than HBM (L5 sibling; parity: reference
+big_modeling.py 627 + hooks.py 709).
+
+TPU-native redesign of the reference's hook architecture. The reference monkey-patches
+`module.forward` with AlignDevicesHooks that fault weights in from a weights_map
+(hooks.py:212-389). Functional JAX can do better: the model is executed as an explicit
+**layer stream** — prelude (embeddings), a loop of identically-shaped layer applications
+(ONE compiled executable reused for every layer), then the tail — while a double-buffer
+of `jax.device_put` transfers prefetches layer N+1's weights from host DRAM / disk-mmap
+into HBM underneath layer N's compute. That is the AlignDevicesHook + `cpu_offload_with_
+hook` pipeline (reference big_modeling.py:169-302) without any hooks.
+
+Tiers: HBM (resident blocks) → host DRAM (numpy, pinned by the OS page cache) → disk
+(`utils/offload.py` mmap store). Placement comes from `infer_auto_device_map`
+(utils/modeling.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from .logging import get_logger
+from .modeling import Model
+from .utils.modeling import (
+    clean_device_map,
+    get_balanced_memory,
+    get_max_memory,
+    group_into_blocks,
+    infer_auto_device_map,
+)
+from .utils.offload import OffloadedWeightsLoader, offload_weight, save_offload_index
+
+logger = get_logger(__name__)
+
+
+def init_empty_weights(module, *sample_args, **sample_kwargs):
+    """Shape-only init: the meta-device replacement (reference big_modeling.py:56
+    patches nn.Module registration; JAX just traces `module.init` without running it).
+
+    Returns a pytree of jax.ShapeDtypeStruct — enough for planning, zero memory."""
+    import jax
+
+    return jax.eval_shape(lambda rng: module.init(rng, *sample_args, **sample_kwargs), jax.random.key(0))
+
+
+@contextlib.contextmanager
+def init_on_device(device):
+    """Context parity shim (reference big_modeling.py:91): place initializers' outputs
+    on `device` by making it the default."""
+    import jax
+
+    with jax.default_device(device):
+        yield
+
+
+class LayeredApply:
+    """Protocol for layer-streamed execution: model families implement this to run
+    over-HBM models (Llama/BERT ship implementations in accelerate_tpu.models).
+
+    `prelude/layer/tail` receive the *sub*-pytrees produced by `split(params)`; layer
+    params must be identically shaped across layers (one compiled executable)."""
+
+    def split(self, params) -> tuple:
+        """→ (prelude_params, [layer_params...], tail_params)"""
+        raise NotImplementedError
+
+    def join(self, prelude, layers, tail):
+        """Inverse of split (used to reassemble a full pytree)."""
+        raise NotImplementedError
+
+    def apply_prelude(self, prelude_params, *args, **kwargs):
+        raise NotImplementedError
+
+    def apply_layer(self, layer_params, carry):
+        raise NotImplementedError
+
+    def apply_tail(self, tail_params, carry):
+        raise NotImplementedError
+
+
+class DispatchedModel:
+    """A model whose parameter blocks live across HBM/host/disk per a device map
+    (reference dispatch_model big_modeling.py:305-495 + hook machinery).
+
+    Callable like a PreparedModel; when all blocks are device-resident this is a plain
+    jitted apply, otherwise the layer stream runs with double-buffered weight prefetch.
+    """
+
+    def __init__(
+        self,
+        model: Model,
+        device_map: Dict[str, Union[int, str]],
+        offload_folder: Optional[str] = None,
+        layered: Optional[LayeredApply] = None,
+        compute_dtype=None,
+    ):
+        import jax
+
+        self.module = model.module
+        self.apply_fn = model.apply_fn
+        self.layered = layered
+        self.device_map = device_map
+        self.offload_folder = offload_folder
+        self.compute_dtype = compute_dtype
+        self._jit_cache: dict = {}
+
+        devices = jax.local_devices()
+        blocks = group_into_blocks(model.params)
+        from .parallel.sharding import tree_paths_and_leaves
+
+        flat, self._treedef = tree_paths_and_leaves(model.params)
+        self._paths = [p for p, _ in flat]
+
+        # Place every leaf according to its block's tier.
+        tier_of: Dict[str, Union[int, str]] = {}
+        for block_name, paths in blocks.items():
+            tier = _lookup_tier(device_map, block_name)
+            for p in paths:
+                tier_of[p] = tier
+        self.tier_of = tier_of
+
+        def _maybe_cast(x):
+            # The planner sized blocks at compute_dtype; cast floats so budgets hold.
+            if compute_dtype is None:
+                return x
+            import jax.numpy as jnp
+
+            dt = getattr(x, "dtype", None)
+            if dt is not None and jnp.issubdtype(dt, jnp.floating):
+                return jnp.asarray(x, dtype=compute_dtype) if isinstance(x, jax.Array) else np.asarray(
+                    jnp.asarray(np.asarray(x), dtype=compute_dtype)
+                )
+            return x
+
+        offload_index: dict = {}
+        self._leaves: Dict[str, Any] = {}
+        self._resident_devices = set()
+        for path, leaf in flat:
+            tier = tier_of.get(path, 0)
+            if tier == "disk":
+                if offload_folder is None:
+                    raise ValueError("device_map places blocks on disk; offload_folder is required")
+                offload_index = offload_weight(_maybe_cast(leaf), path, offload_folder, offload_index)
+                self._leaves[path] = None  # resolved via the offload store
+            elif tier == "cpu":
+                self._leaves[path] = np.asarray(jax.device_get(_maybe_cast(leaf)))
+            else:
+                self._leaves[path] = jax.device_put(_maybe_cast(leaf), devices[int(tier)])
+                self._resident_devices.add(int(tier))
+        if offload_index:
+            save_offload_index(offload_index, offload_folder)
+        self._disk_store = OffloadedWeightsLoader(save_folder=offload_folder) if offload_index else None
+        self.hf_device_map = dict(device_map)  # reference exposes model.hf_device_map
+
+    # -- leaf access -------------------------------------------------------------------
+    def _get_leaf(self, path: str):
+        leaf = self._leaves[path]
+        if leaf is None:
+            leaf = self._disk_store[path]
+        return leaf
+
+    def materialize_params(self, device=None):
+        """Full params pytree fetched to `device` (or default). For models that fit
+        transiently; the streamed path avoids this."""
+        import jax
+
+        leaves = [jax.device_put(np.asarray(self._get_leaf(p))) for p in self._paths]
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    @property
+    def resident_fraction(self) -> float:
+        n_dev = sum(1 for p in self._paths if not isinstance(self.tier_of.get(p, 0), str))
+        return n_dev / max(1, len(self._paths))
+
+    # -- execution ---------------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        import jax
+
+        all_resident = all(not isinstance(self.tier_of.get(p, 0), str) for p in self._paths)
+        if all_resident and len(self._resident_devices) <= 1:
+            if "apply" not in self._jit_cache:
+                self._jit_cache["apply"] = jax.jit(self.apply_fn)
+            params = jax.tree_util.tree_unflatten(self._treedef, [self._leaves[p] for p in self._paths])
+            return self._jit_cache["apply"](params, *args, **kwargs)
+        if self.layered is not None:
+            # Blocks on several devices or host/disk tiers: stream layer-by-layer.
+            # (Per-stage pipelined execution across devices is the PP-inference path;
+            # here remote blocks are copied to the compute device per step.)
+            return self._streamed_call(*args, **kwargs)
+        logger.warning_once(
+            "Model has offloaded blocks but no LayeredApply protocol; materializing all "
+            "params per call (works only if the model fits HBM transiently)."
+        )
+        return self.apply_fn(self.materialize_params(), *args, **kwargs)
+
+    def _fetch_block_pytree(self, subtree):
+        """device_put a sub-pytree whose leaves may live on host/disk (async transfer)."""
+        import jax
+
+        from .parallel.sharding import tree_paths_and_leaves
+
+        flat, treedef = tree_paths_and_leaves(subtree)
+        leaves = []
+        for _, leaf in flat:
+            leaves.append(jax.device_put(np.asarray(leaf) if not isinstance(leaf, jax.Array) else leaf))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _streamed_call(self, *args, **kwargs):
+        """The AlignDevicesHook pipeline, functional: prelude → layer loop with
+        double-buffered weight prefetch → tail (reference hooks.py:315-389 semantics)."""
+        import jax
+
+        params = jax.tree_util.tree_unflatten(
+            self._treedef, [self._get_leaf(p) for p in self._paths]
+        )
+        prelude_p, layer_ps, tail_p = self.layered.split(params)
+
+        if "prelude" not in self._jit_cache:
+            self._jit_cache["prelude"] = jax.jit(self.layered.apply_prelude)
+            self._jit_cache["layer"] = jax.jit(self.layered.apply_layer)
+            self._jit_cache["tail"] = jax.jit(self.layered.apply_tail)
+
+        carry = self._jit_cache["prelude"](self._fetch_block_pytree(prelude_p), *args, **kwargs)
+        n = len(layer_ps)
+        next_block = self._fetch_block_pytree(layer_ps[0]) if n else None
+        for i in range(n):
+            current = next_block
+            if i + 1 < n:
+                # Prefetch the next layer's weights while this layer computes:
+                # device_put is async, so the H2D DMA overlaps the layer matmuls.
+                next_block = self._fetch_block_pytree(layer_ps[i + 1])
+            carry = self._jit_cache["layer"](current, carry)
+        return self._jit_cache["tail"](self._fetch_block_pytree(tail_p), carry)
+
+
+def _lookup_tier(device_map: dict, block_name: str):
+    if block_name in device_map:
+        return device_map[block_name]
+    parts = block_name.split("/")
+    for i in range(len(parts), -1, -1):
+        prefix = "/".join(parts[:i])
+        if prefix in device_map:
+            return device_map[prefix]
+    return 0
+
+
+def dispatch_model(
+    model: Model,
+    device_map: Dict[str, Union[int, str]],
+    offload_folder: Optional[str] = None,
+    layered: Optional[LayeredApply] = None,
+    dtype=None,
+) -> DispatchedModel:
+    """Place a materialized model across tiers (reference big_modeling.py:305)."""
+    if isinstance(device_map, str):
+        raise ValueError("Pass a concrete device_map dict; use load_checkpoint_and_dispatch for 'auto'")
+    return DispatchedModel(
+        model, clean_device_map(device_map), offload_folder=offload_folder, layered=layered, compute_dtype=dtype
+    )
+
+
+def cpu_offload(model: Model, layered: Optional[LayeredApply] = None) -> DispatchedModel:
+    """All params on host DRAM, streamed per layer (reference big_modeling.py:169)."""
+    return DispatchedModel(model, {"": "cpu"}, layered=layered)
+
+
+def disk_offload(model: Model, offload_dir: str, layered: Optional[LayeredApply] = None) -> DispatchedModel:
+    """All params in the disk store (reference big_modeling.py:231)."""
+    return DispatchedModel(model, {"": "disk"}, offload_folder=offload_dir, layered=layered)
+
+
+def load_checkpoint_and_dispatch(
+    model: Model,
+    checkpoint: Optional[str] = None,
+    device_map: Union[str, dict, None] = "auto",
+    max_memory: Optional[dict] = None,
+    no_split_prefixes: Optional[List[str]] = None,
+    offload_folder: Optional[str] = None,
+    dtype=None,
+    layered: Optional[LayeredApply] = None,
+) -> DispatchedModel:
+    """One call: balanced budgets → device map → (load) → dispatch
+    (reference big_modeling.py:498-627)."""
+    from .checkpointing import load_pytree
+
+    if checkpoint is not None:
+        params = load_pytree(checkpoint)
+        model = Model(apply_fn=model.apply_fn, params=params, module=model.module, loss_fn=model.loss_fn,
+                      sharding_rules=model.sharding_rules)
+    if device_map == "auto" or device_map == "balanced":
+        budgets = get_balanced_memory(model.params, max_memory, dtype=dtype)
+        device_map = infer_auto_device_map(
+            model.params, budgets, no_split_prefixes=no_split_prefixes, dtype=dtype
+        )
+    elif device_map == "sequential":
+        device_map = infer_auto_device_map(
+            model.params, get_max_memory(max_memory), no_split_prefixes=no_split_prefixes, dtype=dtype
+        )
+    logger.info("device_map tiers: %s", {k: v for k, v in list(device_map.items())[:8]})
+    return dispatch_model(model, device_map, offload_folder=offload_folder, layered=layered, dtype=dtype)

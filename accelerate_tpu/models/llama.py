@@ -113,6 +113,16 @@ class LlamaLayer(nn.Module):
         return hidden + mlp
 
 
+class _ScanLayerBody(nn.Module):
+    """nn.scan body: carry = hidden, (positions, mask) broadcast, no per-step output."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, carry, positions, mask):
+        return LlamaLayer(self.config, name="layer")(carry, positions, mask), None
+
+
 class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
 
@@ -127,13 +137,13 @@ class LlamaForCausalLM(nn.Module):
             # One compiled layer body scanned over a stacked param axis — the
             # compile-time answer to deep stacks (XLA sees a single layer).
             scan_layer = nn.scan(
-                LlamaLayer,
+                _ScanLayerBody,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.num_hidden_layers,
             )
-            hidden = scan_layer(cfg, name="layers")(hidden, positions, attention_mask)
+            hidden, _ = scan_layer(cfg, name="layers")(hidden, positions, attention_mask)
         else:
             for i in range(cfg.num_hidden_layers):
                 hidden = LlamaLayer(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
@@ -165,6 +175,78 @@ def create_llama_model(config: Optional[LlamaConfig] = None, rng=None, seq_len: 
     sample = jnp.zeros((1, min(seq_len, config.max_position_embeddings)), dtype=jnp.int32)
     params = module.init(rng, sample)
     return Model.from_flax(module, params, loss_fn=causal_lm_loss, sharding_rules=LLAMA_SHARDING_RULES)
+
+
+class LlamaLayeredApply:
+    """LayeredApply protocol for layer-streamed big-model inference
+    (accelerate_tpu.big_modeling): run Llama models larger than HBM by streaming one
+    layer's weights at a time while the previous layer computes."""
+
+    def __init__(self, config: LlamaConfig):
+        self.config = config
+
+    def _layer_names(self, params):
+        inner = params["params"]
+        return sorted(
+            (k for k in inner if k.startswith("layer_") and k != "layers"),
+            key=lambda s: int(s.split("_")[1]),
+        )
+
+    def split(self, params):
+        import jax
+
+        inner = params["params"]
+        prelude = {"params": {"embed_tokens": inner["embed_tokens"]}}
+        if "layers" in inner:
+            # scan_layers=True: stacked [L, ...] params under layers/layer; slice one
+            # layer per step.
+            stacked = inner["layers"]["layer"]
+            layers = [
+                {"params": jax.tree_util.tree_map(lambda x: x[i], stacked)}
+                for i in range(self.config.num_hidden_layers)
+            ]
+        else:
+            layers = [{"params": inner[name]} for name in self._layer_names(params)]
+        tail_keys = {"final_norm"} | ({"lm_head"} if "lm_head" in inner else set())
+        if self.config.tie_word_embeddings:
+            # Tied head: the tail needs the embedding matrix for hidden @ E^T.
+            tail_keys.add("embed_tokens")
+        tail = {"params": {k: inner[k] for k in tail_keys if k in inner}}
+        return prelude, layers, tail
+
+    def join(self, prelude, layers, tail):
+        inner = dict(prelude["params"])
+        for i, lp in enumerate(layers):
+            inner[f"layer_{i}"] = lp["params"]
+        inner.update(tail["params"])
+        return {"params": inner}
+
+    def apply_prelude(self, prelude_params, input_ids, attention_mask=None):
+        cfg = self.config
+        b, s = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens").apply(
+            {"params": {"embedding": prelude_params["params"]["embed_tokens"]["embedding"]}}, input_ids
+        )
+        return (hidden, positions, attention_mask)
+
+    def apply_layer(self, layer_params, carry):
+        hidden, positions, mask = carry
+        hidden = LlamaLayer(self.config).apply(layer_params, hidden, positions, mask)
+        return (hidden, positions, mask)
+
+    def apply_tail(self, tail_params, carry):
+        cfg = self.config
+        hidden, _, _ = carry
+        hidden = RMSNorm(cfg.rms_norm_eps).apply({"params": tail_params["params"]["final_norm"]}, hidden)
+        if "lm_head" in tail_params["params"]:
+            return nn.Dense(cfg.vocab_size, use_bias=False).apply(
+                {"params": tail_params["params"]["lm_head"]}, hidden
+            )
+        if cfg.tie_word_embeddings:
+            embed = tail_params["params"]["embed_tokens"]["embedding"]
+            return hidden @ embed.T
+        return hidden
 
 
 def llama3_8b() -> LlamaConfig:

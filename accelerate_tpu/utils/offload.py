@@ -1,0 +1,108 @@
+"""Disk-backed weight store (parity: reference utils/offload.py:25-192).
+
+Each tensor is one raw `.npy` saved with `np.save` and re-opened `mmap_mode="r"`, plus
+an `index.json` of name → {filename, shape, dtype}; `OffloadedWeightsLoader` is the lazy
+Mapping over (disk index + in-memory state dicts) that the streamed executor reads
+blocks from. bfloat16 round-trips via a uint16 view (npy has no bf16)."""
+
+from __future__ import annotations
+
+import json
+import os
+from collections.abc import Mapping
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def offload_weight(weight, weight_name: str, offload_folder: str, index: Optional[dict] = None) -> dict:
+    """(reference offload.py:25)"""
+    import jax
+
+    arr = np.asarray(jax.device_get(weight)) if not isinstance(weight, np.ndarray) else weight
+    dtype_name = arr.dtype.name
+    save_arr = arr.view(np.uint16) if dtype_name == "bfloat16" else arr
+    os.makedirs(offload_folder, exist_ok=True)
+    fname = weight_name.replace("/", "--") + ".npy"
+    np.save(os.path.join(offload_folder, fname), save_arr)
+    if index is None:
+        index = {}
+    index[weight_name] = {"filename": fname, "shape": list(arr.shape), "dtype": dtype_name}
+    return index
+
+
+def save_offload_index(index: dict, offload_folder: str):
+    with open(os.path.join(offload_folder, "index.json"), "w") as f:
+        json.dump(index, f, indent=2)
+
+
+def load_offload_index(offload_folder: str) -> dict:
+    path = os.path.join(offload_folder, "index.json")
+    if not os.path.isfile(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_offloaded_weight(offload_folder: str, weight_info: dict):
+    """mmap-read one tensor (reference offload.py:79); bf16 restored from uint16.
+
+    The bf16 view stays on the memmap (no np.asarray!) so disk weights are only paged
+    in when a block is actually device_put — the whole point of the disk tier."""
+    arr = np.load(os.path.join(offload_folder, weight_info["filename"]), mmap_mode="r")
+    if weight_info["dtype"] == "bfloat16":
+        import jax.numpy as jnp
+
+        return arr.view(jnp.bfloat16)
+    return arr
+
+
+class OffloadedWeightsLoader(Mapping):
+    """Lazy Mapping over disk-offloaded + in-memory weights (reference offload.py:127)."""
+
+    def __init__(self, state_dict: Optional[Dict] = None, save_folder: Optional[str] = None, index: Optional[dict] = None):
+        if state_dict is None and save_folder is None:
+            raise ValueError("Need either a state_dict or a save_folder")
+        self.state_dict = state_dict or {}
+        self.save_folder = save_folder
+        if index is None and save_folder is not None:
+            index = load_offload_index(save_folder)
+        self.index = index or {}
+        self.all_keys = list(self.state_dict.keys()) + [k for k in self.index if k not in self.state_dict]
+
+    def __getitem__(self, key: str):
+        if key in self.state_dict:
+            return self.state_dict[key]
+        weight_info = self.index[key]
+        return load_offloaded_weight(self.save_folder, weight_info)
+
+    def __iter__(self):
+        return iter(self.all_keys)
+
+    def __len__(self):
+        return len(self.all_keys)
+
+
+class PrefixedDataset(Mapping):
+    """View of a Mapping with a key prefix stripped/applied (reference offload.py:174)."""
+
+    def __init__(self, dataset: Mapping, prefix: str):
+        self.dataset = dataset
+        self.prefix = prefix
+
+    def __getitem__(self, key):
+        return self.dataset[f"{self.prefix}{key}"]
+
+    def __iter__(self):
+        return iter([key for key in self.dataset if key.startswith(self.prefix)])
+
+    def __len__(self):
+        return len([key for key in self.dataset if key.startswith(self.prefix)])
+
+
+def extract_submodule_state(params, prefix: str) -> dict:
+    """Flat {path: leaf} for every param under a block prefix."""
+    from ..parallel.sharding import tree_paths_and_leaves
+
+    flat, _ = tree_paths_and_leaves(params)
+    return {path: leaf for path, leaf in flat if path.startswith(prefix)}
