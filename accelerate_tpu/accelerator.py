@@ -361,7 +361,9 @@ class Accelerator:
 
     @staticmethod
     def _is_model(obj) -> bool:
-        return isinstance(obj, (Model, PreparedModel))
+        from .parallel.pipeline import PipelinedModel
+
+        return isinstance(obj, (Model, PreparedModel, PipelinedModel))
 
     @staticmethod
     def _is_optimizer(obj) -> bool:
@@ -396,7 +398,10 @@ class Accelerator:
     def prepare_model(self, model: Union[Model, PreparedModel], device_placement=None, evaluation_mode=False):
         """Place a model on the mesh with derived shardings
         (reference prepare_model accelerator.py:1316)."""
-        if isinstance(model, PreparedModel):
+        from .parallel.pipeline import PipelinedModel
+
+        if isinstance(model, (PreparedModel, PipelinedModel)):
+            # Already placed (PipelinedModel is stage-sharded at construction).
             if model not in self._models:
                 self._models.append(model)
             return model

@@ -1,0 +1,99 @@
+"""Pipeline-parallel inference (reference inference.py — PiPPy integration).
+
+The reference fx-traces the model into stages (`Pipe.from_tracing`, reference
+inference.py:168-172), places one stage per rank, and moves activations with c10d
+send/recv; batches are chunked and padded (`pad_input_tensors`, reference
+inference.py:101-123). Here the same user surface sits on the TPU-native pipeline
+(parallel/pipeline.py): stages live on the "stage" mesh axis, activation hops are
+`lax.ppermute` over ICI inside one jitted SPMD program, and "tracing" is replaced by the
+`LayeredApply` stage decomposition the model families ship.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .state import AcceleratorState
+from .utils.operations import pad_input_tensors
+
+
+class PipelineInferencer:
+    """Callable wrapper: pads + chunks the batch, runs the pipelined forward, and
+    truncates the padding back off (reference `pippy_forward` inference.py:96-123)."""
+
+    def __init__(self, pipelined, mesh, num_microbatches: int):
+        self.pipelined = pipelined
+        self.mesh = mesh
+        self.num_microbatches = num_microbatches
+        self._divisor = (
+            mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1) * num_microbatches
+        )
+
+    def __call__(self, batch):
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(batch)
+        if not leaves:
+            raise ValueError("Empty batch")
+        n = leaves[0].shape[0]
+        padded_n = math.ceil(n / self._divisor) * self._divisor
+        if padded_n != n:
+            batch = pad_input_tensors(batch, n, self._divisor)
+        out = self.pipelined(batch)
+        if padded_n != n:
+            out = jax.tree_util.tree_map(lambda x: x[:n], out)
+        return out
+
+    @property
+    def params(self):
+        return self.pipelined.params
+
+
+def prepare_pippy(
+    model,
+    layered=None,
+    num_microbatches: Optional[int] = None,
+    mesh=None,
+    compute_dtype=None,
+    batch_to_args: Optional[Callable] = None,
+) -> PipelineInferencer:
+    """Stage-shard a model for pipelined inference (reference prepare_pippy
+    inference.py:126; the name is kept for drop-in familiarity).
+
+    Args:
+        model: a `Model` bundle (accelerate_tpu.modeling).
+        layered: the model's `LayeredApply` stage decomposition; defaults to
+            `model.module.layered_apply()` when the flax module provides one.
+        num_microbatches: batch chunks in flight (reference `num_chunks`, defaults to
+            the number of pipeline stages — one chunk per stage).
+        mesh: defaults to the active AcceleratorState mesh (must have a "stage" axis >1
+            to actually pipeline; with stage=1 this degrades to plain chunked forward).
+    """
+    from .parallel.pipeline import PipelinedModel
+
+    if mesh is None:
+        mesh = AcceleratorState().mesh
+    if layered is None:
+        module = getattr(model, "module", None)
+        maker = getattr(module, "layered_apply", None)
+        if maker is None:
+            raise ValueError(
+                "Pass layered= (a LayeredApply stage decomposition); this model's module "
+                "does not provide one."
+            )
+        layered = maker()
+    if num_microbatches is None:
+        num_microbatches = max(2, mesh.shape.get("stage", 1))
+    pipelined = PipelinedModel(
+        model,
+        layered,
+        mesh,
+        num_microbatches=num_microbatches,
+        compute_dtype=compute_dtype,
+        batch_to_args=batch_to_args,
+        remat=False,  # inference: nothing to rematerialize for
+    )
+    return PipelineInferencer(pipelined, mesh, num_microbatches)

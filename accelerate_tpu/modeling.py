@@ -107,13 +107,15 @@ class PreparedModel:
         self.autocast_enabled = autocast and compute_dtype is not None
         self._jit_cache: dict = {}
 
+        from .parallel.sharding import place_params
+
         params = model.params
         if param_sharding is not None:
-            params = jax.device_put(params, param_sharding)
+            params = place_params(params, param_sharding)
         elif mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            params = jax.device_put(params, NamedSharding(mesh, PartitionSpec()))
+            params = place_params(params, jax.tree_util.tree_map(lambda _: NamedSharding(mesh, PartitionSpec()), params))
         self.params = params
         self._rng = jax.random.key(np.random.randint(0, 2**31 - 1))
 
@@ -166,10 +168,12 @@ class PreparedModel:
         return self.params
 
     def load_state_dict(self, params):
-        import jax
+        from .parallel.sharding import place_params
 
+        # place_params (not device_put): loaded buffers must not alias the caller's
+        # arrays — the optimizer's donated update deletes ours every step.
         if self.param_sharding is not None:
-            params = jax.device_put(params, self.param_sharding)
+            params = place_params(params, self.param_sharding)
         self.params = params
 
     # -- introspection -----------------------------------------------------------------

@@ -1,1 +1,2 @@
 from .mesh import build_mesh, get_default_mesh, mesh_axis_size
+from .pipeline import PipelinedModel, prepare_pipeline

@@ -1,0 +1,409 @@
+"""Pipeline parallelism over the "stage" mesh axis.
+
+The reference reaches pipeline parallelism only through external native runtimes:
+Megatron-LM's 1F1B schedule for training (reference utils/megatron_lm.py:1004-1010) and
+PiPPy's fx-traced stages + c10d send/recv for inference (reference inference.py:126).
+Here PP is in-tree and TPU-native: stages live on the "stage" axis of the one global
+mesh, activations hop between stages with `lax.ppermute` over ICI, and the microbatch
+schedule is a `lax.scan` over pipeline ticks inside one jitted SPMD program — XLA
+overlaps each stage's matmuls with the neighbor DMA, and autodiff through the scan
+produces the backward schedule (GPipe-style, rematerialized per tick so activation
+memory stays O(microbatches), not O(microbatches × layers)).
+
+Layout: a model's stack decomposes via the `LayeredApply` protocol
+(accelerate_tpu.big_modeling) into prelude / N homogeneous layers / tail. Layer params
+are stacked on a leading [L] axis sharded over "stage" (each stage holds L/S layers and
+scans them locally); prelude and tail are replicated — only their owning stage computes
+them (a `lax.cond` gates the FLOPs) and shard_map's transpose inserts the psum that
+makes their gradients globally correct.
+
+Schedule: tick t ∈ [0, M+S-1): stage 0 injects microbatch min(t, M-1), every stage runs
+its local layer chunk, the last stage folds microbatch t-(S-1) into the loss, and the
+carry rotates +1 stage. Injections after t=M-1 are duplicates that never reach the tail
+inside the loop — they occupy the same slots the pipeline bubble would leave idle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+# Path rules consumed by parallel/sharding.py: stacked layer params (and their optimizer
+# moments, whose paths nest under e.g. "0/mu/layers/...") shard dim 0 over "stage".
+PIPELINE_SHARDING_RULES = [(r"(^|/)layers(/|$)", ("stage",))]
+
+
+def _shard_map():
+    from jax import shard_map
+
+    return shard_map
+
+
+def stack_layer_params(layers):
+    """Stack a list of per-layer param pytrees into one pytree with leading [L] axes."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def unstack_layer_params(stacked, num_layers: int):
+    import jax
+
+    return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(num_layers)]
+
+
+def default_causal_lm_logits_loss(logits, batch):
+    """Shifted next-token cross-entropy on a microbatch, as a `(loss_sum, weight)` pair
+    (mirrors models.llama.causal_lm_loss but from logits — the tail output — instead of
+    params). Returning the unnormalized pair lets the pipeline produce the globally
+    token-weighted mean even when label masking is uneven across microbatches/shards."""
+    import jax
+    import jax.numpy as jnp
+
+    labels = batch.get("labels", batch["input_ids"])
+    shift_logits = logits[:, :-1].astype(jnp.float32)
+    shift_labels = labels[:, 1:]
+    logp = jax.nn.log_softmax(shift_logits, axis=-1)
+    valid = (shift_labels >= 0).astype(jnp.float32)
+    safe_labels = jnp.maximum(shift_labels, 0)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    return (nll * valid).sum(), valid.sum()
+
+
+def _default_batch_to_args(batch):
+    if isinstance(batch, dict):
+        return (batch["input_ids"], batch.get("attention_mask"))
+    return (batch,)
+
+
+from ..modeling import _cast_floating
+
+
+class PipelineSpec:
+    """Stage functions for one model: an adapter over the `LayeredApply` protocol plus a
+    logits-level loss. This is the PiPPy `Pipe.from_tracing` replacement — models declare
+    their stage decomposition instead of being fx-traced."""
+
+    def __init__(
+        self,
+        layered,
+        loss_on_logits: Optional[Callable] = None,
+        batch_to_args: Optional[Callable] = None,
+    ):
+        self.layered = layered
+        self.loss_on_logits = loss_on_logits or default_causal_lm_logits_loss
+        self.batch_to_args = batch_to_args or _default_batch_to_args
+
+    def prelude(self, prelude_params, batch):
+        return self.layered.apply_prelude(prelude_params, *self.batch_to_args(batch))
+
+    def layer(self, layer_params, carry):
+        return self.layered.apply_layer(layer_params, carry)
+
+    def tail(self, tail_params, carry):
+        return self.layered.apply_tail(tail_params, carry)
+
+
+def _split_microbatches(batch, num_microbatches: int):
+    import jax
+
+    def _split(x):
+        if x.shape[0] % num_microbatches != 0:
+            raise ValueError(
+                f"Local batch {x.shape[0]} not divisible by num_microbatches={num_microbatches}"
+            )
+        return x.reshape((num_microbatches, x.shape[0] // num_microbatches) + x.shape[1:])
+
+    return jax.tree_util.tree_map(_split, batch)
+
+
+def _build_local_fns(spec: PipelineSpec, num_microbatches: int, compute_dtype=None, remat: bool = True):
+    """The per-device (shard_map-level) pipelined loss and forward."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    M = num_microbatches
+
+    layer_fn = spec.layer
+    if remat:
+        layer_fn = jax.checkpoint(spec.layer)
+
+    def _prep(params, batch):
+        if compute_dtype is not None:
+            params = _cast_floating(params, compute_dtype)
+            batch = _cast_floating(batch, compute_dtype)
+        return params, batch
+
+    def _index_mb(mbs, i):
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False), mbs
+        )
+
+    def _pipeline_scan(params, batch, fold_output):
+        """Runs the tick scan; `fold_output(acc, x, out_mb, valid)` folds the last
+        stage's carry for in-range microbatches into an accumulator."""
+        prelude_p, layers_p, tail_p = params["prelude"], params["layers"], params["tail"]
+        S = lax.axis_size("stage")
+        idx = lax.axis_index("stage")
+        mbs = _split_microbatches(batch, M)
+        mb0 = _index_mb(mbs, jnp.int32(0))
+        carry_struct = jax.eval_shape(spec.prelude, prelude_p, mb0)
+        state0 = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), carry_struct)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, acc = carry
+            mb = _index_mb(mbs, jnp.clip(t, 0, M - 1))
+            # Only stage 0 pays the prelude FLOPs; everyone else keeps the carry it
+            # received last tick.
+            x = lax.cond(idx == 0, lambda s: spec.prelude(prelude_p, mb), lambda s: s, state)
+
+            def scan_layer(h, lp):
+                return layer_fn(lp, h), None
+
+            x, _ = lax.scan(scan_layer, x, layers_p)
+            out_i = jnp.clip(t - (S - 1), 0, M - 1)
+            out_mb = _index_mb(mbs, out_i)
+            valid = jnp.logical_and(t >= S - 1, idx == S - 1)
+            acc = fold_output(acc, tail_p, x, out_mb, out_i, valid)
+            state = jax.tree_util.tree_map(lambda a: lax.ppermute(a, "stage", perm), x)
+            return (state, acc), None
+
+        return lax.scan, tick, state0, S
+
+    def _loss_pair(tail_p, carry, mb):
+        """Normalize loss_on_logits output to a (loss_sum, weight) pair: fns returning a
+        plain scalar (a microbatch mean) get weight 1 — equal-weight averaging; pair
+        returns give exact token-weighted parity with the unpipelined loss."""
+        out = spec.loss_on_logits(spec.tail(tail_p, carry), mb)
+        if isinstance(out, tuple):
+            s, w = out
+            return s.astype(jnp.float32), w.astype(jnp.float32)
+        return out.astype(jnp.float32), jnp.float32(1.0)
+
+    def local_loss(params, batch):
+        params, batch = _prep(params, batch)
+
+        def fold(acc, tail_p, x, out_mb, out_i, valid):
+            # Only the last stage pays the tail (lm_head) FLOPs.
+            s, w = lax.cond(
+                valid,
+                lambda c: _loss_pair(tail_p, c, out_mb),
+                lambda c: (jnp.float32(0.0), jnp.float32(0.0)),
+                x,
+            )
+            return (acc[0] + s, acc[1] + w)
+
+        scan, tick, state0, S = _pipeline_scan(params, batch, fold)
+        (final_state, (loss_sum, weight)), _ = scan(
+            tick, (state0, (jnp.float32(0.0), jnp.float32(0.0))), jnp.arange(M + S - 1)
+        )
+        axes = ("stage", "data", "fsdp")
+        loss_sum = lax.psum(loss_sum, axes)
+        weight = lax.psum(weight, axes)
+        return loss_sum / jnp.maximum(weight, 1e-9)
+
+    def local_forward(params, batch):
+        params, batch = _prep(params, batch)
+        prelude_p, tail_p = params["prelude"], params["tail"]
+        mbs = _split_microbatches(batch, M)
+        mb0 = _index_mb(mbs, np.int32(0))
+        carry_struct = jax.eval_shape(spec.prelude, prelude_p, mb0)
+        out_struct = jax.eval_shape(spec.tail, tail_p, carry_struct)
+        buf0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros((M,) + s.shape, s.dtype), out_struct
+        )
+
+        def fold(buf, tail_p, x, out_mb, out_i, valid):
+            out = lax.cond(
+                valid,
+                lambda c: spec.tail(tail_p, c),
+                lambda c: jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), out_struct),
+                x,
+            )
+            return jax.tree_util.tree_map(
+                lambda b, o: lax.cond(
+                    valid,
+                    lambda args: lax.dynamic_update_index_in_dim(args[0], args[1], out_i, 0),
+                    lambda args: args[0],
+                    (b, o),
+                ),
+                buf,
+                out,
+            )
+
+        scan, tick, state0, S = _pipeline_scan(params, batch, fold)
+        (final_state, buf), _ = scan(tick, (state0, buf0), jnp.arange(M + S - 1))
+        # Outputs live on the last stage only; psum broadcasts them (zeros elsewhere).
+        buf = jax.tree_util.tree_map(lambda b: lax.psum(b, "stage"), buf)
+        return jax.tree_util.tree_map(lambda b: b.reshape((-1,) + b.shape[2:]), buf)
+
+    return local_loss, local_forward
+
+
+class PipelinedModel:
+    """A model placed on the mesh's "stage" axis, quacking like `PreparedModel` so it
+    slots into `Accelerator.backward`/`AcceleratedOptimizer` unchanged.
+
+    params = {"prelude": replicated, "layers": [L, ...] stacked & stage-sharded,
+    "tail": replicated}. `loss(params, batch)` is the pipelined scan; `__call__(batch)`
+    is the pipelined forward returning logits.
+    """
+
+    is_pipelined = True
+
+    def __init__(
+        self,
+        model,
+        layered,
+        mesh,
+        num_microbatches: int = 4,
+        loss_on_logits: Optional[Callable] = None,
+        batch_to_args: Optional[Callable] = None,
+        compute_dtype=None,
+        autocast: bool = True,
+        remat: bool = True,
+    ):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if mesh.shape.get("model", 1) > 1 or mesh.shape.get("seq", 1) > 1:
+            raise NotImplementedError(
+                "Pipeline parallelism currently composes with data/fsdp axes only "
+                "(tp/sp inside pipeline stages needs manual-collective layers)."
+            )
+        self.mesh = mesh
+        self.module = getattr(model, "module", None)
+        self.layered = layered
+        self.compute_dtype = compute_dtype
+        self.autocast_enabled = autocast and compute_dtype is not None
+        self.num_microbatches = num_microbatches
+        self.sharding_rules = PIPELINE_SHARDING_RULES
+        self.spec = PipelineSpec(layered, loss_on_logits, batch_to_args)
+
+        prelude, layers, tail = layered.split(model.params)
+        self.num_layers = len(layers)
+        n_stages = mesh.shape["stage"]
+        if self.num_layers % n_stages != 0:
+            raise ValueError(
+                f"{self.num_layers} layers not divisible by {n_stages} pipeline stages"
+            )
+        stacked = stack_layer_params(layers)
+        self.param_sharding = {
+            "prelude": jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), prelude),
+            "layers": jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P("stage")), stacked),
+            "tail": jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), tail),
+        }
+        from .sharding import place_params
+
+        self.params = place_params(
+            {"prelude": prelude, "layers": stacked, "tail": tail}, self.param_sharding
+        )
+
+        local_loss, local_forward = _build_local_fns(
+            self.spec,
+            num_microbatches,
+            compute_dtype=compute_dtype if self.autocast_enabled else None,
+            remat=remat,
+        )
+        from .sharding import data_spec as _data_spec
+
+        shard_map = _shard_map()
+        data_spec = _data_spec(mesh)
+        param_specs = {
+            "prelude": P(),
+            "layers": P("stage"),
+            "tail": P(),
+        }
+        # check_vma off: the scan carry deliberately mixes device-varying values (the
+        # rotating activations) with unvarying zeros at t=0, which the VMA type system
+        # rejects; correctness is covered by the parity tests.
+        smap_kwargs = dict(mesh=mesh, in_specs=(param_specs, data_spec), check_vma=False)
+        self._loss_fn = shard_map(local_loss, out_specs=P(), **smap_kwargs)
+        self._forward_fn = shard_map(local_forward, out_specs=data_spec, **smap_kwargs)
+        self._jit_forward = None
+        # Accelerator.autocast toggles clear this on every registered model; the
+        # pipeline's compute dtype is baked into the shard_map fns at construction, so
+        # clearing it is a harmless no-op here.
+        self._jit_cache: dict = {}
+
+    # -- PreparedModel-compatible surface ---------------------------------------------
+    def loss(self, params, batch):
+        """Differentiable pipelined loss — the canonical argument to Accelerator.backward."""
+        return self._loss_fn(params, batch)
+
+    def __call__(self, batch):
+        import jax
+
+        if self._jit_forward is None:
+            self._jit_forward = jax.jit(self._forward_fn)
+        return self._jit_forward(self.params, batch)
+
+    def eval_apply(self, batch):
+        return self(batch)
+
+    def state_dict(self):
+        return self.params
+
+    def load_state_dict(self, params):
+        from .sharding import place_params
+
+        # place_params (not device_put): loaded buffers must not alias the caller's
+        # arrays — the optimizer's donated update deletes ours every step.
+        self.params = place_params(params, self.param_sharding)
+
+    def merged_params(self):
+        """Params back in the original (unstacked) model layout — for saving checkpoints
+        interchangeable with the non-pipelined model."""
+        layers = unstack_layer_params(self.params["layers"], self.num_layers)
+        return self.layered.join(self.params["prelude"], layers, self.params["tail"])
+
+    @property
+    def num_parameters(self) -> int:
+        import jax
+
+        return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self.params))
+
+    def __repr__(self):
+        return (
+            f"PipelinedModel(layers={self.num_layers}, stages={self.mesh.shape['stage']}, "
+            f"microbatches={self.num_microbatches}, params={self.num_parameters:,})"
+        )
+
+
+def prepare_pipeline(
+    model,
+    layered,
+    mesh=None,
+    num_microbatches: int = 4,
+    loss_on_logits: Optional[Callable] = None,
+    batch_to_args: Optional[Callable] = None,
+    compute_dtype=None,
+    remat: bool = True,
+) -> PipelinedModel:
+    """Build a PipelinedModel from a Model bundle + its LayeredApply decomposition
+    (the user-facing PP entry, Megatron `pp_degree` / PiPPy `prepare_pippy` parity)."""
+    from ..state import AcceleratorState
+
+    if mesh is None:
+        mesh = AcceleratorState().mesh
+    if compute_dtype is None:
+        # Inherit the Accelerator's mixed-precision policy (prepare_model parity —
+        # accelerator.py sets compute_dtype from state for non-pipelined models).
+        shared = AcceleratorState._shared_state
+        if shared and shared.get("_mixed_precision") in ("bf16", "fp16", "fp8"):
+            compute_dtype = AcceleratorState().compute_dtype
+    return PipelinedModel(
+        model,
+        layered,
+        mesh,
+        num_microbatches=num_microbatches,
+        loss_on_logits=loss_on_logits,
+        batch_to_args=batch_to_args,
+        compute_dtype=compute_dtype,
+        remat=remat,
+    )
