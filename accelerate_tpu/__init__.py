@@ -7,6 +7,7 @@ from .modeling import Model, PreparedModel
 from .optimizer import AcceleratedOptimizer, GradScaler
 from .scheduler import AcceleratedScheduler
 from .data_loader import SimpleDataLoader, prepare_data_loader, skip_first_batches
+from .local_sgd import LocalSGD
 from .tracking import GeneralTracker
 from .utils import (
     DataLoaderConfiguration,

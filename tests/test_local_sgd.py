@@ -1,0 +1,124 @@
+"""LocalSGD tests (reference local_sgd.py:19-102 contract, TPU-native mechanism).
+
+Key invariant exploited for exactness: with `local_sgd_steps=1`, each replica takes one
+step on its local gradient and the params are immediately averaged —
+mean_i(p - lr*g_i) = p - lr*mean_i(g_i) — which equals plain synced-DP SGD exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator, LocalSGD, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+from test_training import make_regression_data, make_regression_model
+
+
+def _reset():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _run(local_sgd_steps=None, n=64, batch=16, lr=0.05):
+    _reset()
+    accelerator = Accelerator()
+    model = make_regression_model(seed=0)
+    dl = SimpleDataLoader(make_regression_data(n, seed=7), BatchSampler(range(n), batch))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(lr), dl)
+    losses = []
+    if local_sgd_steps is None:
+        for batch_ in pdl:
+            loss = accelerator.backward(pmodel.loss, batch_)
+            popt.step()
+            popt.zero_grad()
+            losses.append(float(loss))
+        return losses, pmodel.params
+    with LocalSGD(accelerator=accelerator, model=pmodel, local_sgd_steps=local_sgd_steps) as local_sgd:
+        for batch_ in pdl:
+            loss = accelerator.backward(pmodel.loss, batch_)
+            popt.step()
+            popt.zero_grad()
+            local_sgd.step()
+            losses.append(float(loss))
+    return losses, pmodel.params
+
+
+def test_local_sgd_k1_matches_synced_dp():
+    """K=1 LocalSGD (avg after every local step) must equal plain DP training exactly."""
+    losses_dp, params_dp = _run(local_sgd_steps=None)
+    losses_k1, params_k1 = _run(local_sgd_steps=1)
+    np.testing.assert_allclose(np.array(losses_k1), np.array(losses_dp), rtol=2e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(params_k1), jax.tree_util.tree_leaves(params_dp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6)
+
+
+def test_local_sgd_exit_restores_shapes_and_loss():
+    """On exit the replica axis is gone and the model trains normally again."""
+    _reset()
+    accelerator = Accelerator()
+    model = make_regression_model(seed=0)
+    dl = SimpleDataLoader(make_regression_data(32, seed=2), BatchSampler(range(32), 8))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+    orig_shapes = jax.tree_util.tree_map(lambda x: x.shape, pmodel.params)
+    with LocalSGD(accelerator=accelerator, model=pmodel, local_sgd_steps=2) as local_sgd:
+        for batch_ in pdl:
+            accelerator.backward(pmodel.loss, batch_)
+            popt.step()
+            popt.zero_grad()
+            local_sgd.step()
+        if local_sgd.enabled:
+            # mid-context: params carry the leading replica axis
+            lead = jax.tree_util.tree_leaves(pmodel.params)[0]
+            assert lead.shape[0] == local_sgd.dp
+    assert jax.tree_util.tree_map(lambda x: x.shape, pmodel.params) == orig_shapes
+    # trains fine post-exit
+    for batch_ in pdl:
+        loss = accelerator.backward(pmodel.loss, batch_)
+        popt.step()
+        popt.zero_grad()
+    assert np.isfinite(float(loss))
+
+
+def test_local_sgd_replicas_diverge_then_converge():
+    """Between syncs replica rows differ; at the K-step boundary they are equal."""
+    _reset()
+    accelerator = Accelerator()
+    model = make_regression_model(seed=0)
+    n, batch = 64, 16
+    dl = SimpleDataLoader(make_regression_data(n, seed=9), BatchSampler(range(n), batch))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+    with LocalSGD(accelerator=accelerator, model=pmodel, local_sgd_steps=2) as local_sgd:
+        if not local_sgd.enabled:
+            pytest.skip("needs >1 data-parallel device")
+        it = iter(pdl)
+        accelerator.backward(pmodel.loss, next(it))
+        popt.step()
+        popt.zero_grad()
+        local_sgd.step()  # step 1: no sync yet
+        kernel = np.asarray(jax.tree_util.tree_leaves(pmodel.params)[0])
+        assert not np.allclose(kernel[0], kernel[1])
+        accelerator.backward(pmodel.loss, next(it))
+        popt.step()
+        popt.zero_grad()
+        local_sgd.step()  # step 2: sync boundary
+        kernel = np.asarray(jax.tree_util.tree_leaves(pmodel.params)[0])
+        for r in range(1, kernel.shape[0]):
+            np.testing.assert_allclose(kernel[0], kernel[r], rtol=1e-6, atol=1e-7)
+
+
+def test_local_sgd_rejects_model_sharding():
+    from accelerate_tpu.utils import ParallelismConfig
+
+    _reset()
+    accelerator = Accelerator(parallelism_config=ParallelismConfig(data=1, fsdp=8))
+    model = make_regression_model(seed=0)
+    dl = SimpleDataLoader(make_regression_data(32), BatchSampler(range(32), 8))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+    with pytest.raises(NotImplementedError):
+        LocalSGD(accelerator=accelerator, model=pmodel, local_sgd_steps=2)
