@@ -116,6 +116,10 @@ class PreparedModel:
             from jax.sharding import NamedSharding, PartitionSpec
 
             params = place_params(params, jax.tree_util.tree_map(lambda _: NamedSharding(mesh, PartitionSpec()), params))
+        else:
+            # Still copy: the donated optimizer update would otherwise delete the
+            # user's original arrays through the alias.
+            params = place_params(params)
         self.params = params
         self._rng = jax.random.key(np.random.randint(0, 2**31 - 1))
 
@@ -172,9 +176,7 @@ class PreparedModel:
 
         # place_params (not device_put): loaded buffers must not alias the caller's
         # arrays — the optimizer's donated update deletes ours every step.
-        if self.param_sharding is not None:
-            params = place_params(params, self.param_sharding)
-        self.params = params
+        self.params = place_params(params, self.param_sharding)
 
     # -- introspection -----------------------------------------------------------------
     @property

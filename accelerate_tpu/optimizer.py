@@ -264,11 +264,11 @@ class AcceleratedOptimizer:
         return {"opt_state": self.opt_state, "scaler": self.scaler.state_dict() if self.scaler else None}
 
     def load_state_dict(self, state):
-        import jax
+        from .parallel.sharding import place_params
 
-        opt_state = state["opt_state"]
-        if self.opt_state_sharding is not None:
-            opt_state = jax.device_put(opt_state, self.opt_state_sharding)
-        self.opt_state = opt_state
+        # place_params (not device_put): device_put aliases buffers already placed
+        # correctly, and the donated update would delete the caller's arrays through
+        # that alias on the next step.
+        self.opt_state = place_params(state["opt_state"], self.opt_state_sharding)
         if self.scaler is not None and state.get("scaler") is not None:
             self.scaler.load_state_dict(state["scaler"])

@@ -55,6 +55,51 @@ def unstack_layer_params(stacked, num_layers: int):
     return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(num_layers)]
 
 
+def _dict_path_get(tree, path):
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def _dict_path_set(tree, path, value):
+    """Copy-on-write set: returns a new nested dict with `path` replaced by `value`,
+    creating intermediate dicts as needed (tied paths are pruned from the stored tail)."""
+    if not path:
+        return value
+    out = dict(tree)
+    out[path[0]] = _dict_path_set(tree.get(path[0], {}), path[1:], value)
+    return out
+
+
+def _dict_path_del(tree, path):
+    out = dict(tree)
+    if len(path) == 1:
+        del out[path[0]]
+        return out
+    out[path[0]] = _dict_path_del(tree[path[0]], path[1:])
+    if not out[path[0]]:
+        del out[path[0]]
+    return out
+
+
+def find_tied_leaves(prelude, tail):
+    """Tail leaves sharing a buffer with a prelude leaf (tied weights, e.g. a tied
+    lm head — reference finds these via data_ptr maps, utils/modeling.py:606). Returns
+    [(tail_path, prelude_path)] with paths as tuples of dict keys."""
+    import jax
+
+    def _paths(tree):
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        return [(tuple(getattr(k, "key", k) for k in path), leaf) for path, leaf in flat]
+
+    prelude_by_id = {id(leaf): path for path, leaf in _paths(prelude)}
+    return [
+        (path, prelude_by_id[id(leaf)])
+        for path, leaf in _paths(tail)
+        if id(leaf) in prelude_by_id
+    ]
+
+
 def default_causal_lm_logits_loss(logits, batch):
     """Shifted next-token cross-entropy on a microbatch, as a `(loss_sum, weight)` pair
     (mirrors models.llama.causal_lm_loss but from logits — the tail output — instead of
@@ -292,17 +337,34 @@ class PipelinedModel:
             raise ValueError(
                 f"{self.num_layers} layers not divisible by {n_stages} pipeline stages"
             )
-        stacked = stack_layer_params(layers)
+        # Tied weights (e.g. embed_tokens reused by a tied lm head) appear in both the
+        # prelude and the tail after split. Store them ONCE (in the prelude) and
+        # re-inject the prelude's copy into the tail view inside the differentiated
+        # functions — otherwise the two copies would receive independent partial
+        # gradients and silently diverge under the optimizer.
+        self._ties = find_tied_leaves(prelude, tail)
+        for tail_path, _ in self._ties:
+            tail = _dict_path_del(tail, tail_path)
+        # Stack the per-layer pytrees directly into stage-sharded buffers: jitting the
+        # stack with sharded out_shardings keeps each device to its own [L/S, ...]
+        # slice instead of materializing the full stacked model on one device.
+        stacked_struct = jax.eval_shape(stack_layer_params, layers)
+        layers_sharding = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P("stage")), stacked_struct
+        )
+        stacked = jax.jit(stack_layer_params, out_shardings=layers_sharding)(layers)
         self.param_sharding = {
             "prelude": jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), prelude),
-            "layers": jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P("stage")), stacked),
+            "layers": layers_sharding,
             "tail": jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), tail),
         }
         from .sharding import place_params
 
-        self.params = place_params(
-            {"prelude": prelude, "layers": stacked, "tail": tail}, self.param_sharding
+        placed = place_params(
+            {"prelude": prelude, "tail": tail},
+            {"prelude": self.param_sharding["prelude"], "tail": self.param_sharding["tail"]},
         )
+        self.params = {"prelude": placed["prelude"], "layers": stacked, "tail": placed["tail"]}
 
         local_loss, local_forward = _build_local_fns(
             self.spec,
@@ -323,8 +385,24 @@ class PipelinedModel:
         # rotating activations) with unvarying zeros at t=0, which the VMA type system
         # rejects; correctness is covered by the parity tests.
         smap_kwargs = dict(mesh=mesh, in_specs=(param_specs, data_spec), check_vma=False)
-        self._loss_fn = shard_map(local_loss, out_specs=P(), **smap_kwargs)
-        self._forward_fn = shard_map(local_forward, out_specs=data_spec, **smap_kwargs)
+
+        def _with_ties(fn):
+            if not self._ties:
+                return fn
+            ties = self._ties
+
+            def inner(params, batch):
+                tail = params["tail"]
+                for tail_path, prelude_path in ties:
+                    tail = _dict_path_set(
+                        tail, tail_path, _dict_path_get(params["prelude"], prelude_path)
+                    )
+                return fn({**params, "tail": tail}, batch)
+
+            return inner
+
+        self._loss_fn = shard_map(_with_ties(local_loss), out_specs=P(), **smap_kwargs)
+        self._forward_fn = shard_map(_with_ties(local_forward), out_specs=data_spec, **smap_kwargs)
         self._jit_forward = None
         # Accelerator.autocast toggles clear this on every registered model; the
         # pipeline's compute dtype is baked into the shard_map fns at construction, so

@@ -156,17 +156,19 @@ def derive_opt_state_shardings(opt_state_shapes, mesh, fsdp_plugin=None, rules=N
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def place_params(tree, shardings):
+def place_params(tree, shardings=None):
     """Place a param pytree onto the mesh with GUARANTEED fresh buffers.
 
     `jax.device_put` aliases the source buffer when a shard lands where the input
     already lives (even with may_alias=False) — and the optimizer's donated update
     deletes prepared buffers every step, which would tear down the user's original
     arrays through the alias. A non-donating jit identity always materializes new
-    output buffers.
+    output buffers. `shardings=None` keeps default placement but still copies.
     """
     import jax
 
+    if shardings is None:
+        return jax.jit(lambda t: t)(tree)
     return jax.jit(lambda t: t, out_shardings=shardings)(tree)
 
 

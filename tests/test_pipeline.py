@@ -164,3 +164,34 @@ def test_pipeline_loss_token_weighted_with_uneven_masking():
     pp = PipelinedModel(model, LlamaLayeredApply(cfg), mesh, num_microbatches=2)
     pp_loss = jax.jit(pp.loss)(pp.params, batch)
     np.testing.assert_allclose(float(pp_loss), float(ref_loss), rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_tied_embeddings_grads_match_reference():
+    """Tied lm head: the tied weight is stored once (prelude) and its gradient must be
+    the SUM of the embedding-lookup and lm-head contributions, exactly as in the
+    unpipelined model."""
+    mesh = build_mesh(ParallelismConfig(stage=4, data=2))
+    cfg = _tiny_cfg()
+    cfg = LlamaConfig(**{**cfg.__dict__, "tie_word_embeddings": True})
+    model = create_llama_model(cfg, seq_len=16)
+    batch = _batch()
+    layered = LlamaLayeredApply(cfg)
+    pp = PipelinedModel(model, layered, mesh, num_microbatches=2)
+
+    # the tied weight lives only in the prelude
+    assert "embed_tokens" not in pp.params["tail"].get("params", {})
+
+    ref_loss = causal_lm_loss(model.params, batch, model.apply_fn)
+    pp_loss = jax.jit(pp.loss)(pp.params, batch)
+    np.testing.assert_allclose(float(pp_loss), float(ref_loss), rtol=1e-5, atol=1e-5)
+
+    ref_grads = jax.grad(lambda p: causal_lm_loss(p, batch, model.apply_fn))(model.params)
+    pp_grads = jax.jit(jax.grad(lambda p: pp.loss(p, batch)))(pp.params)
+
+    ref_embed = np.asarray(ref_grads["params"]["embed_tokens"]["embedding"])
+    pp_embed = np.asarray(pp_grads["prelude"]["params"]["embed_tokens"]["embedding"])
+    np.testing.assert_allclose(pp_embed, ref_embed, rtol=5e-4, atol=5e-4)
+
+    # merged layout round-trips to the original structure
+    merged = pp.merged_params()
+    assert jax.tree_util.tree_structure(merged) == jax.tree_util.tree_structure(model.params)
