@@ -8,6 +8,7 @@ from .optimizer import AcceleratedOptimizer, GradScaler
 from .scheduler import AcceleratedScheduler
 from .data_loader import SimpleDataLoader, prepare_data_loader, skip_first_batches
 from .local_sgd import LocalSGD
+from .launchers import debug_launcher, notebook_launcher
 from .tracking import GeneralTracker
 from .utils import (
     DataLoaderConfiguration,

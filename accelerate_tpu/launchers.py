@@ -1,0 +1,139 @@
+"""Process launchers: `notebook_launcher` and `debug_launcher`
+(reference launchers.py:38-258).
+
+TPU-native redesign. The reference must fork 8 processes in a notebook because
+torch_xla drives one core per process (launchers.py:112-153, xmp.spawn); JAX is
+single-controller — one process drives every local chip through SPMD — so
+`notebook_launcher` validates the environment and calls the function in-process.
+
+`debug_launcher` keeps its reference role (launchers.py:225-258: N CPU processes with a
+gloo FileStore rendezvous) re-based on the JAX coordination service: it spawns N host
+processes, each pinned to the CPU platform with one virtual device, rendezvousing on a
+localhost coordinator with gloo cross-process CPU collectives. This is the multi-process
+test harness — the only way to exercise MULTI_HOST code paths without a pod.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import tempfile
+import traceback
+from typing import Callable
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _debug_worker(index: int, function, args, env: dict, error_dir: str):
+    """Child entry: install the env-var protocol BEFORE jax exists, then run."""
+    os.environ.update(env)
+    os.environ["ACCELERATE_TPU_PROCESS_ID"] = str(index)
+    os.environ["ACCELERATE_TPU_LOCAL_PROCESS_INDEX"] = str(index)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        function(*args)
+    except Exception:
+        with open(os.path.join(error_dir, f"rank{index}.err"), "w") as f:
+            f.write(traceback.format_exc())
+        sys.exit(1)
+
+
+def debug_launcher(function: Callable, args=(), num_processes: int = 2):
+    """Launch `function(*args)` in `num_processes` host processes on CPU, rendezvoused
+    through a localhost JAX coordinator (reference debug_launcher launchers.py:225-258).
+
+    Each child is a real `jax.process_index()` rank with one CPU device and working
+    cross-process collectives (gloo), so `PartialState` reports MULTI_HOST — the same
+    topology shape as a TPU pod slice.
+    """
+    import multiprocessing
+
+    port = _free_port()
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
+        "ACCELERATE_TPU_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "ACCELERATE_TPU_NUM_PROCESSES": str(num_processes),
+        "ACCELERATE_TPU_DEBUG_LAUNCHER": "1",
+    }
+    ctx = multiprocessing.get_context("spawn")
+    with tempfile.TemporaryDirectory() as error_dir:
+        procs = [
+            ctx.Process(target=_debug_worker, args=(i, function, args, env, error_dir))
+            for i in range(num_processes)
+        ]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join()
+        failed = [i for i, p in enumerate(procs) if p.exitcode != 0]
+        if failed:
+            msgs = []
+            for i in failed:
+                err_file = os.path.join(error_dir, f"rank{i}.err")
+                if os.path.exists(err_file):
+                    with open(err_file) as f:
+                        msgs.append(f"-- process {i} --\n{f.read()}")
+                else:
+                    msgs.append(f"-- process {i} -- exited with code {procs[i].exitcode}")
+            raise RuntimeError(
+                f"debug_launcher: {len(failed)}/{num_processes} processes failed:\n" + "\n".join(msgs)
+            )
+
+
+def notebook_launcher(
+    function: Callable,
+    args=(),
+    num_processes: int | None = None,
+    mixed_precision: str = "no",
+    use_port: str = "29500",
+    master_addr: str = "127.0.0.1",
+    node_rank: int = 0,
+    num_nodes: int = 1,
+):
+    """Run a training function from a notebook (reference notebook_launcher
+    launchers.py:38-223).
+
+    On TPU/GPU hosts JAX is single-controller, so the fork dance the reference does for
+    torch_xla (8 procs, start_method="fork") is unnecessary: all local chips are already
+    visible to this process and `function` runs here, in-process, under SPMD. Passing
+    `num_processes > 1` on a CPU-only host falls back to `debug_launcher` to simulate a
+    multi-host topology.
+    """
+    from .state import AcceleratorState, PartialState
+
+    if AcceleratorState._shared_state or PartialState._shared_state:
+        # Same guard as the reference (launchers.py:91-101): an Accelerator built
+        # before launching would have claimed devices/state in this process.
+        raise ValueError(
+            "An `Accelerator` (or `PartialState`) already exists in this process. "
+            "Restart the notebook kernel and call notebook_launcher before creating one."
+        )
+    if mixed_precision not in ("no", "fp16", "bf16", "fp8"):
+        raise ValueError(f"Unknown mixed_precision mode: {mixed_precision!r}")
+    os.environ["ACCELERATE_TPU_MIXED_PRECISION"] = mixed_precision
+
+    import jax
+
+    platform = jax.default_backend()
+    if platform == "cpu" and num_processes is not None and num_processes > 1:
+        logger.info("CPU platform: simulating %d processes via debug_launcher", num_processes)
+        return debug_launcher(function, args=args, num_processes=num_processes)
+    logger.info(
+        "Launching in-process on %d local %s device(s) (single-controller SPMD)",
+        jax.local_device_count(),
+        platform,
+    )
+    return function(*args)

@@ -18,6 +18,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow_launch: spawns real subprocesses (multi-process rendezvous tests)"
+    )
+
+
 @pytest.fixture(autouse=True)
 def reset_singletons():
     yield

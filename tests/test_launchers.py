@@ -1,0 +1,120 @@
+"""Launcher tests (reference launchers.py:38-258 contracts).
+
+`debug_launcher` children are real multi-process JAX ranks — module-level worker
+functions below get pickled into spawn children, so they must import cleanly.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from accelerate_tpu import debug_launcher, notebook_launcher
+
+
+def _topology_worker(out_dir):
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    state.wait_for_everyone()
+    with open(os.path.join(out_dir, f"rank{state.process_index}.json"), "w") as f:
+        json.dump(
+            {
+                "num_processes": state.num_processes,
+                "process_index": state.process_index,
+                "distributed_type": str(state.distributed_type),
+                "num_devices": state.num_devices,
+            },
+            f,
+        )
+    state.wait_for_everyone()
+
+
+def _failing_worker():
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    if state.process_index == 1:
+        raise RuntimeError("boom on rank 1")
+
+
+def _psum_worker(out_dir):
+    """Cross-process data-plane collective: psum over the 2-process CPU 'pod'."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    local = np.full((1, 4), float(state.process_index + 1), dtype=np.float32)
+    arr = jax.make_array_from_process_local_data(sharding, local)
+    total = jax.jit(lambda x: jnp.sum(x, axis=0), out_shardings=NamedSharding(mesh, P()))(arr)
+    if state.is_main_process:
+        with open(os.path.join(out_dir, "sum.json"), "w") as f:
+            json.dump(np.asarray(total).tolist(), f)
+    state.wait_for_everyone()
+
+
+@pytest.mark.slow_launch
+def test_debug_launcher_topology():
+    with tempfile.TemporaryDirectory() as out_dir:
+        debug_launcher(_topology_worker, args=(out_dir,), num_processes=2)
+        results = []
+        for i in range(2):
+            with open(os.path.join(out_dir, f"rank{i}.json")) as f:
+                results.append(json.load(f))
+        for i, r in enumerate(results):
+            assert r["num_processes"] == 2
+            assert r["process_index"] == i
+            assert "MULTI_HOST" in r["distributed_type"]
+            assert r["num_devices"] == 2
+
+
+@pytest.mark.slow_launch
+def test_debug_launcher_propagates_child_failure():
+    with pytest.raises(RuntimeError, match="boom on rank 1"):
+        debug_launcher(_failing_worker, num_processes=2)
+
+
+@pytest.mark.slow_launch
+def test_debug_launcher_cross_process_collective():
+    with tempfile.TemporaryDirectory() as out_dir:
+        debug_launcher(_psum_worker, args=(out_dir,), num_processes=2)
+        with open(os.path.join(out_dir, "sum.json")) as f:
+            total = json.load(f)
+        assert total == [3.0, 3.0, 3.0, 3.0]
+
+
+def test_notebook_launcher_runs_in_process():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    box = {}
+
+    def train(a, b):
+        import jax
+
+        box["devices"] = jax.local_device_count()
+        box["sum"] = a + b
+
+    notebook_launcher(train, args=(2, 3))
+    assert box["sum"] == 5
+    assert box["devices"] >= 1
+
+
+def test_notebook_launcher_rejects_existing_state():
+    from accelerate_tpu.state import PartialState
+
+    PartialState()  # claim state in this process
+    with pytest.raises(ValueError, match="already exists"):
+        notebook_launcher(lambda: None)
