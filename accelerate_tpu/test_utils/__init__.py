@@ -1,0 +1,16 @@
+"""In-package test utilities (parity: reference test_utils/ — testing.py, training.py,
+scripts/). Shipped inside the package so launched test scripts are importable
+post-install, exactly as the reference does (SURVEY §4.3)."""
+
+from .testing import (
+    AccelerateTestCase,
+    TempDirTestCase,
+    device_count,
+    execute_subprocess,
+    require_multi_device,
+    require_multi_process,
+    require_single_device,
+    require_tpu,
+    skip,
+)
+from .training import RegressionDataset, RegressionModel, regression_loss

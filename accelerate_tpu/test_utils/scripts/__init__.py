@@ -1,0 +1,4 @@
+"""Launched correctness scripts (parity: reference test_utils/scripts/ — test_script.py,
+test_sync.py, test_ops.py). Each has a `main()` so it can run as `python <script>` on
+any topology (single chip, the 8-device virtual CPU mesh, a pod slice) or be handed to
+`debug_launcher` for real multi-process coverage."""

@@ -1,0 +1,219 @@
+"""The "everything" end-to-end script (parity: reference test_utils/scripts/test_script.py,
+804 LoC): process control, RNG sync, dataloader preparation (default + dispatch mode),
+seedable-sampler determinism, `split_between_processes`, the trigger flag, and the core
+`training_check` — distributed training must match a single-device baseline
+loss-for-loss. Reused by the `accelerate-tpu test` CLI command."""
+
+import os
+import sys
+
+import numpy as np
+
+
+def init_state_check():
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    state.print(f"State: {state!r}")
+    assert state.num_processes >= 1
+    assert state.num_devices >= 1
+    return state
+
+
+def process_execution_check(state):
+    # on_main_process / ordering primitives must run and agree
+    ran = {}
+
+    @state.on_main_process
+    def mark():
+        ran["main"] = state.process_index
+
+    mark()
+    if state.is_main_process:
+        assert ran["main"] == 0
+    else:
+        assert "main" not in ran
+    with state.main_process_first():
+        pass
+    state.wait_for_everyone()
+
+
+def split_between_processes_check(state):
+    items = list(range(17))
+    with state.split_between_processes(items) as mine:
+        counts = state.num_processes
+        base, extra = divmod(17, counts)
+        expected_len = base + (1 if state.process_index < extra else 0)
+        assert len(mine) == expected_len, (len(mine), expected_len)
+    with state.split_between_processes(items, apply_padding=True) as mine:
+        base, extra = divmod(17, state.num_processes)
+        target = base + (1 if extra else 0)
+        assert len(mine) == target
+    with state.split_between_processes({"a": np.arange(8), "b": np.arange(8) * 2}) as mine:
+        assert len(mine["a"]) == len(mine["b"])
+
+
+def rng_sync_check(state):
+    from accelerate_tpu.utils.random import synchronize_rng_states
+
+    np.random.seed(1000 + state.process_index)  # deliberately desynced
+    synchronize_rng_states(["numpy"])
+    draw = np.random.rand(3)
+    from accelerate_tpu.utils import operations as ops
+
+    gathered = ops.gather_object([draw.tolist()])
+    for other in gathered:
+        assert np.allclose(other, gathered[0]), "numpy RNG not synchronized across processes"
+    state.wait_for_everyone()
+
+
+def dl_preparation_check(state):
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader, prepare_data_loader
+
+    n, bs = 64, 8
+    data = [{"x": np.float32([i])} for i in range(n)]
+    dl = SimpleDataLoader(data, BatchSampler(range(n), bs))
+    prepared = prepare_data_loader(dl, use_seedable_sampler=False)
+    seen = []
+    for batch in prepared:
+        arr = np.asarray(batch["x"])  # global array: every process sees the full batch
+        seen.extend(arr[:, 0].tolist())
+    assert sorted(int(v) for v in seen) == list(range(n)), "prepared loader lost/duplicated samples"
+
+    # split_batches: global batch == inner batch size
+    prepared = prepare_data_loader(dl, split_batches=True, use_seedable_sampler=False)
+    for batch in prepared:
+        assert np.asarray(batch["x"]).shape[0] == bs
+        break
+    state.wait_for_everyone()
+
+
+def central_dl_preparation_check(state):
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader, prepare_data_loader
+
+    n, bs = 32, 4
+    data = [{"x": np.float32([i])} for i in range(n)]
+    dl = SimpleDataLoader(data, BatchSampler(range(n), bs))
+    prepared = prepare_data_loader(dl, dispatch_batches=True, use_seedable_sampler=False)
+    seen = []
+    for batch in prepared:
+        seen.extend(np.asarray(batch["x"])[:, 0].tolist())
+    assert sorted(int(v) for v in seen) == list(range(n)), "dispatch loader lost/duplicated samples"
+    state.wait_for_everyone()
+
+
+def seedable_sampler_check(state):
+    from accelerate_tpu.data_loader import (
+        BatchSampler,
+        SeedableRandomSampler,
+        SimpleDataLoader,
+        prepare_data_loader,
+    )
+
+    n, bs = 32, 4
+    data = [{"x": np.float32([i])} for i in range(n)]
+
+    def epoch_order(seed):
+        sampler = SeedableRandomSampler(num_samples=n, seed=seed)
+        dl = SimpleDataLoader(data, BatchSampler(sampler, bs))
+        prepared = prepare_data_loader(dl, use_seedable_sampler=True, data_seed=seed)
+        order = []
+        for batch in prepared:
+            order.extend(np.asarray(batch["x"])[:, 0].astype(int).tolist())
+        return order
+
+    assert epoch_order(42) == epoch_order(42), "seedable sampler not deterministic"
+    assert epoch_order(42) != epoch_order(7), "seedable sampler ignores the seed"
+    state.wait_for_everyone()
+
+
+def training_check(state):
+    import jax
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+    dataset = RegressionDataset(length=64, seed=5)
+    data = [dataset[i] for i in range(len(dataset))]
+
+    # single-device baseline (plain optax loop on the host)
+    import jax.numpy as jnp
+
+    model = RegressionModel()
+    tx = optax.sgd(0.1)
+    params = model.params
+    opt_state = tx.init(params)
+    baseline_losses = []
+    for epoch in range(3):
+        for start in range(0, 64, 16):
+            xs = np.stack([data[i]["x"] for i in range(start, start + 16)])
+            ys = np.stack([data[i]["y"] for i in range(start, start + 16)])
+            batch = {"x": jnp.asarray(xs), "y": jnp.asarray(ys)}
+
+            def loss_fn(p):
+                pred = model.apply_fn(p, batch["x"])
+                return jnp.mean((pred[:, 0] - batch["y"]) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            baseline_losses.append(float(loss))
+
+    # framework run (sharded over whatever topology this script landed on)
+    accelerator = Accelerator()
+    fw_model = RegressionModel()
+    dl = SimpleDataLoader(data, BatchSampler(range(64), 16))
+    pmodel, popt, pdl = accelerator.prepare(fw_model, optax.sgd(0.1), dl)
+    fw_losses = []
+    for epoch in range(3):
+        for batch in pdl:
+            loss = accelerator.backward(pmodel.loss, batch)
+            popt.step()
+            popt.zero_grad()
+            fw_losses.append(float(loss))
+
+    assert len(fw_losses) == len(baseline_losses)
+    np.testing.assert_allclose(np.array(fw_losses), np.array(baseline_losses), rtol=1e-4, atol=1e-5)
+    state.print("training_check: distributed == single-device, loss-for-loss ✓")
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+
+
+def trigger_check(state):
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    accelerator = Accelerator()
+    assert not accelerator.check_trigger()
+    if state.process_index == state.num_processes - 1:
+        accelerator.set_trigger()
+    assert accelerator.check_trigger(), "trigger set on one process must be visible everywhere"
+    assert not accelerator.check_trigger(), "trigger must reset after firing"
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+
+
+def main():
+    state = init_state_check()
+    state.print("**Process control**")
+    process_execution_check(state)
+    split_between_processes_check(state)
+    state.print("**RNG sync**")
+    rng_sync_check(state)
+    state.print("**DataLoader preparation**")
+    dl_preparation_check(state)
+    central_dl_preparation_check(state)
+    seedable_sampler_check(state)
+    state.print("**Training check**")
+    training_check(state)
+    state.print("**Trigger**")
+    trigger_check(state)
+    state.print("All checks passed.")
+
+
+if __name__ == "__main__":
+    main()

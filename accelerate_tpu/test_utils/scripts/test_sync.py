@@ -1,0 +1,108 @@
+"""Gradient accumulation / sync semantics script (parity: reference
+test_utils/scripts/test_sync.py, 392 LoC): accumulated microbatch training must equal
+big-batch training for linear models; `sync_gradients` must flip exactly at
+accumulation boundaries and at end-of-dataloader."""
+
+import numpy as np
+
+
+def _fresh_accelerator(**kwargs):
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def accumulation_equivalence_check():
+    import jax
+    import optax
+
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+    from accelerate_tpu.utils import GradientAccumulationPlugin
+
+    dataset = RegressionDataset(length=64, seed=11)
+    data = [dataset[i] for i in range(len(dataset))]
+
+    def run(accum, batch_size):
+        accelerator = _fresh_accelerator(
+            gradient_accumulation_plugin=GradientAccumulationPlugin(
+                num_steps=accum, sync_with_dataloader=False
+            )
+        )
+        model = RegressionModel()
+        dl = SimpleDataLoader(data, BatchSampler(range(64), batch_size))
+        pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+        for batch in pdl:
+            with accelerator.accumulate(pmodel):
+                accelerator.backward(pmodel.loss, batch)
+                popt.step()
+                popt.zero_grad()
+        return pmodel.params
+
+    params_accum = run(accum=4, batch_size=8)
+    params_big = run(accum=1, batch_size=32)
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(params_accum), jax.tree_util.tree_leaves(params_big)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+    print("accumulation_equivalence ✓")
+
+
+def sync_flag_check():
+    import optax
+
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+    dataset = RegressionDataset(length=32, seed=3)
+    data = [dataset[i] for i in range(len(dataset))]
+    accelerator = _fresh_accelerator(gradient_accumulation_steps=2)
+    model = RegressionModel()
+    dl = SimpleDataLoader(data, BatchSampler(range(32), 8))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.1), dl)
+    flags = []
+    for batch in pdl:
+        with accelerator.accumulate(pmodel):
+            accelerator.backward(pmodel.loss, batch)
+            flags.append(accelerator.sync_gradients)
+            popt.step()
+            popt.zero_grad()
+    assert flags == [False, True, False, True], flags
+    print("sync_flag ✓")
+
+
+def end_of_dataloader_check():
+    import optax
+
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+    dataset = RegressionDataset(length=24, seed=3)
+    data = [dataset[i] for i in range(len(dataset))]
+    accelerator = _fresh_accelerator(gradient_accumulation_steps=4)
+    model = RegressionModel()
+    dl = SimpleDataLoader(data, BatchSampler(range(24), 8))  # 3 batches < accum 4
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.1), dl)
+    flags = []
+    for batch in pdl:
+        with accelerator.accumulate(pmodel):
+            accelerator.backward(pmodel.loss, batch)
+            flags.append(accelerator.sync_gradients)
+            popt.step()
+            popt.zero_grad()
+    assert flags[-1] is True, "end of dataloader must force a sync step"
+    print("end_of_dataloader ✓")
+
+
+def main():
+    accumulation_equivalence_check()
+    sync_flag_check()
+    end_of_dataloader_check()
+    print("All sync checks passed.")
+
+
+if __name__ == "__main__":
+    main()

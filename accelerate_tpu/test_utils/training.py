@@ -1,0 +1,52 @@
+"""Toy training fixtures (parity: reference test_utils/training.py:22-62 —
+RegressionDataset / RegressionModel, the y = 2x + 3 strategy used by every launched
+correctness script)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RegressionDataset:
+    """y = a*x + b with small noise (reference training.py:22-40)."""
+
+    def __init__(self, a=2, b=3, length=64, seed=0):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + 0.1 * rng.normal(size=(length,))).astype(np.float32)
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.x[i : i + 1], "y": self.y[i]}
+
+
+def regression_loss(params, batch, apply_fn):
+    import jax.numpy as jnp
+
+    pred = apply_fn(params, batch["x"])
+    return jnp.mean((pred[:, 0] - batch["y"]) ** 2)
+
+
+def RegressionModel(a=0.0, b=0.0):
+    """A one-parameter-pair linear model as a Model bundle (reference training.py:42-62).
+
+    Initialized at (a, b) so launched scripts can start all ranks identically without
+    relying on seed plumbing.
+    """
+    import jax.numpy as jnp
+
+    from ..modeling import Model
+
+    params = {"a": jnp.asarray([float(a)]), "b": jnp.asarray([float(b)])}
+
+    def apply_fn(p, x):
+        return x * p["a"] + p["b"]
+
+    def loss_fn(p, batch, apply_fn_):
+        pred = apply_fn_(p, batch["x"])
+        return jnp.mean((pred[:, 0] - batch["y"]) ** 2)
+
+    return Model.from_fn(apply_fn, params, loss_fn=loss_fn)

@@ -1,0 +1,55 @@
+"""Run the bundled launched scripts (reference pattern: tests spawn
+test_utils/scripts/* via execute_subprocess — testing.py:501-560, test_multigpu.py).
+
+Covers three topologies: the 8-device virtual CPU mesh (single process), a real
+2-process rendezvous via debug_launcher, and the `accelerate-tpu test` CLI path.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.test_utils.testing import cpu_mesh_env, run_test_script
+
+
+@pytest.mark.slow_launch
+def test_script_on_virtual_mesh():
+    result = run_test_script("test_script.py")
+    assert "All checks passed." in result.stdout
+
+
+@pytest.mark.slow_launch
+def test_sync_script_on_virtual_mesh():
+    result = run_test_script("test_sync.py")
+    assert "All sync checks passed." in result.stdout
+
+
+@pytest.mark.slow_launch
+def test_ops_script_on_virtual_mesh():
+    result = run_test_script("test_ops.py")
+    assert "All op checks passed." in result.stdout
+
+
+@pytest.mark.slow_launch
+def test_ops_script_multiprocess():
+    """Real 2-process run: object plane, debug-mode verifier, uneven pad all exercised
+    across actual process boundaries."""
+    from accelerate_tpu import debug_launcher
+    from accelerate_tpu.test_utils.scripts.test_ops import main
+
+    debug_launcher(main, num_processes=2)
+
+
+@pytest.mark.slow_launch
+def test_cli_test_command():
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "test", "--cpu"],
+        env=cpu_mesh_env(),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "success" in result.stdout
