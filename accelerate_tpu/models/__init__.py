@@ -12,6 +12,13 @@ from .llama import (
     llama_1b,
     llama_tiny,
 )
+from .mixtral import (
+    MixtralConfig,
+    MixtralForCausalLM,
+    create_mixtral_model,
+    mixtral_8x7b,
+    mixtral_tiny,
+)
 
 _CONFIG_REGISTRY = {
     "bert-base": lambda: _bert_cfg(bert_base()),
@@ -19,7 +26,25 @@ _CONFIG_REGISTRY = {
     "llama-3-8b": lambda: _llama_cfg(llama3_8b()),
     "llama-1b": lambda: _llama_cfg(llama_1b()),
     "llama-tiny": lambda: _llama_cfg(llama_tiny()),
+    "mixtral-8x7b": lambda: _mixtral_cfg(mixtral_8x7b()),
+    "mixtral-tiny": lambda: _mixtral_cfg(mixtral_tiny()),
 }
+
+
+def _mixtral_cfg(c: MixtralConfig) -> dict:
+    return {
+        "model_type": "mixtral",
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.num_hidden_layers,
+        "num_attention_heads": c.num_attention_heads,
+        "num_key_value_heads": c.num_key_value_heads,
+        "intermediate_size": c.intermediate_size,
+        "num_local_experts": c.num_local_experts,
+        "num_experts_per_tok": c.num_experts_per_tok,
+        "hidden_act": "silu",
+        "tie_word_embeddings": False,
+    }
 
 
 def _bert_cfg(c: BertConfig) -> dict:

@@ -1,2 +1,4 @@
 from .mesh import build_mesh, get_default_mesh, mesh_axis_size
 from .pipeline import PipelinedModel, prepare_pipeline
+from .expert import EXPERT_SHARDING_RULES, ExpertMLP, MoEBlock, expert_capacity, top_k_routing
+from .ring_attention import ring_attention
