@@ -1,0 +1,122 @@
+"""Quantization tests (reference analogue: bnb int8/4-bit loading, utils/bnb.py):
+round-trip error bounds, packing size accounting, jit-compatibility of QuantTensor
+pytrees, skip rules, and an end-to-end quantized Llama forward close to the dense one."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.utils.quantization import (
+    QuantTensor,
+    QuantizationConfig,
+    dequantize_params,
+    load_and_quantize_model,
+    quantize_int4,
+    quantize_int8,
+    quantize_nf4,
+    quantize_params,
+    quantized_nbytes,
+)
+
+
+def _w(shape, seed=0, scale=0.02):
+    return jnp.asarray(np.random.default_rng(seed).normal(size=shape).astype(np.float32) * scale)
+
+
+def test_int8_round_trip():
+    w = _w((64, 32))
+    q = quantize_int8(w)
+    err = np.abs(np.asarray(q.dequantize(jnp.float32)) - np.asarray(w))
+    # per-channel absmax/127 bounds the error at half a step
+    col_absmax = np.abs(np.asarray(w)).max(axis=0)
+    assert (err <= col_absmax / 127.0 * 0.5001 + 1e-8).all()
+    assert q.q.dtype == jnp.int8
+    assert q.nbytes_quantized < w.size * 4 / 3.5  # ~4x smaller than fp32 (+scales)
+
+
+@pytest.mark.parametrize("quant", [quantize_int4, quantize_nf4])
+def test_4bit_round_trip(quant):
+    w = _w((48, 32), seed=1)
+    q = quant(w, block_size=64)
+    deq = np.asarray(q.dequantize(jnp.float32))
+    assert deq.shape == w.shape
+    # 4-bit: coarse, but relative error must stay bounded
+    rel = np.abs(deq - np.asarray(w)).mean() / np.abs(np.asarray(w)).mean()
+    assert rel < 0.2, rel
+    # two values per byte + one fp32 scale per 64-block
+    expected_bytes = w.size // 2 + (w.size // 64) * 4
+    assert q.nbytes_quantized == expected_bytes
+
+
+def test_4bit_round_trip_with_padding():
+    w = _w((5, 7), seed=2)  # 35 elements: forces padding to the 64-block
+    for quant in (quantize_int4, quantize_nf4):
+        q = quant(w, block_size=64)
+        assert q.dequantize(jnp.float32).shape == w.shape
+
+
+def test_quant_tensor_is_jittable_pytree():
+    w = _w((32, 16))
+    q = quantize_nf4(w)
+    leaves, treedef = jax.tree_util.tree_flatten(q)
+    assert len(leaves) == 2  # q + scale only; metadata is static
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert rebuilt.kind == "nf4" and rebuilt.shape == (32, 16)
+
+    @jax.jit
+    def matmul(qt, x):
+        return x @ qt.dequantize(jnp.bfloat16).astype(jnp.float32)
+
+    out = matmul(q, jnp.ones((4, 32)))
+    assert out.shape == (4, 16)
+
+
+def test_quantize_params_skip_rules():
+    params = {"params": {"layer_0": {"kernel": _w((16, 16))}, "lm_head": {"kernel": _w((16, 8))}, "norm": {"scale": _w((16,))}}}
+    cfg = QuantizationConfig(load_in_8bit=True, skip_modules=["lm_head"])
+    qp = quantize_params(params, cfg)
+    assert isinstance(qp["params"]["layer_0"]["kernel"], QuantTensor)
+    assert not isinstance(qp["params"]["lm_head"]["kernel"], QuantTensor)  # skipped
+    assert not isinstance(qp["params"]["norm"]["scale"], QuantTensor)  # 1-D: kept dense
+    deq = dequantize_params(qp, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(deq["params"]["lm_head"]["kernel"]), np.asarray(params["params"]["lm_head"]["kernel"])
+    )
+
+
+def test_quantized_model_end_to_end():
+    from accelerate_tpu.models.llama import create_llama_model, llama_tiny
+
+    model = create_llama_model(llama_tiny(), seq_len=16)
+    ids = jnp.asarray(np.random.default_rng(0).integers(1, 500, (2, 16)), jnp.int32)
+    dense_logits = np.asarray(model.apply_fn(model.params, ids), dtype=np.float32)
+
+    qmodel = load_and_quantize_model(
+        model, QuantizationConfig(load_in_8bit=True, compute_dtype=jnp.float32)
+    )
+    q_logits = np.asarray(jax.jit(qmodel.apply_fn)(qmodel.params, ids), dtype=np.float32)
+    assert q_logits.shape == dense_logits.shape
+    # int8 per-channel keeps logits close; compare top-1 predictions + numeric drift
+    agree = (q_logits.argmax(-1) == dense_logits.argmax(-1)).mean()
+    assert agree > 0.9, agree
+    drift = np.abs(q_logits - dense_logits).mean() / (np.abs(dense_logits).mean() + 1e-9)
+    assert drift < 0.2, drift
+
+    # memory: quantized params must be well under half the dense fp32 footprint
+    dense_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(model.params))
+    assert quantized_nbytes(qmodel.params) < dense_bytes / 2
+
+    # loss path still works
+    loss = qmodel.loss_fn(qmodel.params, {"input_ids": ids}, qmodel.apply_fn)
+    loss = loss[0] if isinstance(loss, tuple) else loss
+    assert np.isfinite(float(loss))
+
+
+def test_quantization_config_validation():
+    with pytest.raises(ValueError):
+        QuantizationConfig(load_in_8bit=True, load_in_4bit=True)
+    with pytest.raises(ValueError):
+        QuantizationConfig(load_in_4bit=True, quant_type="fp3")
+    assert not QuantizationConfig().enabled
