@@ -58,6 +58,7 @@ from .tracking import LOGGER_TYPE_TO_CLASS, GeneralTracker, filter_trackers
 from .utils import operations as ops
 from .utils.dataclasses import (
     AutocastKwargs,
+    FP8RecipeKwargs,
     CompilationConfig,
     DataLoaderConfiguration,
     DeepSpeedPlugin,
@@ -120,6 +121,7 @@ class Accelerator:
         self.init_handler = None
         self.autocast_handler = None
         self.ddp_handler = None
+        self.fp8_recipe_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
@@ -129,6 +131,8 @@ class Accelerator:
                 self.autocast_handler = handler
             elif isinstance(handler, DistributedDataParallelKwargs):
                 self.ddp_handler = handler  # accepted for parity; no-op under GSPMD
+            elif isinstance(handler, FP8RecipeKwargs):
+                self.fp8_recipe_handler = handler
 
         init_kwargs = {}
         if self.init_handler is not None and self.init_handler.timeout is not None:
@@ -417,12 +421,16 @@ class Accelerator:
             autocast = False
         if self.state.mixed_precision in ("bf16", "fp16", "fp8"):
             compute_dtype = self.state.compute_dtype
+        fp8_recipe = None
+        if self.state.mixed_precision == "fp8":
+            fp8_recipe = self.fp8_recipe_handler or FP8RecipeKwargs()
         prepared = PreparedModel(
             model,
             mesh=mesh,
             param_sharding=param_sharding,
             compute_dtype=compute_dtype,
             autocast=autocast,
+            fp8_recipe=fp8_recipe,
         )
         self._models.append(prepared)
         return prepared

@@ -94,6 +94,7 @@ class PreparedModel:
         param_sharding=None,
         compute_dtype=None,
         autocast: bool = True,
+        fp8_recipe=None,
     ):
         import jax
 
@@ -105,6 +106,7 @@ class PreparedModel:
         self.param_sharding = param_sharding
         self.compute_dtype = compute_dtype
         self.autocast_enabled = autocast and compute_dtype is not None
+        self.fp8_recipe = fp8_recipe
         self._jit_cache: dict = {}
 
         from .parallel.sharding import place_params
@@ -125,14 +127,24 @@ class PreparedModel:
 
     # -- forward -----------------------------------------------------------------------
     def _mp_apply(self, params, *args, **kwargs):
+        import contextlib
+
         import jax.numpy as jnp
 
-        if self.autocast_enabled:
-            params = _cast_floating(params, self.compute_dtype)
-            args = _cast_floating(args, self.compute_dtype)
-            out = self.apply_fn(params, *args, **kwargs)
-            return _cast_floating(out, jnp.float32)
-        return self.apply_fn(params, *args, **kwargs)
+        # fp8: Dense matmuls run through the fp8 interceptor during tracing
+        # (ops/fp8.py, the TE convert_model replacement); other ops stay bf16.
+        ctx = contextlib.nullcontext()
+        if self.fp8_recipe is not None:
+            from .ops.fp8 import fp8_autocast
+
+            ctx = fp8_autocast(self.fp8_recipe)
+        with ctx:
+            if self.autocast_enabled:
+                params = _cast_floating(params, self.compute_dtype)
+                args = _cast_floating(args, self.compute_dtype)
+                out = self.apply_fn(params, *args, **kwargs)
+                return _cast_floating(out, jnp.float32)
+            return self.apply_fn(params, *args, **kwargs)
 
     @property
     def jitted_apply(self):
