@@ -91,6 +91,18 @@ def dot_product_attention(
             mesh, mode = impl
             return sequence_parallel_attention(q, k, v, mesh=mesh, causal=causal, scale=scale, mode=mode)
 
+    # Flash kernel: explicit, or automatic on TPU for long unmasked sequences where
+    # the [S,S] score materialization would dominate HBM traffic.
+    use_flash = implementation == "flash"
+    if implementation is None and mask is None and sq >= 1024 and sq % 128 == 0 and skv % 128 == 0:
+        import jax
+
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
     if hq != hkv:
         reps = hq // hkv
         k = jnp.repeat(k, reps, axis=2)
