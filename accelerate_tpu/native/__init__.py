@@ -1,0 +1,109 @@
+"""Native (C++) host data plane: build + ctypes bindings.
+
+The reference reaches native code through torch's C++ DataLoader workers and external
+runtimes; here the in-tree `src/data_plane.cpp` provides the host-side hot paths
+(GIL-free batch gather, parallel disk reads). Compiled on first use with the system
+toolchain into `~/.cache/accelerate_tpu/` (or `ACCELERATE_TPU_NATIVE_CACHE`); every
+consumer falls back to numpy paths when the toolchain or platform is unavailable
+(`ACCELERATE_TPU_DISABLE_NATIVE=1` forces the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_FAILED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src", "data_plane.cpp")
+
+
+def _cache_dir() -> str:
+    return os.environ.get(
+        "ACCELERATE_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "accelerate_tpu"),
+    )
+
+
+def _lib_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_cache_dir(), f"data_plane_{digest}.so")
+
+
+def _build() -> str:
+    path = _lib_path()
+    if os.path.exists(path):
+        return path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, path)  # atomic: concurrent builders race benignly
+    return path
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.atl_pool_create.argtypes = [c.c_int]
+    lib.atl_pool_create.restype = c.c_void_p
+    lib.atl_pool_destroy.argtypes = [c.c_void_p]
+    lib.atl_pool_size.argtypes = [c.c_void_p]
+    lib.atl_pool_size.restype = c.c_int
+    lib.atl_gather_rows.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.POINTER(c.c_int64), c.c_int64, c.c_void_p]
+    lib.atl_gather_submit.argtypes = [
+        c.c_void_p,
+        c.POINTER(c.c_void_p),
+        c.POINTER(c.c_int64),
+        c.c_int,
+        c.POINTER(c.c_int64),
+        c.c_int64,
+        c.POINTER(c.c_void_p),
+    ]
+    lib.atl_gather_submit.restype = c.c_int64
+    lib.atl_wait.argtypes = [c.c_void_p, c.c_int64]
+    lib.atl_store_open.argtypes = [c.c_char_p]
+    lib.atl_store_open.restype = c.c_void_p
+    lib.atl_store_close.argtypes = [c.c_void_p]
+    lib.atl_store_read.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int64, c.c_void_p]
+    lib.atl_store_read.restype = c.c_int
+    lib.atl_store_prefetch.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int64, c.c_void_p]
+    lib.atl_store_prefetch.restype = c.c_int64
+    return lib
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the native library; None when unavailable."""
+    global _LIB, _LOAD_FAILED
+    if _LIB is not None:
+        return _LIB
+    if _LOAD_FAILED or os.environ.get("ACCELERATE_TPU_DISABLE_NATIVE") == "1":
+        return None
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        try:
+            _LIB = _bind(ctypes.CDLL(_build()))
+        except Exception as e:  # toolchain missing, sandboxed fs, unsupported platform
+            logger.warning("native data plane unavailable (%s); using numpy fallback", e)
+            _LOAD_FAILED = True
+            return None
+    return _LIB
+
+
+from .loader import ArrayDataset, NativeGatherPool  # noqa: E402
+from .offload import NativeOffloadStore  # noqa: E402

@@ -1,0 +1,130 @@
+"""Native disk offload store: one binary blob + JSON index, parallel pread + async
+readahead (the perf-bearing replacement for the reference's per-tensor .dat mmap files,
+utils/offload.py:25-192 — same role, single-file layout, C++ read path).
+
+Write path is plain Python (offload writes are cold); the hot path — streaming layer
+weights back while earlier layers compute — uses the thread pool for striped pread and
+`prefetch()` tickets for overlap. Numpy-only fallback reads with np.fromfile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class NativeOffloadStore:
+    """Tensor name -> (offset, shape, dtype) in one blob file."""
+
+    INDEX_NAME = "index.json"
+    BLOB_NAME = "weights.bin"
+
+    def __init__(self, directory: str, num_threads: int = 4):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.index_path = os.path.join(directory, self.INDEX_NAME)
+        self.blob_path = os.path.join(directory, self.BLOB_NAME)
+        self.index: Dict[str, dict] = {}
+        if os.path.exists(self.index_path):
+            with open(self.index_path) as f:
+                self.index = json.load(f)
+        from . import load_library
+
+        self.lib = load_library()
+        self._pool = self.lib.atl_pool_create(int(num_threads)) if self.lib else None
+        self._store = None
+        self._tickets: Dict[str, tuple] = {}
+
+    # -- write --------------------------------------------------------------------
+    def save(self, tensors: Dict[str, np.ndarray]):
+        """Append tensors to the blob and update the index."""
+        self._close_store()
+        mode = "ab" if os.path.exists(self.blob_path) else "wb"
+        with open(self.blob_path, mode) as f:
+            for name, arr in tensors.items():
+                arr = np.ascontiguousarray(arr)
+                offset = f.tell()
+                f.write(arr.tobytes())
+                self.index[name] = {
+                    "offset": offset,
+                    "shape": list(arr.shape),
+                    "dtype": str(arr.dtype),
+                }
+        with open(self.index_path, "w") as f:
+            json.dump(self.index, f)
+
+    # -- read ---------------------------------------------------------------------
+    def _open_store(self):
+        if self._store is None and self.lib is not None:
+            self._store = self.lib.atl_store_open(self.blob_path.encode())
+        return self._store
+
+    def _close_store(self):
+        if self._store is not None:
+            self.lib.atl_store_close(self._store)
+            self._store = None
+
+    def keys(self):
+        return self.index.keys()
+
+    def __contains__(self, name):
+        return name in self.index
+
+    def _meta(self, name):
+        meta = self.index[name]
+        shape = tuple(meta["shape"])
+        dtype = np.dtype(meta["dtype"])
+        nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+        return meta["offset"], shape, dtype, nbytes
+
+    def read(self, name: str) -> np.ndarray:
+        """Blocking read; consumes a pending prefetch for `name` when one exists."""
+        if name in self._tickets:
+            ticket, out = self._tickets.pop(name)
+            self.lib.atl_wait(self._pool, ticket)
+            return out
+        offset, shape, dtype, nbytes = self._meta(name)
+        store = self._open_store()
+        if store is None:
+            with open(self.blob_path, "rb") as f:
+                f.seek(offset)
+                return np.frombuffer(f.read(nbytes), dtype=dtype).reshape(shape).copy()
+        out = np.empty(shape, dtype=dtype)
+        rc = self.lib.atl_store_read(
+            self._pool, store, offset, nbytes, out.ctypes.data_as(__import__("ctypes").c_void_p)
+        )
+        if rc != 0:
+            raise IOError(f"short read for {name!r} in {self.blob_path}")
+        return out
+
+    def prefetch(self, name: str):
+        """Start an async readahead for `name` (no-op without the native lib)."""
+        store = self._open_store()
+        if store is None or name in self._tickets:
+            return
+        offset, shape, dtype, nbytes = self._meta(name)
+        out = np.empty(shape, dtype=dtype)
+        import ctypes
+
+        ticket = self.lib.atl_store_prefetch(
+            self._pool, store, offset, nbytes, out.ctypes.data_as(ctypes.c_void_p)
+        )
+        self._tickets[name] = (ticket, out)
+
+    def close(self):
+        for name, (ticket, _out) in list(self._tickets.items()):
+            self.lib.atl_wait(self._pool, ticket)
+        self._tickets.clear()
+        self._close_store()
+        if self._pool is not None:
+            self.lib.atl_pool_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

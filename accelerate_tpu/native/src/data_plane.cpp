@@ -1,0 +1,249 @@
+// Native host data plane for accelerate_tpu.
+//
+// TPU-native counterpart of the reference's native loader stack (torch DataLoader's
+// C++ worker pool + pinned-memory collate, reached via data_loader.py; and the
+// disk-offload mmap store, utils/offload.py:25-192). Two engines behind a tiny C ABI
+// (bound from Python with ctypes — no pybind11 in the image):
+//
+//   1. Batch gather: a persistent thread pool copies selected rows of columnar
+//      (contiguous) host arrays into caller-owned batch buffers, synchronously or as
+//      async double-buffered tickets. This is the GIL-free replacement for
+//      python-level `[dataset[i] for i in indices]` + np.stack collation.
+//
+//   2. Offload store: positional file reads (pread) parallelized across the pool,
+//      plus async readahead tickets — the layer-streaming backend for big-model
+//      disk offload (reference OffloadedWeightsLoader).
+//
+// Everything is plain C++17 + POSIX; built with `g++ -O3 -shared -fPIC -pthread`.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ------------------------------------------------------------------ thread pool
+class Pool {
+ public:
+  explicit Pool(int n_threads) : stop_(false), next_ticket_(1) {
+    if (n_threads < 1) n_threads = 1;
+    for (int i = 0; i < n_threads; ++i) {
+      workers_.emplace_back([this] { Run(); });
+    }
+  }
+
+  ~Pool() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  int size() const { return static_cast<int>(workers_.size()); }
+
+  // Enqueue `n` subtasks under one ticket; ticket completes when all subtasks do.
+  int64_t Submit(std::vector<std::function<void()>> subtasks) {
+    int64_t ticket = next_ticket_.fetch_add(1);
+    auto remaining = std::make_shared<std::atomic<int64_t>>(
+        static_cast<int64_t>(subtasks.size()));
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      pending_[ticket] = false;
+      for (auto& fn : subtasks) {
+        queue_.emplace_back([this, ticket, remaining, fn = std::move(fn)] {
+          fn();
+          if (remaining->fetch_sub(1) == 1) {
+            std::unique_lock<std::mutex> lk(mu_);
+            pending_[ticket] = true;
+            done_cv_.notify_all();
+          }
+        });
+      }
+    }
+    cv_.notify_all();
+    return ticket;
+  }
+
+  void Wait(int64_t ticket) {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this, ticket] {
+      auto it = pending_.find(ticket);
+      return it == pending_.end() || it->second;
+    });
+    pending_.erase(ticket);
+  }
+
+ private:
+  void Run() {
+    for (;;) {
+      std::function<void()> task;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+        if (stop_ && queue_.empty()) return;
+        task = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      task();
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::deque<std::function<void()>> queue_;
+  std::unordered_map<int64_t, bool> pending_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable done_cv_;
+  bool stop_;
+  std::atomic<int64_t> next_ticket_;
+};
+
+// Split `n` rows across up to `shards` roughly even contiguous chunks.
+std::vector<std::pair<int64_t, int64_t>> Chunks(int64_t n, int shards) {
+  std::vector<std::pair<int64_t, int64_t>> out;
+  if (n <= 0) return out;
+  int64_t per = (n + shards - 1) / shards;
+  for (int64_t start = 0; start < n; start += per) {
+    out.emplace_back(start, std::min(per, n - start));
+  }
+  return out;
+}
+
+void GatherChunk(const char* src, int64_t row_bytes, const int64_t* indices,
+                 int64_t start, int64_t count, char* dst) {
+  for (int64_t r = start; r < start + count; ++r) {
+    std::memcpy(dst + r * row_bytes, src + indices[r] * row_bytes,
+                static_cast<size_t>(row_bytes));
+  }
+}
+
+struct Store {
+  int fd;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ------------------------------------------------------------------ pool
+void* atl_pool_create(int num_threads) { return new Pool(num_threads); }
+
+void atl_pool_destroy(void* pool) { delete static_cast<Pool*>(pool); }
+
+int atl_pool_size(void* pool) { return static_cast<Pool*>(pool)->size(); }
+
+// ------------------------------------------------------------------ batch gather
+// Copy rows `indices[0..n)` of `src` (row_bytes each) into dst, in parallel.
+void atl_gather_rows(void* pool, const void* src, int64_t row_bytes,
+                     const int64_t* indices, int64_t n, void* dst) {
+  Pool* p = static_cast<Pool*>(pool);
+  std::vector<std::function<void()>> tasks;
+  for (auto [start, count] : Chunks(n, p->size())) {
+    tasks.push_back([=] {
+      GatherChunk(static_cast<const char*>(src), row_bytes, indices, start,
+                  count, static_cast<char*>(dst));
+    });
+  }
+  p->Wait(p->Submit(std::move(tasks)));
+}
+
+// Async gather over multiple columns under one ticket: column c copies rows
+// `indices` from srcs[c] (row_bytes[c] each) into dsts[c].
+int64_t atl_gather_submit(void* pool, const void** srcs,
+                          const int64_t* row_bytes, int n_cols,
+                          const int64_t* indices, int64_t n_rows, void** dsts) {
+  Pool* p = static_cast<Pool*>(pool);
+  std::vector<std::function<void()>> tasks;
+  for (int c = 0; c < n_cols; ++c) {
+    const char* src = static_cast<const char*>(srcs[c]);
+    char* dst = static_cast<char*>(dsts[c]);
+    int64_t rb = row_bytes[c];
+    // Subdivide large columns so one wide column still uses the whole pool.
+    int shards = std::max(1, p->size() / n_cols);
+    for (auto [start, count] : Chunks(n_rows, shards)) {
+      tasks.push_back(
+          [=] { GatherChunk(src, rb, indices, start, count, dst); });
+    }
+  }
+  return p->Submit(std::move(tasks));
+}
+
+void atl_wait(void* pool, int64_t ticket) {
+  static_cast<Pool*>(pool)->Wait(ticket);
+}
+
+// ------------------------------------------------------------------ offload store
+void* atl_store_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  return new Store{fd};
+}
+
+void atl_store_close(void* store) {
+  Store* s = static_cast<Store*>(store);
+  if (s) {
+    ::close(s->fd);
+    delete s;
+  }
+}
+
+// Parallel positional read of [offset, offset+nbytes) into dst. Returns 0 on
+// success, -1 on a short/failed read.
+int atl_store_read(void* pool, void* store, int64_t offset, int64_t nbytes,
+                   void* dst) {
+  Pool* p = static_cast<Pool*>(pool);
+  Store* s = static_cast<Store*>(store);
+  std::atomic<int> status{0};
+  std::vector<std::function<void()>> tasks;
+  for (auto [start, count] : Chunks(nbytes, p->size())) {
+    tasks.push_back([=, &status] {
+      int64_t done = 0;
+      while (done < count) {
+        ssize_t got = ::pread(s->fd, static_cast<char*>(dst) + start + done,
+                              static_cast<size_t>(count - done),
+                              offset + start + done);
+        if (got <= 0) {
+          status.store(-1);
+          return;
+        }
+        done += got;
+      }
+    });
+  }
+  p->Wait(p->Submit(std::move(tasks)));
+  return status.load();
+}
+
+// Async readahead ticket for the same read.
+int64_t atl_store_prefetch(void* pool, void* store, int64_t offset,
+                           int64_t nbytes, void* dst) {
+  Pool* p = static_cast<Pool*>(pool);
+  Store* s = static_cast<Store*>(store);
+  std::vector<std::function<void()>> tasks;
+  for (auto [start, count] : Chunks(nbytes, p->size())) {
+    tasks.push_back([=] {
+      int64_t done = 0;
+      while (done < count) {
+        ssize_t got = ::pread(s->fd, static_cast<char*>(dst) + start + done,
+                              static_cast<size_t>(count - done),
+                              offset + start + done);
+        if (got <= 0) return;
+        done += got;
+      }
+    });
+  }
+  return p->Submit(std::move(tasks));
+}
+
+}  // extern "C"
