@@ -9,6 +9,15 @@ from .scheduler import AcceleratedScheduler
 from .data_loader import SimpleDataLoader, prepare_data_loader, skip_first_batches
 from .local_sgd import LocalSGD
 from .launchers import debug_launcher, notebook_launcher
+from .hooks import (
+    CpuOffload,
+    ModelHook,
+    SequentialHook,
+    UserCpuOffloadHook,
+    add_hook_to_module,
+    cpu_offload_with_hook,
+    remove_hook_from_module,
+)
 from .tracking import GeneralTracker
 from .utils import (
     DataLoaderConfiguration,

@@ -618,6 +618,32 @@ class Accelerator:
             return True
         return False
 
+    # ------------------------------------------------------------------ profiling
+    @contextlib.contextmanager
+    def profile(self, log_dir: Optional[str] = None):
+        """Capture an XLA device trace for the wrapped block (SURVEY §5: the
+        first-class profiler the reference lacks — its perf observation is tracker
+        callbacks + psutil threads, benchmarks/measures_util.py). Output is an xplane
+        dump viewable in TensorBoard / xprof / Perfetto."""
+        import jax
+
+        if log_dir is None:
+            base = self.logging_dir or self.project_dir or "."
+            log_dir = os.path.join(str(base), "profile")
+        if self.is_main_process:
+            os.makedirs(log_dir, exist_ok=True)
+        with jax.profiler.trace(log_dir):
+            yield
+        self.wait_for_everyone()
+
+    def save_memory_profile(self, path: str):
+        """Dump a device-memory (HBM) profile in pprof format."""
+        import jax
+
+        if self.is_main_process:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            jax.profiler.save_device_memory_profile(path)
+
     # ------------------------------------------------------------------ precision
     @contextlib.contextmanager
     def autocast(self, autocast_handler: Optional[AutocastKwargs] = None):

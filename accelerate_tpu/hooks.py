@@ -1,0 +1,200 @@
+"""Hook engine (parity: reference hooks.py — ModelHook :33, SequentialHook :91,
+add_hook_to_module :120, CpuOffload/UserCpuOffloadHook :661-709).
+
+The reference intercepts `module.forward` by monkey-patching bound methods. Functional
+redesign: hooks wrap a Model/PreparedModel's `apply_fn`. A hook sees the full call —
+`pre_forward(model, params, args, kwargs)` may move/replace params (that's how offload
+hooks stream weights in), `post_forward(model, output)` may transform the output. The
+big-model machinery (big_modeling.py) uses explicit layer streaming instead of hooks
+for its own execution — this engine is the extension surface users attach custom
+behavior with, matching the reference API shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class ModelHook:
+    """Base hook (reference hooks.py:33). Subclass and override any stage."""
+
+    no_grad = False
+
+    def init_hook(self, model):
+        """Called when attached; may return a modified model."""
+        return model
+
+    def pre_forward(self, model, params, args: tuple, kwargs: dict):
+        """May replace params/args/kwargs before the wrapped apply."""
+        return params, args, kwargs
+
+    def post_forward(self, model, output):
+        """May replace the output after the wrapped apply."""
+        return output
+
+    def detach_hook(self, model):
+        """Called when removed; may return a modified model."""
+        return model
+
+
+class SequentialHook(ModelHook):
+    """Runs several hooks in order (reference hooks.py:91)."""
+
+    def __init__(self, *hooks: ModelHook):
+        self.hooks = list(hooks)
+
+    def init_hook(self, model):
+        for hook in self.hooks:
+            model = hook.init_hook(model)
+        return model
+
+    def pre_forward(self, model, params, args, kwargs):
+        for hook in self.hooks:
+            params, args, kwargs = hook.pre_forward(model, params, args, kwargs)
+        return params, args, kwargs
+
+    def post_forward(self, model, output):
+        for hook in self.hooks:
+            output = hook.post_forward(model, output)
+        return output
+
+    def detach_hook(self, model):
+        for hook in self.hooks:
+            model = hook.detach_hook(model)
+        return model
+
+
+def add_hook_to_module(model, hook: ModelHook, append: bool = False):
+    """Attach `hook` to a Model/PreparedModel by wrapping its apply_fn
+    (reference add_hook_to_module hooks.py:120; `append` chains like :147-153)."""
+    if append and getattr(model, "_atl_hook", None) is not None:
+        hook = SequentialHook(model._atl_hook, hook)
+        remove_hook_from_module(model)
+
+    old_apply = model.apply_fn
+    model = hook.init_hook(model)
+
+    def hooked_apply(params, *args, **kwargs):
+        params, args, kwargs = hook.pre_forward(model, params, args, kwargs)
+        output = old_apply(params, *args, **kwargs)
+        return hook.post_forward(model, output)
+
+    model._atl_hook = hook
+    model._atl_old_apply = old_apply
+    model.apply_fn = hooked_apply
+    # PreparedModel caches jitted applies keyed on the old fn; drop them.
+    if hasattr(model, "_jit_cache"):
+        model._jit_cache.clear()
+    return model
+
+
+def remove_hook_from_module(model, recurse: bool = False):
+    """Inverse of add_hook_to_module (reference hooks.py:157)."""
+    hook = getattr(model, "_atl_hook", None)
+    if hook is not None:
+        hook.detach_hook(model)
+        model.apply_fn = model._atl_old_apply
+        model._atl_hook = None
+        model._atl_old_apply = None
+        if hasattr(model, "_jit_cache"):
+            model._jit_cache.clear()
+    return model
+
+
+class CpuOffload(ModelHook):
+    """Keep params on host between calls; move to device for the forward
+    (reference CpuOffload hooks.py:661). With `execution_device=None` uses the default
+    device. `prev_module_hook` mirrors the pipeline-friendly chaining: attaching model
+    B with prev=A's hook offloads A when B runs."""
+
+    def __init__(self, execution_device=None, prev_module_hook: Optional["UserCpuOffloadHook"] = None):
+        self.execution_device = execution_device
+        self.prev_module_hook = prev_module_hook
+
+    def init_hook(self, model):
+        import jax
+
+        # params start on host
+        model.params = jax.device_get(model.params)
+        return model
+
+    def pre_forward(self, model, params, args, kwargs):
+        import jax
+
+        if self.prev_module_hook is not None:
+            self.prev_module_hook.offload()
+        device = self.execution_device or jax.local_devices()[0]
+        params = jax.device_put(params, device)
+        return params, args, kwargs
+
+
+class UserCpuOffloadHook:
+    """User handle pairing a model with its CpuOffload hook
+    (reference UserCpuOffloadHook hooks.py:682): offload() sends weights home."""
+
+    def __init__(self, model, hook: CpuOffload):
+        self.model = model
+        self.hook = hook
+
+    def offload(self):
+        import jax
+
+        self.model.params = jax.device_get(self.model.params)
+
+    def remove(self):
+        remove_hook_from_module(self.model)
+
+
+def cpu_offload_with_hook(model, execution_device=None, prev_module_hook: Optional[UserCpuOffloadHook] = None):
+    """Offload a model to host, returning (model, hook handle) for pipelines
+    (reference cpu_offload_with_hook big_modeling.py:275-302)."""
+    hook = CpuOffload(execution_device=execution_device, prev_module_hook=prev_module_hook)
+    model = add_hook_to_module(model, hook)
+    return model, UserCpuOffloadHook(model, hook)
+
+
+class AlignDevicesHook(ModelHook):
+    """Pull params from a weights map onto the execution device before the forward and
+    release them after (reference AlignDevicesHook hooks.py:212 — the per-module weight
+    streaming primitive; big_modeling's layer streaming is the batched version).
+
+    `weights_map`: Mapping name -> array (e.g. OffloadedWeightsLoader); names follow
+    the '/'-joined param-pytree paths.
+    """
+
+    def __init__(self, execution_device=None, weights_map=None, offload: bool = True, io_same_device: bool = False):
+        self.execution_device = execution_device
+        self.weights_map = weights_map
+        self.offload = offload
+        self.io_same_device = io_same_device
+
+    def pre_forward(self, model, params, args, kwargs):
+        import jax
+
+        device = self.execution_device or jax.local_devices()[0]
+        if self.weights_map is not None:
+            params = _tree_from_flat(
+                {name: self.weights_map[name] for name in self.weights_map}
+            )
+        params = jax.device_put(params, device)
+        return params, args, kwargs
+
+    def post_forward(self, model, output):
+        if self.offload and self.weights_map is not None:
+            # nothing to free explicitly: streamed buffers die with the forward's scope
+            pass
+        return output
+
+
+def _tree_from_flat(flat: Dict[str, Any]):
+    """'a/b/c' -> nested dicts (inverse of the '/'-joined path flattening)."""
+    tree: Dict[str, Any] = {}
+    for path, value in flat.items():
+        parts = path.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree
