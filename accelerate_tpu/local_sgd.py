@@ -114,10 +114,24 @@ class LocalSGD:
 
         opt = self._bound_optimizer()
         if opt is not None and opt.opt_state is not None:
-            opt_shardings = self._replica_sharding(opt.opt_state)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # Moments mirror params and get the replica axis; SCALAR leaves (step
+            # counts) stay shared — adam's bias correction 1-b^count must broadcast
+            # against [dp, ...] moments, and the count is identical per replica anyway.
+            def _is_stacked(x):
+                return hasattr(x, "ndim") and x.ndim >= 1
+
+            self._opt_stacked_mask = jax.tree_util.tree_map(_is_stacked, opt.opt_state)
+            opt_shardings = jax.tree_util.tree_map(
+                lambda x: NamedSharding(
+                    self.mesh, PartitionSpec("data") if _is_stacked(x) else PartitionSpec()
+                ),
+                opt.opt_state,
+            )
             opt.opt_state = jax.jit(
                 lambda t: jax.tree_util.tree_map(
-                    lambda x: _stack(x) if hasattr(x, "shape") and x.ndim >= 0 else x, t
+                    lambda x: _stack(x) if _is_stacked(x) else x, t
                 ),
                 out_shardings=opt_shardings,
             )(opt.opt_state)
@@ -158,7 +172,9 @@ class LocalSGD:
         opt = self._bound_optimizer()
         if opt is not None and opt.opt_state is not None:
             opt.opt_state = jax.tree_util.tree_map(
-                lambda x: x[0] if hasattr(x, "shape") and x.ndim >= 1 else x, opt.opt_state
+                lambda x, stacked: x[0] if stacked else x,
+                opt.opt_state,
+                self._opt_stacked_mask,
             )
             opt.opt_state_sharding = None
             opt._jit_cache.clear()

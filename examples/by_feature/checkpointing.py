@@ -1,0 +1,73 @@
+"""by_feature/checkpointing (parity: reference examples/by_feature/checkpointing.py):
+the nlp_example plus `save_state`/`load_state` every epoch and mid-epoch resume via
+`skip_first_batches`.
+
+    python examples/by_feature/checkpointing.py --resume_from_checkpoint latest
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from nlp_example import MAX_LEN, get_dataset  # noqa: E402
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
+from accelerate_tpu.models import bert_tiny, create_bert_model
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+
+def training_function(args):
+    accelerator = Accelerator(
+        project_dir=args.output_dir,
+        project_config=ProjectConfiguration(automatic_checkpoint_naming=True, total_limit=3),
+    )
+    set_seed(args.seed)
+    config = bert_tiny()
+    model = create_bert_model(config, seq_len=MAX_LEN)
+    data = get_dataset(config.vocab_size - 1, n=args.train_size)
+    sampler = SeedableRandomSampler(num_samples=len(data), seed=args.seed)
+    train_dl = SimpleDataLoader(data, BatchSampler(sampler, args.batch_size))
+    optimizer = optax.adamw(args.lr)
+    model, optimizer, train_dl = accelerator.prepare(model, optimizer, train_dl)
+
+    start_epoch = 0
+    resume_step = 0
+    if args.resume_from_checkpoint:
+        path = args.resume_from_checkpoint
+        if path == "latest":
+            ckpts = sorted(os.listdir(os.path.join(args.output_dir, "checkpoints")))
+            path = os.path.join(args.output_dir, "checkpoints", ckpts[-1])
+        accelerator.load_state(path)
+        completed = accelerator.save_iteration
+        start_epoch = completed // len(train_dl)
+        resume_step = completed % len(train_dl)
+        accelerator.print(f"resumed from {path}: epoch {start_epoch}, step {resume_step}")
+
+    for epoch in range(start_epoch, args.epochs):
+        dl = train_dl
+        if epoch == start_epoch and resume_step:
+            dl = accelerator.skip_first_batches(train_dl, resume_step)
+        for batch in dl:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(model.loss, batch)
+                optimizer.step()
+                optimizer.zero_grad()
+        accelerator.save_state()
+        accelerator.print(f"epoch {epoch}: loss {float(loss):.4f} (state saved)")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=256)
+    parser.add_argument("--output_dir", default="/tmp/accelerate_tpu_ckpt_example")
+    parser.add_argument("--resume_from_checkpoint", default=None)
+    training_function(parser.parse_args())

@@ -1,0 +1,55 @@
+"""by_feature/gradient_accumulation (parity: reference
+examples/by_feature/gradient_accumulation.py): the nlp_example with
+`gradient_accumulation_steps` N and the `accumulate()` context — optimizer/scheduler
+step only at accumulation boundaries."""
+
+import argparse
+import os
+import sys
+
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from nlp_example import MAX_LEN, get_dataset  # noqa: E402
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
+from accelerate_tpu.models import bert_tiny, create_bert_model
+from accelerate_tpu.utils import set_seed
+
+
+def training_function(args):
+    accelerator = Accelerator(gradient_accumulation_steps=args.gradient_accumulation_steps)
+    set_seed(args.seed)
+    config = bert_tiny()
+    model = create_bert_model(config, seq_len=MAX_LEN)
+    data = get_dataset(config.vocab_size - 1, n=args.train_size)
+    sampler = SeedableRandomSampler(num_samples=len(data), seed=args.seed)
+    train_dl = SimpleDataLoader(data, BatchSampler(sampler, args.batch_size))
+    optimizer = optax.adamw(args.lr)
+    model, optimizer, train_dl = accelerator.prepare(model, optimizer, train_dl)
+
+    steps = 0
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(model.loss, batch)
+                optimizer.step()  # no-op until sync_gradients
+                optimizer.zero_grad()
+            if accelerator.sync_gradients:
+                steps += 1
+        accelerator.print(
+            f"epoch {epoch}: loss {float(loss):.4f} ({steps} optimizer steps, "
+            f"{args.gradient_accumulation_steps}x accumulation)"
+        )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=4)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=256)
+    training_function(parser.parse_args())

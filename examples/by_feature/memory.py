@@ -1,0 +1,55 @@
+"""by_feature/memory (parity: reference examples/by_feature/memory.py):
+`find_executable_batch_size` halves the batch size on OOM and restarts the inner
+function — the decorator owns the retry loop, the user code stays linear."""
+
+import argparse
+import os
+import sys
+
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from nlp_example import MAX_LEN, get_dataset  # noqa: E402
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
+from accelerate_tpu.models import bert_tiny, create_bert_model
+from accelerate_tpu.utils import find_executable_batch_size, set_seed
+
+
+def training_function(args):
+    accelerator = Accelerator()
+    set_seed(args.seed)
+    config = bert_tiny()
+    data = get_dataset(config.vocab_size - 1, n=args.train_size)
+
+    @find_executable_batch_size(starting_batch_size=args.batch_size)
+    def inner_training_loop(batch_size):
+        accelerator.print(f"Trying batch size: {batch_size}")
+        accelerator.free_memory()  # fresh state for each attempt (reference memory.py)
+        model = create_bert_model(config, seq_len=MAX_LEN)
+        sampler = SeedableRandomSampler(num_samples=len(data), seed=args.seed)
+        train_dl = SimpleDataLoader(data, BatchSampler(sampler, batch_size))
+        optimizer = optax.adamw(args.lr)
+        pmodel, popt, pdl = accelerator.prepare(model, optimizer, train_dl)
+        for epoch in range(args.epochs):
+            for batch in pdl:
+                with accelerator.accumulate(pmodel):
+                    loss = accelerator.backward(pmodel.loss, batch)
+                    popt.step()
+                    popt.zero_grad()
+            accelerator.print(f"epoch {epoch}: loss {float(loss):.4f}")
+        return batch_size
+
+    used = inner_training_loop()
+    accelerator.print(f"Trained with batch size {used}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=256)
+    training_function(parser.parse_args())

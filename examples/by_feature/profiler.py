@@ -1,0 +1,58 @@
+"""by_feature/profiler (reference analogue: examples/by_feature/profiler.py):
+`accelerator.profile()` wraps training steps in an XLA device trace (xplane dump for
+TensorBoard/xprof) and `save_memory_profile` snapshots HBM in pprof format."""
+
+import argparse
+import os
+import sys
+
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from nlp_example import MAX_LEN, get_dataset  # noqa: E402
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
+from accelerate_tpu.models import bert_tiny, create_bert_model
+from accelerate_tpu.utils import set_seed
+
+
+def training_function(args):
+    accelerator = Accelerator(project_dir=args.output_dir)
+    set_seed(args.seed)
+    config = bert_tiny()
+    model = create_bert_model(config, seq_len=MAX_LEN)
+    data = get_dataset(config.vocab_size - 1, n=args.train_size)
+    sampler = SeedableRandomSampler(num_samples=len(data), seed=args.seed)
+    train_dl = SimpleDataLoader(data, BatchSampler(sampler, args.batch_size))
+    optimizer = optax.adamw(args.lr)
+    model, optimizer, train_dl = accelerator.prepare(model, optimizer, train_dl)
+
+    # Warm up (compile) outside the trace so the profile shows steady-state steps.
+    for batch in train_dl:
+        accelerator.backward(model.loss, batch)
+        optimizer.step()
+        optimizer.zero_grad()
+        break
+
+    trace_dir = os.path.join(args.output_dir, "profile")
+    with accelerator.profile(log_dir=trace_dir):
+        for step, batch in enumerate(train_dl):
+            loss = accelerator.backward(model.loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+            if step + 1 >= args.profile_steps:
+                break
+    accelerator.save_memory_profile(os.path.join(args.output_dir, "memory.prof"))
+    accelerator.print(f"trace written to {trace_dir} (loss {float(loss):.4f})")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--profile_steps", type=int, default=4)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=256)
+    parser.add_argument("--output_dir", default="/tmp/accelerate_tpu_profile_example")
+    training_function(parser.parse_args())

@@ -1,0 +1,70 @@
+"""by_feature/tracking (parity: reference examples/by_feature/tracking.py): tracker
+fan-out via `init_trackers`/`log`/`end_training`. Uses the JSON/CSV trackers (always
+available); pass --log_with tensorboard/wandb when those packages are installed."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from nlp_example import MAX_LEN, get_dataset  # noqa: E402
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
+from accelerate_tpu.models import bert_tiny, create_bert_model
+from accelerate_tpu.utils import set_seed
+
+
+def training_function(args):
+    accelerator = Accelerator(log_with=args.log_with, project_dir=args.output_dir)
+    set_seed(args.seed)
+    config = bert_tiny()
+    model = create_bert_model(config, seq_len=MAX_LEN)
+    train_data = get_dataset(config.vocab_size - 1, n=args.train_size, seed=0)
+    eval_data = get_dataset(config.vocab_size - 1, n=args.eval_size, seed=1)
+    sampler = SeedableRandomSampler(num_samples=len(train_data), seed=args.seed)
+    train_dl = SimpleDataLoader(train_data, BatchSampler(sampler, args.batch_size))
+    eval_dl = SimpleDataLoader(eval_data, BatchSampler(range(len(eval_data)), args.batch_size))
+    optimizer = optax.adamw(args.lr)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(model, optimizer, train_dl, eval_dl)
+
+    accelerator.init_trackers("tracking_example", config=vars(args))
+    overall_step = 0
+    for epoch in range(args.epochs):
+        total_loss = 0.0
+        for batch in train_dl:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(model.loss, batch)
+                total_loss += float(loss)
+                optimizer.step()
+                optimizer.zero_grad()
+            overall_step += 1
+        correct, total = 0, 0
+        for batch in eval_dl:
+            logits = model(batch["input_ids"], None, batch["token_type_ids"])
+            preds = accelerator.gather_for_metrics(np.asarray(logits).argmax(-1))
+            labels = accelerator.gather_for_metrics(np.asarray(batch["labels"]))
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            total += len(np.asarray(labels))
+        accelerator.log(
+            {"train_loss": total_loss / len(train_dl), "accuracy": correct / total, "epoch": epoch},
+            step=overall_step,
+        )
+        accelerator.print(f"epoch {epoch}: acc {correct / total:.3f}")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--log_with", default="json", help="json, csv, tensorboard, wandb, mlflow, all")
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=256)
+    parser.add_argument("--eval_size", type=int, default=64)
+    parser.add_argument("--output_dir", default="/tmp/accelerate_tpu_tracking_example")
+    training_function(parser.parse_args())

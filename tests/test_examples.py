@@ -1,0 +1,69 @@
+"""Example-as-test (reference tests/test_examples.py pattern: every by_feature script
+must actually run). Each example runs as a subprocess on the 8-device virtual CPU mesh
+with tiny sizes; asserts on exit code + expected output markers."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.test_utils.testing import cpu_mesh_env
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(rel_path, *extra):
+    cmd = [sys.executable, os.path.join(EXAMPLES_DIR, rel_path), *extra]
+    result = subprocess.run(cmd, env=cpu_mesh_env(), capture_output=True, text=True, timeout=560)
+    assert result.returncode == 0, f"{rel_path} failed:\n{result.stdout}\n{result.stderr}"
+    return result.stdout
+
+
+@pytest.mark.slow_launch
+def test_nlp_example():
+    out = _run("nlp_example.py", "--train_size", "128", "--eval_size", "64", "--epochs", "2")
+    assert "accuracy" in out
+
+
+@pytest.mark.slow_launch
+def test_cv_example():
+    out = _run("cv_example.py", "--epochs", "3")
+    assert "accuracy" in out
+
+
+@pytest.mark.slow_launch
+@pytest.mark.parametrize(
+    "script,args,marker",
+    [
+        ("gradient_accumulation.py", ["--train_size", "64"], "accumulation"),
+        ("local_sgd.py", ["--train_size", "64"], "loss"),
+        ("memory.py", ["--train_size", "64"], "Trained with batch size"),
+        ("fsdp.py", ["--train_size", "64"], "peak HBM"),
+        ("profiler.py", ["--train_size", "64"], "trace written"),
+        ("tracking.py", ["--train_size", "64"], "acc"),
+    ],
+)
+def test_by_feature_examples(script, args, marker):
+    out = _run(os.path.join("by_feature", script), *args)
+    assert marker in out, out
+
+
+@pytest.mark.slow_launch
+def test_checkpointing_example_resume():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        _run("by_feature/checkpointing.py", "--train_size", "64", "--output_dir", d, "--epochs", "1")
+        out = _run(
+            "by_feature/checkpointing.py",
+            "--train_size",
+            "64",
+            "--output_dir",
+            d,
+            "--epochs",
+            "2",
+            "--resume_from_checkpoint",
+            "latest",
+        )
+        assert "resumed from" in out
