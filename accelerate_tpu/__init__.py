@@ -23,6 +23,7 @@ from .scheduler import AcceleratedScheduler
 from .data_loader import SimpleDataLoader, prepare_data_loader, skip_first_batches
 from .local_sgd import LocalSGD
 from .launchers import debug_launcher, notebook_launcher
+from .fault_tolerance import PREEMPTED_EXIT_CODE, PreemptionHandler, Supervisor
 from .hooks import (
     CpuOffload,
     ModelHook,

@@ -618,6 +618,37 @@ class Accelerator:
             return True
         return False
 
+    # ------------------------------------------------------------------ preemption
+    def register_preemption_checkpoint(self, output_dir: Optional[str] = None, exit_on_save: bool = True):
+        """Install a SIGTERM latch (TPU-VM preemption); `check_preemption()` then
+        saves full state at the next step boundary (SURVEY §5: the elastic/preemption
+        machinery the reference delegates to torchrun)."""
+        from .fault_tolerance import PreemptionHandler
+
+        self._preemption_handler = PreemptionHandler()
+        self._preemption_dir = output_dir
+        self._preemption_exit = exit_on_save
+        return self._preemption_handler
+
+    @property
+    def preemption_requested(self) -> bool:
+        handler = getattr(self, "_preemption_handler", None)
+        return handler is not None and handler.preemption_requested
+
+    def check_preemption(self) -> bool:
+        """Call at step boundaries: on a latched SIGTERM, saves state (to the
+        registered dir or the project checkpoint dir) and exits 143. Returns False
+        when training should continue."""
+        if not self.preemption_requested:
+            return False
+        from .fault_tolerance import PREEMPTED_EXIT_CODE
+
+        path = self.save_state(getattr(self, "_preemption_dir", None))
+        self.print(f"preemption checkpoint saved to {path}")
+        if getattr(self, "_preemption_exit", True):
+            raise SystemExit(PREEMPTED_EXIT_CODE)
+        return True
+
     # ------------------------------------------------------------------ profiling
     @contextlib.contextmanager
     def profile(self, log_dir: Optional[str] = None):

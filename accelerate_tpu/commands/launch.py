@@ -38,6 +38,8 @@ def add_launch_args(parser):
     parser.add_argument("--profile_dir", default=None, help="Enable jax.profiler traces into this directory")
     for axis in ("data", "fsdp", "model", "seq", "expert", "stage"):
         parser.add_argument(f"--mesh_{axis}", type=int, default=None, help=f"Mesh axis size for `{axis}`")
+    parser.add_argument("--max_restarts", type=int, default=0, help="Restart budget on child failure (elastic supervision)")
+    parser.add_argument("--grace_period", type=float, default=30.0, help="Seconds a signaled child gets to checkpoint")
     parser.add_argument("--tpu_use_cluster", action="store_true", help="Launch on every worker of a TPU pod")
     parser.add_argument("--tpu_name", default=None)
     parser.add_argument("--tpu_zone", default=None)
@@ -102,6 +104,16 @@ def launch_command(args):
         return pod_launcher(args, config)
     env = build_launch_env(args, config)
     cmd = [sys.executable, args.training_script] + list(args.training_script_args)
+    max_restarts = args.max_restarts or int(config.get("max_restarts", 0) or 0)
+    if max_restarts > 0:
+        from ..fault_tolerance import Supervisor
+
+        code = Supervisor(
+            cmd, env=env, max_restarts=max_restarts, grace_period=args.grace_period
+        ).run()
+        if code != 0:
+            raise SystemExit(code)
+        return
     process = subprocess.run(cmd, env=env)
     if process.returncode != 0:
         raise SystemExit(process.returncode)

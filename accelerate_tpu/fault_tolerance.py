@@ -1,0 +1,144 @@
+"""Fault tolerance: restart supervision + preemption checkpointing.
+
+The reference delegates elasticity to torchrun (`--max_restarts`, rdzv args pass
+straight through — commands/launch.py:322-345) and has no preemption handling of its
+own (SURVEY §5: "none in-tree"). On TPU pods both must be first-class: Cloud TPU VMs
+are preemptible (SIGTERM, then hard kill) and pod launches need a per-host supervisor
+with a restart budget.
+
+Two pieces:
+
+  - `Supervisor`: runs the training command as a child, restarts on failure up to
+    `max_restarts` (with linear backoff), forwards SIGTERM/SIGINT and gives the child
+    `grace_period` seconds to checkpoint before the hard kill. This is what
+    `accelerate-tpu launch --max_restarts N` wraps around the user script.
+
+  - `PreemptionHandler`: in-process SIGTERM latch. The training loop (or
+    `Accelerator.check_preemption()`) polls it at step boundaries; when set, the
+    Accelerator saves full state and exits 143 so the supervisor/scheduler sees a
+    clean preemption, and `--resume_from_checkpoint latest` continues after respawn.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+PREEMPTED_EXIT_CODE = 143  # 128 + SIGTERM, the conventional graceful-preemption code
+
+
+class Supervisor:
+    """Restart a child command on failure (the torchrun elastic-agent replacement).
+
+    Exit code 0 and `PREEMPTED_EXIT_CODE` end supervision (success / clean preemption
+    handoff); any other exit restarts until the budget is spent.
+    """
+
+    def __init__(
+        self,
+        cmd: List[str],
+        env: Optional[dict] = None,
+        max_restarts: int = 0,
+        grace_period: float = 30.0,
+        backoff_seconds: float = 1.0,
+        monitor_interval: float = 0.5,
+    ):
+        self.cmd = cmd
+        self.env = env
+        self.max_restarts = max_restarts
+        self.grace_period = grace_period
+        self.backoff_seconds = backoff_seconds
+        self.monitor_interval = monitor_interval
+        self.restart_count = 0
+        self._child: Optional[subprocess.Popen] = None
+        self._terminating = False
+
+    def _forward_signal(self, signum, frame):
+        self._terminating = True
+        child = self._child
+        if child is not None and child.poll() is None:
+            logger.info("supervisor: forwarding signal %d to pid %d", signum, child.pid)
+            child.send_signal(signum)
+            deadline = time.time() + self.grace_period
+            while child.poll() is None and time.time() < deadline:
+                time.sleep(self.monitor_interval)
+            if child.poll() is None:
+                logger.warning("supervisor: grace period expired; killing pid %d", child.pid)
+                child.kill()
+
+    def run(self) -> int:
+        prev_term = signal.signal(signal.SIGTERM, self._forward_signal)
+        prev_int = signal.signal(signal.SIGINT, self._forward_signal)
+        try:
+            while True:
+                self._child = subprocess.Popen(self.cmd, env=self.env)
+                while self._child.poll() is None:
+                    time.sleep(self.monitor_interval)
+                code = self._child.returncode
+                if code == 0 or code == PREEMPTED_EXIT_CODE or self._terminating:
+                    return code
+                if self.restart_count >= self.max_restarts:
+                    logger.warning(
+                        "supervisor: child failed (exit %d); restart budget (%d) exhausted",
+                        code,
+                        self.max_restarts,
+                    )
+                    return code
+                self.restart_count += 1
+                logger.warning(
+                    "supervisor: child failed (exit %d); restart %d/%d",
+                    code,
+                    self.restart_count,
+                    self.max_restarts,
+                )
+                time.sleep(self.backoff_seconds * self.restart_count)
+        finally:
+            signal.signal(signal.SIGTERM, prev_term)
+            signal.signal(signal.SIGINT, prev_int)
+
+
+class PreemptionHandler:
+    """Latch SIGTERM (and optionally SIGINT) for graceful preemption.
+
+    Installed via `Accelerator.register_preemption_checkpoint()` or standalone:
+
+        handler = PreemptionHandler()
+        for batch in dl:
+            ...
+            if handler.preemption_requested:
+                accelerator.save_state(ckpt_dir); sys.exit(PREEMPTED_EXIT_CODE)
+    """
+
+    def __init__(self, catch_sigint: bool = False, on_preempt: Optional[Callable] = None):
+        self._requested = threading.Event()
+        self.on_preempt = on_preempt
+        self._prev = {}
+        for sig in [signal.SIGTERM] + ([signal.SIGINT] if catch_sigint else []):
+            self._prev[sig] = signal.signal(sig, self._handle)
+
+    def _handle(self, signum, frame):
+        logger.warning("preemption signal %d received; will checkpoint at step boundary", signum)
+        self._requested.set()
+        if self.on_preempt is not None:
+            self.on_preempt()
+
+    @property
+    def preemption_requested(self) -> bool:
+        return self._requested.is_set()
+
+    def reset(self):
+        self._requested.clear()
+
+    def uninstall(self):
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev = {}

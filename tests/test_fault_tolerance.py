@@ -1,0 +1,158 @@
+"""Fault-tolerance tests: supervisor restart budget + signal forwarding, preemption
+latch, Accelerator.check_preemption saving state and exiting 143, and the launch CLI
+--max_restarts path (the elastic machinery the reference delegates to torchrun)."""
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+import pytest
+
+from accelerate_tpu.fault_tolerance import PREEMPTED_EXIT_CODE, PreemptionHandler, Supervisor
+from accelerate_tpu.test_utils.testing import cpu_mesh_env
+
+CRASHY = """
+import os, sys
+marker = sys.argv[1]
+fail_times = int(sys.argv[2])
+n = 0
+if os.path.exists(marker):
+    with open(marker) as f:
+        n = int(f.read())
+with open(marker, "w") as f:
+    f.write(str(n + 1))
+sys.exit(1 if n < fail_times else 0)
+"""
+
+
+def _script(tmp, name, body):
+    path = os.path.join(tmp, name)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(body))
+    return path
+
+
+def test_supervisor_restarts_until_success():
+    with tempfile.TemporaryDirectory() as d:
+        script = _script(d, "crashy.py", CRASHY)
+        marker = os.path.join(d, "attempts")
+        sup = Supervisor([sys.executable, script, marker, "2"], max_restarts=5, backoff_seconds=0.01, monitor_interval=0.05)
+        code = sup.run()
+        assert code == 0
+        assert sup.restart_count == 2
+        with open(marker) as f:
+            assert f.read() == "3"  # two failures + one success
+
+
+def test_supervisor_respects_budget():
+    with tempfile.TemporaryDirectory() as d:
+        script = _script(d, "crashy.py", CRASHY)
+        marker = os.path.join(d, "attempts")
+        sup = Supervisor([sys.executable, script, marker, "99"], max_restarts=2, backoff_seconds=0.01, monitor_interval=0.05)
+        code = sup.run()
+        assert code == 1
+        with open(marker) as f:
+            assert f.read() == "3"  # initial + 2 restarts
+
+
+def test_supervisor_treats_preemption_exit_as_final():
+    with tempfile.TemporaryDirectory() as d:
+        script = _script(d, "preempt.py", f"import sys; sys.exit({PREEMPTED_EXIT_CODE})")
+        sup = Supervisor([sys.executable, script], max_restarts=5, monitor_interval=0.05)
+        assert sup.run() == PREEMPTED_EXIT_CODE
+        assert sup.restart_count == 0
+
+
+def test_preemption_handler_latch():
+    handler = PreemptionHandler()
+    try:
+        assert not handler.preemption_requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.1)
+        assert handler.preemption_requested
+        handler.reset()
+        assert not handler.preemption_requested
+    finally:
+        handler.uninstall()
+
+
+PREEMPT_TRAIN = """
+import os, signal, sys, time
+import numpy as np
+import optax
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler
+from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+out_dir = sys.argv[1]
+accelerator = Accelerator(project_dir=out_dir)
+accelerator.register_preemption_checkpoint(os.path.join(out_dir, "preempt_ckpt"))
+data = [RegressionDataset(length=32)[i] for i in range(32)]
+dl = SimpleDataLoader(data, BatchSampler(range(32), 8))
+model, opt, pdl = accelerator.prepare(RegressionModel(), optax.sgd(0.05), dl)
+print("READY", flush=True)
+for epoch in range(10000):
+    for batch in pdl:
+        accelerator.backward(model.loss, batch)
+        opt.step(); opt.zero_grad()
+        accelerator.check_preemption()
+    time.sleep(0.05)
+"""
+
+
+@pytest.mark.slow_launch
+def test_check_preemption_saves_and_exits_143():
+    with tempfile.TemporaryDirectory() as d:
+        script = _script(d, "train.py", PREEMPT_TRAIN)
+        proc = subprocess.Popen(
+            [sys.executable, script, d],
+            env=cpu_mesh_env(),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        # wait for steady state
+        for line in proc.stdout:
+            if "READY" in line:
+                break
+        time.sleep(1.0)
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == PREEMPTED_EXIT_CODE, proc.stdout.read()
+        ckpt = os.path.join(d, "preempt_ckpt")
+        assert os.path.isdir(ckpt) and os.listdir(ckpt), "preemption checkpoint missing"
+
+
+@pytest.mark.slow_launch
+def test_launch_cli_max_restarts():
+    with tempfile.TemporaryDirectory() as d:
+        script = _script(d, "crashy.py", CRASHY)
+        marker = os.path.join(d, "attempts")
+        result = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "accelerate_tpu.commands.accelerate_cli",
+                "launch",
+                "--max_restarts",
+                "3",
+                script,
+                marker,
+                "1",
+            ],
+            env=cpu_mesh_env(),
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        with open(marker) as f:
+            assert f.read() == "2"
