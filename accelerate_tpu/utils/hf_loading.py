@@ -1,0 +1,244 @@
+"""HF checkpoint interchange: load torch-layout Llama/Mixtral checkpoints into the
+in-tree flax models, and export back.
+
+The reference consumes HF checkpoints natively because it IS torch
+(`load_checkpoint_in_model` utils/modeling.py:1565, `load_state_dict` :1424,
+`shard_checkpoint` :206). Here the torch↔flax seam needs an explicit name/layout map:
+
+  - torch `nn.Linear.weight` is [out, in]; flax `Dense.kernel` is [in, out] → transpose.
+  - HF llama: `model.layers.N.self_attn.q_proj.weight` → `layer_N/attention/wq/kernel`.
+  - HF mixtral experts are per-expert modules (`block_sparse_moe.experts.E.w1`);
+    ours are stacked [E, in, out] (parallel/expert.py) → stack + transpose.
+
+Supports single-file `.safetensors`, HF sharded checkpoints
+(`model.safetensors.index.json`), and torch `.bin` (pickle) files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, Optional
+
+import numpy as np
+
+
+# --------------------------------------------------------------------- file readers
+def _read_torch_bin(path: str) -> Dict[str, np.ndarray]:
+    import torch
+
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    out = {}
+    for k, v in state.items():
+        t = v.detach()
+        if t.dtype == torch.bfloat16:
+            out[k] = t.view(torch.uint16).numpy().view("bfloat16")
+        else:
+            out[k] = t.numpy()
+    return out
+
+
+def load_hf_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Flat name->array from a checkpoint file, sharded-index dir, or directory."""
+    from .modeling import load_safetensors_state_dict
+
+    if os.path.isdir(path):
+        index = os.path.join(path, "model.safetensors.index.json")
+        bin_index = os.path.join(path, "pytorch_model.bin.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                weight_map = json.load(f)["weight_map"]
+            state: Dict[str, np.ndarray] = {}
+            for shard in sorted(set(weight_map.values())):
+                state.update(load_safetensors_state_dict(os.path.join(path, shard)))
+            return state
+        if os.path.exists(bin_index):
+            with open(bin_index) as f:
+                weight_map = json.load(f)["weight_map"]
+            state = {}
+            for shard in sorted(set(weight_map.values())):
+                state.update(_read_torch_bin(os.path.join(path, shard)))
+            return state
+        for name in ("model.safetensors", "pytorch_model.bin"):
+            p = os.path.join(path, name)
+            if os.path.exists(p):
+                return load_hf_state_dict(p)
+        raise FileNotFoundError(f"No checkpoint found in {path}")
+    if path.endswith(".safetensors"):
+        return load_safetensors_state_dict(path)
+    return _read_torch_bin(path)
+
+
+# --------------------------------------------------------------------- llama mapping
+def _llama_from_hf(flat: Dict[str, np.ndarray], config) -> dict:
+    def T(name):
+        return np.ascontiguousarray(flat[name].T)
+
+    inner: dict = {
+        "embed_tokens": {"embedding": np.asarray(flat["model.embed_tokens.weight"])},
+        "final_norm": {"scale": np.asarray(flat["model.norm.weight"])},
+    }
+    for i in range(config.num_hidden_layers):
+        p = f"model.layers.{i}."
+        inner[f"layer_{i}"] = {
+            "attention": {
+                "wq": {"kernel": T(p + "self_attn.q_proj.weight")},
+                "wk": {"kernel": T(p + "self_attn.k_proj.weight")},
+                "wv": {"kernel": T(p + "self_attn.v_proj.weight")},
+                "wo": {"kernel": T(p + "self_attn.o_proj.weight")},
+            },
+            "mlp": {
+                "w_gate": {"kernel": T(p + "mlp.gate_proj.weight")},
+                "w_up": {"kernel": T(p + "mlp.up_proj.weight")},
+                "w_down": {"kernel": T(p + "mlp.down_proj.weight")},
+            },
+            "input_norm": {"scale": np.asarray(flat[p + "input_layernorm.weight"])},
+            "post_attn_norm": {"scale": np.asarray(flat[p + "post_attention_layernorm.weight"])},
+        }
+    if not config.tie_word_embeddings:
+        inner["lm_head"] = {"kernel": T("lm_head.weight")}
+    return {"params": inner}
+
+
+def _llama_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
+    inner = params["params"]
+
+    def T(x):
+        return np.ascontiguousarray(np.asarray(x).T)
+
+    flat = {
+        "model.embed_tokens.weight": np.asarray(inner["embed_tokens"]["embedding"]),
+        "model.norm.weight": np.asarray(inner["final_norm"]["scale"]),
+    }
+    for i in range(config.num_hidden_layers):
+        lp = inner[f"layer_{i}"]
+        p = f"model.layers.{i}."
+        flat[p + "self_attn.q_proj.weight"] = T(lp["attention"]["wq"]["kernel"])
+        flat[p + "self_attn.k_proj.weight"] = T(lp["attention"]["wk"]["kernel"])
+        flat[p + "self_attn.v_proj.weight"] = T(lp["attention"]["wv"]["kernel"])
+        flat[p + "self_attn.o_proj.weight"] = T(lp["attention"]["wo"]["kernel"])
+        flat[p + "mlp.gate_proj.weight"] = T(lp["mlp"]["w_gate"]["kernel"])
+        flat[p + "mlp.up_proj.weight"] = T(lp["mlp"]["w_up"]["kernel"])
+        flat[p + "mlp.down_proj.weight"] = T(lp["mlp"]["w_down"]["kernel"])
+        flat[p + "input_layernorm.weight"] = np.asarray(lp["input_norm"]["scale"])
+        flat[p + "post_attention_layernorm.weight"] = np.asarray(lp["post_attn_norm"]["scale"])
+    if "lm_head" in inner:
+        flat["lm_head.weight"] = T(inner["lm_head"]["kernel"])
+    return flat
+
+
+# -------------------------------------------------------------------- mixtral mapping
+def _mixtral_from_hf(flat: Dict[str, np.ndarray], config) -> dict:
+    def T(name):
+        return np.ascontiguousarray(flat[name].T)
+
+    inner: dict = {
+        "embed_tokens": {"embedding": np.asarray(flat["model.embed_tokens.weight"])},
+        "final_norm": {"scale": np.asarray(flat["model.norm.weight"])},
+        "lm_head": {"kernel": T("lm_head.weight")},
+    }
+    E = config.num_local_experts
+    for i in range(config.num_hidden_layers):
+        p = f"model.layers.{i}."
+        moe = p + "block_sparse_moe."
+        # HF mixtral expert module: w1 = gate, w3 = up, w2 = down (all [out, in])
+        w_gate = np.stack([flat[f"{moe}experts.{e}.w1.weight"].T for e in range(E)])
+        w_up = np.stack([flat[f"{moe}experts.{e}.w3.weight"].T for e in range(E)])
+        w_down = np.stack([flat[f"{moe}experts.{e}.w2.weight"].T for e in range(E)])
+        inner[f"layer_{i}"] = {
+            "attention": {
+                "wq": {"kernel": T(p + "self_attn.q_proj.weight")},
+                "wk": {"kernel": T(p + "self_attn.k_proj.weight")},
+                "wv": {"kernel": T(p + "self_attn.v_proj.weight")},
+                "wo": {"kernel": T(p + "self_attn.o_proj.weight")},
+            },
+            "moe": {
+                "router": {"kernel": T(moe + "gate.weight")},
+                "experts": {
+                    "w_gate/kernel": np.ascontiguousarray(w_gate),
+                    "w_up/kernel": np.ascontiguousarray(w_up),
+                    "w_down/kernel": np.ascontiguousarray(w_down),
+                },
+            },
+            "input_norm": {"scale": np.asarray(flat[p + "input_layernorm.weight"])},
+            "post_attn_norm": {"scale": np.asarray(flat[p + "post_attention_layernorm.weight"])},
+        }
+    return {"params": inner}
+
+
+def _mixtral_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
+    inner = params["params"]
+
+    def T(x):
+        return np.ascontiguousarray(np.asarray(x).T)
+
+    flat = {
+        "model.embed_tokens.weight": np.asarray(inner["embed_tokens"]["embedding"]),
+        "model.norm.weight": np.asarray(inner["final_norm"]["scale"]),
+        "lm_head.weight": T(inner["lm_head"]["kernel"]),
+    }
+    for i in range(config.num_hidden_layers):
+        lp = inner[f"layer_{i}"]
+        p = f"model.layers.{i}."
+        moe = p + "block_sparse_moe."
+        for ours, theirs in [("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj")]:
+            flat[p + f"self_attn.{theirs}.weight"] = T(lp["attention"][ours]["kernel"])
+        flat[moe + "gate.weight"] = T(lp["moe"]["router"]["kernel"])
+        experts = lp["moe"]["experts"]
+        for e in range(config.num_local_experts):
+            flat[f"{moe}experts.{e}.w1.weight"] = T(np.asarray(experts["w_gate/kernel"])[e])
+            flat[f"{moe}experts.{e}.w3.weight"] = T(np.asarray(experts["w_up/kernel"])[e])
+            flat[f"{moe}experts.{e}.w2.weight"] = T(np.asarray(experts["w_down/kernel"])[e])
+        flat[p + "input_layernorm.weight"] = np.asarray(lp["input_norm"]["scale"])
+        flat[p + "post_attention_layernorm.weight"] = np.asarray(lp["post_attn_norm"]["scale"])
+    return flat
+
+
+_FROM_HF = {"llama": _llama_from_hf, "mixtral": _mixtral_from_hf}
+_TO_HF = {"llama": _llama_to_hf, "mixtral": _mixtral_to_hf}
+
+
+def convert_hf_state_dict(flat: Dict[str, np.ndarray], model_type: str, config) -> dict:
+    """Flat HF state dict -> our nested params pytree."""
+    if model_type not in _FROM_HF:
+        raise ValueError(f"Unsupported model_type {model_type!r}; known: {sorted(_FROM_HF)}")
+    return _FROM_HF[model_type](flat, config)
+
+
+def export_hf_state_dict(params: dict, model_type: str, config) -> Dict[str, np.ndarray]:
+    """Our params pytree -> flat HF-layout state dict (torch [out, in] kernels)."""
+    if model_type not in _TO_HF:
+        raise ValueError(f"Unsupported model_type {model_type!r}; known: {sorted(_TO_HF)}")
+    return _TO_HF[model_type](params, config)
+
+
+def load_hf_checkpoint_in_model(model, checkpoint_path: str, model_type: str, config=None):
+    """Load an HF torch checkpoint into a Model bundle in place (reference
+    load_checkpoint_in_model utils/modeling.py:1565). Returns the model."""
+    config = config or getattr(getattr(model, "module", None), "config", None)
+    if config is None:
+        raise ValueError("Pass config= when the model bundle has no flax module config")
+    flat = load_hf_state_dict(checkpoint_path)
+    params = convert_hf_state_dict(flat, model_type, config)
+    if hasattr(model, "load_state_dict"):
+        model.load_state_dict(params)
+    else:
+        model.params = params
+    return model
+
+
+def save_hf_checkpoint(params: dict, model_type: str, config, save_path: str):
+    """Write params as a single HF-layout .safetensors file."""
+    from safetensors.numpy import save_file
+
+    flat = export_hf_state_dict(params, model_type, config)
+    # safetensors-numpy can't take bf16 ml_dtypes arrays directly; view as uint16
+    clean = {}
+    for k, v in flat.items():
+        if v.dtype.name == "bfloat16":
+            clean[k] = v.view(np.uint16)
+        else:
+            clean[k] = v
+    os.makedirs(os.path.dirname(os.path.abspath(save_path)), exist_ok=True)
+    save_file(clean, save_path)
