@@ -24,6 +24,7 @@ from .data_loader import SimpleDataLoader, prepare_data_loader, skip_first_batch
 from .local_sgd import LocalSGD
 from .launchers import debug_launcher, notebook_launcher
 from .fault_tolerance import PREEMPTED_EXIT_CODE, PreemptionHandler, Supervisor
+from .generation import GenerationConfig, Generator, generate
 from .hooks import (
     CpuOffload,
     ModelHook,

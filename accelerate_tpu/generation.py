@@ -1,0 +1,121 @@
+"""KV-cached autoregressive generation — the serving path behind the reference's
+big-model-inference benchmark (benchmarks/big_model_inference.py: model load time +
+per-token generation latency are the published numbers, benchmarks/README.md:27-37).
+
+TPU design: one compiled prefill (writes the whole prompt into the KV cache and
+returns first-token logits — the TTFT program) plus one compiled decode step
+([B, 1] token → logits, cache written in place via donation, so the cache never
+round-trips HBM↔host). The cache lives in the flax "cache" collection
+(models/llama.py LlamaAttention decode path) with static capacity
+`prompt_len + max_new_tokens` — static shapes keep both programs cached in the
+compilation cache across calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class GenerationConfig:
+    max_new_tokens: int = 32
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = full vocab
+    eos_token_id: Optional[int] = None
+
+
+def _sample(logits, config: GenerationConfig, rng):
+    """[B, V] logits -> [B] token ids."""
+    if not config.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
+    logits = logits.astype(jnp.float32) / jnp.maximum(config.temperature, 1e-6)
+    if config.top_k:
+        kth = jax.lax.top_k(logits, config.top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    rng, sub = jax.random.split(rng)
+    return jax.random.categorical(sub, logits, axis=-1).astype(jnp.int32), rng
+
+
+class Generator:
+    """Compiled prefill + decode-step pair for a causal-LM Model bundle.
+
+    Reusable across prompts of the same (batch, prompt_len) shape; per-token decode is
+    shape-stable for any prompt length up to the cache capacity.
+    """
+
+    def __init__(self, model, max_new_tokens: int = 32, max_length: Optional[int] = None):
+        if getattr(model, "module", None) is None or not hasattr(model.module, "config"):
+            raise ValueError("generate() needs a Model bundle built from an in-tree flax module")
+        self.base_config = model.module.config
+        self.params = model.params
+        self.max_new_tokens = max_new_tokens
+        self.max_length = max_length or self.base_config.max_position_embeddings
+        decode_cfg = dataclasses.replace(self.base_config, decode_cache_length=self.max_length)
+        self.decode_module = type(model.module)(decode_cfg)
+
+        module = self.decode_module
+
+        def prefill(params, input_ids, positions):
+            logits, mutated = module.apply(
+                params, input_ids, None, positions, mutable=["cache"]
+            )
+            return logits[:, -1, :], mutated["cache"]
+
+        def step(params, cache, token, position):
+            logits, mutated = module.apply(
+                {**params, "cache": cache}, token[:, None], None, position[:, None], mutable=["cache"]
+            )
+            return logits[:, -1, :], mutated["cache"]
+
+        self._prefill = jax.jit(prefill)
+        self._step = jax.jit(step, donate_argnums=(1,))
+
+    def __call__(self, input_ids, generation_config: Optional[GenerationConfig] = None, rng=None, **kwargs):
+        config = generation_config or GenerationConfig(**kwargs)
+        if rng is None:
+            rng = jax.random.key(0)
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, prompt_len = input_ids.shape
+        max_new = min(config.max_new_tokens, self.max_length - prompt_len)
+        if max_new <= 0:
+            raise ValueError(
+                f"Prompt length {prompt_len} leaves no room in the {self.max_length}-token cache"
+            )
+        positions = jnp.broadcast_to(jnp.arange(prompt_len)[None, :], (b, prompt_len))
+        params = self.params if "params" in self.params else {"params": self.params}
+        logits, cache = self._prefill(params, input_ids, positions)
+
+        tokens = []
+        token, rng = _sample(logits, config, rng)
+        tokens.append(token)
+        finished = np.zeros(b, dtype=bool)
+        for i in range(1, max_new):
+            if config.eos_token_id is not None:
+                finished |= np.asarray(tokens[-1]) == config.eos_token_id
+                if finished.all():
+                    break
+            position = jnp.full((b,), prompt_len + i - 1, jnp.int32)
+            logits, cache = self._step(params, cache, tokens[-1], position)
+            token, rng = _sample(logits, config, rng)
+            tokens.append(token)
+        generated = jnp.stack(tokens, axis=1)
+        return jnp.concatenate([input_ids, generated], axis=1)
+
+
+def generate(model, input_ids, max_new_tokens: int = 32, **kwargs):
+    """One-shot convenience: build a Generator and run it (HF `model.generate` shape)."""
+    gen_kwargs = {
+        k: kwargs.pop(k)
+        for k in ("do_sample", "temperature", "top_k", "eos_token_id")
+        if k in kwargs
+    }
+    generator = Generator(model, max_new_tokens=max_new_tokens, **kwargs)
+    return generator(input_ids, GenerationConfig(max_new_tokens=max_new_tokens, **gen_kwargs))

@@ -44,6 +44,9 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     scan_layers: bool = False
+    # When set, attention keeps a [B, decode_cache_length] KV cache in the flax
+    # "cache" collection (incremental decoding); 0 = normal training/forward path.
+    decode_cache_length: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -86,7 +89,29 @@ class LlamaAttention(nn.Module):
         v = nn.Dense(hkv * d, use_bias=False, name="wv")(hidden).reshape(b, s, hkv, d)
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
-        out = dot_product_attention(q, k, v, mask=mask, causal=True)
+
+        if cfg.decode_cache_length:
+            # Incremental decoding: persist K/V in the flax "cache" collection.
+            # One write path covers prefill (s = prompt_len at index 0) and decode
+            # (s = 1 at the running index); attention masks out unwritten slots.
+            L = cfg.decode_cache_length
+            cached_k = self.variable("cache", "cached_key", jnp.zeros, (b, L, hkv, d), k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros, (b, L, hkv, d), v.dtype)
+            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+            cur = cache_index.value
+            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, cur, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, cur, 0, 0))
+            cache_index.value = cur + s
+            k_all, v_all = cached_k.value, cached_v.value
+            # causal over absolute positions: query row i (absolute cur+i) sees
+            # cache slots j <= cur+i and only written slots (j < cur+s).
+            rows = cur + jnp.arange(s)[:, None]
+            cols = jnp.arange(L)[None, :]
+            attend = (cols <= rows) & (cols < cur + s)  # [s, L]
+            decode_mask = jnp.broadcast_to(attend[None, None, :, :], (b, 1, s, L))
+            out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
+        else:
+            out = dot_product_attention(q, k, v, mask=mask, causal=True)
         return nn.Dense(cfg.hidden_size, use_bias=False, name="wo")(out.reshape(b, s, hq * d))
 
 

@@ -20,15 +20,77 @@ import time
 import numpy as np
 
 
+def inference_bench(args):
+    """Big-model-inference metric (reference benchmarks/big_model_inference.py:
+    model load + per-token generation latency, README.md:27-37): reports p50 TTFT
+    (compiled prefill) and per-token decode latency through the KV-cache path."""
+    import jax
+
+    from accelerate_tpu.generation import GenerationConfig, Generator
+    from accelerate_tpu.models.llama import create_llama_model, llama_1b, llama_tiny
+
+    on_accel = jax.devices()[0].platform in ("tpu", "gpu")
+    model_name = args.model if args.model.startswith("llama") else "llama-1b"
+    if not on_accel:
+        model_name = "llama-tiny"
+    t_load = time.perf_counter()
+    cfg = llama_1b() if model_name == "llama-1b" else llama_tiny()
+    model = create_llama_model(cfg, seq_len=args.seq_len)
+    load_s = time.perf_counter() - t_load
+
+    batch = args.batch_size or 1
+    prompt_len = min(args.seq_len, cfg.max_position_embeddings // 2)
+    new_tokens = 32
+    gen = Generator(model, max_new_tokens=new_tokens, max_length=prompt_len + new_tokens)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, cfg.vocab_size, (batch, prompt_len)).astype(np.int32)
+
+    # compile both programs
+    gen(prompt, GenerationConfig(max_new_tokens=2))
+
+    ttfts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        gen(prompt, GenerationConfig(max_new_tokens=1))
+        ttfts.append(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    out = gen(prompt, GenerationConfig(max_new_tokens=new_tokens))
+    jax.block_until_ready(out)
+    total = time.perf_counter() - t0
+    ttft_p50 = sorted(ttfts)[len(ttfts) // 2]
+    per_token = (total - ttft_p50) / max(new_tokens - 1, 1)
+
+    # reference headline: GPT-J-6B fp16 on 2x Titan RTX = 0.05 s/token
+    # (benchmarks/README.md:31); vs_baseline = reference / ours (higher is better).
+    vs_baseline = 0.05 / per_token if per_token > 0 else 0.0
+    result = {
+        "metric": f"per-token generation latency ({model_name}, prompt {prompt_len}, bs {batch})",
+        "value": round(per_token * 1000, 3),
+        "unit": "ms/token",
+        "vs_baseline": round(vs_baseline, 4),
+        "extra": {
+            "ttft_p50_ms": round(ttft_p50 * 1000, 3),
+            "model_load_s": round(load_s, 2),
+            "device_kind": jax.devices()[0].device_kind,
+            "new_tokens": new_tokens,
+        },
+    }
+    print(json.dumps(result))
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="bert-base", choices=["bert-base", "bert-tiny", "llama-1b", "llama-tiny"])
+    parser.add_argument("--mode", default="train", choices=["train", "inference"])
     parser.add_argument("--batch_size", type=int, default=None, help="per-chip batch size")
     parser.add_argument("--seq_len", type=int, default=128)
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--mixed_precision", default="bf16")
     args = parser.parse_args()
+
+    if args.mode == "inference":
+        return inference_bench(args)
 
     import jax
     import optax
