@@ -12,6 +12,7 @@ from .llama import (
     llama_1b,
     llama_tiny,
 )
+from .resnet import ResNet, ResNetConfig, create_resnet_model, resnet50, resnet_tiny
 from .mixtral import (
     MixtralConfig,
     MixtralForCausalLM,

@@ -278,6 +278,33 @@ def llama3_8b() -> LlamaConfig:
     return LlamaConfig()
 
 
+def llama3_70b() -> LlamaConfig:
+    """The big-model-inference flagship size (BASELINE.json: Llama-3-70B
+    device_map='auto' across pod)."""
+    return LlamaConfig(
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_hidden_layers=80,
+        num_attention_heads=64,
+        num_key_value_heads=8,
+    )
+
+
+def mistral_7b() -> LlamaConfig:
+    """Mistral-7B dims (BASELINE.json: ZeRO-3→GSPMD config). Same decoder family;
+    sliding-window attention degenerates to full attention at seq <= 4096."""
+    return LlamaConfig(
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        max_position_embeddings=32768,
+        rope_theta=1000000.0,
+    )
+
+
 def llama_1b() -> LlamaConfig:
     return LlamaConfig(
         vocab_size=128256,

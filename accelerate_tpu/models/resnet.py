@@ -1,0 +1,103 @@
+"""ResNet family (v1.5 bottleneck) in flax — the cv_example/data-parallel benchmark
+model (BASELINE.json configs: "examples/cv_example.py — ResNet-50 image
+classification"). NHWC layout (TPU-native conv layout), BatchNorm with mutable
+batch_stats threaded through the Model bundle's apply.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..modeling import Model
+
+
+@dataclass
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    num_channels: int = 3
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = lambda name: nn.BatchNorm(use_running_average=not train, momentum=0.9, name=name)
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False, name="conv1")(x)
+        y = nn.relu(norm("bn1")(y))
+        y = nn.Conv(self.features, (3, 3), self.strides, use_bias=False, name="conv2")(y)
+        y = nn.relu(norm("bn2")(y))
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False, name="conv3")(y)
+        y = norm("bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features * 4, (1, 1), self.strides, use_bias=False, name="downsample_conv"
+            )(residual)
+            residual = norm("downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):  # x: [B, H, W, C] (NHWC)
+        cfg = self.config
+        x = nn.Conv(cfg.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], use_bias=False, name="stem_conv")(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, name="stem_bn")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, size in enumerate(cfg.stage_sizes):
+            for j in range(size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(cfg.width * 2**i, strides, name=f"stage{i}_block{j}")(x, train)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(cfg.num_classes, name="classifier")(x)
+
+
+def image_classification_loss(variables, batch, apply_fn):
+    """Cross-entropy over `pixel_values`/`labels`. BatchNorm runs on (stop-gradiented)
+    running stats inside the differentiated loss so the optimizer never touches
+    `batch_stats` — zero-grad under adam means those leaves stay fixed."""
+    if isinstance(variables, dict) and "batch_stats" in variables:
+        variables = {
+            **variables,
+            "batch_stats": jax.tree_util.tree_map(jax.lax.stop_gradient, variables["batch_stats"]),
+        }
+    logits = apply_fn(variables, batch["pixel_values"])
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, batch["labels"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return nll.mean()
+
+
+def create_resnet_model(config: Optional[ResNetConfig] = None, rng=None, image_size: int = 224) -> Model:
+    config = config or ResNetConfig()
+    if rng is None:
+        rng = jax.random.key(0)
+    module = ResNet(config)
+    sample = jnp.zeros((1, image_size, image_size, config.num_channels), jnp.float32)
+    variables = module.init(rng, sample)
+    return Model.from_flax(module, variables, loss_fn=image_classification_loss)
+
+
+def resnet50(num_classes: int = 1000) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 4, 6, 3), num_classes=num_classes)
+
+
+def resnet18_ish(num_classes: int = 1000) -> ResNetConfig:
+    """Shallow bottleneck variant for quicker runs."""
+    return ResNetConfig(stage_sizes=(2, 2, 2, 2), num_classes=num_classes)
+
+
+def resnet_tiny(num_classes: int = 4) -> ResNetConfig:
+    """Test-size config."""
+    return ResNetConfig(stage_sizes=(1, 1), num_classes=num_classes, width=8)

@@ -1,0 +1,13 @@
+"""Optional rich console integration (parity: reference utils/rich.py — installs a
+rich traceback handler when the package is available)."""
+
+from .imports import is_rich_available
+
+if is_rich_available():
+    from rich.traceback import install
+
+    install(show_locals=False)
+else:
+    raise ModuleNotFoundError(
+        "To use the rich extension, install rich with `pip install rich`"
+    )

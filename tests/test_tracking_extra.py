@@ -1,0 +1,41 @@
+"""Tracker registry parity: all 7 reference integrations + in-tree json/csv are
+registered, availability-gated, and `filter_trackers` behaves per reference
+tracking.py:971 (skip-unavailable with warning, 'all' = available set)."""
+
+import pytest
+
+from accelerate_tpu.tracking import (
+    LOGGER_TYPE_TO_CLASS,
+    _AVAILABILITY,
+    GeneralTracker,
+    filter_trackers,
+)
+
+
+def test_registry_covers_reference_integrations():
+    # reference tracking.py ships: tensorboard, wandb, comet_ml, aim, mlflow,
+    # clearml, dvclive (7) — plus our always-available json/csv
+    for name in ["tensorboard", "wandb", "comet_ml", "aim", "mlflow", "clearml", "dvclive", "json", "csv"]:
+        assert name in LOGGER_TYPE_TO_CLASS, name
+        assert name in _AVAILABILITY, name
+        assert issubclass(LOGGER_TYPE_TO_CLASS[name], GeneralTracker)
+        assert LOGGER_TYPE_TO_CLASS[name].name == name
+
+
+def test_filter_skips_unavailable():
+    # comet_ml/aim/clearml/dvclive aren't installed in this image: selected
+    # explicitly they warn + skip rather than raise
+    out = filter_trackers(["json", "comet_ml"], logging_dir="/tmp/x")
+    assert out == ["json"]
+
+
+def test_filter_all_returns_available_only():
+    out = filter_trackers("all", logging_dir="/tmp/x")
+    assert "json" in out and "csv" in out
+    for name in out:
+        assert _AVAILABILITY[name]()
+
+
+def test_unknown_tracker_raises():
+    with pytest.raises(ValueError, match="Unknown tracker"):
+        filter_trackers("not_a_tracker")
