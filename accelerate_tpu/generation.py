@@ -30,6 +30,7 @@ class GenerationConfig:
     temperature: float = 1.0
     top_k: int = 0  # 0 = full vocab
     eos_token_id: Optional[int] = None
+    pad_token_id: Optional[int] = None  # fill for finished rows; defaults to eos
 
 
 def _sample(logits, config: GenerationConfig, rng):
@@ -97,6 +98,7 @@ class Generator:
         token, rng = _sample(logits, config, rng)
         tokens.append(token)
         finished = np.zeros(b, dtype=bool)
+        pad_id = config.pad_token_id if config.pad_token_id is not None else config.eos_token_id
         for i in range(1, max_new):
             if config.eos_token_id is not None:
                 finished |= np.asarray(tokens[-1]) == config.eos_token_id
@@ -105,6 +107,9 @@ class Generator:
             position = jnp.full((b,), prompt_len + i - 1, jnp.int32)
             logits, cache = self._step(params, cache, tokens[-1], position)
             token, rng = _sample(logits, config, rng)
+            if config.eos_token_id is not None and finished.any():
+                # Rows past their EOS emit pad/eos, matching HF generate's padding.
+                token = jnp.where(jnp.asarray(finished), jnp.int32(pad_id), token)
             tokens.append(token)
         generated = jnp.stack(tokens, axis=1)
         return jnp.concatenate([input_ids, generated], axis=1)
@@ -114,7 +119,7 @@ def generate(model, input_ids, max_new_tokens: int = 32, **kwargs):
     """One-shot convenience: build a Generator and run it (HF `model.generate` shape)."""
     gen_kwargs = {
         k: kwargs.pop(k)
-        for k in ("do_sample", "temperature", "top_k", "eos_token_id")
+        for k in ("do_sample", "temperature", "top_k", "eos_token_id", "pad_token_id")
         if k in kwargs
     }
     generator = Generator(model, max_new_tokens=max_new_tokens, **kwargs)

@@ -72,6 +72,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.atl_gather_submit.restype = c.c_int64
     lib.atl_wait.argtypes = [c.c_void_p, c.c_int64]
+    lib.atl_wait_status.argtypes = [c.c_void_p, c.c_int64]
+    lib.atl_wait_status.restype = c.c_int
     lib.atl_store_open.argtypes = [c.c_char_p]
     lib.atl_store_open.restype = c.c_void_p
     lib.atl_store_close.argtypes = [c.c_void_p]

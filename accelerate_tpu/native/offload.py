@@ -84,7 +84,9 @@ class NativeOffloadStore:
         """Blocking read; consumes a pending prefetch for `name` when one exists."""
         if name in self._tickets:
             ticket, out = self._tickets.pop(name)
-            self.lib.atl_wait(self._pool, ticket)
+            rc = self.lib.atl_wait_status(self._pool, ticket)
+            if rc != 0:
+                raise IOError(f"prefetch read failed for {name!r} in {self.blob_path}")
             return out
         offset, shape, dtype, nbytes = self._meta(name)
         store = self._open_store()

@@ -53,19 +53,29 @@ class Pool {
   int size() const { return static_cast<int>(workers_.size()); }
 
   // Enqueue `n` subtasks under one ticket; ticket completes when all subtasks do.
-  int64_t Submit(std::vector<std::function<void()>> subtasks) {
+  // A subtask returning nonzero marks the whole ticket failed (first error wins).
+  // Zero subtasks complete the ticket immediately.
+  int64_t Submit(std::vector<std::function<int()>> subtasks) {
     int64_t ticket = next_ticket_.fetch_add(1);
+    if (subtasks.empty()) {
+      std::unique_lock<std::mutex> lk(mu_);
+      pending_[ticket] = TicketState{true, 0};
+      done_cv_.notify_all();
+      return ticket;
+    }
     auto remaining = std::make_shared<std::atomic<int64_t>>(
         static_cast<int64_t>(subtasks.size()));
     {
       std::unique_lock<std::mutex> lk(mu_);
-      pending_[ticket] = false;
+      pending_[ticket] = TicketState{false, 0};
       for (auto& fn : subtasks) {
         queue_.emplace_back([this, ticket, remaining, fn = std::move(fn)] {
-          fn();
+          int rc = fn();
+          std::unique_lock<std::mutex> lk(mu_);
+          TicketState& st = pending_[ticket];
+          if (rc != 0 && st.status == 0) st.status = rc;
           if (remaining->fetch_sub(1) == 1) {
-            std::unique_lock<std::mutex> lk(mu_);
-            pending_[ticket] = true;
+            st.done = true;
             done_cv_.notify_all();
           }
         });
@@ -75,16 +85,28 @@ class Pool {
     return ticket;
   }
 
-  void Wait(int64_t ticket) {
+  // Blocks until the ticket completes; returns its status (0 = ok).
+  int Wait(int64_t ticket) {
     std::unique_lock<std::mutex> lk(mu_);
     done_cv_.wait(lk, [this, ticket] {
       auto it = pending_.find(ticket);
-      return it == pending_.end() || it->second;
+      return it == pending_.end() || it->second.done;
     });
-    pending_.erase(ticket);
+    int status = 0;
+    auto it = pending_.find(ticket);
+    if (it != pending_.end()) {
+      status = it->second.status;
+      pending_.erase(it);
+    }
+    return status;
   }
 
  private:
+  struct TicketState {
+    bool done = false;
+    int status = 0;
+  };
+
   void Run() {
     for (;;) {
       std::function<void()> task;
@@ -101,7 +123,7 @@ class Pool {
 
   std::vector<std::thread> workers_;
   std::deque<std::function<void()>> queue_;
-  std::unordered_map<int64_t, bool> pending_;
+  std::unordered_map<int64_t, TicketState> pending_;
   std::mutex mu_;
   std::condition_variable cv_;
   std::condition_variable done_cv_;
@@ -148,11 +170,12 @@ int atl_pool_size(void* pool) { return static_cast<Pool*>(pool)->size(); }
 void atl_gather_rows(void* pool, const void* src, int64_t row_bytes,
                      const int64_t* indices, int64_t n, void* dst) {
   Pool* p = static_cast<Pool*>(pool);
-  std::vector<std::function<void()>> tasks;
+  std::vector<std::function<int()>> tasks;
   for (auto [start, count] : Chunks(n, p->size())) {
     tasks.push_back([=] {
       GatherChunk(static_cast<const char*>(src), row_bytes, indices, start,
                   count, static_cast<char*>(dst));
+      return 0;
     });
   }
   p->Wait(p->Submit(std::move(tasks)));
@@ -164,7 +187,7 @@ int64_t atl_gather_submit(void* pool, const void** srcs,
                           const int64_t* row_bytes, int n_cols,
                           const int64_t* indices, int64_t n_rows, void** dsts) {
   Pool* p = static_cast<Pool*>(pool);
-  std::vector<std::function<void()>> tasks;
+  std::vector<std::function<int()>> tasks;
   for (int c = 0; c < n_cols; ++c) {
     const char* src = static_cast<const char*>(srcs[c]);
     char* dst = static_cast<char*>(dsts[c]);
@@ -172,8 +195,10 @@ int64_t atl_gather_submit(void* pool, const void** srcs,
     // Subdivide large columns so one wide column still uses the whole pool.
     int shards = std::max(1, p->size() / n_cols);
     for (auto [start, count] : Chunks(n_rows, shards)) {
-      tasks.push_back(
-          [=] { GatherChunk(src, rb, indices, start, count, dst); });
+      tasks.push_back([=] {
+        GatherChunk(src, rb, indices, start, count, dst);
+        return 0;
+      });
     }
   }
   return p->Submit(std::move(tasks));
@@ -181,6 +206,11 @@ int64_t atl_gather_submit(void* pool, const void** srcs,
 
 void atl_wait(void* pool, int64_t ticket) {
   static_cast<Pool*>(pool)->Wait(ticket);
+}
+
+// Blocking wait that surfaces the ticket's status (0 = ok, -1 = failed subtask).
+int atl_wait_status(void* pool, int64_t ticket) {
+  return static_cast<Pool*>(pool)->Wait(ticket);
 }
 
 // ------------------------------------------------------------------ offload store
@@ -198,39 +228,24 @@ void atl_store_close(void* store) {
   }
 }
 
+int64_t atl_store_prefetch(void* pool, void* store, int64_t offset,
+                           int64_t nbytes, void* dst);
+
 // Parallel positional read of [offset, offset+nbytes) into dst. Returns 0 on
 // success, -1 on a short/failed read.
 int atl_store_read(void* pool, void* store, int64_t offset, int64_t nbytes,
                    void* dst) {
   Pool* p = static_cast<Pool*>(pool);
-  Store* s = static_cast<Store*>(store);
-  std::atomic<int> status{0};
-  std::vector<std::function<void()>> tasks;
-  for (auto [start, count] : Chunks(nbytes, p->size())) {
-    tasks.push_back([=, &status] {
-      int64_t done = 0;
-      while (done < count) {
-        ssize_t got = ::pread(s->fd, static_cast<char*>(dst) + start + done,
-                              static_cast<size_t>(count - done),
-                              offset + start + done);
-        if (got <= 0) {
-          status.store(-1);
-          return;
-        }
-        done += got;
-      }
-    });
-  }
-  p->Wait(p->Submit(std::move(tasks)));
-  return status.load();
+  return p->Wait(atl_store_prefetch(pool, store, offset, nbytes, dst));
 }
 
-// Async readahead ticket for the same read.
+// Async readahead ticket for the same read; failure is recorded on the ticket
+// and surfaced by atl_wait_status.
 int64_t atl_store_prefetch(void* pool, void* store, int64_t offset,
                            int64_t nbytes, void* dst) {
   Pool* p = static_cast<Pool*>(pool);
   Store* s = static_cast<Store*>(store);
-  std::vector<std::function<void()>> tasks;
+  std::vector<std::function<int()>> tasks;
   for (auto [start, count] : Chunks(nbytes, p->size())) {
     tasks.push_back([=] {
       int64_t done = 0;
@@ -238,9 +253,10 @@ int64_t atl_store_prefetch(void* pool, void* store, int64_t offset,
         ssize_t got = ::pread(s->fd, static_cast<char*>(dst) + start + done,
                               static_cast<size_t>(count - done),
                               offset + start + done);
-        if (got <= 0) return;
+        if (got <= 0) return -1;
         done += got;
       }
+      return 0;
     });
   }
   return p->Submit(std::move(tasks));

@@ -98,6 +98,8 @@ def dot_product_attention(
         import jax
 
         use_flash = jax.default_backend() == "tpu"
+    if use_flash and causal and sq > skv and implementation is None:
+        use_flash = False  # degenerate mask shape the kernel rejects; use XLA path
     if use_flash:
         from .flash_attention import flash_attention
 

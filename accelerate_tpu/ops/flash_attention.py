@@ -24,22 +24,25 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _causal_block_visible(iq, ik, block_q: int, block_k: int) -> "jnp.ndarray":
-    """Whether KV block ik has any unmasked position for Q block iq."""
+def _causal_block_visible(iq, ik, block_q: int, block_k: int, offset: int) -> "jnp.ndarray":
+    """Whether KV block ik has any unmasked position for Q block iq.
+
+    `offset = Skv - Sq` gives bottom-right alignment (query i attends keys
+    j <= i + offset), matching `ops.attention.make_causal_mask`."""
     q_last = (iq + 1) * block_q - 1
     k_first = ik * block_k
-    return k_first <= q_last
+    return k_first <= q_last + offset
 
 
-def _block_mask(iq, ik, block_q: int, block_k: int):
-    """[Bq, Bk] causal mask for the (iq, ik) tile (True = attend)."""
+def _block_mask(iq, ik, block_q: int, block_k: int, offset: int):
+    """[Bq, Bk] bottom-right-aligned causal mask for the (iq, ik) tile (True = attend)."""
     rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + iq * block_q
     cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + ik * block_k
-    return cols <= rows
+    return cols <= rows + offset
 
 
 # ---------------------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale, causal, block_q, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale, causal, block_q, block_k, offset):
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -52,7 +55,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
 
-    run = _causal_block_visible(iq, ik, block_q, block_k) if causal else True
+    run = _causal_block_visible(iq, ik, block_q, block_k, offset) if causal else True
 
     @pl.when(run)
     def _step():
@@ -63,7 +66,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [Bq, Bk]
         if causal:
-            s = jnp.where(_block_mask(iq, ik, block_q, block_k), s, NEG_INF)
+            s = jnp.where(_block_mask(iq, ik, block_q, block_k, offset), s, NEG_INF)
         m_prev = m_scr[:, 0:1]  # [Bq, 1]
         l_prev = l_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -92,7 +95,7 @@ def _fwd_call(q, k, v, scale, causal, block_q, block_k, interpret):
     Sk = k.shape[1]
     grid = (BH, S // block_q, Sk // block_k)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, offset=Sk - S
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -121,7 +124,7 @@ def _fwd_call(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 # --------------------------------------------------------------------------- backward
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_k):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_k, offset):
     from jax.experimental import pallas as pl
 
     ik = pl.program_id(1)
@@ -133,7 +136,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = _causal_block_visible(iq, ik, block_q, block_k) if causal else True
+    run = _causal_block_visible(iq, ik, block_q, block_k, offset) if causal else True
 
     @pl.when(run)
     def _step():
@@ -147,7 +150,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            s = jnp.where(_block_mask(iq, ik, block_q, block_k), s, NEG_INF)
+            s = jnp.where(_block_mask(iq, ik, block_q, block_k, offset), s, NEG_INF)
         p = jnp.exp(s - lse)  # [Bq, Bk]
         # dv += p^T @ do
         dv_acc[:] += jax.lax.dot_general(
@@ -169,7 +172,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, scale, causal, block_q, block_k, offset):
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -180,7 +183,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = _causal_block_visible(iq, ik, block_q, block_k) if causal else True
+    run = _causal_block_visible(iq, ik, block_q, block_k, offset) if causal else True
 
     @pl.when(run)
     def _step():
@@ -194,7 +197,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            s = jnp.where(_block_mask(iq, ik, block_q, block_k), s, NEG_INF)
+            s = jnp.where(_block_mask(iq, ik, block_q, block_k, offset), s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -218,7 +221,7 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, S]
 
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, offset=Sk - S
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -247,7 +250,7 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret):
     )(q, k, v, do, lse, delta)
 
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, offset=Sk - S
     )
     dq = pl.pallas_call(
         dq_kernel,
@@ -316,6 +319,10 @@ def flash_attention(
     block_k = min(block_k, skv)
     if sq % block_q or skv % block_k:
         raise ValueError(f"Sequence lengths ({sq}, {skv}) must divide blocks ({block_q}, {block_k})")
+    if causal and sq > skv:
+        # Bottom-right alignment would leave the first (sq - skv) query rows with no
+        # visible keys — a degenerate mask the XLA path also can't represent sensibly.
+        raise ValueError(f"causal flash attention requires Sq <= Skv, got ({sq}, {skv})")
     if hq != hkv:
         if hq % hkv:
             raise ValueError(f"GQA requires Hq % Hkv == 0, got {hq}, {hkv}")

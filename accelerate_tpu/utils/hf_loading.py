@@ -233,12 +233,19 @@ def save_hf_checkpoint(params: dict, model_type: str, config, save_path: str):
     from safetensors.numpy import save_file
 
     flat = export_hf_state_dict(params, model_type, config)
-    # safetensors-numpy can't take bf16 ml_dtypes arrays directly; view as uint16
-    clean = {}
-    for k, v in flat.items():
-        if v.dtype.name == "bfloat16":
-            clean[k] = v.view(np.uint16)
-        else:
-            clean[k] = v
     os.makedirs(os.path.dirname(os.path.abspath(save_path)), exist_ok=True)
-    save_file(clean, save_path)
+    try:
+        # safetensors >= 0.4 writes ml_dtypes bfloat16 arrays as real BF16, so the
+        # file round-trips through HF transformers and load_hf_state_dict.
+        save_file(dict(flat), save_path)
+    except (TypeError, ValueError):
+        # Old safetensors without numpy-bf16 support: record the view in metadata
+        # so readers can restore the dtype.
+        clean, viewed = {}, []
+        for k, v in flat.items():
+            if v.dtype.name == "bfloat16":
+                clean[k] = v.view(np.uint16)
+                viewed.append(k)
+            else:
+                clean[k] = v
+        save_file(clean, save_path, metadata={"bfloat16_as_uint16": ",".join(viewed)})

@@ -301,6 +301,13 @@ def load_safetensors_state_dict(path: str) -> dict:
 
     out = {}
     with safe_open(path, framework="np") as f:
+        meta = f.metadata() or {}
+        # Files written by old safetensors versions record bf16 tensors as U16 views
+        # (see hf_loading.save_hf_checkpoint fallback); restore the real dtype.
+        viewed = set(filter(None, meta.get("bfloat16_as_uint16", "").split(",")))
         for key in f.keys():
-            out[key] = f.get_tensor(key)
+            t = f.get_tensor(key)
+            if key in viewed:
+                t = t.view("bfloat16")
+            out[key] = t
     return out

@@ -58,6 +58,31 @@ def test_flash_grads_match_xla(causal):
         )
 
 
+def test_flash_causal_cross_length_matches_xla():
+    """Causal with Sq != Skv must use bottom-right alignment like the XLA path
+    (advisor: the kernel was top-left aligned, silently diverging)."""
+    rng = np.random.default_rng(3)
+    b, h, d = 1, 2, 32
+    sq, skv = 64, 192
+    q = jnp.asarray(rng.normal(size=(b, sq, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, skv, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, skv, h, d)).astype(np.float32))
+    ref = dot_product_attention(q, k, v, causal=True, implementation="xla")
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    # gradients agree too
+    def loss_flash(q_):
+        return jnp.sum(jnp.square(flash_attention(q_, k, v, causal=True, block_q=64, block_k=64, interpret=True)))
+
+    def loss_ref(q_):
+        return jnp.sum(jnp.square(dot_product_attention(q_, k, v, causal=True, implementation="xla")))
+
+    gf = jax.grad(loss_flash)(q)
+    gr = jax.grad(loss_ref)(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), rtol=5e-4, atol=5e-4)
+
+
 def test_flash_uneven_blocks_rejected():
     q, k, v = _qkv(1, 96, 2, 32)
     with pytest.raises(ValueError, match="divide"):

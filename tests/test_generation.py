@@ -85,6 +85,26 @@ def test_eos_early_stop():
     assert out.shape[1] == prompt.shape[1] + 1
 
 
+def test_finished_rows_padded_after_eos():
+    """In a batch, rows that hit EOS emit pad/eos afterwards, not live samples
+    (advisor: finished sequences carried post-EOS garbage)."""
+    model = _model()
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(1, 128, (2, 4)).astype(np.int32)
+    # pick row 0's first greedy token as eos so row 0 finishes immediately
+    first = np.asarray(generate(model, prompt, max_new_tokens=1))[:, -1]
+    eos = int(first[0])
+    if int(first[1]) == eos:
+        pytest.skip("both rows emit the same first token; can't distinguish")
+    out = np.asarray(
+        generate(model, prompt, max_new_tokens=6, eos_token_id=eos, pad_token_id=0)
+    )
+    row0_gen = out[0, prompt.shape[1]:]
+    # first generated token is eos, everything after must be the pad id
+    assert row0_gen[0] == eos
+    assert (row0_gen[1:] == 0).all()
+
+
 def test_cache_capacity_validation():
     model = _model()
     gen = Generator(model, max_new_tokens=4, max_length=8)

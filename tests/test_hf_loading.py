@@ -77,6 +77,30 @@ def test_safetensors_file_round_trip():
     np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
 
 
+def test_bf16_export_is_real_bf16():
+    """bf16 checkpoints must record dtype BF16, not U16 (advisor finding): the file
+    has to load back as bfloat16 in HF transformers and in load_hf_state_dict."""
+    import ml_dtypes
+    import jax.tree_util as jtu
+    from safetensors import safe_open
+
+    cfg = _tiny_llama()
+    model = create_llama_model(cfg, seq_len=16)
+    bf16_params = jtu.tree_map(
+        lambda a: np.asarray(a).astype(ml_dtypes.bfloat16), model.params
+    )
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.safetensors")
+        save_hf_checkpoint(bf16_params, "llama", cfg, path)
+        with safe_open(path, framework="np") as f:
+            meta = f.metadata()
+            name = next(iter(f.keys()))
+            assert f.get_tensor(name).dtype == ml_dtypes.bfloat16
+        assert not meta or "bfloat16_as_uint16" not in (meta or {})
+        loaded = load_hf_state_dict(path)
+        assert all(v.dtype == ml_dtypes.bfloat16 for v in loaded.values())
+
+
 def test_torch_bin_round_trip():
     torch = pytest.importorskip("torch")
     cfg = _tiny_llama()

@@ -104,6 +104,46 @@ def test_offload_store_round_trip_and_prefetch():
         store2.close()
 
 
+def test_empty_gather_does_not_hang():
+    """Zero-subtask tickets complete immediately (advisor: Submit([]) used to deadlock)."""
+    cols = _columns(n=8, seed=5)
+    pool = NativeGatherPool(num_threads=2)
+    out = pool.gather(cols, [])
+    for k in cols:
+        assert out[k].shape[0] == 0
+    t = pool.submit(cols, [])
+    out2 = pool.wait(t)
+    assert out2["x"].shape[0] == 0
+    pool.close()
+
+
+def test_empty_store_read_and_prefetch():
+    with tempfile.TemporaryDirectory() as d:
+        store = NativeOffloadStore(d, num_threads=2)
+        store.save({"empty": np.zeros((0, 4), dtype=np.float32)})
+        got = store.read("empty")
+        assert got.shape == (0, 4)
+        store.prefetch("empty")
+        got = store.read("empty")
+        assert got.shape == (0, 4)
+        store.close()
+
+
+def test_prefetch_failure_surfaces_ioerror():
+    """A prefetch whose pread fails raises on read() instead of returning garbage."""
+    with tempfile.TemporaryDirectory() as d:
+        store = NativeOffloadStore(d, num_threads=2)
+        store.save({"w": np.arange(1024, dtype=np.float32)})
+        if store.lib is None:
+            pytest.skip("native lib unavailable")
+        # Corrupt the index so the read runs past EOF (short read).
+        store.index["w"]["offset"] = 10**9
+        store.prefetch("w")
+        with pytest.raises(IOError):
+            store.read("w")
+        store.close()
+
+
 def test_fallback_without_native(monkeypatch):
     import importlib
 
