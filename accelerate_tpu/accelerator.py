@@ -550,6 +550,47 @@ class Accelerator:
             return loss, aux
         return loss
 
+    def train_step(
+        self,
+        loss_fn: Optional[Callable] = None,
+        *,
+        model: Optional[PreparedModel] = None,
+        max_grad_norm: Optional[float] = None,
+        accumulation_steps: Optional[int] = None,
+    ):
+        """Build the fused per-step program: ONE jitted call doing
+        value_and_grad + (clip) + optimizer update with donated params/opt-state,
+        with `lax.scan` microbatch accumulation when `accumulation_steps > 1`.
+
+        This is the TPU performance path; `backward()`/`optimizer.step()` remain as
+        the eager-feel compatibility surface (reference accelerator.py:2093-2121).
+
+        Usage::
+
+            step_fn = accelerator.train_step(max_grad_norm=1.0)
+            for batch in loader:
+                loss = step_fn(batch)
+                scheduler.step()
+
+        `accumulation_steps` defaults to the Accelerator's
+        `gradient_accumulation_steps`; in that mode pass one batch pytree whose
+        arrays stack the microbatches along dim 0 (`[k*b, ...]`).
+        """
+        from .train_step import FusedTrainStep
+
+        model = self._resolve_model(model)
+        optimizer = self._optimizer_for(model)
+        if accumulation_steps is None:
+            accumulation_steps = self.gradient_state.num_steps
+        return FusedTrainStep(
+            model,
+            optimizer,
+            loss_fn=loss_fn,
+            max_grad_norm=max_grad_norm,
+            accumulation_steps=accumulation_steps,
+            gradient_state=self.gradient_state,
+        )
+
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: int = 2, model=None):
         """Clip accumulated grads by global norm; no-op while accumulating
         (reference accelerator.py:2221)."""

@@ -192,13 +192,20 @@ def causal_lm_loss(params, batch, apply_fn):
     return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
 
 
-def create_llama_model(config: Optional[LlamaConfig] = None, rng=None, seq_len: int = 2048) -> Model:
+def create_llama_model(
+    config: Optional[LlamaConfig] = None, rng=None, seq_len: int = 2048, param_dtype=None
+) -> Model:
     config = config or llama_tiny()
     if rng is None:
         rng = jax.random.key(0)
     module = LlamaForCausalLM(config)
     sample = jnp.zeros((1, min(seq_len, config.max_position_embeddings)), dtype=jnp.int32)
     params = module.init(rng, sample)
+    if param_dtype is not None:
+        dtype = jnp.dtype(param_dtype)
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, params
+        )
     return Model.from_flax(module, params, loss_fn=causal_lm_loss, sharding_rules=LLAMA_SHARDING_RULES)
 
 

@@ -63,6 +63,58 @@ class GradScaler:
         self._growth_tracker = state["growth_tracker"]
 
 
+def apply_update_core(
+    tx,
+    params,
+    opt_state,
+    grads,
+    inv_scale,
+    lr_override=None,
+    *,
+    use_scaler: bool = False,
+    max_norm: Optional[float] = None,
+):
+    """Shared traced body of the optimizer update, used by both the eager
+    `AcceleratedOptimizer._update_fn` and the fused train step so their semantics
+    cannot drift: unscale grads -> finite check -> optional global-norm clip ->
+    optional LR override -> tx.update -> skip-revert on non-finite.
+
+    Matches the reference ordering: gradients are unscaled BEFORE clipping
+    (reference accelerator.py:2186 unscale_gradients inside clip_grad_norm_).
+    Returns (new_params, new_opt_state, finite).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    grads = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
+    finite = jnp.array(True)
+    if use_scaler:
+        finite = jnp.all(
+            jnp.stack([jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)])
+        )
+    if max_norm is not None:
+        norm = jnp.sqrt(
+            sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(grads))
+        )
+        factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+        grads = jax.tree_util.tree_map(lambda g: (g * factor).astype(g.dtype), grads)
+    if lr_override is not None and hasattr(opt_state, "hyperparams"):
+        opt_state = opt_state._replace(hyperparams={**opt_state.hyperparams, "learning_rate": lr_override})
+    updates, new_opt_state = tx.update(grads, opt_state, params)
+    new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    if use_scaler:
+        # Skipped step on non-finite grads: keep the old state untouched.
+        new_params = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(finite, new, old), new_params, params
+        )
+        new_opt_state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(finite, new, old) if hasattr(new, "shape") else new,
+            new_opt_state,
+            opt_state,
+        )
+    return new_params, new_opt_state, finite
+
+
 class AcceleratedOptimizer:
     """Wraps an `optax.GradientTransformation` bound to a `PreparedModel`
     (reference AcceleratedOptimizer optimizer.py:38).
@@ -88,6 +140,7 @@ class AcceleratedOptimizer:
         self.step_was_skipped = False
         self._accum_count = 0
         self._grads = None
+        self._grads_unscaled = False  # set by clip_*: grads already divided by loss scale
         self._jit_cache: dict = {}
 
         if model is not None:
@@ -127,6 +180,7 @@ class AcceleratedOptimizer:
         """Add a microbatch's gradients into the accumulation buffer."""
         if self._grads is None:
             self._grads = grads
+            self._grads_unscaled = False
         else:
             self._grads = self._accumulate_fn()(self._grads, grads)
         self._accum_count += 1
@@ -136,18 +190,28 @@ class AcceleratedOptimizer:
         return self._grads
 
     # ---- clipping --------------------------------------------------------------------
+    def _unscale_factor(self) -> float:
+        """1/loss_scale the first time grads are touched pre-step; 1.0 after
+        (the reference's unscale_gradients-once contract, accelerator.py:2186)."""
+        if self.scaler is not None and self.scaler.enabled and not self._grads_unscaled:
+            self._grads_unscaled = True
+            return 1.0 / self.scaler.scale
+        return 1.0
+
     def clip_grad_norm_(self, max_norm: float):
-        """Clip accumulated grads by global norm; returns the pre-clip norm
-        (reference accelerator.py:2221-2269)."""
+        """Unscale then clip accumulated grads by global norm; returns the pre-clip
+        (unscaled) norm (reference accelerator.py:2221-2269, which unscales first)."""
         import jax
         import jax.numpy as jnp
 
         if self._grads is None:
             return None
+        inv_scale = self._unscale_factor()
         key = ("clip", float(max_norm))
         if key not in self._jit_cache:
 
-            def _clip(grads):
+            def _clip(grads, inv):
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
                 norm = jnp.sqrt(
                     sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(grads))
                 )
@@ -155,53 +219,36 @@ class AcceleratedOptimizer:
                 return jax.tree_util.tree_map(lambda g: (g * factor).astype(g.dtype), grads), norm
 
             self._jit_cache[key] = jax.jit(_clip, donate_argnums=(0,))
-        self._grads, norm = self._jit_cache[key](self._grads)
+        self._grads, norm = self._jit_cache[key](self._grads, jnp.asarray(inv_scale, jnp.float32))
         return norm
 
     def clip_grad_value_(self, clip_value: float):
         import jax
+        import jax.numpy as jnp
 
         if self._grads is None:
             return
+        inv_scale = self._unscale_factor()
         key = ("clipv", float(clip_value))
         if key not in self._jit_cache:
 
-            def _clip(grads):
-                return jax.tree_util.tree_map(lambda g: g.clip(-clip_value, clip_value), grads)
+            def _clip(grads, inv):
+                return jax.tree_util.tree_map(lambda g: (g * inv).clip(-clip_value, clip_value), grads)
 
             self._jit_cache[key] = jax.jit(_clip, donate_argnums=(0,))
-        self._grads = self._jit_cache[key](self._grads)
+        self._grads = self._jit_cache[key](self._grads, jnp.asarray(inv_scale, jnp.float32))
 
     # ---- the update ------------------------------------------------------------------
     def _update_fn(self):
         import jax
-        import jax.numpy as jnp
 
         if "update" not in self._jit_cache:
+            use_scaler = self.scaler is not None and self.scaler.enabled
 
             def _update(params, opt_state, grads, inv_scale, lr_override):
-                grads = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
-                finite = jnp.array(True)
-                if self.scaler is not None and self.scaler.enabled:
-                    finite = jnp.all(
-                        jnp.stack([jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)])
-                    )
-                if hasattr(opt_state, "hyperparams") and lr_override is not None:
-                    opt_state = opt_state._replace(
-                        hyperparams={**opt_state.hyperparams, "learning_rate": lr_override}
-                    )
-                updates, new_opt_state = self.tx.update(grads, opt_state, params)
-                new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-                # Skipped step on non-finite grads: keep the old state untouched.
-                new_params = jax.tree_util.tree_map(
-                    lambda new, old: jnp.where(finite, new, old), new_params, params
+                return apply_update_core(
+                    self.tx, params, opt_state, grads, inv_scale, lr_override, use_scaler=use_scaler
                 )
-                new_opt_state = jax.tree_util.tree_map(
-                    lambda new, old: jnp.where(finite, new, old) if hasattr(new, "shape") else new,
-                    new_opt_state,
-                    opt_state,
-                )
-                return new_params, new_opt_state, finite
 
             donate = (0, 1, 2)
             self._jit_cache["update"] = jax.jit(_update, donate_argnums=donate)
@@ -218,15 +265,14 @@ class AcceleratedOptimizer:
         if self._grads is None:
             self.step_was_skipped = True
             return
-        inv_scale = 1.0
-        if self.scaler is not None and self.scaler.enabled:
-            inv_scale = 1.0 / self.scaler.scale
+        inv_scale = self._unscale_factor()
         lr = self._lr_override
         new_params, new_opt_state, finite = self._update_fn()(
             self.model.params, self.opt_state, self._grads, jnp.asarray(inv_scale, jnp.float32), lr
         )
         self._grads = None
         self._accum_count = 0
+        self._grads_unscaled = False
         if self.scaler is not None and self.scaler.enabled:
             found_inf = not bool(finite)
             self.scaler.update(found_inf)
@@ -243,6 +289,7 @@ class AcceleratedOptimizer:
         if self.gradient_state.sync_gradients:
             self._grads = None
             self._accum_count = 0
+            self._grads_unscaled = False
 
     # ---- scheduler hook --------------------------------------------------------------
     def set_learning_rate(self, lr: float):

@@ -1,0 +1,183 @@
+"""Fused training step: one compiled program per optimizer step.
+
+The eager-feel path (`Accelerator.backward` -> `optimizer.step` -> `zero_grad`)
+dispatches >=3 compiled programs per step (grad, accumulate-add, update) with host
+round-trips between them — the reference's backward/step choreography
+(accelerator.py:2093-2121, optimizer.py:125-168) translated call-for-call. On TPU
+the dispatch gaps are dead MXU time, so the hot path belongs in ONE jitted call:
+value_and_grad + optional global-norm clip + optax update, with donated
+params/opt-state so XLA updates weights in place in HBM.
+
+Gradient accumulation becomes a `lax.scan` over microbatches inside the same
+program (SURVEY §7 "hard parts": the `sync_gradients` boundary is the scan
+boundary), instead of N eager microbatch dispatches plus an accumulate-add each.
+
+The eager API remains the compatibility surface; `Accelerator.train_step` is the
+performance path used by `bench.py` and `examples/`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class FusedTrainStep:
+    """Callable `step_fn(batch) -> loss` running grad+clip+update as one program.
+
+    - `loss_fn(params, *args, **kwargs)` returns a scalar loss (or `(loss, aux)`);
+      defaults to the model bundle's `loss`.
+    - `accumulation_steps=k > 1`: the call takes ONE positional batch pytree whose
+      arrays stack k microbatches along dim 0 (shape `[k*b, ...]`); gradients are
+      accumulated across a `lax.scan` and the mean microbatch loss is returned
+      (aux outputs are not available in this mode).
+    - fp16 dynamic loss scaling and skipped-step detection follow the eager path's
+      contract (`optimizer.step_was_skipped`, scaler backoff).
+    - The learning-rate override installed by `AcceleratedScheduler.step()` via
+      `optimizer.set_learning_rate` is honored (requires `optax.inject_hyperparams`,
+      same as the eager path).
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer,
+        loss_fn: Optional[Callable] = None,
+        max_grad_norm: Optional[float] = None,
+        accumulation_steps: int = 1,
+        gradient_state=None,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn if loss_fn is not None else model.loss
+        self.max_grad_norm = max_grad_norm
+        self.accumulation_steps = int(accumulation_steps or 1)
+        self.gradient_state = gradient_state
+        self._jitted: dict = {}
+
+    # ---- program construction ---------------------------------------------------------
+    def _build(self, with_lr: bool):
+        import jax
+        import jax.numpy as jnp
+
+        tx = self.optimizer.tx
+        k = self.accumulation_steps
+        max_norm = self.max_grad_norm
+        scaler = self.optimizer.scaler
+        use_scaler = scaler is not None and scaler.enabled
+        loss_fn = self.loss_fn
+        mesh = getattr(self.model, "mesh", None)
+
+        def grads_of(params, scale, *args, **kwargs):
+            def scaled(p):
+                out = loss_fn(p, *args, **kwargs)
+                loss, aux = out if isinstance(out, tuple) else (out, None)
+                return loss * scale, (loss, aux)
+
+            return jax.grad(scaled, has_aux=True)(params)
+
+        def split_microbatches(batch):
+            def _split(x):
+                if x.shape[0] % k:
+                    raise ValueError(
+                        f"accumulation_steps={k} must divide the batch dim ({x.shape[0]})"
+                    )
+                return x.reshape((k, x.shape[0] // k) + x.shape[1:])
+
+            mb = jax.tree_util.tree_map(_split, batch)
+            if mesh is not None and ("data" in mesh.shape or "fsdp" in mesh.shape):
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                axes = tuple(a for a in ("data", "fsdp") if a in mesh.shape)
+                spec = NamedSharding(mesh, PartitionSpec(None, axes))
+
+                def _constrain(x):
+                    if x.ndim >= 2:
+                        return jax.lax.with_sharding_constraint(x, spec)
+                    return x
+
+                mb = jax.tree_util.tree_map(_constrain, mb)
+            return mb
+
+        def fused(params, opt_state, scale, inv_scale, lr, *args, **kwargs):
+            if k > 1:
+                if len(args) != 1 or kwargs:
+                    raise ValueError(
+                        "accumulation_steps > 1 takes exactly one positional batch pytree"
+                    )
+                microbatches = split_microbatches(args[0])
+
+                def body(acc, mbatch):
+                    g, (loss, _aux) = grads_of(params, scale, mbatch)
+                    return jax.tree_util.tree_map(jnp.add, acc, g), loss
+
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+                grads, losses = jax.lax.scan(body, zeros, microbatches)
+                loss, aux = jnp.mean(losses), None
+            else:
+                grads, (loss, aux) = grads_of(params, scale, *args, **kwargs)
+
+            from .optimizer import apply_update_core
+
+            new_params, new_opt_state, finite = apply_update_core(
+                tx,
+                params,
+                opt_state,
+                grads,
+                inv_scale,
+                lr if with_lr else None,
+                use_scaler=use_scaler,
+                max_norm=max_norm,
+            )
+            return new_params, new_opt_state, loss, aux, finite
+
+        return jax.jit(fused, donate_argnums=(0, 1))
+
+    # ---- the hot call -----------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        import jax.numpy as jnp
+
+        opt = self.optimizer
+        scaler = opt.scaler
+        use_scaler = scaler is not None and scaler.enabled
+        loss_scale = scaler.scale if use_scaler else 1.0
+        scale = loss_scale / self.accumulation_steps
+        inv_scale = 1.0 / loss_scale
+        lr = opt._lr_override
+        with_lr = lr is not None
+        if with_lr not in self._jitted:
+            self._jitted[with_lr] = self._build(with_lr)
+        new_params, new_opt_state, loss, aux, finite = self._jitted[with_lr](
+            self.model.params,
+            opt.opt_state,
+            jnp.asarray(scale, jnp.float32),
+            jnp.asarray(inv_scale, jnp.float32),
+            jnp.asarray(lr if with_lr else 0.0, jnp.float32),
+            *args,
+            **kwargs,
+        )
+        self.model.params = new_params
+        opt.opt_state = new_opt_state
+        opt._grads = None
+        opt._accum_count = 0
+        if use_scaler:
+            found_inf = not bool(finite)
+            scaler.update(found_inf)
+            opt.step_was_skipped = found_inf
+            if found_inf:
+                logger.warning(
+                    "Skipping fused step: non-finite gradients (loss scale -> %s)", scaler.scale
+                )
+        else:
+            opt.step_was_skipped = False
+        # Every fused call IS a full optimizer step: mark the sync boundary so
+        # schedulers/clipping/gather_for_metrics see the same contract as the
+        # eager accumulate() flow.
+        if self.gradient_state is not None:
+            self.gradient_state._set_sync_gradients(True)
+        if aux is not None:
+            return loss, aux
+        return loss

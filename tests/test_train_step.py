@@ -1,0 +1,178 @@
+"""Fused train step tests: the single-dispatch performance path must reproduce the
+eager backward/step/zero_grad trajectory exactly (same updates, same scaler and
+scheduler semantics), including `lax.scan` microbatch accumulation."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils import GradientAccumulationPlugin
+
+from test_training import make_regression_data, make_regression_model
+
+
+def _reset():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _run_eager(data, batch_size, accum=1, lr=0.05, max_norm=None, steps_epochs=2):
+    _reset()
+    accelerator = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=accum, sync_with_dataloader=False
+        )
+    )
+    model = make_regression_model(seed=0)
+    dl = SimpleDataLoader(data, BatchSampler(range(len(data)), batch_size))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(lr), dl)
+    losses = []
+    for _ in range(steps_epochs):
+        for batch in pdl:
+            with accelerator.accumulate(pmodel):
+                loss = accelerator.backward(pmodel.loss, batch)
+                if max_norm is not None:
+                    accelerator.clip_grad_norm_(max_norm=max_norm)
+                popt.step()
+                popt.zero_grad()
+            losses.append(float(loss))
+    return losses, pmodel.params
+
+
+def _run_fused(data, batch_size, accum=1, lr=0.05, max_norm=None, steps_epochs=2):
+    _reset()
+    accelerator = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=accum, sync_with_dataloader=False
+        )
+    )
+    model = make_regression_model(seed=0)
+    # fused mode consumes the full accumulation span in one call
+    dl = SimpleDataLoader(data, BatchSampler(range(len(data)), batch_size * accum))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(lr), dl)
+    step_fn = accelerator.train_step(max_grad_norm=max_norm)
+    losses = []
+    for _ in range(steps_epochs):
+        for batch in pdl:
+            losses.append(float(step_fn(batch)))
+    return losses, pmodel.params
+
+
+def _assert_params_close(a, b, rtol=2e-5, atol=1e-6):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=atol)
+
+
+def test_fused_matches_eager_trajectory():
+    data = make_regression_data(64, seed=5)
+    eager_losses, eager_params = _run_eager(data, batch_size=16)
+    fused_losses, fused_params = _run_fused(data, batch_size=16)
+    np.testing.assert_allclose(np.array(fused_losses), np.array(eager_losses), rtol=2e-5, atol=1e-6)
+    _assert_params_close(fused_params, eager_params)
+
+
+def test_fused_scan_accumulation_matches_eager_accumulation():
+    data = make_regression_data(64, seed=6)
+    _, eager_params = _run_eager(data, batch_size=8, accum=4)
+    fused_losses, fused_params = _run_fused(data, batch_size=8, accum=4)
+    # 64 samples / (8*4) per fused step = 2 steps/epoch
+    assert len(fused_losses) == 4
+    _assert_params_close(fused_params, eager_params, rtol=1e-4)
+
+
+def test_fused_clipping_matches_eager_clipping():
+    data = make_regression_data(64, seed=7)
+    _, eager_params = _run_eager(data, batch_size=16, max_norm=0.5)
+    _, fused_params = _run_fused(data, batch_size=16, max_norm=0.5)
+    _assert_params_close(fused_params, eager_params, rtol=1e-4)
+
+
+def test_fused_fp16_clipping_matches_eager():
+    """fp16 + clipping: both paths must clip UNSCALED grads (the reference
+    unscale-before-clip contract) and land on the same params."""
+
+    def run(fused):
+        _reset()
+        accelerator = Accelerator(mixed_precision="fp16")
+        model = make_regression_model(seed=0)
+        data = make_regression_data(64, seed=11)
+        dl = SimpleDataLoader(data, BatchSampler(range(64), 16))
+        pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+        if fused:
+            step_fn = accelerator.train_step(max_grad_norm=0.5)
+            for _ in range(2):
+                for batch in pdl:
+                    step_fn(batch)
+        else:
+            for _ in range(2):
+                for batch in pdl:
+                    with accelerator.accumulate(pmodel):
+                        accelerator.backward(pmodel.loss, batch)
+                        accelerator.clip_grad_norm_(max_norm=0.5)
+                        popt.step()
+                        popt.zero_grad()
+        return pmodel.params
+
+    _assert_params_close(run(fused=True), run(fused=False), rtol=2e-3, atol=1e-4)
+
+
+def test_fused_fp16_skips_on_overflow():
+    _reset()
+    accelerator = Accelerator(mixed_precision="fp16")
+    model = make_regression_model(seed=0)
+    data = make_regression_data(16, seed=8)
+    dl = SimpleDataLoader(data, BatchSampler(range(16), 8))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+    step_fn = accelerator.train_step()
+    scale_before = popt.scaler.scale
+    params_before = jax.tree_util.tree_map(np.asarray, pmodel.params)
+    bad = {"x": np.full((8, 1), np.inf, np.float32), "y": np.zeros(8, np.float32)}
+    step_fn(bad)
+    assert popt.step_was_skipped
+    assert popt.scaler.scale < scale_before
+    _assert_params_close(pmodel.params, params_before)
+    # good batches afterwards recover (the scaler backs off until grads fit fp16)
+    good = next(iter(pdl))
+    for _ in range(12):
+        step_fn(good)
+        if not popt.step_was_skipped:
+            break
+    assert not popt.step_was_skipped
+
+
+def test_fused_honors_scheduler_lr_override():
+    _reset()
+    accelerator = Accelerator()
+    model = make_regression_model(seed=0)
+    data = make_regression_data(32, seed=9)
+    dl = SimpleDataLoader(data, BatchSampler(range(32), 8))
+    schedule = optax.linear_schedule(0.1, 0.0, 16)
+    tx = optax.inject_hyperparams(optax.sgd)(learning_rate=0.1)
+    pmodel, popt, pdl, sched = accelerator.prepare(model, tx, dl, schedule)
+    step_fn = accelerator.train_step()
+    for batch in pdl:
+        step_fn(batch)
+        sched.step()
+    # scheduler advanced and pushed a decayed LR into the fused update
+    assert sched.step_count > 0
+    assert popt.learning_rate is not None and popt.learning_rate < 0.1
+
+
+def test_fused_step_marks_sync_boundary():
+    _reset()
+    accelerator = Accelerator(gradient_accumulation_steps=2)
+    model = make_regression_model(seed=0)
+    data = make_regression_data(32, seed=10)
+    dl = SimpleDataLoader(data, BatchSampler(range(32), 16))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+    step_fn = accelerator.train_step()
+    batch = next(iter(pdl))
+    step_fn(batch)
+    assert accelerator.sync_gradients
