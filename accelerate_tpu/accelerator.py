@@ -431,6 +431,7 @@ class Accelerator:
             compute_dtype=compute_dtype,
             autocast=autocast,
             fp8_recipe=fp8_recipe,
+            offload_params=bool(getattr(self.state.fsdp_plugin, "offload_params", False)),
         )
         self._models.append(prepared)
         return prepared
@@ -530,6 +531,10 @@ class Accelerator:
             import jax
 
             def _compute(params, scale, *fargs, **fkwargs):
+                # Host-offloaded params stream to device memory OUTSIDE the grad
+                # closure so gradients come out device-resident.
+                params = model.to_compute_memory(params)
+
                 def scaled(p):
                     out = loss_fn(p, *fargs, **fkwargs)
                     loss, aux = out if isinstance(out, tuple) else (out, None)

@@ -177,6 +177,16 @@ class LocalSGD:
                 self._opt_stacked_mask,
             )
             opt.opt_state_sharding = None
+            if getattr(opt, "offload_opt_state", False):
+                # Collapse loses the derived shardings the host tier needs; keep the
+                # state on device rather than silently mis-placing it.
+                from .logging import get_logger
+
+                get_logger(__name__).warning(
+                    "LocalSGD collapse disables optimizer-state host offload; "
+                    "state stays in device memory from here on."
+                )
+                opt.offload_opt_state = False
             opt._jit_cache.clear()
         model.loss_fn = self._saved_loss_fn
         self._saved_loss_fn = None

@@ -95,6 +95,7 @@ class PreparedModel:
         compute_dtype=None,
         autocast: bool = True,
         fp8_recipe=None,
+        offload_params: bool = False,
     ):
         import jax
 
@@ -103,11 +104,27 @@ class PreparedModel:
         self.loss_fn = model.loss_fn
         self.sharding_rules = model.sharding_rules
         self.mesh = mesh
-        self.param_sharding = param_sharding
         self.compute_dtype = compute_dtype
         self.autocast_enabled = autocast and compute_dtype is not None
         self.fp8_recipe = fp8_recipe
         self._jit_cache: dict = {}
+
+        # Host-offloaded parameters (ZeRO-offload param tier): weights live in
+        # pinned host memory and stream to HBM inside each jitted program.
+        self.offload_params = False
+        self.param_compute_sharding = param_sharding
+        if offload_params and param_sharding is not None:
+            from .parallel.sharding import host_memory_available, with_memory_kind
+
+            if host_memory_available():
+                self.offload_params = True
+                param_sharding = with_memory_kind(param_sharding, "pinned_host")
+            else:
+                logger.warning(
+                    "offload_params requested but this backend exposes no pinned_host "
+                    "memory space; parameters stay in device memory."
+                )
+        self.param_sharding = param_sharding
 
         from .parallel.sharding import place_params
 
@@ -124,6 +141,24 @@ class PreparedModel:
             params = place_params(params)
         self.params = params
         self._rng = jax.random.key(np.random.randint(0, 2**31 - 1))
+
+    def to_compute_memory(self, params):
+        """Traceable: stream host-offloaded params into device memory (identity when
+        not offloaded). Call OUTSIDE a grad closure so gradients are device-resident."""
+        import jax
+
+        if self.offload_params:
+            return jax.device_put(params, self.param_compute_sharding)
+        return params
+
+    def to_storage_memory(self, params):
+        """Eager: place updated params back on their storage tier (pinned host when
+        offloaded, identity otherwise). The write-back half of to_compute_memory."""
+        import jax
+
+        if self.offload_params and self.param_sharding is not None:
+            return jax.device_put(params, self.param_sharding)
+        return params
 
     # -- forward -----------------------------------------------------------------------
     def _mp_apply(self, params, *args, **kwargs):
@@ -151,7 +186,11 @@ class PreparedModel:
         import jax
 
         if "apply" not in self._jit_cache:
-            self._jit_cache["apply"] = jax.jit(self._mp_apply)
+
+            def _fwd(params, *args, **kwargs):
+                return self._mp_apply(self.to_compute_memory(params), *args, **kwargs)
+
+            self._jit_cache["apply"] = jax.jit(_fwd)
         return self._jit_cache["apply"]
 
     def __call__(self, *args, **kwargs):

@@ -143,8 +143,14 @@ class AcceleratedOptimizer:
         self._grads_unscaled = False  # set by clip_*: grads already divided by loss scale
         self._jit_cache: dict = {}
 
+        self.offload_opt_state = False
+        self._opt_compute_sharding = None
         if model is not None:
-            from .parallel.sharding import derive_opt_state_shardings
+            from .parallel.sharding import (
+                derive_opt_state_shardings,
+                host_memory_available,
+                with_memory_kind,
+            )
 
             if mesh is None:
                 mesh = model.mesh
@@ -153,7 +159,25 @@ class AcceleratedOptimizer:
             if mesh is not None:
                 state_shapes = jax.eval_shape(self.tx.init, model.params)
                 self.opt_state_sharding = derive_opt_state_shardings(state_shapes, mesh, fsdp_plugin, rules)
-                self.opt_state = jax.jit(self.tx.init, out_shardings=self.opt_state_sharding)(model.params)
+                want_offload = bool(getattr(fsdp_plugin, "offload_optimizer_state", False))
+                if want_offload and not host_memory_available():
+                    logger.warning(
+                        "offload_optimizer_state requested but this backend exposes no "
+                        "pinned_host memory space; optimizer state stays in device memory."
+                    )
+                    want_offload = False
+                if want_offload:
+                    # ZeRO-offload tier (reference accelerator.py:1563-1785,
+                    # dataclasses.py:704-719): optimizer state lives in pinned host
+                    # memory; the update streams it to HBM inside the jitted step and
+                    # the new state is written back host-side.
+                    self.offload_opt_state = True
+                    self._opt_compute_sharding = self.opt_state_sharding
+                    self.opt_state_sharding = with_memory_kind(self.opt_state_sharding, "pinned_host")
+                    dev_state = jax.jit(self.tx.init, out_shardings=self._opt_compute_sharding)(model.params)
+                    self.opt_state = jax.device_put(dev_state, self.opt_state_sharding)
+                else:
+                    self.opt_state = jax.jit(self.tx.init, out_shardings=self.opt_state_sharding)(model.params)
             else:
                 self.opt_state_sharding = None
                 self.opt_state = self.tx.init(model.params)
@@ -163,6 +187,24 @@ class AcceleratedOptimizer:
             self.opt_state = None
 
         self._lr_override = None
+
+    # ---- offload tier movement -------------------------------------------------------
+    def opt_to_compute_memory(self, opt_state):
+        """Traceable: stream host-offloaded optimizer state into device memory
+        (identity when not offloaded)."""
+        import jax
+
+        if self.offload_opt_state and self._opt_compute_sharding is not None:
+            return jax.device_put(opt_state, self._opt_compute_sharding)
+        return opt_state
+
+    def opt_to_storage_memory(self, opt_state):
+        """Eager: place updated optimizer state back on its storage tier."""
+        import jax
+
+        if self.offload_opt_state and self.opt_state_sharding is not None:
+            return jax.device_put(opt_state, self.opt_state_sharding)
+        return opt_state
 
     # ---- gradient intake -------------------------------------------------------------
     def _accumulate_fn(self):
@@ -244,8 +286,13 @@ class AcceleratedOptimizer:
 
         if "update" not in self._jit_cache:
             use_scaler = self.scaler is not None and self.scaler.enabled
+            to_compute = getattr(self.model, "to_compute_memory", lambda p: p)
 
             def _update(params, opt_state, grads, inv_scale, lr_override):
+                # Host-offloaded tiers stream into device memory for the update;
+                # the caller writes the results back to pinned host.
+                opt_state = self.opt_to_compute_memory(opt_state)
+                params = to_compute(params)
                 return apply_update_core(
                     self.tx, params, opt_state, grads, inv_scale, lr_override, use_scaler=use_scaler
                 )
@@ -257,6 +304,7 @@ class AcceleratedOptimizer:
     def step(self):
         """Apply the update if at a sync boundary; no-op otherwise (reference
         optimizer.py:125-152)."""
+        import jax
         import jax.numpy as jnp
 
         if not self.gradient_state.sync_gradients:
@@ -281,8 +329,10 @@ class AcceleratedOptimizer:
                 logger.warning("Skipping optimizer step: non-finite gradients (loss scale -> %s)", self.scaler.scale)
         else:
             self.step_was_skipped = False
+        if hasattr(self.model, "to_storage_memory"):
+            new_params = self.model.to_storage_memory(new_params)
         self.model.params = new_params
-        self.opt_state = new_opt_state
+        self.opt_state = self.opt_to_storage_memory(new_opt_state)
 
     def zero_grad(self, set_to_none: bool = True):
         """Clear accumulated grads; no-op mid-accumulation (reference optimizer.py:112)."""

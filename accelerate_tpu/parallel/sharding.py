@@ -156,6 +156,27 @@ def derive_opt_state_shardings(opt_state_shapes, mesh, fsdp_plugin=None, rules=N
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def with_memory_kind(shardings, memory_kind: str):
+    """Rebuild a NamedSharding pytree with a different memory kind (the host-offload
+    tier lever: `pinned_host` holds ZeRO-offload state, reference accelerator.py:1563+)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(s.mesh, s.spec, memory_kind=memory_kind), shardings
+    )
+
+
+def host_memory_available() -> bool:
+    """Whether the backend exposes a pinned_host memory space."""
+    import jax
+
+    try:
+        return any(m.kind == "pinned_host" for m in jax.devices()[0].addressable_memories())
+    except Exception:
+        return False
+
+
 def place_params(tree, shardings=None):
     """Place a param pytree onto the mesh with GUARANTEED fresh buffers.
 
@@ -169,6 +190,23 @@ def place_params(tree, shardings=None):
 
     if shardings is None:
         return jax.jit(lambda t: t)(tree)
+    flat = jax.tree_util.tree_leaves(shardings)
+    if any(getattr(s, "memory_kind", None) == "pinned_host" for s in flat):
+        # jit out_shardings with memory kinds trips the SPMD partitioner on some
+        # backends, so host placement goes through eager device_put. device_put
+        # aliases a source already committed to the identical sharding — break the
+        # alias with a host materialization so the fresh-buffer guarantee holds.
+        def _fresh(x, s):
+            if (
+                isinstance(x, jax.Array)
+                and x.is_fully_addressable
+                and getattr(x, "committed", False)
+                and x.sharding == s
+            ):
+                x = np.asarray(x)
+            return jax.device_put(x, s)
+
+        return jax.tree_util.tree_map(_fresh, tree, shardings)
     return jax.jit(lambda t: t, out_shardings=shardings)(tree)
 
 

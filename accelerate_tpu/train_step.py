@@ -102,7 +102,14 @@ class FusedTrainStep:
                 mb = jax.tree_util.tree_map(_constrain, mb)
             return mb
 
+        to_compute = getattr(self.model, "to_compute_memory", lambda p: p)
+        opt_to_compute = self.optimizer.opt_to_compute_memory
+
         def fused(params, opt_state, scale, inv_scale, lr, *args, **kwargs):
+            # Host-offloaded tiers stream to device memory at the top of the
+            # program; the caller writes results back to pinned host.
+            params = to_compute(params)
+            opt_state = opt_to_compute(opt_state)
             if k > 1:
                 if len(args) != 1 or kwargs:
                     raise ValueError(
@@ -159,8 +166,10 @@ class FusedTrainStep:
             *args,
             **kwargs,
         )
+        if hasattr(self.model, "to_storage_memory"):
+            new_params = self.model.to_storage_memory(new_params)
         self.model.params = new_params
-        opt.opt_state = new_opt_state
+        opt.opt_state = opt.opt_to_storage_memory(new_opt_state)
         opt._grads = None
         opt._accum_count = 0
         if use_scaler:

@@ -300,6 +300,12 @@ class FullyShardedDataParallelPlugin:
     min_num_params: int = 0
     transformer_cls_names_to_wrap: Optional[list] = None
     cpu_offload: bool = False
+    # Host-offload tiers (ZeRO-offload parity, reference accelerator.py:1563-1785 +
+    # dataclasses.py:704-719): place optimizer state / parameters in pinned host
+    # memory (`memory_kind="pinned_host"`), streamed to HBM inside the update step.
+    # None -> follow cpu_offload.
+    offload_optimizer_state: Optional[bool] = None
+    offload_params: Optional[bool] = None
     state_dict_type: str = "SHARDED_STATE_DICT"
     activation_checkpointing: bool = False
     sync_module_states: bool = True
@@ -321,6 +327,10 @@ class FullyShardedDataParallelPlugin:
             raise ValueError(f"auto_wrap_policy must be one of {FSDP_AUTO_WRAP_POLICY}")
         self.min_num_params = int(env.get(prefix + "MIN_NUM_PARAMS", self.min_num_params))
         self.cpu_offload = parse_flag_from_env(prefix + "OFFLOAD_PARAMS", self.cpu_offload)
+        if self.offload_optimizer_state is None:
+            self.offload_optimizer_state = self.cpu_offload
+        if self.offload_params is None:
+            self.offload_params = self.cpu_offload
         self.state_dict_type = env.get(prefix + "STATE_DICT_TYPE", self.state_dict_type)
         if self.state_dict_type not in FSDP_STATE_DICT_TYPE:
             raise ValueError(f"state_dict_type must be one of {FSDP_STATE_DICT_TYPE}")
@@ -388,6 +398,8 @@ class DeepSpeedPlugin:
             sharding_strategy=strategy,
             cpu_offload=self.offload_param_device in ("cpu", "nvme")
             or self.offload_optimizer_device in ("cpu", "nvme"),
+            offload_optimizer_state=self.offload_optimizer_device in ("cpu", "nvme"),
+            offload_params=self.offload_param_device in ("cpu", "nvme"),
         )
 
 

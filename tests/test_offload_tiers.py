@@ -1,0 +1,170 @@
+"""Host-offload tiers (ZeRO-offload parity, reference accelerator.py:1563-1785 +
+dataclasses.py:704-719): optimizer state / params requested onto the host tier must
+actually carry `memory_kind="pinned_host"`, and training must match the non-offload
+trajectory in both the eager and fused paths."""
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils import DeepSpeedPlugin, FullyShardedDataParallelPlugin
+
+from test_training import make_regression_data, make_regression_model
+
+
+def _reset():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _leaf_kinds(tree):
+    return {
+        getattr(leaf.sharding, "memory_kind", None)
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "sharding")
+    }
+
+
+def _train(plugin, fused, data, epochs=2):
+    _reset()
+    accelerator = Accelerator(fsdp_plugin=plugin)
+    model = make_regression_model(seed=0)
+    dl = SimpleDataLoader(data, BatchSampler(range(len(data)), 16))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.adam(0.05), dl)
+    if fused:
+        step_fn = accelerator.train_step()
+        for _ in range(epochs):
+            for batch in pdl:
+                step_fn(batch)
+    else:
+        for _ in range(epochs):
+            for batch in pdl:
+                with accelerator.accumulate(pmodel):
+                    accelerator.backward(pmodel.loss, batch)
+                    popt.step()
+                    popt.zero_grad()
+    return pmodel, popt
+
+
+def _params_close(a, b, rtol=2e-5, atol=1e-6):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("fused", [False, True], ids=["eager", "fused"])
+def test_optimizer_state_offload_matches_baseline(fused):
+    data = make_regression_data(64, seed=20)
+    plugin_off = FullyShardedDataParallelPlugin(
+        sharding_strategy="SHARD_GRAD_OP", offload_optimizer_state=True, min_num_params=0
+    )
+    pmodel_off, popt_off = _train(plugin_off, fused, data)
+    assert popt_off.offload_opt_state
+    assert _leaf_kinds(popt_off.opt_state) == {"pinned_host"}
+    assert _leaf_kinds(pmodel_off.params) == {"device"}
+
+    plugin_base = FullyShardedDataParallelPlugin(
+        sharding_strategy="SHARD_GRAD_OP", min_num_params=0
+    )
+    pmodel_base, popt_base = _train(plugin_base, fused, data)
+    assert not popt_base.offload_opt_state
+    _params_close(pmodel_off.params, pmodel_base.params)
+    _params_close(popt_off.opt_state, popt_base.opt_state)
+
+
+@pytest.mark.parametrize("fused", [False, True], ids=["eager", "fused"])
+def test_param_offload_matches_baseline(fused):
+    data = make_regression_data(64, seed=21)
+    plugin_off = FullyShardedDataParallelPlugin(
+        sharding_strategy="FULL_SHARD", cpu_offload=True, min_num_params=0
+    )
+    pmodel_off, popt_off = _train(plugin_off, fused, data)
+    assert pmodel_off.offload_params and popt_off.offload_opt_state
+    assert _leaf_kinds(pmodel_off.params) == {"pinned_host"}
+    assert _leaf_kinds(popt_off.opt_state) == {"pinned_host"}
+
+    plugin_base = FullyShardedDataParallelPlugin(sharding_strategy="FULL_SHARD", min_num_params=0)
+    pmodel_base, _ = _train(plugin_base, fused, data)
+    _params_close(pmodel_off.params, pmodel_base.params)
+
+
+def test_offloaded_forward_works():
+    _reset()
+    accelerator = Accelerator(
+        fsdp_plugin=FullyShardedDataParallelPlugin(cpu_offload=True, min_num_params=0)
+    )
+    model = make_regression_model(seed=0)
+    pmodel = accelerator.prepare(model)
+    out = pmodel({"x": np.ones((4, 1), np.float32)}["x"])
+    assert np.asarray(out).shape == (4, 1)
+
+
+def test_deepspeed_offload_config_lowers_to_host_tier():
+    """A ZeRO-offload ds_config must actually produce pinned_host placement
+    (round-1 gap: parsed then silently ignored)."""
+    _reset()
+    ds = DeepSpeedPlugin(
+        hf_ds_config={
+            "zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}}
+        }
+    )
+    fsdp = ds.to_fsdp_plugin()
+    assert fsdp.offload_optimizer_state and not fsdp.offload_params
+    accelerator = Accelerator(fsdp_plugin=fsdp)
+    model = make_regression_model(seed=0)
+    pmodel, popt = accelerator.prepare(model, optax.adam(0.01))
+    assert popt.offload_opt_state
+    assert _leaf_kinds(popt.opt_state) == {"pinned_host"}
+    assert not pmodel.offload_params
+
+
+def test_offloaded_load_state_dict_does_not_alias():
+    """load_state_dict(state_dict()) on a host-offloaded model must copy: the next
+    donated update would otherwise delete the caller's arrays through the alias."""
+    data = make_regression_data(32, seed=23)
+    plugin = FullyShardedDataParallelPlugin(cpu_offload=True, min_num_params=0)
+    _reset()
+    accelerator = Accelerator(fsdp_plugin=plugin)
+    model = make_regression_model(seed=0)
+    dl = SimpleDataLoader(data, BatchSampler(range(32), 16))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.adam(0.05), dl)
+    snapshot = pmodel.state_dict()
+    pmodel.load_state_dict(snapshot)
+    step_fn = accelerator.train_step()
+    for batch in pdl:
+        step_fn(batch)
+    # the snapshot's buffers must still be alive and readable
+    for leaf in jax.tree_util.tree_leaves(snapshot):
+        np.asarray(leaf)
+
+
+def test_checkpoint_roundtrip_with_offload(tmp_path):
+    data = make_regression_data(32, seed=22)
+    plugin = FullyShardedDataParallelPlugin(
+        sharding_strategy="SHARD_GRAD_OP", offload_optimizer_state=True, min_num_params=0
+    )
+    _reset()
+    accelerator = Accelerator(fsdp_plugin=plugin)
+    model = make_regression_model(seed=0)
+    dl = SimpleDataLoader(data, BatchSampler(range(32), 16))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.adam(0.05), dl)
+    step_fn = accelerator.train_step()
+    for batch in pdl:
+        step_fn(batch)
+    accelerator.save_state(str(tmp_path / "ckpt"))
+    want = jax.tree_util.tree_map(np.asarray, popt.opt_state)
+    for batch in pdl:
+        step_fn(batch)
+    accelerator.load_state(str(tmp_path / "ckpt"))
+    got = jax.tree_util.tree_map(np.asarray, popt.opt_state)
+    for a, b in zip(jax.tree_util.tree_leaves(want), jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(a, b)
+    # restored state must land back on the host tier and keep training
+    assert _leaf_kinds(popt.opt_state) == {"pinned_host"}
+    for batch in pdl:
+        step_fn(batch)
