@@ -530,10 +530,14 @@ class Accelerator:
         if key not in self._backward_cache:
             import jax
 
+            # Optional PreparedModel protocol, same guard as optimizer.py:289 /
+            # train_step.py:105 — duck-typed models need not implement offload.
+            to_compute = getattr(model, "to_compute_memory", lambda p: p)
+
             def _compute(params, scale, *fargs, **fkwargs):
                 # Host-offloaded params stream to device memory OUTSIDE the grad
                 # closure so gradients come out device-resident.
-                params = model.to_compute_memory(params)
+                params = to_compute(params)
 
                 def scaled(p):
                     out = loss_fn(p, *fargs, **fkwargs)

@@ -300,6 +300,17 @@ class PipelinedModel:
     """
 
     is_pipelined = True
+    # Pipeline params always live in device memory (stage-sharded HBM); the
+    # host-offload tiers (modeling.py:145-161) don't compose with the stage scan.
+    offload_params = False
+
+    def to_compute_memory(self, params):
+        """PreparedModel protocol (modeling.py:145): identity — never offloaded."""
+        return params
+
+    def to_storage_memory(self, params):
+        """PreparedModel protocol (modeling.py:154): identity — never offloaded."""
+        return params
 
     def __init__(
         self,

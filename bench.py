@@ -66,6 +66,16 @@ def supervise(argv):
             except json.JSONDecodeError:
                 continue
         if proc.returncode == 0 and line:
+            if cpu_fallback:
+                # Never let a CPU smoke number masquerade as the chip benchmark
+                # (round-2 verdict, weak #4): tag the metric and zero the ratio.
+                # (The worker also self-tags "cpu-smoke" off its actual platform;
+                # this marks that the supervisor FORCED the fallback.)
+                parsed = json.loads(line)
+                parsed["metric"] = "cpu-fallback " + parsed["metric"]
+                parsed["vs_baseline"] = 0.0
+                parsed.setdefault("extra", {})["cpu_fallback"] = True
+                line = json.dumps(parsed)
             print(line, flush=True)
             return 0
         log(
@@ -93,6 +103,29 @@ def supervise(argv):
 
 
 # ------------------------------------------------------------------------------ worker
+def force_readback(tree) -> float:
+    """Trustworthy execution fence: read one element of the first and last array
+    leaf back to host (any output of a TPU executable fences the whole program).
+
+    On this TPU backend `jax.block_until_ready()` can return before execution
+    finishes (round-2 verdict: a dispatch-only loop 'measured' MFU 3.9), so every
+    timed region must end with a data-dependent host read. Indexing `leaf[0,...,0]`
+    makes a scalar whose value requires the whole array to exist; `np.asarray`
+    forces the device->host transfer of just that scalar.
+    """
+    import jax
+
+    leaves = [l for l in jax.tree_util.tree_leaves(tree) if hasattr(l, "ndim")]
+    # One element of the first and last leaf suffices: a TPU executable's outputs
+    # all materialize when the program finishes, so any output fences the program
+    # (and, transitively, every step it depends on). Reading every leaf would add
+    # hundreds of scalar transfers to the timed region.
+    total = 0.0
+    for leaf in (leaves[:1] + leaves[-1:] if len(leaves) > 1 else leaves):
+        total += float(np.asarray(leaf[(0,) * leaf.ndim]))
+    return total
+
+
 def inference_bench(args):
     """Big-model-inference metric (reference benchmarks/big_model_inference.py:
     model load + per-token generation latency, README.md:27-37): reports p50 TTFT
@@ -119,25 +152,29 @@ def inference_bench(args):
     prompt = rng.integers(1, cfg.vocab_size, (batch, prompt_len)).astype(np.int32)
 
     # compile both programs
-    gen(prompt, GenerationConfig(max_new_tokens=2))
+    force_readback(gen(prompt, GenerationConfig(max_new_tokens=2)))
 
     ttfts = []
     for _ in range(5):
         t0 = time.perf_counter()
-        gen(prompt, GenerationConfig(max_new_tokens=1))
+        force_readback(gen(prompt, GenerationConfig(max_new_tokens=1)))
         ttfts.append(time.perf_counter() - t0)
     t0 = time.perf_counter()
-    out = gen(prompt, GenerationConfig(max_new_tokens=new_tokens))
-    jax.block_until_ready(out)
+    force_readback(gen(prompt, GenerationConfig(max_new_tokens=new_tokens)))
     total = time.perf_counter() - t0
     ttft_p50 = sorted(ttfts)[len(ttfts) // 2]
     per_token = (total - ttft_p50) / max(new_tokens - 1, 1)
 
     # reference headline: GPT-J-6B fp16 on 2x Titan RTX = 0.05 s/token
     # (benchmarks/README.md:31); vs_baseline = reference / ours (higher is better).
-    vs_baseline = 0.05 / per_token if per_token > 0 else 0.0
+    metric = f"per-token generation latency ({model_name}, prompt {prompt_len}, bs {batch})"
+    if on_accel:
+        vs_baseline = 0.05 / per_token if per_token > 0 else 0.0
+    else:
+        metric = "cpu-smoke " + metric
+        vs_baseline = 0.0
     result = {
-        "metric": f"per-token generation latency ({model_name}, prompt {prompt_len}, bs {batch})",
+        "metric": metric,
         "value": round(per_token * 1000, 3),
         "unit": "ms/token",
         "vs_baseline": round(vs_baseline, 4),
@@ -218,6 +255,8 @@ def train_bench(args):
                     popt.step()
                     popt.zero_grad()
                 count += 1
+                if args.per_step_readback:
+                    float(last_loss)
             return count, last_loss
 
     else:
@@ -229,6 +268,8 @@ def train_bench(args):
             for batch in pdl:
                 last_loss = step_fn(batch)
                 count += 1
+                if args.per_step_readback:
+                    float(last_loss)
             return count, last_loss
 
     # Warmup (compile)
@@ -237,16 +278,19 @@ def train_bench(args):
     while steps_done < args.warmup:
         c, loss = one_epoch()
         steps_done += c
-    jax.block_until_ready(pmodel.params)
+    force_readback(pmodel.params)
     log(f"warmup+compile {time.time() - t0:.1f}s")
 
-    # Timed
+    # Timed. Every region ends in force_readback (NOT block_until_ready — see its
+    # docstring); --per_step_readback re-measures with a sync after every step to
+    # validate that the pipelined number is within noise of the fully-synced one.
     t0 = time.perf_counter()
     steps_done = 0
     while steps_done < args.steps:
         c, loss = one_epoch()
         steps_done += c
-    jax.block_until_ready(pmodel.params)
+    force_readback(pmodel.params)
+    final_loss = float(loss) if loss is not None else None
     elapsed = time.perf_counter() - t0
 
     samples = steps_done * global_batch
@@ -261,15 +305,26 @@ def train_bench(args):
     model_flops_per_sec = flops_per_token * tokens_per_sec
     peak = get_device_peak_flops(device_kind) * n_chips
     mfu = (model_flops_per_sec / peak) if peak > 0 else None
+    if mfu is not None and mfu > 1.0:
+        # MFU above 1.0 is physically impossible — it means the timing fence
+        # failed and we measured dispatch, not execution. Refuse to publish it.
+        raise RuntimeError(
+            f"measured MFU {mfu:.3f} > 1.0 — timing fence failed (dispatch-only "
+            f"measurement); refusing to emit an invalid benchmark number"
+        )
 
+    # Tag by the ACTUAL platform the worker ran on, not the supervisor's forced
+    # env: a worker that silently lands on the CPU backend must never emit an
+    # untagged chip number or a nonzero baseline ratio.
+    metric = f"samples/sec/chip ({args.model}, seq {args.seq_len}, bs {args.batch_size}/chip, {args.mixed_precision})"
     if mfu is not None:
         vs_baseline = mfu / 0.45
     else:
-        # CPU smoke fallback: normalize against a nominal 1 sample/sec/chip.
-        vs_baseline = samples_per_sec_per_chip / 1.0
+        metric = "cpu-smoke " + metric
+        vs_baseline = 0.0
 
     result = {
-        "metric": f"samples/sec/chip ({args.model}, seq {args.seq_len}, bs {args.batch_size}/chip, {args.mixed_precision})",
+        "metric": metric,
         "value": round(samples_per_sec_per_chip, 3),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
@@ -279,7 +334,7 @@ def train_bench(args):
             "mfu": round(mfu, 4) if mfu is not None else None,
             "tokens_per_sec": round(tokens_per_sec, 1),
             "params": param_count,
-            "final_loss": float(loss) if loss is not None else None,
+            "final_loss": final_loss,
             "steps": steps_done,
             "path": "eager" if args.eager else "fused",
         },
@@ -298,6 +353,11 @@ def parse_args(argv):
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--mixed_precision", default="bf16")
     parser.add_argument("--eager", action="store_true", help="use the eager backward/step path instead of the fused step")
+    parser.add_argument(
+        "--per_step_readback",
+        action="store_true",
+        help="force a host readback after every step (validation mode for the timing fence)",
+    )
     parser.add_argument("--no-supervise", action="store_true", help="run in-process (no retry wrapper)")
     return parser.parse_args(argv)
 
