@@ -139,6 +139,14 @@ class Accelerator:
             init_kwargs["timeout"] = self.init_handler.timeout
         if fsdp_plugin is None and parse_flag_from_env("ACCELERATE_TPU_USE_FSDP"):
             fsdp_plugin = FullyShardedDataParallelPlugin()
+        if sequence_parallel_plugin is None and os.environ.get("ACCELERATE_TPU_SP_MODE"):
+            from .utils import SequenceParallelPlugin
+
+            sequence_parallel_plugin = SequenceParallelPlugin(
+                seq_degree=int(os.environ.get("ACCELERATE_TPU_MESH_SEQ", "1") or 1),
+                mode=os.environ["ACCELERATE_TPU_SP_MODE"],
+                block_size=int(os.environ.get("ACCELERATE_TPU_SP_BLOCK_SIZE", "512")),
+            )
 
         self.state = AcceleratorState(
             mixed_precision=mixed_precision,

@@ -33,12 +33,16 @@ def register_subcommand(subparsers):
 
 
 def _ask(prompt, default, cast=str):
-    raw = input(f"{prompt} [{default}]: ").strip()
-    if not raw:
-        return default
-    if cast is bool:
-        return raw.lower() in ("1", "true", "yes", "y")
-    return cast(raw)
+    while True:
+        raw = input(f"{prompt} [{default}]: ").strip()
+        if not raw:
+            return default
+        if cast is bool:
+            return raw.lower() in ("1", "true", "yes", "y")
+        try:
+            return cast(raw)
+        except ValueError:
+            print(f"Please enter a {cast.__name__}")
 
 
 def write_basic_config(config_file=None, mixed_precision="bf16", **overrides):
@@ -66,21 +70,102 @@ def load_config_file(config_file=None) -> dict:
         return yaml.safe_load(f) or {}
 
 
+def run_questionnaire() -> dict:
+    """The full interactive flow (parity: reference commands/config/cluster.py, 717 LoC
+    + config_args.py:175-244 ClusterConfig field set, re-shaped around a TPU mesh).
+
+    Sections: compute environment -> topology (hosts/coordinator, TPU pod fields) ->
+    mesh axes -> parallelism plugins (FSDP/ZeRO, sequence parallel, pipeline) ->
+    precision -> runtime knobs (grad accumulation, compile cache, debug).
+    """
+    from .menu import select_value
+
+    config = dict(DEFAULT_CONFIG)
+
+    # -- compute environment ---------------------------------------------------------
+    env_choice = select_value(
+        "In which environment are you running?",
+        ["This machine (single TPU host / CPU)", "TPU pod (multi-host slice)"],
+    )
+    pod = env_choice.startswith("TPU pod")
+    config["compute_environment"] = "TPU_POD" if pod else "LOCAL_MACHINE"
+    config["distributed_type"] = "XLA_SPMD"
+
+    if pod:
+        config["num_processes"] = _ask("How many host processes (pod workers)?", 4, int)
+        config["coordinator_address"] = _ask(
+            "Coordinator address (host:port of worker 0)", "localhost:8476"
+        )
+        config["tpu_use_cluster"] = _ask(
+            "Launch on every pod worker via gcloud ssh (tpu_use_cluster)?", True, bool
+        )
+        if config["tpu_use_cluster"]:
+            config["tpu_name"] = _ask("TPU name", "my-tpu") or None
+            config["tpu_zone"] = _ask("TPU zone", "us-central2-b") or None
+            cmds = _ask(
+                "Setup commands to run on each worker before launch (`;`-separated, empty for none)",
+                "",
+            )
+            config["tpu_commands"] = [c.strip() for c in cmds.split(";") if c.strip()] or None
+    else:
+        config["num_processes"] = 1
+
+    # -- mesh ------------------------------------------------------------------------
+    mesh = {}
+    if _ask("Customize the device mesh axes?", False, bool):
+        for axis in ("data", "fsdp", "model", "seq", "expert", "stage"):
+            default = -1 if axis == "data" else 1
+            mesh[axis] = _ask(f"Mesh axis size `{axis}` (-1 = remaining devices)", default, int)
+    else:
+        mesh = dict(DEFAULT_CONFIG["mesh"])
+    config["mesh"] = mesh
+
+    # -- FSDP / ZeRO -----------------------------------------------------------------
+    if _ask("Use FSDP/ZeRO parameter sharding?", False, bool):
+        fsdp = {}
+        fsdp["sharding_strategy"] = select_value(
+            "Sharding strategy",
+            ["FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD"],
+            "FULL_SHARD",
+        )
+        fsdp["min_num_params"] = _ask("Min parameter count to shard (auto-wrap threshold)", 1024, int)
+        fsdp["cpu_offload"] = _ask("Offload params/optimizer state to host memory?", False, bool)
+        fsdp["activation_checkpointing"] = _ask("Activation checkpointing (remat)?", False, bool)
+        fsdp["state_dict_type"] = select_value(
+            "Checkpoint state-dict type", ["SHARDED_STATE_DICT", "FULL_STATE_DICT"], "SHARDED_STATE_DICT"
+        )
+        config["fsdp_config"] = fsdp
+        if mesh.get("fsdp", 1) == 1 and fsdp["sharding_strategy"] != "NO_SHARD":
+            print("note: set mesh axis `fsdp` > 1 (or leave data=-1, fsdp=1 for pure DP) to shard across devices")
+
+    # -- sequence parallelism --------------------------------------------------------
+    if mesh.get("seq", 1) != 1 or _ask("Enable sequence/context parallelism (long sequences)?", False, bool):
+        sp = {}
+        sp["mode"] = select_value("Sequence-parallel attention", ["ring", "allgather"], "ring")
+        sp["block_size"] = _ask("Ring attention block size", 512, int)
+        config["sequence_parallel_config"] = sp
+        if mesh.get("seq", 1) == 1:
+            mesh["seq"] = _ask("Mesh axis size `seq`", 2, int)
+
+    # -- precision & runtime ---------------------------------------------------------
+    config["mixed_precision"] = select_value(
+        "Mixed precision", ["bf16", "no", "fp16", "fp8"], "bf16"
+    )
+    if config["mixed_precision"] == "bf16":
+        config["downcast_bf16"] = _ask("Downcast fp64->bf16 aggressively (downcast_bf16)?", False, bool)
+    config["gradient_accumulation_steps"] = _ask("Gradient accumulation steps", 1, int)
+    cache = _ask("Persistent XLA compilation cache dir (empty to disable)", "")
+    if cache:
+        config["compilation_cache"] = cache
+    config["debug"] = _ask("Enable debug-mode collective verification?", False, bool)
+    return config
+
+
 def config_command(args):
     if args.default:
         path = write_basic_config(args.config_file)
         print(f"accelerate-tpu configuration saved at {path}")
         return
-    config = dict(DEFAULT_CONFIG)
-    config["mixed_precision"] = _ask("Mixed precision (no/bf16/fp16/fp8)", "bf16")
-    config["num_processes"] = _ask("Number of host processes", 1, int)
-    if config["num_processes"] > 1:
-        config["coordinator_address"] = _ask("Coordinator address (host:port)", "localhost:8476")
-    mesh = {}
-    for axis in ("data", "fsdp", "model", "seq", "expert", "stage"):
-        default = -1 if axis == "data" else 1
-        mesh[axis] = _ask(f"Mesh axis size `{axis}` (-1 = remaining devices)", default, int)
-    config["mesh"] = mesh
-    config["gradient_accumulation_steps"] = _ask("Gradient accumulation steps", 1, int)
+    config = run_questionnaire()
     path = write_basic_config(args.config_file, **config)
     print(f"accelerate-tpu configuration saved at {path}")

@@ -71,10 +71,36 @@ def build_launch_env(args, config: dict) -> dict:
             val = mesh_cfg.get(axis)
         if val is not None:
             env[f"ACCELERATE_TPU_MESH_{axis.upper()}"] = str(val)
-    if args.debug:
+    if args.debug or config.get("debug"):
         env["ACCELERATE_TPU_DEBUG_MODE"] = "1"
     if args.profile_dir:
         env["ACCELERATE_TPU_PROFILE_DIR"] = args.profile_dir
+
+    # Plugin blocks from the questionnaire YAML -> the env protocol the worker-side
+    # dataclasses' __post_init__ reads (reference utils/launch.py:226-267 FSDP_* block).
+    fsdp_cfg = config.get("fsdp_config") or {}
+    if fsdp_cfg:
+        env["ACCELERATE_TPU_USE_FSDP"] = "1"
+        mapping = {
+            "sharding_strategy": "SHARDING_STRATEGY",
+            "min_num_params": "MIN_NUM_PARAMS",
+            "cpu_offload": "OFFLOAD_PARAMS",
+            "activation_checkpointing": "ACTIVATION_CHECKPOINTING",
+            "state_dict_type": "STATE_DICT_TYPE",
+        }
+        for key, suffix in mapping.items():
+            if key in fsdp_cfg and fsdp_cfg[key] is not None:
+                val = fsdp_cfg[key]
+                env[f"ACCELERATE_TPU_FSDP_{suffix}"] = str(val) if not isinstance(val, bool) else str(val).lower()
+    sp_cfg = config.get("sequence_parallel_config") or {}
+    if sp_cfg:
+        env["ACCELERATE_TPU_SP_MODE"] = str(sp_cfg.get("mode", "ring"))
+        if sp_cfg.get("block_size"):
+            env["ACCELERATE_TPU_SP_BLOCK_SIZE"] = str(sp_cfg["block_size"])
+    if config.get("compilation_cache"):
+        env["ACCELERATE_TPU_COMPILATION_CACHE"] = str(config["compilation_cache"])
+    if config.get("downcast_bf16"):
+        env["ACCELERATE_TPU_DOWNCAST_BF16"] = "true"
 
     num_processes = pick(args.num_processes, "num_processes", 1)
     coordinator = pick(args.coordinator_address, "coordinator_address")

@@ -166,6 +166,8 @@ class PreparedModel:
 
         import jax.numpy as jnp
 
+        from .parallel.sharding import activation_sharding_scope
+
         # fp8: Dense matmuls run through the fp8 interceptor during tracing
         # (ops/fp8.py, the TE convert_model replacement); other ops stay bf16.
         ctx = contextlib.nullcontext()
@@ -173,7 +175,10 @@ class PreparedModel:
             from .ops.fp8 import fp8_autocast
 
             ctx = fp8_autocast(self.fp8_recipe)
-        with ctx:
+        # Activation constraints (constrain_activation at the models' residual
+        # seams) are active only when the model actually sits on a mesh.
+        act_ctx = activation_sharding_scope(self.mesh) if self.mesh is not None else contextlib.nullcontext()
+        with ctx, act_ctx:
             if self.autocast_enabled:
                 params = _cast_floating(params, self.compute_dtype)
                 args = _cast_floating(args, self.compute_dtype)

