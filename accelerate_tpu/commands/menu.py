@@ -14,10 +14,11 @@ from typing import Optional, Sequence
 _UP = ("\x1b[A", "k")
 _DOWN = ("\x1b[B", "j")
 _ENTER = ("\r", "\n")
-_INTERRUPT = ("\x03", "\x04", "\x1b\x1b")
+_INTERRUPT = ("\x03", "\x04", "\x1b")
 
 
 def _read_key() -> str:
+    import select
     import termios
     import tty
 
@@ -26,8 +27,11 @@ def _read_key() -> str:
     try:
         tty.setraw(fd)
         ch = sys.stdin.read(1)
-        if ch == "\x1b":  # escape sequence (arrows)
-            ch += sys.stdin.read(2)
+        if ch == "\x1b":
+            # Arrow keys arrive as a 3-byte burst; a bare ESC press arrives alone.
+            # Peek instead of blocking so ESC can mean "cancel".
+            if select.select([fd], [], [], 0.05)[0]:
+                ch += sys.stdin.read(2)
         return ch
     finally:
         termios.tcsetattr(fd, termios.TCSADRAIN, old)

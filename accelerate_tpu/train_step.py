@@ -157,12 +157,17 @@ class FusedTrainStep:
         with_lr = lr is not None
         if with_lr not in self._jitted:
             self._jitted[with_lr] = self._build(with_lr)
+        # Scalars change rarely (scale only on scaler growth/backoff, lr per
+        # scheduler step); cache their device buffers so the hot loop doesn't pay
+        # three host->device transfers per step.
+        key = (scale, inv_scale, lr if with_lr else 0.0)
+        if key != getattr(self, "_scalar_key", None):
+            self._scalar_key = key
+            self._scalar_bufs = tuple(jnp.asarray(v, jnp.float32) for v in key)
         new_params, new_opt_state, loss, aux, finite = self._jitted[with_lr](
             self.model.params,
             opt.opt_state,
-            jnp.asarray(scale, jnp.float32),
-            jnp.asarray(inv_scale, jnp.float32),
-            jnp.asarray(lr if with_lr else 0.0, jnp.float32),
+            *self._scalar_bufs,
             *args,
             **kwargs,
         )
