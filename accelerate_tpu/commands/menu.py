@@ -18,6 +18,7 @@ _INTERRUPT = ("\x03", "\x04", "\x1b")
 
 
 def _read_key() -> str:
+    import os
     import select
     import termios
     import tty
@@ -26,12 +27,15 @@ def _read_key() -> str:
     old = termios.tcgetattr(fd)
     try:
         tty.setraw(fd)
-        ch = sys.stdin.read(1)
+        # os.read, NOT sys.stdin.read: the TextIOWrapper would slurp the whole
+        # escape burst into its own buffer, making the select() peek below always
+        # see an empty fd (every arrow would then look like a bare ESC).
+        ch = os.read(fd, 1).decode(errors="replace")
         if ch == "\x1b":
             # Arrow keys arrive as a 3-byte burst; a bare ESC press arrives alone.
             # Peek instead of blocking so ESC can mean "cancel".
             if select.select([fd], [], [], 0.05)[0]:
-                ch += sys.stdin.read(2)
+                ch += os.read(fd, 2).decode(errors="replace")
         return ch
     finally:
         termios.tcsetattr(fd, termios.TCSADRAIN, old)

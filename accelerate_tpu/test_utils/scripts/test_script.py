@@ -183,6 +183,113 @@ def training_check(state):
     GradientState._reset_state()
 
 
+def training_variants_check(state):
+    """Loss-parity for the prepare() variants the reference exercises in
+    training_check (test_script.py:420+): split_batches, bf16 autocast, and
+    gradient accumulation — each against the same plain-optax baseline."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+    dataset = RegressionDataset(length=64, seed=5)
+    data = [dataset[i] for i in range(len(dataset))]
+
+    def baseline(batch_size):
+        model = RegressionModel()
+        tx = optax.sgd(0.1)
+        params = model.params
+        opt_state = tx.init(params)
+        losses = []
+        for start in range(0, 64, batch_size):
+            xs = np.stack([data[i]["x"] for i in range(start, start + batch_size)])
+            ys = np.stack([data[i]["y"] for i in range(start, start + batch_size)])
+
+            def loss_fn(p):
+                pred = model.apply_fn(p, jnp.asarray(xs))
+                return jnp.mean((pred[:, 0] - jnp.asarray(ys)) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            losses.append(float(loss))
+        return losses
+
+    def framework(batch_size, **acc_kwargs):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        accelerator = Accelerator(**acc_kwargs)
+        dl = SimpleDataLoader(data, BatchSampler(range(64), batch_size))
+        pmodel, popt, pdl = accelerator.prepare(RegressionModel(), optax.sgd(0.1), dl)
+        losses = []
+        for batch in pdl:
+            with accelerator.accumulate(pmodel):
+                losses.append(float(accelerator.backward(pmodel.loss, batch)))
+                popt.step()
+                popt.zero_grad()
+        return losses
+
+    np.testing.assert_allclose(framework(16, split_batches=True), baseline(16), rtol=1e-4, atol=1e-5)
+    # bf16 autocast: same convergence at reduced precision (loose tolerance)
+    np.testing.assert_allclose(framework(16, mixed_precision="bf16"), baseline(16), rtol=0.1, atol=0.05)
+    # accumulation 2 over half-size batches == big-batch baseline: both microbatch
+    # losses are computed at the SAME params, so their mean equals the big-batch loss.
+    accum = np.asarray(framework(8, gradient_accumulation_steps=2))
+    np.testing.assert_allclose((accum[0::2] + accum[1::2]) / 2, baseline(16), rtol=1e-3, atol=1e-4)
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    state.print("training_variants: split_batches / bf16 / accumulation ✓")
+
+
+def resume_check(state):
+    """skip_first_batches mid-epoch resume determinism (reference data_loader.py:1082)."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    n, bs = 32, 4
+    data = [{"x": np.float32([i])} for i in range(n)]
+    accelerator = Accelerator()
+    dl = SimpleDataLoader(data, BatchSampler(range(n), bs))
+    pdl = accelerator.prepare_data_loader(dl)
+    full = [np.asarray(b["x"])[:, 0].tolist() for b in pdl]
+    resumed = [np.asarray(b["x"])[:, 0].tolist() for b in accelerator.skip_first_batches(pdl, 3)]
+    assert resumed == full[3:], (resumed, full[3:])
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    state.print("resume (skip_first_batches) ✓")
+
+
+def gather_for_metrics_check(state):
+    """Uneven tail: the duplicated pad samples must be dropped (reference
+    accelerator.py:2331-2396), plus the object plane the reference can't do on XLA."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    n = 19  # not divisible by the batch
+    data = [{"x": np.float32([i])} for i in range(n)]
+    accelerator = Accelerator()
+    dl = SimpleDataLoader(data, BatchSampler(range(n), 8, drop_last=False))
+    pdl = accelerator.prepare_data_loader(dl)
+    seen = []
+    for batch in pdl:
+        seen.append(np.asarray(accelerator.gather_for_metrics(batch["x"]))[:, 0])
+    seen = np.concatenate(seen)
+    assert seen.shape[0] == n, (seen.shape, n)
+    assert sorted(int(v) for v in seen) == list(range(n))
+
+    objs = accelerator.gather_for_metrics([f"rank{state.process_index}"], use_gather_object=True)
+    assert objs == [f"rank{i}" for i in range(state.num_processes)], objs
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    state.print("gather_for_metrics: remainder truncation + object plane ✓")
+
+
 def trigger_check(state):
     from accelerate_tpu import Accelerator
     from accelerate_tpu.state import AcceleratorState, GradientState
@@ -210,6 +317,10 @@ def main():
     seedable_sampler_check(state)
     state.print("**Training check**")
     training_check(state)
+    training_variants_check(state)
+    state.print("**Resume / metrics**")
+    resume_check(state)
+    gather_for_metrics_check(state)
     state.print("**Trigger**")
     trigger_check(state)
     state.print("All checks passed.")

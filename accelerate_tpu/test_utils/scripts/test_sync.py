@@ -97,10 +97,133 @@ def end_of_dataloader_check():
     print("end_of_dataloader ✓")
 
 
+def grad_equality_at_boundaries_check():
+    """Reference test_sync.py:113-305 asserts grads are equal/unequal across ranks at
+    exactly the right steps. Under GSPMD grads are one logical array, so the TPU-native
+    contract is: accumulated grads equal the SUM of the microbatch grads at the
+    boundary, and params move ONLY at boundary steps."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+    dataset = RegressionDataset(length=32, seed=13)
+    data = [dataset[i] for i in range(len(dataset))]
+    accelerator = _fresh_accelerator(gradient_accumulation_steps=2)
+    model = RegressionModel()
+    dl = SimpleDataLoader(data, BatchSampler(range(32), 8))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.1), dl)
+
+    # Independent per-microbatch grads at the CURRENT params, for comparison.
+    def manual_grad(params, batch):
+        def loss_fn(p):
+            pred = pmodel._mp_apply(p, np.asarray(batch["x"]))
+            return jnp.mean((pred[:, 0] - jnp.asarray(np.asarray(batch["y"]))) ** 2)
+
+        return jax.grad(loss_fn)(params)
+
+    batches = list(pdl)
+    params_before = jax.tree_util.tree_map(np.asarray, pmodel.params)
+    expected = None
+    for i, batch in enumerate(batches):
+        with accelerator.accumulate(pmodel):
+            g = manual_grad(pmodel.params, batch)
+            # loss is scaled by 1/accum inside backward; mirror that
+            g = jax.tree_util.tree_map(lambda x: x / 2.0, g)
+            expected = g if expected is None else jax.tree_util.tree_map(jnp.add, expected, g)
+            accelerator.backward(pmodel.loss, batch)
+            if accelerator.sync_gradients:
+                acc_grads = popt._grads
+                for a, b in zip(jax.tree_util.tree_leaves(acc_grads), jax.tree_util.tree_leaves(expected)):
+                    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+                expected = None
+            popt.step()
+            popt.zero_grad()
+        params_now = jax.tree_util.tree_map(np.asarray, pmodel.params)
+        moved = any(
+            not np.allclose(a, b)
+            for a, b in zip(jax.tree_util.tree_leaves(params_before), jax.tree_util.tree_leaves(params_now))
+        )
+        boundary = i % 2 == 1
+        assert moved == boundary, f"params {'moved' if moved else 'frozen'} at step {i} (boundary={boundary})"
+        params_before = params_now
+    print("grad_equality_at_boundaries ✓")
+
+
+def no_sync_check():
+    """accelerator.no_sync(): grads accumulate without stepping, exactly like the
+    reference's DDP no_sync contract (accelerator.py:909-948)."""
+    import jax
+    import optax
+
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+    dataset = RegressionDataset(length=16, seed=2)
+    data = [dataset[i] for i in range(len(dataset))]
+    accelerator = _fresh_accelerator()
+    model = RegressionModel()
+    dl = SimpleDataLoader(data, BatchSampler(range(16), 8))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.1), dl)
+    batches = list(pdl)
+    before = jax.tree_util.tree_map(np.asarray, pmodel.params)
+    with accelerator.no_sync(pmodel):
+        accelerator.backward(pmodel.loss, batches[0])
+        popt.step()
+        popt.zero_grad()
+    after_nosync = jax.tree_util.tree_map(np.asarray, pmodel.params)
+    for a, b in zip(jax.tree_util.tree_leaves(before), jax.tree_util.tree_leaves(after_nosync)):
+        np.testing.assert_array_equal(a, b)
+    assert popt._grads is not None, "no_sync must keep the accumulated grads"
+    accelerator.backward(pmodel.loss, batches[1])
+    popt.step()
+    popt.zero_grad()
+    after_sync = jax.tree_util.tree_map(np.asarray, pmodel.params)
+    moved = any(
+        not np.allclose(a, b)
+        for a, b in zip(jax.tree_util.tree_leaves(before), jax.tree_util.tree_leaves(after_sync))
+    )
+    assert moved, "step after no_sync must apply the accumulated update"
+    print("no_sync ✓")
+
+
+def scheduler_step_check():
+    """AcceleratedScheduler steps only when the optimizer really stepped
+    (reference scheduler.py:54-82)."""
+    import optax
+
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+    dataset = RegressionDataset(length=32, seed=4)
+    data = [dataset[i] for i in range(len(dataset))]
+    accelerator = _fresh_accelerator(gradient_accumulation_steps=2)
+    model = RegressionModel()
+    dl = SimpleDataLoader(data, BatchSampler(range(32), 8))
+    schedule = optax.linear_schedule(init_value=0.1, end_value=0.0, transition_steps=10)
+    pmodel, popt, psched, pdl = accelerator.prepare(model, optax.sgd(0.1), schedule, dl)
+    counts = []
+    for batch in pdl:
+        with accelerator.accumulate(pmodel):
+            accelerator.backward(pmodel.loss, batch)
+            popt.step()
+            psched.step()
+            popt.zero_grad()
+            counts.append(psched._step_count)
+    # 4 batches, accumulation 2 -> the schedule advances on steps 2 and 4 only.
+    assert counts == [0, 1, 1, 2], counts
+    print("scheduler_step ✓")
+
+
 def main():
     accumulation_equivalence_check()
     sync_flag_check()
     end_of_dataloader_check()
+    grad_equality_at_boundaries_check()
+    no_sync_check()
+    scheduler_step_check()
     print("All sync checks passed.")
 
 

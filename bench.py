@@ -33,10 +33,12 @@ def log(msg):
 
 
 # --------------------------------------------------------------------------- supervisor
-def supervise(argv):
+def supervise(argv, total_steps: int = 0):
     """Run the worker with retry/backoff/timeout; last resort falls back to CPU."""
     attempts = int(os.environ.get("BENCH_MAX_ATTEMPTS", "3"))
-    timeout_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1500"))
+    # Scale the per-attempt timeout with the requested workload so a user-set
+    # --steps/--trials can't silently turn every attempt into a timeout kill.
+    timeout_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", str(max(1500, 300 + 2 * total_steps))))
     cmd = [sys.executable, os.path.abspath(__file__), "--_worker"] + argv
     for attempt in range(attempts + 1):  # final extra attempt = CPU fallback
         env = dict(os.environ)
@@ -375,7 +377,7 @@ def main():
     argv = sys.argv[1:]
     args = parse_args(argv)
     if not args._worker and not args.no_supervise:
-        sys.exit(supervise([a for a in argv if a != "--no-supervise"]))
+        sys.exit(supervise([a for a in argv if a != "--no-supervise"], total_steps=args.trials * args.steps))
     if args.mode == "inference":
         return inference_bench(args)
     return train_bench(args)
