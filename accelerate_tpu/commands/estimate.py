@@ -1,10 +1,14 @@
 """`accelerate-tpu estimate-memory` — model-memory estimator (parity: reference
 commands/estimate.py:63-299).
 
-The reference pulls meta-models from the Hub; this estimator works offline from (a) a
-local HF `config.json`, or (b) a named in-tree model family (`models/` registry), and
-prints the dtype table of total / largest-layer size plus the ≈4× training footprint
-heuristic (reference estimate.py:250-299)."""
+Like the reference, the primary path builds a REAL meta-model — `transformers`
+AutoConfig + `AutoModel.from_config` on the torch meta device (the reference's
+`create_empty_model`, estimate.py:63-137) — so the numbers are measured from actual
+parameter shapes for any architecture transformers knows, config-only, no weights
+download. Hub names resolve when a network/cache is available and fail with a clear
+offline message otherwise; local checkpoint dirs and in-tree model names always work
+(zero-egress path). Closed-form estimation from a raw config.json remains the
+fallback for configs transformers can't instantiate."""
 
 import argparse
 import json
@@ -17,10 +21,74 @@ DTYPE_BYTES = {"float32": 4, "bf16": 2, "bfloat16": 2, "float16": 2, "int8": 1, 
 
 def register_subcommand(subparsers):
     parser = subparsers.add_parser("estimate-memory", help="Estimate model memory usage")
-    parser.add_argument("model_name", help="Path to a HF config.json / model dir, or in-tree model name")
+    parser.add_argument("model_name", help="Hub model id, local HF config/model dir, or in-tree model name")
     parser.add_argument("--dtypes", nargs="+", default=["float32", "bf16", "int8", "int4"])
+    parser.add_argument(
+        "--trust_remote_code",
+        action="store_true",
+        help="Allow custom modeling code shipped with the Hub repo (reference estimate.py flag)",
+    )
     parser.set_defaults(func=estimate_command)
     return parser
+
+
+def create_empty_model(model_name: str, trust_remote_code: bool = False):
+    """Meta-device model from a config (reference create_empty_model estimate.py:63-137):
+    AutoConfig resolves the name (local dir or Hub), AutoModel materializes shapes on
+    `torch.device("meta")` — exact parameter accounting, zero weight bytes."""
+    import torch
+    import transformers
+
+    try:
+        config = transformers.AutoConfig.from_pretrained(model_name, trust_remote_code=trust_remote_code)
+    except OSError as e:
+        raise RuntimeError(
+            f"Could not resolve `{model_name}`: not a local path and the Hub is unreachable "
+            f"from this host (offline?). Pass a local checkpoint/config dir instead. [{e}]"
+        ) from e
+    # Pick the task-specific Auto class from the architecture name (the concrete
+    # classes don't implement from_config; only Auto* do). Substring -> Auto map,
+    # most specific first; AutoModel covers the rest.
+    auto_by_task = [
+        ("ForCausalLM", "AutoModelForCausalLM"),
+        ("ForSeq2SeqLM", "AutoModelForSeq2SeqLM"),
+        ("ForConditionalGeneration", "AutoModelForSeq2SeqLM"),
+        ("ForSequenceClassification", "AutoModelForSequenceClassification"),
+        ("ForTokenClassification", "AutoModelForTokenClassification"),
+        ("ForQuestionAnswering", "AutoModelForQuestionAnswering"),
+        ("ForMaskedLM", "AutoModelForMaskedLM"),
+        ("ForImageClassification", "AutoModelForImageClassification"),
+    ]
+    cls = transformers.AutoModel
+    for arch in getattr(config, "architectures", None) or []:
+        for marker, auto_name in auto_by_task:
+            if marker in arch and hasattr(transformers, auto_name):
+                cls = getattr(transformers, auto_name)
+                break
+        else:
+            continue
+        break
+    with torch.device("meta"):
+        model = cls.from_config(config, trust_remote_code=trust_remote_code)
+    return model
+
+
+def sizes_from_meta_model(model) -> tuple:
+    """(total_params, largest_layer_params) measured from a torch meta model —
+    the reference's calculate_maximum_sizes/get_max_layer_size over real modules."""
+    import torch.nn as nn
+
+    total = sum(p.numel() for p in model.parameters()) + sum(b.numel() for b in model.buffers())
+    candidates = [0]
+    for module in model.modules():
+        if isinstance(module, nn.ModuleList) and len(module):
+            candidates.extend(sum(p.numel() for p in child.parameters()) for child in module)
+        elif isinstance(module, nn.Embedding):
+            candidates.append(module.weight.numel())
+    largest = max(candidates)
+    if largest == 0:  # no repeated blocks found: fall back to the whole model
+        largest = total
+    return total, largest
 
 
 def estimate_parameters_from_hf_config(cfg: dict) -> tuple:
@@ -46,6 +114,7 @@ def estimate_parameters_from_hf_config(cfg: dict) -> tuple:
 
 def gather_data(args):
     path = args.model_name
+    total = largest = None
     cfg = None
     if os.path.isdir(path) and os.path.isfile(os.path.join(path, "config.json")):
         path = os.path.join(path, "config.json")
@@ -53,10 +122,30 @@ def gather_data(args):
         with open(path) as f:
             cfg = json.load(f)
     else:
-        from ..models import get_model_config
+        try:
+            from ..models import get_model_config
 
-        cfg = get_model_config(path)
-    total, largest = estimate_parameters_from_hf_config(cfg)
+            cfg = get_model_config(path)
+        except ValueError:
+            cfg = None  # not an in-tree name: treat as a Hub id below
+    if cfg is None or os.path.isfile(str(args.model_name)) or os.path.isdir(str(args.model_name)):
+        # Primary path: measured sizes from a real meta-model (any transformers arch).
+        try:
+            meta = create_empty_model(args.model_name, trust_remote_code=args.trust_remote_code)
+            total, largest = sizes_from_meta_model(meta)
+        except RuntimeError:
+            if cfg is None:
+                raise
+        except Exception as e:
+            # transformers can't build this config: closed-form fallback below —
+            # but only if we actually have a config to fall back to.
+            if cfg is None:
+                raise RuntimeError(
+                    f"transformers could not instantiate `{args.model_name}` ({e!r}) and no "
+                    "local config is available for closed-form estimation."
+                ) from e
+    if total is None:
+        total, largest = estimate_parameters_from_hf_config(cfg)
     rows = []
     for dtype in args.dtypes:
         bytes_per = DTYPE_BYTES[dtype]

@@ -33,9 +33,13 @@ def _read_key() -> str:
         ch = os.read(fd, 1).decode(errors="replace")
         if ch == "\x1b":
             # Arrow keys arrive as a 3-byte burst; a bare ESC press arrives alone.
-            # Peek instead of blocking so ESC can mean "cancel".
-            if select.select([fd], [], [], 0.05)[0]:
-                ch += os.read(fd, 2).decode(errors="replace")
+            # Peek instead of blocking so ESC can mean "cancel". os.read may
+            # short-read when the burst splits across packets (slow links), so
+            # keep reading until both continuation bytes arrive or the peek dries.
+            while len(ch) < 3 and select.select([fd], [], [], 0.05)[0]:
+                ch += os.read(fd, 3 - len(ch)).decode(errors="replace")
+            if ch != "\x1b" and len(ch) < 3:
+                ch = ""  # truncated burst: drop rather than misparse
         return ch
     finally:
         termios.tcsetattr(fd, termios.TCSADRAIN, old)

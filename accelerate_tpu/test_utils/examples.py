@@ -1,0 +1,68 @@
+"""Example-drift harness (parity: reference test_utils/examples.py:63
+`compare_against_test` + tests/test_examples.py::ExampleDifferenceTests).
+
+The reference keeps every `by_feature/*` script a copy of the canonical example plus
+ONE feature, and diffs them line-by-line so examples can't rot apart from the docs.
+Here the same contract is enforced structurally: each by_feature script must (a)
+reuse the canonical data pipeline by importing from `nlp_example` rather than
+re-implementing it, (b) keep the canonical training shape (a `training_function`,
+an argparse entry, the prepare() call), and (c) introduce its feature — asserted by
+requiring the feature's API marker to appear.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+
+def parse_example(path: str | Path):
+    src = Path(path).read_text()
+    return src, ast.parse(src)
+
+
+def imports_canonical_dataset(tree: ast.Module) -> bool:
+    """True if the script imports get_dataset (or the corpus helper) instead of
+    redefining the data pipeline."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "nlp_example":
+            if any(alias.name == "get_dataset" for alias in node.names):
+                return True
+    # Self-contained corpora (e.g. pretraining) must at least define their own
+    # deterministic generator, not inline data literals.
+    return any(
+        isinstance(node, ast.FunctionDef) and node.name in ("get_corpus", "get_dataset")
+        for node in ast.walk(tree)
+    )
+
+
+def toplevel_function_names(tree: ast.Module) -> set:
+    return {n.name for n in tree.body if isinstance(n, ast.FunctionDef)}
+
+
+def has_argparse_main(tree: ast.Module) -> bool:
+    """The canonical entry shape: argparse wiring under `if __name__ == "__main__"`."""
+    for node in tree.body:
+        if isinstance(node, ast.If):
+            test = ast.unparse(node.test).replace("'", '"')
+            if test == '__name__ == "__main__"':
+                return "ArgumentParser" in ast.unparse(node)
+    return False
+
+
+def check_example_shape(path: str | Path, feature_markers: list) -> list:
+    """Return a list of drift problems (empty = conforming)."""
+    src, tree = parse_example(path)
+    problems = []
+    if not imports_canonical_dataset(tree):
+        problems.append("does not reuse the canonical dataset (import get_dataset from nlp_example)")
+    if "training_function" not in toplevel_function_names(tree) and "main" not in toplevel_function_names(tree):
+        problems.append("missing the canonical training_function/main entry")
+    if not has_argparse_main(tree):
+        problems.append("missing the canonical argparse __main__ block")
+    if ".prepare(" not in src:
+        problems.append("never calls accelerator.prepare()")
+    missing = [m for m in feature_markers if m not in src]
+    if missing:
+        problems.append(f"feature marker(s) absent: {missing}")
+    return problems

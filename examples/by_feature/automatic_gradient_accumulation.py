@@ -1,0 +1,71 @@
+"""by_feature/automatic_gradient_accumulation (parity: reference
+examples/by_feature/automatic_gradient_accumulation.py): combine
+`find_executable_batch_size` (HBM-OOM retry, reference utils/memory.py:87-158) with
+gradient accumulation so the EFFECTIVE batch size stays constant: whenever the
+per-step batch halves after an OOM, the accumulation step count doubles."""
+
+import argparse
+import os
+import sys
+
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from nlp_example import MAX_LEN, get_dataset  # noqa: E402
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
+from accelerate_tpu.models import bert_tiny, create_bert_model
+from accelerate_tpu.utils import set_seed
+from accelerate_tpu.utils.memory import find_executable_batch_size
+
+
+def training_function(args):
+    set_seed(args.seed)
+    config = bert_tiny()
+    data = get_dataset(config.vocab_size - 1, n=args.train_size, seed=0)
+
+    @find_executable_batch_size(starting_batch_size=args.observed_batch_size)
+    def inner_training_loop(batch_size):
+        # Fresh accelerator per attempt: the accumulation count depends on the
+        # batch size this attempt is trying.
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        accumulation = max(1, args.target_batch_size // batch_size)
+        accelerator = Accelerator(
+            mixed_precision=args.mixed_precision, gradient_accumulation_steps=accumulation
+        )
+        accelerator.print(f"trying batch_size={batch_size} x accumulation={accumulation}")
+        accelerator.free_memory()
+        model = create_bert_model(config, seq_len=MAX_LEN)
+        sampler = SeedableRandomSampler(num_samples=len(data), seed=args.seed)
+        train_dl = SimpleDataLoader(data, BatchSampler(sampler, batch_size))
+        model, optimizer, train_dl = accelerator.prepare(model, optax.adamw(args.lr), train_dl)
+        loss = None
+        for _ in range(args.epochs):
+            for batch in train_dl:
+                with accelerator.accumulate(model):
+                    loss = accelerator.backward(model.loss, batch)
+                    optimizer.step()
+                    optimizer.zero_grad()
+        accelerator.print(
+            f"done: batch_size={batch_size} accumulation={accumulation} "
+            f"(effective {batch_size * accumulation}) final loss {float(loss):.4f}"
+        )
+        return float(loss)
+
+    return inner_training_loop()
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--observed_batch_size", type=int, default=32, help="first batch size to try")
+    parser.add_argument("--target_batch_size", type=int, default=64, help="effective batch size to preserve")
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=128)
+    training_function(parser.parse_args())

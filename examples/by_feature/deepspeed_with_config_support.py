@@ -1,0 +1,77 @@
+"""by_feature/deepspeed_with_config_support (parity: reference
+examples/by_feature/deepspeed_with_config_support.py): train from a DeepSpeed-style
+ds_config.json. On TPU the DeepSpeedPlugin is a compatibility shim — the zero stage
+and offload devices lower to GSPMD sharding specs + pinned-host placement
+(utils/dataclasses.py DeepSpeedPlugin.to_fsdp_plugin), so existing ds_configs keep
+working with no DeepSpeed runtime."""
+
+import argparse
+import json
+import os
+import sys
+
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from nlp_example import MAX_LEN, get_dataset  # noqa: E402
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
+from accelerate_tpu.models import bert_tiny, create_bert_model
+from accelerate_tpu.utils import DeepSpeedPlugin, set_seed
+
+DEFAULT_DS_CONFIG = {
+    "train_micro_batch_size_per_gpu": 16,
+    "gradient_accumulation_steps": 2,
+    "gradient_clipping": 1.0,
+    "zero_optimization": {
+        "stage": 2,
+        "offload_optimizer": {"device": "none"},
+    },
+    "bf16": {"enabled": True},
+}
+
+
+def training_function(args):
+    if args.ds_config:
+        with open(args.ds_config) as f:
+            ds_config = json.load(f)
+    else:
+        ds_config = DEFAULT_DS_CONFIG
+    plugin = DeepSpeedPlugin(hf_ds_config=ds_config)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision, deepspeed_plugin=plugin)
+    accelerator.print(
+        f"ds_config: zero_stage={plugin.zero_stage} -> "
+        f"{accelerator.state.fsdp_plugin.sharding_strategy}, "
+        f"accumulation={plugin.gradient_accumulation_steps}"
+    )
+    set_seed(args.seed)
+    config = bert_tiny()
+    model = create_bert_model(config, seq_len=MAX_LEN)
+    data = get_dataset(config.vocab_size - 1, n=args.train_size, seed=0)
+    sampler = SeedableRandomSampler(num_samples=len(data), seed=args.seed)
+    train_dl = SimpleDataLoader(data, BatchSampler(sampler, args.batch_size))
+    model, optimizer, train_dl = accelerator.prepare(model, optax.adamw(args.lr), train_dl)
+
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(model.loss, batch)
+                if plugin.gradient_clipping:
+                    accelerator.clip_grad_norm_(max_norm=plugin.gradient_clipping)
+                optimizer.step()
+                optimizer.zero_grad()
+        accelerator.print(f"epoch {epoch}: loss {float(loss):.4f}")
+    return float(loss)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--ds_config", default=None, help="path to a DeepSpeed config json")
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=128)
+    training_function(parser.parse_args())

@@ -1,0 +1,84 @@
+"""by_feature/early_stopping (parity: reference examples/by_feature/early_stopping.py):
+the nlp_example plus patience-based early stopping. The break decision is made
+cross-process-consistently via the trigger flag (`set_trigger`/`check_trigger`,
+reference accelerator.py:2127-2153) so every rank leaves the epoch loop together."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from nlp_example import MAX_LEN, get_dataset  # noqa: E402
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
+from accelerate_tpu.models import bert_tiny, create_bert_model
+from accelerate_tpu.utils import set_seed
+
+
+class EarlyStoppingCallback:
+    def __init__(self, min_delta: float = 0.0, patience: int = 2):
+        self.min_delta = min_delta
+        self.patience = patience
+        self.best = float("inf")
+        self.counter = 0
+
+    def check(self, eval_loss: float) -> bool:
+        if eval_loss < self.best - self.min_delta:
+            self.best = eval_loss
+            self.counter = 0
+            return False
+        self.counter += 1
+        return self.counter >= self.patience
+
+
+def training_function(args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    set_seed(args.seed)
+    config = bert_tiny()
+    model = create_bert_model(config, seq_len=MAX_LEN)
+    train_data = get_dataset(config.vocab_size - 1, n=args.train_size, seed=0)
+    eval_data = get_dataset(config.vocab_size - 1, n=args.eval_size, seed=1)
+    sampler = SeedableRandomSampler(num_samples=len(train_data), seed=args.seed)
+    train_dl = SimpleDataLoader(train_data, BatchSampler(sampler, args.batch_size))
+    eval_dl = SimpleDataLoader(eval_data, BatchSampler(range(len(eval_data)), args.batch_size))
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        model, optax.adamw(args.lr), train_dl, eval_dl
+    )
+
+    stopper = EarlyStoppingCallback(patience=args.patience)
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            loss = accelerator.backward(model.loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        eval_losses = []
+        for batch in eval_dl:
+            eval_losses.append(np.asarray(accelerator.gather_for_metrics(model.loss(model.params, batch))))
+        eval_loss = float(np.mean(eval_losses))
+        accelerator.print(f"epoch {epoch}: train loss {float(loss):.4f} eval loss {eval_loss:.4f}")
+        # Decide on the main process; broadcast the decision through the trigger so
+        # every rank breaks on the same epoch (a per-rank break would deadlock
+        # collectives on a real pod).
+        if accelerator.is_main_process and stopper.check(eval_loss):
+            accelerator.set_trigger()
+        if accelerator.check_trigger():
+            accelerator.print(f"early stopping at epoch {epoch} (patience {args.patience})")
+            break
+    return eval_loss
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--epochs", type=int, default=10)
+    parser.add_argument("--patience", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=128)
+    parser.add_argument("--eval_size", type=int, default=64)
+    training_function(parser.parse_args())

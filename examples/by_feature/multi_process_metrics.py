@@ -1,0 +1,78 @@
+"""by_feature/multi_process_metrics (parity: reference
+examples/by_feature/multi_process_metrics.py): correct distributed evaluation. The
+point demonstrated: use `gather_for_metrics` — NOT `gather` — for eval, because the
+loader pads the final uneven batch to keep shapes static and `gather_for_metrics`
+drops exactly those duplicated samples (GradientState.remainder contract, reference
+accelerator.py:2331-2396)."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from nlp_example import MAX_LEN, get_dataset  # noqa: E402
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
+from accelerate_tpu.models import bert_tiny, create_bert_model
+from accelerate_tpu.utils import set_seed
+
+
+def training_function(args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    set_seed(args.seed)
+    config = bert_tiny()
+    model = create_bert_model(config, seq_len=MAX_LEN)
+    train_data = get_dataset(config.vocab_size - 1, n=args.train_size, seed=0)
+    # Deliberately NOT a multiple of the batch size: the last batch is padded.
+    eval_data = get_dataset(config.vocab_size - 1, n=args.eval_size - 3, seed=1)
+    sampler = SeedableRandomSampler(num_samples=len(train_data), seed=args.seed)
+    train_dl = SimpleDataLoader(train_data, BatchSampler(sampler, args.batch_size))
+    eval_dl = SimpleDataLoader(
+        eval_data, BatchSampler(range(len(eval_data)), args.batch_size, drop_last=False)
+    )
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        model, optax.adamw(args.lr), train_dl, eval_dl
+    )
+
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            loss = accelerator.backward(model.loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+
+        all_preds, all_labels = [], []
+        for batch in eval_dl:
+            logits = model(batch["input_ids"], None, batch["token_type_ids"])
+            # One call gathers the whole (pred, label) tuple and truncates padding.
+            preds, labels = accelerator.gather_for_metrics(
+                (np.asarray(logits).argmax(-1), np.asarray(batch["labels"]))
+            )
+            all_preds.append(np.asarray(preds))
+            all_labels.append(np.asarray(labels))
+        all_preds = np.concatenate(all_preds)
+        all_labels = np.concatenate(all_labels)
+        assert all_preds.shape[0] == len(eval_data), (
+            f"metric sample count {all_preds.shape[0]} != dataset size {len(eval_data)}"
+        )
+        accuracy = float((all_preds == all_labels).mean())
+        accelerator.print(
+            f"epoch {epoch}: loss {float(loss):.4f} accuracy {accuracy:.4f} "
+            f"({all_preds.shape[0]} samples, exact count)"
+        )
+    return accuracy
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=128)
+    parser.add_argument("--eval_size", type=int, default=64)
+    training_function(parser.parse_args())

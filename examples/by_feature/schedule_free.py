@@ -1,0 +1,70 @@
+"""by_feature/schedule_free (parity: reference examples/by_feature/schedule_free.py,
+which uses facebookresearch/schedule_free): schedule-free AdamW via
+`optax.contrib.schedule_free_adamw` — no LR schedule to configure, but evaluation must
+run at the AVERAGED parameters (`schedule_free_eval_params`), which is the one wrinkle
+this example demonstrates."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from nlp_example import MAX_LEN, get_dataset  # noqa: E402
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
+from accelerate_tpu.models import bert_tiny, create_bert_model
+from accelerate_tpu.utils import set_seed
+
+
+def training_function(args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    set_seed(args.seed)
+    config = bert_tiny()
+    model = create_bert_model(config, seq_len=MAX_LEN)
+    train_data = get_dataset(config.vocab_size - 1, n=args.train_size, seed=0)
+    eval_data = get_dataset(config.vocab_size - 1, n=args.eval_size, seed=1)
+    sampler = SeedableRandomSampler(num_samples=len(train_data), seed=args.seed)
+    train_dl = SimpleDataLoader(train_data, BatchSampler(sampler, args.batch_size))
+    eval_dl = SimpleDataLoader(eval_data, BatchSampler(range(len(eval_data)), args.batch_size))
+
+    optimizer = optax.contrib.schedule_free_adamw(learning_rate=args.lr, warmup_steps=args.warmup_steps)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(model, optimizer, train_dl, eval_dl)
+
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            loss = accelerator.backward(model.loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+
+        # Schedule-free: the training params are the fast iterates; metrics belong to
+        # the averaged ("x") sequence extracted from the optimizer state.
+        eval_params = optax.contrib.schedule_free_eval_params(optimizer.opt_state, model.params)
+        correct, total = 0, 0
+        for batch in eval_dl:
+            logits = model.apply(eval_params, batch["input_ids"], None, batch["token_type_ids"])
+            preds, labels = accelerator.gather_for_metrics(
+                (np.asarray(logits).argmax(-1), np.asarray(batch["labels"]))
+            )
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            total += len(np.asarray(labels))
+        accelerator.print(
+            f"epoch {epoch}: loss {float(loss):.4f} accuracy {correct / total:.4f} (schedule-free eval params)"
+        )
+    return correct / total
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--warmup_steps", type=int, default=4)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=128)
+    parser.add_argument("--eval_size", type=int, default=64)
+    training_function(parser.parse_args())
