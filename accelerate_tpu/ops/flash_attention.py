@@ -22,6 +22,11 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+# Trailing lane dim for the per-row lse/delta stats: Mosaic's minimum tile is
+# (8, 128) on the last two dims, so [BH, S]-shaped stats can't be blocked per
+# (bh, q-block); they ride a broadcast 128-lane axis instead (same layout as
+# jax's in-tree TPU flash kernel's l/m buffers).
+LANE = 128
 
 
 def _causal_block_visible(iq, ik, block_q: int, block_k: int, offset: int) -> "jnp.ndarray":
@@ -84,7 +89,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale
         l = l_scr[:, 0:1]
         safe_l = jnp.maximum(l, 1e-30)
         o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, 0] + jnp.log(safe_l[:, 0])).astype(jnp.float32)
+        # lse carries a broadcast 128-lane trailing dim: Mosaic requires the last
+        # two block dims to be (8k, 128k) or match the array, so a [BH, S] layout
+        # cannot be blocked (1, block_q). Same workaround as jax's in-tree TPU
+        # flash kernel (l/m stored [B, H, S, MIN_BLOCK_SIZE]).
+        lse = (m_scr[:, 0:1] + jnp.log(safe_l)).astype(jnp.float32)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
 def _fwd_call(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -101,7 +111,7 @@ def _fwd_call(q, k, v, scale, causal, block_q, block_k, interpret):
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, LANE), jnp.float32),
         ),
         grid=grid,
         in_specs=[
@@ -111,7 +121,7 @@ def _fwd_call(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, i, 0)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -144,8 +154,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         k = k_ref[0].astype(jnp.float32)  # [Bk, D]
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)  # [Bq, D]
-        lse = lse_ref[0][:, None]  # [Bq, 1]
-        delta = delta_ref[0][:, None]  # [Bq, 1]
+        lse = lse_ref[0][:, 0:1]  # [Bq, 1] (lane dim is broadcast)
+        delta = delta_ref[0][:, 0:1]  # [Bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -191,8 +201,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, 0:1]  # [Bq, 1] (lane dim is broadcast)
+        delta = delta_ref[0][:, 0:1]  # [Bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -218,7 +228,10 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret):
 
     BH, S, D = q.shape
     Sk = k.shape[1]
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, S]
+    # [BH, S, LANE] — broadcast lane dim for the same Mosaic tiling reason as lse.
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[..., None], (BH, S, LANE)
+    )
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, offset=Sk - S
@@ -235,8 +248,8 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),  # k
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),  # v
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),  # do
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),  # lse
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),  # delta
+            pl.BlockSpec((1, block_q, LANE), lambda b, j, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, block_q, LANE), lambda b, j, i: (b, i, 0)),  # delta
         ],
         out_specs=(
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
@@ -261,8 +274,8 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],

@@ -28,11 +28,11 @@ def imports_canonical_dataset(tree: ast.Module) -> bool:
         if isinstance(node, ast.ImportFrom) and node.module == "nlp_example":
             if any(alias.name == "get_dataset" for alias in node.names):
                 return True
-    # Self-contained corpora (e.g. pretraining) must at least define their own
-    # deterministic generator, not inline data literals.
+    # Only genuinely different-domain scripts (pretraining corpora) may be
+    # self-contained, and they must use the distinct `get_corpus` name — a local
+    # `get_dataset` is exactly the copy-instead-of-import rot this harness catches.
     return any(
-        isinstance(node, ast.FunctionDef) and node.name in ("get_corpus", "get_dataset")
-        for node in ast.walk(tree)
+        isinstance(node, ast.FunctionDef) and node.name == "get_corpus" for node in ast.walk(tree)
     )
 
 

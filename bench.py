@@ -128,6 +128,12 @@ def force_readback(tree) -> float:
     return total
 
 
+def _last_attention_dispatch():
+    from accelerate_tpu.ops import attention
+
+    return attention.LAST_DISPATCH
+
+
 def inference_bench(args):
     """Big-model-inference metric (reference benchmarks/big_model_inference.py:
     model load + per-token generation latency, README.md:27-37): reports p50 TTFT
@@ -347,6 +353,9 @@ def train_bench(args):
             "final_loss": final_loss,
             "steps": steps_done,
             "path": "eager" if args.eager else "fused",
+            # Which attention implementation the model's trace actually used —
+            # proves (or disproves) that the flash kernel is on the measured path.
+            "attention_impl": _last_attention_dispatch(),
         },
     }
     print(json.dumps(result))

@@ -27,8 +27,13 @@ def training_function(args):
     config = bert_tiny()
     model = create_bert_model(config, seq_len=MAX_LEN)
     train_data = get_dataset(config.vocab_size - 1, n=args.train_size, seed=0)
-    # Deliberately NOT a multiple of the batch size: the last batch is padded.
-    eval_data = get_dataset(config.vocab_size - 1, n=args.eval_size - 3, seed=1)
+    eval_data = get_dataset(config.vocab_size - 1, n=args.eval_size, seed=1)
+    if args.eval_size % args.batch_size == 0:
+        raise SystemExit(
+            f"--eval_size {args.eval_size} is a multiple of --batch_size {args.batch_size}: "
+            "pick an uneven size so the padded-final-batch truncation this example "
+            "demonstrates actually happens."
+        )
     sampler = SeedableRandomSampler(num_samples=len(train_data), seed=args.seed)
     train_dl = SimpleDataLoader(train_data, BatchSampler(sampler, args.batch_size))
     eval_dl = SimpleDataLoader(
@@ -74,5 +79,5 @@ if __name__ == "__main__":
     parser.add_argument("--epochs", type=int, default=2)
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--train_size", type=int, default=128)
-    parser.add_argument("--eval_size", type=int, default=64)
+    parser.add_argument("--eval_size", type=int, default=67, help="keep this NOT a multiple of batch_size")
     training_function(parser.parse_args())

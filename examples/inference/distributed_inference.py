@@ -18,13 +18,25 @@ from accelerate_tpu.utils.operations import gather_object
 def main(args):
     state = PartialState()
     if args.checkpoint:
-        from accelerate_tpu.utils.hf_loading import load_llama_from_hf
+        import json
 
-        model = load_llama_from_hf(args.checkpoint)
+        from accelerate_tpu.models.llama import LlamaConfig
+        from accelerate_tpu.utils.hf_loading import load_hf_checkpoint_in_model
+
+        with open(f"{args.checkpoint}/config.json") as f:
+            hf_cfg = json.load(f)
+        cfg = LlamaConfig(
+            **{k: hf_cfg[k] for k in (
+                "vocab_size", "hidden_size", "intermediate_size", "num_hidden_layers",
+                "num_attention_heads", "num_key_value_heads", "max_position_embeddings",
+                "rope_theta",
+            ) if k in hf_cfg}
+        )
+        model = create_llama_model(cfg, seq_len=args.prompt_len + args.max_new_tokens)
+        load_hf_checkpoint_in_model(model, args.checkpoint, "llama", cfg)
     else:
-        model = create_llama_model(llama_tiny(), seq_len=args.prompt_len + args.max_new_tokens)
-
-    cfg = model.module.config if hasattr(model, "module") else llama_tiny()
+        cfg = llama_tiny()
+        model = create_llama_model(cfg, seq_len=args.prompt_len + args.max_new_tokens)
     rng = np.random.default_rng(0)
     # Stand-in prompts: token arrays (a tokenizer would produce these).
     prompts = [

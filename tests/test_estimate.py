@@ -103,6 +103,13 @@ def test_gather_data_raw_config_json(tmp_path):
 
 
 def test_offline_hub_id_fails_cleanly(monkeypatch):
-    monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+    # HF_HUB_OFFLINE is read at import time, so patch the resolution call itself:
+    # no network I/O from the suite, and the offline handling path is what runs.
+    import transformers
+
+    def _offline(*a, **k):
+        raise OSError("We couldn't connect to 'https://huggingface.co' (simulated offline)")
+
+    monkeypatch.setattr(transformers.AutoConfig, "from_pretrained", _offline)
     with pytest.raises(RuntimeError, match="Hub is unreachable|Could not resolve"):
         gather_data(_Args("some-org/nonexistent-model-xyz"))

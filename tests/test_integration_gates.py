@@ -39,7 +39,15 @@ def launch_gate(strategy: str, extra_args=()):
         "0.82",
         *extra_args,
     ]
-    return execute_subprocess(cmd, env=cpu_mesh_env(), timeout=900)
+    try:
+        return execute_subprocess(cmd, env=cpu_mesh_env(), timeout=900)
+    except RuntimeError as e:
+        # On a loaded single-core host the 8-virtual-device in-process collective
+        # rendezvous (40s hard timeout in XLA:CPU) can spuriously trip. One retry
+        # distinguishes that environment flake from a real gate failure.
+        if "Termination timeout" in str(e) or "rendezvous" in str(e).lower():
+            return execute_subprocess(cmd, env=cpu_mesh_env(), timeout=900)
+        raise
 
 
 @pytest.mark.slow_launch
