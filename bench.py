@@ -159,8 +159,10 @@ def inference_bench(args):
     rng = np.random.default_rng(0)
     prompt = rng.integers(1, cfg.vocab_size, (batch, prompt_len)).astype(np.int32)
 
-    # compile both programs
-    force_readback(gen(prompt, GenerationConfig(max_new_tokens=2)))
+    # Compile every program the timed sections use: prefill, the 1-token decode
+    # (TTFT loop), and the full fused decode loop (compiled per max_new).
+    force_readback(gen(prompt, GenerationConfig(max_new_tokens=1)))
+    force_readback(gen(prompt, GenerationConfig(max_new_tokens=new_tokens)))
 
     ttfts = []
     for _ in range(5):
