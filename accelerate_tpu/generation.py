@@ -35,11 +35,14 @@ class GenerationConfig:
     pad_token_id: Optional[int] = None  # fill for finished rows; defaults to eos
 
 
-def _sample(logits, config: GenerationConfig, rng):
-    """[B, V] logits -> [B] token ids."""
+def _sample(logits, config: GenerationConfig, rng, temperature=None):
+    """[B, V] logits -> [B] token ids. `temperature` may be a traced scalar (the
+    fused decode loop passes it as an operand so changing it never recompiles)."""
     if not config.do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
-    logits = logits.astype(jnp.float32) / jnp.maximum(config.temperature, 1e-6)
+    if temperature is None:
+        temperature = config.temperature
+    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
     if config.top_k:
         kth = jax.lax.top_k(logits, config.top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
@@ -93,8 +96,9 @@ class Generator:
         executable per bucket instead of recompiling the whole model."""
         key = (bucket, config.do_sample, config.eos_token_id, config.pad_token_id)
         if config.do_sample:
-            # temperature/top_k are baked into the sampler only when sampling.
-            key += (config.temperature, config.top_k)
+            # top_k shapes the program (lax.top_k); temperature rides in as a
+            # traced operand so it never forces a recompile.
+            key += (config.top_k,)
         if key in self._decode_cache:
             return self._decode_cache[key]
 
@@ -102,9 +106,9 @@ class Generator:
         pad_id = config.pad_token_id if config.pad_token_id is not None else (eos if eos is not None else 0)
         step_inner = self._step_inner
 
-        def decode(params, cache, first_logits, prompt_len, limit, rng):
+        def decode(params, cache, first_logits, prompt_len, limit, temperature, rng):
             b = first_logits.shape[0]
-            token, rng = _sample(first_logits, config, rng)
+            token, rng = _sample(first_logits, config, rng, temperature)
             tokens = jnp.full((b, bucket), jnp.int32(pad_id))
             tokens = tokens.at[:, 0].set(token)
             finished = jnp.zeros((b,), bool)
@@ -122,7 +126,7 @@ class Generator:
                     finished = finished | (token == eos)
                 position = jnp.broadcast_to(prompt_len + i - 1, (b,)).astype(jnp.int32)
                 logits, cache = step_inner(params, cache, token, position)
-                token, rng = _sample(logits, config, rng)
+                token, rng = _sample(logits, config, rng, temperature)
                 if eos is not None:
                     # Rows past their EOS emit pad/eos, matching HF generate's padding.
                     token = jnp.where(finished, jnp.int32(pad_id), token)
@@ -153,7 +157,13 @@ class Generator:
         logits, cache = self._prefill(params, input_ids, positions)
         bucket = 1 << (max_new - 1).bit_length()  # next power of two >= max_new
         generated, _cache = self._decode_fn(bucket, config)(
-            params, cache, logits, jnp.int32(prompt_len), jnp.int32(max_new), rng
+            params,
+            cache,
+            logits,
+            jnp.int32(prompt_len),
+            jnp.int32(max_new),
+            jnp.float32(config.temperature),
+            rng,
         )
         generated = generated[:, :max_new]
         if config.eos_token_id is not None:

@@ -105,11 +105,7 @@ class FusedTrainStep:
         to_compute = getattr(self.model, "to_compute_memory", lambda p: p)
         opt_to_compute = self.optimizer.opt_to_compute_memory
 
-        def fused(params, opt_state, scale, inv_scale, lr, *args, **kwargs):
-            # Host-offloaded tiers stream to device memory at the top of the
-            # program; the caller writes results back to pinned host.
-            params = to_compute(params)
-            opt_state = opt_to_compute(opt_state)
+        def compute_grads(params, scale, *args, **kwargs):
             if k > 1:
                 if len(args) != 1 or kwargs:
                     raise ValueError(
@@ -123,9 +119,32 @@ class FusedTrainStep:
 
                 zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
                 grads, losses = jax.lax.scan(body, zeros, microbatches)
-                loss, aux = jnp.mean(losses), None
-            else:
-                grads, (loss, aux) = grads_of(params, scale, *args, **kwargs)
+                return grads, jnp.mean(losses), None
+            grads, (loss, aux) = grads_of(params, scale, *args, **kwargs)
+            return grads, loss, aux
+
+        if self.optimizer.offload_opt_state:
+            # Chunked-offload mode: the update CANNOT live in this program (streaming
+            # the whole host-resident state would OOM HBM — optimizer.py
+            # apply_chunked_update). This program does grads + unscale/finite/clip
+            # (the shared unscale_and_clip, same ordering as apply_update_core); the
+            # per-group update programs follow in __call__.
+            from .optimizer import unscale_and_clip
+
+            def grads_program(params, scale, inv_scale, *args, **kwargs):
+                params = to_compute(params)
+                grads, loss, aux = compute_grads(params, scale, *args, **kwargs)
+                grads, finite = unscale_and_clip(grads, inv_scale, max_norm, use_scaler)
+                return grads, loss, aux, finite
+
+            return jax.jit(grads_program)
+
+        def fused(params, opt_state, scale, inv_scale, lr, *args, **kwargs):
+            # Host-offloaded tiers stream to device memory at the top of the
+            # program; the caller writes results back to pinned host.
+            params = to_compute(params)
+            opt_state = opt_to_compute(opt_state)
+            grads, loss, aux = compute_grads(params, scale, *args, **kwargs)
 
             from .optimizer import apply_update_core
 
@@ -164,17 +183,27 @@ class FusedTrainStep:
         if key != getattr(self, "_scalar_key", None):
             self._scalar_key = key
             self._scalar_bufs = tuple(jnp.asarray(v, jnp.float32) for v in key)
-        new_params, new_opt_state, loss, aux, finite = self._jitted[with_lr](
-            self.model.params,
-            opt.opt_state,
-            *self._scalar_bufs,
-            *args,
-            **kwargs,
-        )
-        if hasattr(self.model, "to_storage_memory"):
-            new_params = self.model.to_storage_memory(new_params)
-        self.model.params = new_params
-        opt.opt_state = opt.opt_to_storage_memory(new_opt_state)
+        if opt.offload_opt_state:
+            # grads program (unscale+clip inside), then the chunked per-group update.
+            grads, loss, aux, finite = self._jitted[with_lr](
+                self.model.params, self._scalar_bufs[0], self._scalar_bufs[1], *args, **kwargs
+            )
+            new_params, finite = opt.apply_chunked_update(
+                self.model.params, grads, 1.0, lr, finite=finite
+            )
+            self.model.params = new_params
+        else:
+            new_params, new_opt_state, loss, aux, finite = self._jitted[with_lr](
+                self.model.params,
+                opt.opt_state,
+                *self._scalar_bufs,
+                *args,
+                **kwargs,
+            )
+            if hasattr(self.model, "to_storage_memory"):
+                new_params = self.model.to_storage_memory(new_params)
+            self.model.params = new_params
+            opt.opt_state = opt.opt_to_storage_memory(new_opt_state)
         opt._grads = None
         opt._accum_count = 0
         if use_scaler:
