@@ -1,0 +1,264 @@
+"""Managed-cloud job launch: provision a Cloud TPU slice, sync the code, run the
+training job on it, optionally tear it down — the TPU-native equivalent of the
+reference's managed SageMaker path (commands/launch.py:880 sagemaker_launcher +
+commands/config/sagemaker.py questionnaire), re-shaped around GCP primitives:
+
+  SageMaker estimator + EC2 instance type  ->  Cloud TPU queued resource / tpu-vm
+  estimator.fit() job submission           ->  gcloud create + scp workdir + ssh run
+  spot instances                           ->  --spot (preemptible queued resource)
+  job artifacts on S3                      ->  --output_gcs bucket sync after the run
+
+Everything funnels through `plan_cloud_job`, which returns the ordered list of
+gcloud commands; `--dry_run` prints them instead of executing (tests drive this —
+no gcloud/network in CI, same pattern as commands/tpu.py)."""
+
+import os
+import shlex
+import subprocess
+import time
+
+GCLOUD_TPU = ["gcloud", "compute", "tpus"]
+
+
+class CloudJobConfig:
+    """Field set mirroring the reference's SageMakerConfig (config_args.py:228-244),
+    GCP-shaped. Populated from the `cloud_config` block of the config YAML and/or
+    launch CLI flags; CLI wins."""
+
+    FIELDS = {
+        "name": "accelerate-tpu-job",
+        "project": None,
+        "zone": "us-central2-b",
+        "accelerator_type": "v5litepod-8",
+        "runtime_version": "tpu-ubuntu2204-base",
+        "spot": False,
+        "use_queued_resource": True,
+        "reserved": False,
+        "setup_commands": None,  # list[str] run on every worker before the job
+        "output_gcs": None,  # gs:// prefix to sync the project dir to after the run
+        "teardown": True,  # delete the slice when the job exits
+        "poll_seconds": 30,  # queued-resource readiness poll interval
+        "max_wait_seconds": 3600,
+    }
+
+    def __init__(self, config: dict, args):
+        block = (config.get("cloud_config") or {}) if config else {}
+        for field, default in self.FIELDS.items():
+            cli = getattr(args, f"cloud_{field}", None)
+            setattr(self, field, cli if cli is not None else block.get(field, default))
+        if not self.project:
+            raise ValueError(
+                "Cloud launch needs a GCP project: set cloud_config.project in the config "
+                "file (accelerate-tpu config) or pass --cloud_project"
+            )
+
+
+def add_cloud_args(parser):
+    parser.add_argument(
+        "--cloud",
+        action="store_true",
+        help="Provision a Cloud TPU slice and run the job on it (managed-cloud launch)",
+    )
+    parser.add_argument("--cloud_name", default=None, help="Name for the TPU slice / queued resource")
+    parser.add_argument("--cloud_project", default=None)
+    parser.add_argument("--cloud_zone", default=None)
+    parser.add_argument("--cloud_accelerator_type", default=None, help="e.g. v5litepod-8, v5litepod-256")
+    parser.add_argument("--cloud_runtime_version", default=None)
+    parser.add_argument("--cloud_spot", action="store_true", default=None, help="Use a preemptible (spot) slice")
+    parser.add_argument("--cloud_output_gcs", default=None, help="gs:// prefix to sync results to after the run")
+    parser.add_argument(
+        "--cloud_no_teardown",
+        dest="cloud_teardown",
+        action="store_false",
+        default=None,
+        help="Keep the slice alive after the job exits",
+    )
+    parser.add_argument("--dry_run", action="store_true", help="Print the gcloud commands, don't run them")
+    return parser
+
+
+def _scope(cfg):
+    return ["--zone", cfg.zone, "--project", cfg.project]
+
+
+def plan_cloud_job(cfg: CloudJobConfig, launch_argv: list) -> list:
+    """The ordered command plan for one managed job. Returns `(tag, argv)` pairs;
+    tags let the executor treat provisioning/polling/teardown differently and let
+    tests assert the sequence without parsing argv."""
+    plan = []
+    if cfg.use_queued_resource:
+        create = GCLOUD_TPU + [
+            "queued-resources",
+            "create",
+            cfg.name,
+            "--node-id",
+            cfg.name,
+            "--accelerator-type",
+            cfg.accelerator_type,
+            "--runtime-version",
+            cfg.runtime_version,
+        ] + _scope(cfg)
+        if cfg.spot:
+            create.append("--spot")
+        if cfg.reserved:
+            create.append("--reserved")
+        plan.append(("provision", create))
+        plan.append(
+            (
+                "poll",
+                GCLOUD_TPU
+                + ["queued-resources", "describe", cfg.name, "--format", "value(state.state)"]
+                + _scope(cfg),
+            )
+        )
+    else:
+        create = GCLOUD_TPU + [
+            "tpu-vm",
+            "create",
+            cfg.name,
+            "--accelerator-type",
+            cfg.accelerator_type,
+            "--version",
+            cfg.runtime_version,
+        ] + _scope(cfg)
+        if cfg.spot:
+            create.append("--preemptible")
+        plan.append(("provision", create))
+
+    ssh_base = GCLOUD_TPU + ["tpu-vm", "ssh", cfg.name] + _scope(cfg) + ["--worker", "all", "--command"]
+    # Clear any previous run's tree first: scp -r into an EXISTING ~/job would
+    # nest the new copy under it and the run step would execute stale code.
+    plan.append(("clean", ssh_base + ["rm -rf ~/job"]))
+    scp = GCLOUD_TPU + [
+        "tpu-vm",
+        "scp",
+        "--recurse",
+        os.getcwd(),
+        f"{cfg.name}:~/job",
+    ] + _scope(cfg) + ["--worker", "all"]
+    plan.append(("sync", scp))
+    for setup in cfg.setup_commands or []:
+        plan.append(("setup", ssh_base + [setup]))
+    # ACCELERATE_TPU_MULTIHOST=1 makes each worker join the jax.distributed
+    # coordination service (same prefix as the pod launcher, commands/tpu.py):
+    # on a multi-worker slice the N ssh invocations must form ONE job.
+    run = "cd ~/job && ACCELERATE_TPU_MULTIHOST=1 " + shlex.join(
+        ["python", "-m", "accelerate_tpu.commands.launch"] + launch_argv
+    )
+    plan.append(("run", ssh_base + [run]))
+    if cfg.output_gcs:
+        plan.append(("collect", ssh_base + [f"gsutil -m rsync -r ~/job {shlex.quote(cfg.output_gcs)}"]))
+    if cfg.teardown:
+        if cfg.use_queued_resource:
+            delete = GCLOUD_TPU + ["queued-resources", "delete", cfg.name, "--force", "--quiet"] + _scope(cfg)
+        else:
+            delete = GCLOUD_TPU + ["tpu-vm", "delete", cfg.name, "--quiet"] + _scope(cfg)
+        plan.append(("teardown", delete))
+    return plan
+
+
+def _wait_active(cfg, describe_cmd):
+    """Poll the queued resource until it is ACTIVE (provisioned and running).
+    Transient describe failures (network blips over an up-to-1h wait) are retried;
+    only 5 consecutive failures abort — aborting tears the slice down, losing the
+    user's place in the capacity queue."""
+    deadline = time.time() + cfg.max_wait_seconds
+    consecutive_failures = 0
+    while True:
+        try:
+            state = subprocess.run(
+                describe_cmd, capture_output=True, text=True, check=True
+            ).stdout.strip()
+            consecutive_failures = 0
+        except subprocess.SubprocessError as exc:
+            consecutive_failures += 1
+            if consecutive_failures >= 5:
+                raise RuntimeError(f"describe failed {consecutive_failures}x in a row: {exc}") from exc
+            print(f"[cloud] describe failed ({exc}); retrying", flush=True)
+            time.sleep(cfg.poll_seconds)
+            continue
+        if state == "ACTIVE":
+            return
+        if state in ("FAILED", "SUSPENDED"):
+            raise RuntimeError(f"queued resource {cfg.name} entered state {state}")
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"queued resource {cfg.name} not ACTIVE after {cfg.max_wait_seconds}s (state {state})"
+            )
+        print(f"[cloud] {cfg.name}: {state}; waiting {cfg.poll_seconds}s...", flush=True)
+        time.sleep(cfg.poll_seconds)
+
+
+STAGED_CONFIG = ".accelerate_tpu_job_config.yaml"
+
+
+def build_remote_config(args, config: dict) -> dict:
+    """The launch config the job runs with ON the slice: the local config minus the
+    cloud block (the remote must not re-provision), with local CLI launch flags
+    folded in so `--mixed_precision`/`--mesh_*`/etc. aren't silently dropped."""
+    remote = {k: v for k, v in (config or {}).items() if k not in ("cloud_config", "compute_environment")}
+    for key in (
+        "mixed_precision",
+        "gradient_accumulation_steps",
+        "num_processes",
+        "coordinator_address",
+        "profile_dir",
+        "grace_period",
+    ):
+        val = getattr(args, key, None)
+        if val is not None:
+            remote[key] = val
+    if getattr(args, "max_restarts", 0):
+        remote["max_restarts"] = args.max_restarts
+    mesh_overrides = {
+        axis: getattr(args, f"mesh_{axis}")
+        for axis in ("data", "fsdp", "model", "seq", "expert", "stage")
+        if getattr(args, f"mesh_{axis}", None) is not None
+    }
+    if mesh_overrides:
+        remote["mesh"] = {**(remote.get("mesh") or {}), **mesh_overrides}
+    if getattr(args, "debug", False):
+        remote["debug"] = True
+    return remote
+
+
+def cloud_launcher(args, config: dict):
+    """Provision → sync → run → collect → teardown. Teardown runs even when the job
+    fails (billing), unless --cloud_no_teardown."""
+    import yaml
+
+    cfg = CloudJobConfig(config, args)
+    remote_config = build_remote_config(args, config)
+    launch_argv = ["--config_file", STAGED_CONFIG, args.training_script] + list(args.training_script_args)
+    plan = plan_cloud_job(cfg, launch_argv)
+    if args.dry_run:
+        for tag, cmd in plan:
+            print(f"[{tag}] {' '.join(cmd)}")
+        return plan
+    # Stage the effective config inside the synced workdir so the remote launch
+    # sees the same settings as a local one would (removed again on exit).
+    staged_path = os.path.join(os.getcwd(), STAGED_CONFIG)
+    with open(staged_path, "w") as f:
+        yaml.safe_dump(remote_config, f, sort_keys=False)
+    steps = [(tag, cmd) for tag, cmd in plan if tag != "teardown"]
+    teardown = next((cmd for tag, cmd in plan if tag == "teardown"), None)
+    provisioned = False
+    try:
+        for tag, cmd in steps:
+            if tag == "poll":
+                _wait_active(cfg, cmd)
+            else:
+                print(f"[cloud] {tag}: {' '.join(cmd)}", flush=True)
+                subprocess.run(cmd, check=True)
+            if tag == "provision":
+                provisioned = True
+    finally:
+        try:
+            os.unlink(staged_path)
+        except OSError:
+            pass
+        # A billing slice must come down on ANY exit — job failure, Ctrl-C,
+        # SystemExit — once provisioning was attempted.
+        if teardown is not None and provisioned:
+            print(f"[cloud] teardown: {' '.join(teardown)}", flush=True)
+            subprocess.run(teardown, check=False)

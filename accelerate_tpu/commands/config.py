@@ -85,11 +85,33 @@ def run_questionnaire() -> dict:
     # -- compute environment ---------------------------------------------------------
     env_choice = select_value(
         "In which environment are you running?",
-        ["This machine (single TPU host / CPU)", "TPU pod (multi-host slice)"],
+        [
+            "This machine (single TPU host / CPU)",
+            "TPU pod (multi-host slice)",
+            "GCP Cloud TPU (provision on demand)",
+        ],
     )
     pod = env_choice.startswith("TPU pod")
-    config["compute_environment"] = "TPU_POD" if pod else "LOCAL_MACHINE"
+    cloud = env_choice.startswith("GCP Cloud")
+    config["compute_environment"] = "TPU_POD" if pod else ("GCP_CLOUD" if cloud else "LOCAL_MACHINE")
     config["distributed_type"] = "XLA_SPMD"
+
+    if cloud:
+        # Managed-cloud block (parity: reference sagemaker questionnaire
+        # commands/config/sagemaker.py — GCP-shaped, consumed by commands/cloud.py).
+        cc = {}
+        cc["name"] = _ask("Job/slice name", "accelerate-tpu-job")
+        cc["project"] = _ask("GCP project", "my-project")
+        cc["zone"] = _ask("Zone", "us-central2-b")
+        cc["accelerator_type"] = _ask("Accelerator type (e.g. v5litepod-8)", "v5litepod-8")
+        cc["runtime_version"] = _ask("TPU runtime version", "tpu-ubuntu2204-base")
+        cc["use_queued_resource"] = _ask("Provision via queued resource (vs direct create)?", True, bool)
+        cc["spot"] = _ask("Use spot (preemptible) capacity?", False, bool)
+        out = _ask("GCS output prefix to sync results to (empty for none)", "")
+        if out:
+            cc["output_gcs"] = out
+        cc["teardown"] = _ask("Tear the slice down when the job exits?", True, bool)
+        config["cloud_config"] = cc
 
     if pod:
         config["num_processes"] = _ask("How many host processes (pod workers)?", 4, int)

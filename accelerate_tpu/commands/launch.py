@@ -39,10 +39,18 @@ def add_launch_args(parser):
     for axis in ("data", "fsdp", "model", "seq", "expert", "stage"):
         parser.add_argument(f"--mesh_{axis}", type=int, default=None, help=f"Mesh axis size for `{axis}`")
     parser.add_argument("--max_restarts", type=int, default=0, help="Restart budget on child failure (elastic supervision)")
-    parser.add_argument("--grace_period", type=float, default=30.0, help="Seconds a signaled child gets to checkpoint")
+    parser.add_argument(
+        "--grace_period",
+        type=float,
+        default=None,
+        help="Seconds a signaled child gets to checkpoint (default 30, or the config file's value)",
+    )
     parser.add_argument("--tpu_use_cluster", action="store_true", help="Launch on every worker of a TPU pod")
     parser.add_argument("--tpu_name", default=None)
     parser.add_argument("--tpu_zone", default=None)
+    from .cloud import add_cloud_args
+
+    add_cloud_args(parser)
     parser.add_argument("training_script", type=str, help="The script to launch")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER, help="Script arguments")
     return parser
@@ -73,8 +81,9 @@ def build_launch_env(args, config: dict) -> dict:
             env[f"ACCELERATE_TPU_MESH_{axis.upper()}"] = str(val)
     if args.debug or config.get("debug"):
         env["ACCELERATE_TPU_DEBUG_MODE"] = "1"
-    if args.profile_dir:
-        env["ACCELERATE_TPU_PROFILE_DIR"] = args.profile_dir
+    profile_dir = pick(args.profile_dir, "profile_dir")
+    if profile_dir:
+        env["ACCELERATE_TPU_PROFILE_DIR"] = str(profile_dir)
 
     # Plugin blocks from the questionnaire YAML -> the env protocol the worker-side
     # dataclasses' __post_init__ reads (reference utils/launch.py:226-267 FSDP_* block).
@@ -124,6 +133,10 @@ def build_launch_env(args, config: dict) -> dict:
 
 def launch_command(args):
     config = load_config_file(args.config_file)
+    if args.cloud or config.get("compute_environment") == "GCP_CLOUD":
+        from .cloud import cloud_launcher
+
+        return cloud_launcher(args, config)
     if args.tpu_use_cluster or config.get("tpu_use_cluster"):
         from .tpu import pod_launcher
 
@@ -134,9 +147,8 @@ def launch_command(args):
     if max_restarts > 0:
         from ..fault_tolerance import Supervisor
 
-        code = Supervisor(
-            cmd, env=env, max_restarts=max_restarts, grace_period=args.grace_period
-        ).run()
+        grace = args.grace_period if args.grace_period is not None else float(config.get("grace_period", 30.0))
+        code = Supervisor(cmd, env=env, max_restarts=max_restarts, grace_period=grace).run()
         if code != 0:
             raise SystemExit(code)
         return

@@ -20,6 +20,7 @@ from .mixtral import (
     mixtral_8x7b,
     mixtral_tiny,
 )
+from .gptj import GPTJConfig, GPTJForCausalLM, create_gptj_model, gptj_6b, gptj_tiny
 
 _CONFIG_REGISTRY = {
     "bert-base": lambda: _bert_cfg(bert_base()),
@@ -29,7 +30,22 @@ _CONFIG_REGISTRY = {
     "llama-tiny": lambda: _llama_cfg(llama_tiny()),
     "mixtral-8x7b": lambda: _mixtral_cfg(mixtral_8x7b()),
     "mixtral-tiny": lambda: _mixtral_cfg(mixtral_tiny()),
+    "gptj-6b": lambda: _gptj_cfg(gptj_6b()),
+    "gptj-tiny": lambda: _gptj_cfg(gptj_tiny()),
 }
+
+
+def _gptj_cfg(c: GPTJConfig) -> dict:
+    return {
+        "model_type": "gptj",
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.num_hidden_layers,
+        "num_attention_heads": c.num_attention_heads,
+        "intermediate_size": c.intermediate_size,
+        "rotary_dim": c.rotary_dim,
+        "tie_word_embeddings": False,
+    }
 
 
 def _mixtral_cfg(c: MixtralConfig) -> dict:

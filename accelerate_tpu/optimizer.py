@@ -278,25 +278,17 @@ class AcceleratedOptimizer:
             "max_grad_norm / clip_grad_norm_ for global clipping instead."
         )
         groups = self._offload_groups(params)
-        slice_state, merge_state = self._state_slicer(params)
+        slice_state = self._state_slicer(params)
         self._jit_cache["chunk_groups"] = groups
-        self._jit_cache["chunk_slicer"] = (slice_state, merge_state)
+        self._jit_cache["chunk_slicer"] = slice_state
+        ptreedef, param_paths, is_param_shaped, _to_flat = self._param_tree_tools(params)
         flat_params = dict(tree_paths_and_leaves(params)[0])
-        param_paths = list(flat_params)
-        params_treedef = jax.tree_util.tree_structure(params)
-        ptreedef = params_treedef
 
         group_states = []
         for paths in groups:
             p_g = {p: flat_params[p] for p in paths}
             s_g = jax.jit(self.tx.init)(p_g)
             group_states.append(jax.device_put(s_g, slice_state(self.opt_state_sharding, paths)))
-
-        def is_param_shaped(x):
-            try:
-                return jax.tree_util.tree_structure(x) == ptreedef
-            except Exception:
-                return False
 
         def assemble(template_node, *group_nodes):
             if is_param_shaped(template_node):
@@ -308,12 +300,11 @@ class AcceleratedOptimizer:
 
         return jax.tree_util.tree_map(assemble, state_shapes, *group_states, is_leaf=is_param_shaped)
 
-    def _state_slicer(self, params):
-        """(slice_fn, merge_fn) decomposing ANY optax state whose param-mirroring
-        subtrees match the params treedef (adam/sgd/adafactor-family — every
-        element-wise transform). slice_fn(state, paths) -> group state with those
-        subtrees replaced by flat {path: leaf} dicts; merge_fn writes a group's new
-        state back into the global tree."""
+    @staticmethod
+    def _param_tree_tools(params):
+        """Shared decomposition contract for optax states whose subtrees mirror the
+        params treedef (adam/sgd/adafactor-family — every element-wise transform):
+        (ptreedef, param_paths, is_param_shaped, to_flat)."""
         import jax
 
         from .parallel.sharding import tree_paths_and_leaves
@@ -330,6 +321,16 @@ class AcceleratedOptimizer:
         def to_flat(subtree):
             return dict(zip(param_paths, jax.tree_util.tree_leaves(subtree)))
 
+        return ptreedef, param_paths, is_param_shaped, to_flat
+
+    def _state_slicer(self, params):
+        """slice_fn(state, paths) -> group state with param-mirroring subtrees
+        replaced by flat {path: leaf} dicts (used for states AND their sharding
+        trees; the write-back side lives in _state_chunker)."""
+        import jax
+
+        _ptreedef, _param_paths, is_param_shaped, to_flat = self._param_tree_tools(params)
+
         def slice_state(state, paths):
             pathset = set(paths)
             return jax.tree_util.tree_map(
@@ -342,20 +343,49 @@ class AcceleratedOptimizer:
                 is_leaf=is_param_shaped,
             )
 
-        def merge_state(global_state, group_state):
-            """Overwrite the global tree's param-shaped subtrees at the group's paths
-            (and take the group's value for shared scalars like step counts)."""
+        return slice_state
 
-            def _merge(sub, new_sub):
-                if is_param_shaped(sub):
-                    flat = to_flat(sub)
-                    flat.update(new_sub)
-                    return jax.tree_util.tree_unflatten(ptreedef, [flat[p] for p in param_paths])
-                return new_sub
+    def _state_chunker(self, params):
+        """O(P)-per-step decomposition of an optax state for the chunked-offload loop
+        (vs O(groups x P) for slice-per-group): `decompose` flattens the state
+        ONCE into slots (param-shaped subtrees -> path-keyed dicts, scalars as-is),
+        `group_state` builds a group's sliced state in O(|group|), `absorb` writes a
+        group's updated slots back in O(|group|), `recompose` rebuilds the full tree
+        once after the loop."""
+        import jax
 
-            return jax.tree_util.tree_map(_merge, global_state, group_state, is_leaf=is_param_shaped)
+        ptreedef, param_paths, is_param_shaped, to_flat = self._param_tree_tools(params)
 
-        return slice_state, merge_state
+        def decompose(state):
+            leaves, state_def = jax.tree_util.tree_flatten(state, is_leaf=is_param_shaped)
+            slots = [to_flat(l) if is_param_shaped(l) else l for l in leaves]
+            return slots, state_def
+
+        def group_state(slots, state_def, paths):
+            return state_def.unflatten(
+                [{p: d[p] for p in paths} if isinstance(d, dict) else d for d in slots]
+            )
+
+        def absorb(slots, state_def, new_group_state):
+            # flatten_up_to stops at state_def's leaf positions, so each value is the
+            # group's path-dict (param slot) or scalar (shared slot; last group wins).
+            for i, val in enumerate(state_def.flatten_up_to(new_group_state)):
+                if isinstance(slots[i], dict):
+                    slots[i].update(val)
+                else:
+                    slots[i] = val
+
+        def recompose(slots, state_def):
+            return state_def.unflatten(
+                [
+                    jax.tree_util.tree_unflatten(ptreedef, [d[p] for p in param_paths])
+                    if isinstance(d, dict)
+                    else d
+                    for d in slots
+                ]
+            )
+
+        return decompose, group_state, absorb, recompose
 
     def apply_chunked_update(self, params, grads, inv_scale, lr_override, finite=None):
         """Offload-tier update: global finite check first (an fp16 skipped step must
@@ -371,42 +401,50 @@ class AcceleratedOptimizer:
         import jax
         import jax.numpy as jnp
 
-        from .parallel.sharding import tree_paths_and_leaves
-
         use_scaler = self.scaler is not None and self.scaler.enabled
         with_lr = lr_override is not None
-        flat_params = dict(tree_paths_and_leaves(params)[0])
-        flat_grads = dict(tree_paths_and_leaves(grads)[0])
-        params_treedef = jax.tree_util.tree_structure(params)
-        param_paths = list(flat_params)
 
+        params_offloaded = bool(getattr(self.model, "offload_params", False))
         if "chunk_groups" not in self._jit_cache:
             self._jit_cache["chunk_groups"] = self._offload_groups(params)
             self._jit_cache["chunk_slicer"] = self._state_slicer(params)
+        if "chunk_chunker" not in self._jit_cache:
+            self._jit_cache["chunk_chunker"] = self._state_chunker(params)
+        if "chunk_static" not in self._jit_cache:
+            # Static tree metadata: paths, treedef, and the offload-tier sharding
+            # flat-dicts never change after init; per-step values are re-zipped
+            # against the cached paths below (tree_leaves order is deterministic).
+            ptreedef, param_paths, _ips, _tf = self._param_tree_tools(params)
+            from .parallel.sharding import tree_paths_and_leaves
+
+            p_compute_flat = p_storage_flat = None
+            if params_offloaded:
+                p_compute_flat = dict(tree_paths_and_leaves(self.model.param_compute_sharding)[0])
+                p_storage_flat = dict(tree_paths_and_leaves(self.model.param_sharding)[0])
+            self._jit_cache["chunk_static"] = (ptreedef, param_paths, p_compute_flat, p_storage_flat)
         groups = self._jit_cache["chunk_groups"]
-        slice_state, merge_state = self._jit_cache["chunk_slicer"]
+        slice_state = self._jit_cache["chunk_slicer"]
+        decompose, group_state, absorb, recompose = self._jit_cache["chunk_chunker"]
+        params_treedef, param_paths, p_compute_flat, p_storage_flat = self._jit_cache["chunk_static"]
+        flat_params = dict(zip(param_paths, jax.tree_util.tree_leaves(params)))
+        flat_grads = dict(zip(param_paths, jax.tree_util.tree_leaves(grads)))
 
         if finite is None:
             finite = jnp.array(True)
             if use_scaler:
                 if "chunk_finite" not in self._jit_cache:
-                    from .optimizer import unscale_and_clip
-
                     self._jit_cache["chunk_finite"] = jax.jit(
                         lambda g, inv: unscale_and_clip(g, inv, None, True)[1]
                     )
                 finite = self._jit_cache["chunk_finite"](grads, jnp.asarray(float(inv_scale), jnp.float32))
 
-        # Host-offloaded PARAMS stream per group too (both tiers on: the
-        # "full ZeRO-offload" configuration).
-        params_offloaded = bool(getattr(self.model, "offload_params", False))
-        p_compute_flat = p_storage_flat = None
-        if params_offloaded:
-            p_compute_flat = dict(tree_paths_and_leaves(self.model.param_compute_sharding)[0])
-            p_storage_flat = dict(tree_paths_and_leaves(self.model.param_sharding)[0])
-
         new_flat = dict(flat_params)
-        new_state = self.opt_state
+        state_slots, state_def = decompose(self.opt_state)
+        # Reads come from state_slots (every group's update must see the ORIGINAL
+        # shared scalars — e.g. Adam's count — not a prior group's increment);
+        # writes land in out_slots. Param-slot dicts are shared objects, which is
+        # safe: groups touch disjoint path sets.
+        out_slots = list(state_slots)
         # Scalars change rarely: cache their device buffers (same rationale as the
         # fused step's _scalar_bufs — no per-step H2D for constants).
         skey = (float(inv_scale), float(lr_override) if with_lr else 0.0)
@@ -431,19 +469,23 @@ class AcceleratedOptimizer:
                     )
 
                 self._jit_cache[key] = jax.jit(_group_update, donate_argnums=(0, 2))
+                self._jit_cache[("chunk_store_shard", gi)] = slice_state(self.opt_state_sharding, paths)
+                self._jit_cache[("chunk_param_store", gi)] = (
+                    {p: p_storage_flat[p] for p in paths} if params_offloaded else None
+                )
             p_g = {p: flat_params[p] for p in paths}
             g_g = {p: flat_grads[p] for p in paths}
-            s_g = slice_state(self.opt_state, paths)
+            s_g = group_state(state_slots, state_def, paths)
             p_new, s_new = self._jit_cache[key](p_g, s_g, g_g, inv_buf, lr_val, finite)
             # Write the group state straight back to its pinned-host tier (the D2H
-            # overlaps the next group program) and merge into the global tree.
-            s_new = jax.device_put(s_new, slice_state(self.opt_state_sharding, paths))
+            # overlaps the next group program) and absorb into the step's slots.
+            s_new = jax.device_put(s_new, self._jit_cache[("chunk_store_shard", gi)])
             if params_offloaded:
-                p_new = jax.device_put(p_new, {p: p_storage_flat[p] for p in paths})
-            new_state = merge_state(new_state, s_new)
+                p_new = jax.device_put(p_new, self._jit_cache[("chunk_param_store", gi)])
+            absorb(out_slots, state_def, s_new)
             new_flat.update(p_new)
 
-        self.opt_state = new_state
+        self.opt_state = recompose(out_slots, state_def)
         new_params = jax.tree_util.tree_unflatten(params_treedef, [new_flat[p] for p in param_paths])
         return new_params, finite
 

@@ -174,8 +174,15 @@ class FusedTrainStep:
         inv_scale = 1.0 / loss_scale
         lr = opt._lr_override
         with_lr = lr is not None
-        if with_lr not in self._jitted:
-            self._jitted[with_lr] = self._build(with_lr)
+        # In offload mode the jitted program is grads-only — lr enters via
+        # apply_chunked_update — so one cache entry serves both lr states
+        # (a with_lr-keyed cache would recompile the identical program the
+        # first time a scheduler installs an override). The sentinel keeps it
+        # distinct from the fused program in case offload_opt_state is toggled
+        # mid-run (e.g. LocalSGD collapse).
+        cache_key = "offload" if opt.offload_opt_state else with_lr
+        if cache_key not in self._jitted:
+            self._jitted[cache_key] = self._build(cache_key)
         # Scalars change rarely (scale only on scaler growth/backoff, lr per
         # scheduler step); cache their device buffers so the hot loop doesn't pay
         # three host->device transfers per step.
@@ -185,7 +192,7 @@ class FusedTrainStep:
             self._scalar_bufs = tuple(jnp.asarray(v, jnp.float32) for v in key)
         if opt.offload_opt_state:
             # grads program (unscale+clip inside), then the chunked per-group update.
-            grads, loss, aux, finite = self._jitted[with_lr](
+            grads, loss, aux, finite = self._jitted[cache_key](
                 self.model.params, self._scalar_bufs[0], self._scalar_bufs[1], *args, **kwargs
             )
             new_params, finite = opt.apply_chunked_update(
@@ -193,7 +200,7 @@ class FusedTrainStep:
             )
             self.model.params = new_params
         else:
-            new_params, new_opt_state, loss, aux, finite = self._jitted[with_lr](
+            new_params, new_opt_state, loss, aux, finite = self._jitted[cache_key](
                 self.model.params,
                 opt.opt_state,
                 *self._scalar_bufs,

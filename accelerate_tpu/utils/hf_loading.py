@@ -195,8 +195,69 @@ def _mixtral_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
     return flat
 
 
-_FROM_HF = {"llama": _llama_from_hf, "mixtral": _mixtral_from_hf}
-_TO_HF = {"llama": _llama_to_hf, "mixtral": _mixtral_to_hf}
+# ---------------------------------------------------------------------- gptj mapping
+def _gptj_from_hf(flat: Dict[str, np.ndarray], config) -> dict:
+    def T(name):
+        return np.ascontiguousarray(flat[name].T)
+
+    inner: dict = {
+        "wte": {"embedding": np.asarray(flat["transformer.wte.weight"])},
+        "ln_f": {
+            "scale": np.asarray(flat["transformer.ln_f.weight"]),
+            "bias": np.asarray(flat["transformer.ln_f.bias"]),
+        },
+        "lm_head": {"kernel": T("lm_head.weight"), "bias": np.asarray(flat["lm_head.bias"])},
+    }
+    for i in range(config.num_hidden_layers):
+        p = f"transformer.h.{i}."
+        inner[f"layer_{i}"] = {
+            "ln_1": {
+                "scale": np.asarray(flat[p + "ln_1.weight"]),
+                "bias": np.asarray(flat[p + "ln_1.bias"]),
+            },
+            "attention": {
+                "wq": {"kernel": T(p + "attn.q_proj.weight")},
+                "wk": {"kernel": T(p + "attn.k_proj.weight")},
+                "wv": {"kernel": T(p + "attn.v_proj.weight")},
+                "wo": {"kernel": T(p + "attn.out_proj.weight")},
+            },
+            "mlp": {
+                "fc_in": {"kernel": T(p + "mlp.fc_in.weight"), "bias": np.asarray(flat[p + "mlp.fc_in.bias"])},
+                "fc_out": {"kernel": T(p + "mlp.fc_out.weight"), "bias": np.asarray(flat[p + "mlp.fc_out.bias"])},
+            },
+        }
+    return {"params": inner}
+
+
+def _gptj_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
+    inner = params["params"]
+
+    def T(x):
+        return np.ascontiguousarray(np.asarray(x).T)
+
+    flat = {
+        "transformer.wte.weight": np.asarray(inner["wte"]["embedding"]),
+        "transformer.ln_f.weight": np.asarray(inner["ln_f"]["scale"]),
+        "transformer.ln_f.bias": np.asarray(inner["ln_f"]["bias"]),
+        "lm_head.weight": T(inner["lm_head"]["kernel"]),
+        "lm_head.bias": np.asarray(inner["lm_head"]["bias"]),
+    }
+    for i in range(config.num_hidden_layers):
+        lp = inner[f"layer_{i}"]
+        p = f"transformer.h.{i}."
+        flat[p + "ln_1.weight"] = np.asarray(lp["ln_1"]["scale"])
+        flat[p + "ln_1.bias"] = np.asarray(lp["ln_1"]["bias"])
+        for ours, theirs in [("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "out_proj")]:
+            flat[p + f"attn.{theirs}.weight"] = T(lp["attention"][ours]["kernel"])
+        flat[p + "mlp.fc_in.weight"] = T(lp["mlp"]["fc_in"]["kernel"])
+        flat[p + "mlp.fc_in.bias"] = np.asarray(lp["mlp"]["fc_in"]["bias"])
+        flat[p + "mlp.fc_out.weight"] = T(lp["mlp"]["fc_out"]["kernel"])
+        flat[p + "mlp.fc_out.bias"] = np.asarray(lp["mlp"]["fc_out"]["bias"])
+    return flat
+
+
+_FROM_HF = {"llama": _llama_from_hf, "mixtral": _mixtral_from_hf, "gptj": _gptj_from_hf}
+_TO_HF = {"llama": _llama_to_hf, "mixtral": _mixtral_to_hf, "gptj": _gptj_to_hf}
 
 
 def convert_hf_state_dict(flat: Dict[str, np.ndarray], model_type: str, config) -> dict:

@@ -141,15 +141,24 @@ def inference_bench(args):
     import jax
 
     from accelerate_tpu.generation import GenerationConfig, Generator
-    from accelerate_tpu.models.llama import create_llama_model, llama_1b, llama_tiny
 
     on_accel = jax.devices()[0].platform in ("tpu", "gpu")
-    model_name = args.model if args.model.startswith("llama") else "llama-1b"
+    model_name = args.model if args.model.startswith(("llama", "gptj")) else "llama-1b"
     if not on_accel:
-        model_name = "llama-tiny"
+        model_name = "gptj-tiny" if model_name.startswith("gptj") else "llama-tiny"
     t_load = time.perf_counter()
-    cfg = llama_1b() if model_name == "llama-1b" else llama_tiny()
-    model = create_llama_model(cfg, seq_len=args.seq_len, param_dtype="bfloat16" if on_accel else None)
+    if model_name.startswith("gptj"):
+        # The reference's own headline config: GPT-J-6B, benchmarks/README.md:31
+        # (0.05 s/token fp16 on 2x Titan RTX).
+        from accelerate_tpu.models.gptj import create_gptj_model, gptj_6b, gptj_tiny
+
+        cfg = gptj_6b() if model_name == "gptj-6b" else gptj_tiny()
+        model = create_gptj_model(cfg, seq_len=args.seq_len, param_dtype="bfloat16" if on_accel else None)
+    else:
+        from accelerate_tpu.models.llama import create_llama_model, llama_1b, llama_tiny
+
+        cfg = llama_1b() if model_name == "llama-1b" else llama_tiny()
+        model = create_llama_model(cfg, seq_len=args.seq_len, param_dtype="bfloat16" if on_accel else None)
     load_s = time.perf_counter() - t_load
 
     batch = args.batch_size or 1
@@ -240,10 +249,16 @@ def train_bench(args):
         hidden = cfg.hidden_size
         vocab = cfg.vocab_size
     else:
-        from accelerate_tpu.models.llama import create_llama_model, llama_1b, llama_tiny
+        if args.model.startswith("gptj"):
+            from accelerate_tpu.models.gptj import create_gptj_model, gptj_6b, gptj_tiny
 
-        cfg = llama_1b() if args.model == "llama-1b" else llama_tiny()
-        model = create_llama_model(cfg, seq_len=args.seq_len)
+            cfg = gptj_6b() if args.model == "gptj-6b" else gptj_tiny()
+            model = create_gptj_model(cfg, seq_len=args.seq_len)
+        else:
+            from accelerate_tpu.models.llama import create_llama_model, llama_1b, llama_tiny
+
+            cfg = llama_1b() if args.model == "llama-1b" else llama_tiny()
+            model = create_llama_model(cfg, seq_len=args.seq_len)
         rng = np.random.default_rng(0)
         global_batch = args.batch_size * n_chips
         n = global_batch * (args.trials * args.steps + args.warmup + 2)
@@ -366,7 +381,11 @@ def train_bench(args):
 def parse_args(argv):
     parser = argparse.ArgumentParser()
     parser.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
-    parser.add_argument("--model", default="bert-base", choices=["bert-base", "bert-tiny", "llama-1b", "llama-tiny"])
+    parser.add_argument(
+        "--model",
+        default="bert-base",
+        choices=["bert-base", "bert-tiny", "llama-1b", "llama-tiny", "gptj-6b", "gptj-tiny"],
+    )
     parser.add_argument("--mode", default="train", choices=["train", "inference"])
     parser.add_argument("--batch_size", type=int, default=None, help="per-chip batch size")
     parser.add_argument("--seq_len", type=int, default=128)

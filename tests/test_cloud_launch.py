@@ -1,0 +1,145 @@
+"""Managed-cloud launch path (the reference's SageMaker equivalent, GCP-shaped:
+sagemaker_launcher commands/launch.py:880 + config questionnaire sagemaker.py).
+Everything is asserted through the dry-run plan — no gcloud/network in CI."""
+
+import argparse
+
+import pytest
+
+from accelerate_tpu.commands.cloud import CloudJobConfig, plan_cloud_job
+from accelerate_tpu.commands.launch import add_launch_args, launch_command
+
+from test_config_cli import run_config
+
+
+def _args(extra=()):
+    parser = argparse.ArgumentParser(allow_abbrev=False)
+    add_launch_args(parser)
+    return parser.parse_args([*extra, "train.py", "--lr", "3e-4"])
+
+
+def _cfg(**overrides):
+    block = {"project": "my-proj", "name": "job1", **overrides}
+    return CloudJobConfig({"cloud_config": block}, _args())
+
+
+def test_plan_queued_resource_full_lifecycle():
+    plan = plan_cloud_job(_cfg(spot=True, output_gcs="gs://bkt/run1"), ["train.py", "--lr", "3e-4"])
+    tags = [t for t, _ in plan]
+    assert tags == ["provision", "poll", "clean", "sync", "run", "collect", "teardown"]
+    provision = dict(plan)["provision"]
+    assert "queued-resources" in provision and "--spot" in provision
+    assert "v5litepod-8" in provision  # default accelerator type
+    run_cmd = dict(plan)["run"]
+    assert run_cmd[-1].endswith("python -m accelerate_tpu.commands.launch train.py --lr 3e-4")
+    assert "--worker" in run_cmd and "all" in run_cmd
+    teardown = dict(plan)["teardown"]
+    assert "delete" in teardown and "job1" in teardown
+
+
+def test_plan_direct_create_no_teardown():
+    plan = plan_cloud_job(_cfg(use_queued_resource=False, teardown=False), ["t.py"])
+    tags = [t for t, _ in plan]
+    assert tags == ["provision", "clean", "sync", "run"]  # no poll (direct), no teardown
+    assert "tpu-vm" in dict(plan)["provision"]
+
+
+def test_plan_setup_commands_ordered():
+    plan = plan_cloud_job(_cfg(setup_commands=["pip install -e .", "echo ok"]), ["t.py"])
+    tags = [t for t, _ in plan]
+    assert tags.index("sync") < tags.index("setup") < tags.index("run")
+    setups = [cmd[-1] for t, cmd in plan if t == "setup"]
+    assert setups == ["pip install -e .", "echo ok"]
+
+
+def test_cloud_requires_project():
+    with pytest.raises(ValueError, match="project"):
+        CloudJobConfig({}, _args())
+
+
+def test_remote_run_args_are_shell_quoted():
+    plan = plan_cloud_job(_cfg(), ["train.py", "--run_name", "my run; rm -rf /"])
+    run_cmd = dict(plan)["run"][-1]
+    assert "'my run; rm -rf /'" in run_cmd
+
+
+def test_remote_config_strips_cloud_block_and_folds_cli_flags():
+    """The staged config must not re-provision on the slice, and local CLI launch
+    flags must survive the hop."""
+    from accelerate_tpu.commands.cloud import build_remote_config
+
+    args = _args(["--mixed_precision", "bf16", "--mesh_fsdp", "8", "--debug"])
+    remote = build_remote_config(
+        args,
+        {
+            "compute_environment": "GCP_CLOUD",
+            "cloud_config": {"project": "p"},
+            "mesh": {"data": -1, "model": 2},
+            "gradient_accumulation_steps": 2,
+        },
+    )
+    assert "cloud_config" not in remote and "compute_environment" not in remote
+    assert remote["mixed_precision"] == "bf16"
+    assert remote["mesh"] == {"data": -1, "model": 2, "fsdp": 8}
+    assert remote["gradient_accumulation_steps"] == 2
+    assert remote["debug"] is True
+
+
+def test_launch_command_cloud_dry_run(tmp_path, capsys):
+    """`launch --cloud --dry_run` goes through the real dispatch and prints the plan;
+    CLI flags override the config block."""
+    import yaml
+
+    config_file = tmp_path / "c.yaml"
+    config_file.write_text(
+        yaml.safe_dump(
+            {
+                "compute_environment": "GCP_CLOUD",
+                "cloud_config": {"project": "p1", "zone": "us-east5-b", "name": "nightly"},
+            }
+        )
+    )
+    args = _args(
+        ["--config_file", str(config_file), "--dry_run", "--cloud_accelerator_type", "v5litepod-16"]
+    )
+    plan = launch_command(args)
+    out = capsys.readouterr().out
+    assert "[provision]" in out and "[teardown]" in out
+    assert any("v5litepod-16" in " ".join(cmd) for _, cmd in plan)
+    assert any("us-east5-b" in " ".join(cmd) for _, cmd in plan)
+
+
+def test_questionnaire_cloud_flow(tmp_path):
+    answers = [
+        "2",            # GCP Cloud TPU
+        "nightly-job",  # name
+        "proj-7",       # project
+        "",             # zone default
+        "v5litepod-32",  # accelerator type
+        "",             # runtime version default
+        "y",            # queued resource
+        "y",            # spot
+        "gs://bkt/out",  # output gcs
+        "y",            # teardown
+        "",             # customize mesh? (no)
+        "",             # fsdp? (no)
+        "",             # sp? (no)
+        "",             # precision default (bf16)
+        "",             # downcast
+        "",             # grad accumulation
+        "",             # compile cache
+        "",             # debug
+    ]
+    config, _ = run_config(tmp_path, answers)
+    assert config["compute_environment"] == "GCP_CLOUD"
+    assert config["cloud_config"] == {
+        "name": "nightly-job",
+        "project": "proj-7",
+        "zone": "us-central2-b",
+        "accelerator_type": "v5litepod-32",
+        "runtime_version": "tpu-ubuntu2204-base",
+        "use_queued_resource": True,
+        "spot": True,
+        "output_gcs": "gs://bkt/out",
+        "teardown": True,
+    }

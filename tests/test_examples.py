@@ -3,20 +3,18 @@ must actually run). Each example runs as a subprocess on the 8-device virtual CP
 with tiny sizes; asserts on exit code + expected output markers."""
 
 import os
-import subprocess
 import sys
 
 import pytest
 
-from accelerate_tpu.test_utils.testing import cpu_mesh_env
+from accelerate_tpu.test_utils.testing import cpu_mesh_env, execute_subprocess
 
 EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
 
 
 def _run(rel_path, *extra):
     cmd = [sys.executable, os.path.join(EXAMPLES_DIR, rel_path), *extra]
-    result = subprocess.run(cmd, env=cpu_mesh_env(), capture_output=True, text=True, timeout=560)
-    assert result.returncode == 0, f"{rel_path} failed:\n{result.stdout}\n{result.stderr}"
+    result = execute_subprocess(cmd, env=cpu_mesh_env(), timeout=560)
     return result.stdout
 
 
