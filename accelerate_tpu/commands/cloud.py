@@ -233,14 +233,15 @@ def cloud_launcher(args, config: dict):
     plan = plan_cloud_job(cfg, launch_argv)
     if args.dry_run:
         for tag, cmd in plan:
-            print(f"[{tag}] {' '.join(cmd)}")
+            print(f"[{tag}] {shlex.join(cmd)}")
         return plan
     # Stage the effective config inside the synced workdir so the remote launch
     # sees the same settings as a local one would (removed again on exit).
     staged_path = os.path.join(os.getcwd(), STAGED_CONFIG)
     with open(staged_path, "w") as f:
         yaml.safe_dump(remote_config, f, sort_keys=False)
-    steps = [(tag, cmd) for tag, cmd in plan if tag != "teardown"]
+    steps = [(tag, cmd) for tag, cmd in plan if tag not in ("collect", "teardown")]
+    collect = next((cmd for tag, cmd in plan if tag == "collect"), None)
     teardown = next((cmd for tag, cmd in plan if tag == "teardown"), None)
     provisioned = False
     try:
@@ -248,7 +249,7 @@ def cloud_launcher(args, config: dict):
             if tag == "poll":
                 _wait_active(cfg, cmd)
             else:
-                print(f"[cloud] {tag}: {' '.join(cmd)}", flush=True)
+                print(f"[cloud] {tag}: {shlex.join(cmd)}", flush=True)
                 subprocess.run(cmd, check=True)
             if tag == "provision":
                 provisioned = True
@@ -257,8 +258,15 @@ def cloud_launcher(args, config: dict):
             os.unlink(staged_path)
         except OSError:
             pass
+        # Artifacts first, then the slice: a FAILED run's checkpoints/logs are
+        # exactly the ones needed for diagnosis and resume, so the gsutil sync
+        # runs on any exit once the slice exists — before teardown deletes the
+        # only copy of ~/job.
+        if collect is not None and provisioned:
+            print(f"[cloud] collect: {shlex.join(collect)}", flush=True)
+            subprocess.run(collect, check=False)
         # A billing slice must come down on ANY exit — job failure, Ctrl-C,
         # SystemExit — once provisioning was attempted.
         if teardown is not None and provisioned:
-            print(f"[cloud] teardown: {' '.join(teardown)}", flush=True)
+            print(f"[cloud] teardown: {shlex.join(teardown)}", flush=True)
             subprocess.run(teardown, check=False)

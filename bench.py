@@ -250,9 +250,9 @@ def train_bench(args):
         vocab = cfg.vocab_size
     else:
         if args.model.startswith("gptj"):
-            from accelerate_tpu.models.gptj import create_gptj_model, gptj_6b, gptj_tiny
+            from accelerate_tpu.models.gptj import create_gptj_model, gptj_tiny
 
-            cfg = gptj_6b() if args.model == "gptj-6b" else gptj_tiny()
+            cfg = gptj_tiny()
             model = create_gptj_model(cfg, seq_len=args.seq_len)
         else:
             from accelerate_tpu.models.llama import create_llama_model, llama_1b, llama_tiny
@@ -406,6 +406,14 @@ def parse_args(argv):
 def main():
     argv = sys.argv[1:]
     args = parse_args(argv)
+    if args.mode == "train" and args.model == "gptj-6b":
+        # 6B can't TRAIN on one 16GB chip (bf16 params 12GB + Adam state 24GB);
+        # it exists for --mode inference, where it is the reference benchmark's
+        # own model. Checked BEFORE any jax import so the message is immediate.
+        raise SystemExit(
+            "gptj-6b is inference-only on a single chip: "
+            "run `python bench.py --mode inference --model gptj-6b`"
+        )
     if not args._worker and not args.no_supervise:
         sys.exit(supervise([a for a in argv if a != "--no-supervise"], total_steps=args.trials * args.steps))
     if args.mode == "inference":
