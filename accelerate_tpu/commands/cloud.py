@@ -244,6 +244,7 @@ def cloud_launcher(args, config: dict):
     collect = next((cmd for tag, cmd in plan if tag == "collect"), None)
     teardown = next((cmd for tag, cmd in plan if tag == "teardown"), None)
     provisioned = False
+    collect_failed = None
     try:
         for tag, cmd in steps:
             if tag == "poll":
@@ -261,12 +262,22 @@ def cloud_launcher(args, config: dict):
         # Artifacts first, then the slice: a FAILED run's checkpoints/logs are
         # exactly the ones needed for diagnosis and resume, so the gsutil sync
         # runs on any exit once the slice exists — before teardown deletes the
-        # only copy of ~/job.
+        # only copy of ~/job. A failed sync must not prevent teardown (billing),
+        # but it must be LOUD and fail the launcher on the success path below.
         if collect is not None and provisioned:
             print(f"[cloud] collect: {shlex.join(collect)}", flush=True)
-            subprocess.run(collect, check=False)
+            rc = subprocess.run(collect, check=False).returncode
+            if rc != 0:
+                collect_failed = rc
+                print(
+                    f"[cloud] WARNING: artifact sync failed (exit {rc}) — "
+                    f"~/job will be lost with the slice",
+                    flush=True,
+                )
         # A billing slice must come down on ANY exit — job failure, Ctrl-C,
         # SystemExit — once provisioning was attempted.
         if teardown is not None and provisioned:
             print(f"[cloud] teardown: {shlex.join(teardown)}", flush=True)
             subprocess.run(teardown, check=False)
+    if collect_failed is not None:
+        raise RuntimeError(f"cloud job ran but artifact collection failed (exit {collect_failed})")

@@ -21,6 +21,14 @@ from .mixtral import (
     mixtral_tiny,
 )
 from .gptj import GPTJConfig, GPTJForCausalLM, create_gptj_model, gptj_6b, gptj_tiny
+from .gpt_neox import (
+    GPTNeoXConfig,
+    GPTNeoXForCausalLM,
+    create_gpt_neox_model,
+    gpt_neox_20b,
+    gpt_neox_tiny,
+)
+from .opt import OPTConfig, OPTForCausalLM, create_opt_model, opt_30b, opt_tiny
 
 _CONFIG_REGISTRY = {
     "bert-base": lambda: _bert_cfg(bert_base()),
@@ -32,7 +40,36 @@ _CONFIG_REGISTRY = {
     "mixtral-tiny": lambda: _mixtral_cfg(mixtral_tiny()),
     "gptj-6b": lambda: _gptj_cfg(gptj_6b()),
     "gptj-tiny": lambda: _gptj_cfg(gptj_tiny()),
+    "gpt-neox-20b": lambda: _gpt_neox_cfg(gpt_neox_20b()),
+    "gpt-neox-tiny": lambda: _gpt_neox_cfg(gpt_neox_tiny()),
+    "opt-30b": lambda: _opt_cfg(opt_30b()),
+    "opt-tiny": lambda: _opt_cfg(opt_tiny()),
 }
+
+
+def _gpt_neox_cfg(c: GPTNeoXConfig) -> dict:
+    return {
+        "model_type": "gpt_neox",
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.num_hidden_layers,
+        "num_attention_heads": c.num_attention_heads,
+        "intermediate_size": c.intermediate_size,
+        "rotary_pct": c.rotary_pct,
+        "tie_word_embeddings": False,
+    }
+
+
+def _opt_cfg(c: OPTConfig) -> dict:
+    return {
+        "model_type": "opt",
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.num_hidden_layers,
+        "num_attention_heads": c.num_attention_heads,
+        "intermediate_size": c.intermediate_size,
+        "tie_word_embeddings": True,
+    }
 
 
 def _gptj_cfg(c: GPTJConfig) -> dict:

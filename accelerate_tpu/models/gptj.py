@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..modeling import Model
-from ..ops.attention import dot_product_attention
+from ..ops.attention import dot_product_attention, update_decode_cache
 from ..parallel.sharding import constrain_activation
 from .llama import causal_lm_loss
 
@@ -91,20 +91,9 @@ class GPTJAttention(nn.Module):
         k = partial_rotary(k, positions, cfg.rotary_dim)
 
         if cfg.decode_cache_length:
-            # Same single-write-path KV cache as LlamaAttention (llama.py:95-114).
             L = cfg.decode_cache_length
-            cached_k = self.variable("cache", "cached_key", jnp.zeros, (b, L, h, d), k.dtype)
-            cached_v = self.variable("cache", "cached_value", jnp.zeros, (b, L, h, d), v.dtype)
-            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
-            cur = cache_index.value
-            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, cur, 0, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, cur, 0, 0))
-            cache_index.value = cur + s
-            rows = cur + jnp.arange(s)[:, None]
-            cols = jnp.arange(L)[None, :]
-            attend = (cols <= rows) & (cols < cur + s)
-            decode_mask = jnp.broadcast_to(attend[None, None, :, :], (b, 1, s, L))
-            out = dot_product_attention(q, cached_k.value, cached_v.value, mask=decode_mask, causal=False)
+            k_all, v_all, decode_mask = update_decode_cache(self, k, v, L)
+            out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
         else:
             out = dot_product_attention(q, k, v, mask=mask, causal=True)
         return nn.Dense(cfg.hidden_size, use_bias=False, param_dtype=cfg._pdtype, name="wo")(out.reshape(b, s, h * d))

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..modeling import Model
-from ..ops.attention import dot_product_attention
+from ..ops.attention import dot_product_attention, update_decode_cache
 
 from ..parallel.sharding import constrain_activation
 
@@ -93,24 +93,9 @@ class LlamaAttention(nn.Module):
         k = rotary_embedding(k, positions, cfg.rope_theta)
 
         if cfg.decode_cache_length:
-            # Incremental decoding: persist K/V in the flax "cache" collection.
-            # One write path covers prefill (s = prompt_len at index 0) and decode
-            # (s = 1 at the running index); attention masks out unwritten slots.
-            L = cfg.decode_cache_length
-            cached_k = self.variable("cache", "cached_key", jnp.zeros, (b, L, hkv, d), k.dtype)
-            cached_v = self.variable("cache", "cached_value", jnp.zeros, (b, L, hkv, d), v.dtype)
-            cache_index = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
-            cur = cache_index.value
-            cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, cur, 0, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, cur, 0, 0))
-            cache_index.value = cur + s
-            k_all, v_all = cached_k.value, cached_v.value
-            # causal over absolute positions: query row i (absolute cur+i) sees
-            # cache slots j <= cur+i and only written slots (j < cur+s).
-            rows = cur + jnp.arange(s)[:, None]
-            cols = jnp.arange(L)[None, :]
-            attend = (cols <= rows) & (cols < cur + s)  # [s, L]
-            decode_mask = jnp.broadcast_to(attend[None, None, :, :], (b, 1, s, L))
+            # Incremental decoding through the shared flax-cache write path
+            # (ops/attention.update_decode_cache).
+            k_all, v_all, decode_mask = update_decode_cache(self, k, v, cfg.decode_cache_length)
             out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
         else:
             out = dot_product_attention(q, k, v, mask=mask, causal=True)

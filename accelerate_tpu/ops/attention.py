@@ -31,6 +31,38 @@ def make_causal_mask(q_len: int, kv_len: int, dtype=None):
     return (j <= i + (kv_len - q_len)).astype(dtype or jnp.bool_)
 
 
+def update_decode_cache(module, k, v, cache_length: int):
+    """The KV-cache write path shared by every decoder family (llama/gptj/
+    gpt_neox/opt): persist K/V in the flax "cache" collection with static capacity
+    `cache_length`. ONE write path covers prefill (s = prompt_len at index 0) and
+    decode (s = 1 at the running index); the returned mask is causal over absolute
+    positions and masks unwritten slots.
+
+    Call from inside the attention module's `__call__` (needs `module.variable`).
+    Returns `(k_full, v_full, decode_mask)` — feed to
+    `dot_product_attention(..., mask=decode_mask, causal=False)`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, s, h, d = k.shape
+    L = cache_length
+    cached_k = module.variable("cache", "cached_key", jnp.zeros, (b, L, h, d), k.dtype)
+    cached_v = module.variable("cache", "cached_value", jnp.zeros, (b, L, h, d), v.dtype)
+    cache_index = module.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+    cur = cache_index.value
+    cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, k, (0, cur, 0, 0))
+    cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, v, (0, cur, 0, 0))
+    cache_index.value = cur + s
+    # causal over absolute positions: query row i (absolute cur+i) sees cache
+    # slots j <= cur+i and only written slots (j < cur+s).
+    rows = cur + jnp.arange(s)[:, None]
+    cols = jnp.arange(L)[None, :]
+    attend = (cols <= rows) & (cols < cur + s)
+    decode_mask = jnp.broadcast_to(attend[None, None, :, :], (b, 1, s, L))
+    return cached_k.value, cached_v.value, decode_mask
+
+
 def _auto_sequence_parallel(batch: int, seq_len: int):
     """(mesh, mode) when an already-built mesh has a real "seq" axis and the shapes
     divide cleanly — models then get ring attention with zero code changes. None

@@ -256,8 +256,171 @@ def _gptj_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
     return flat
 
 
-_FROM_HF = {"llama": _llama_from_hf, "mixtral": _mixtral_from_hf, "gptj": _gptj_from_hf}
-_TO_HF = {"llama": _llama_to_hf, "mixtral": _mixtral_to_hf, "gptj": _gptj_to_hf}
+# ------------------------------------------------------------------ gpt_neox mapping
+def _gpt_neox_from_hf(flat: Dict[str, np.ndarray], config) -> dict:
+    """HF GPT-NeoX fuses QKV as `query_key_value` with a PER-HEAD [h, 3, d] layout;
+    ours are separate wq/wk/wv — split by reshaping [3H, H] -> [h, 3, d, H]."""
+    h, d = config.num_attention_heads, config.head_dim
+
+    def T(name):
+        return np.ascontiguousarray(flat[name].T)
+
+    def ln(name):
+        return {"scale": np.asarray(flat[name + ".weight"]), "bias": np.asarray(flat[name + ".bias"])}
+
+    inner: dict = {
+        "embed_in": {"embedding": np.asarray(flat["gpt_neox.embed_in.weight"])},
+        "final_norm": ln("gpt_neox.final_layer_norm"),
+        "embed_out": {"kernel": T("embed_out.weight")},
+    }
+    for i in range(config.num_hidden_layers):
+        p = f"gpt_neox.layers.{i}."
+        qkv_w = flat[p + "attention.query_key_value.weight"].reshape(h, 3, d, config.hidden_size)
+        qkv_b = flat[p + "attention.query_key_value.bias"].reshape(h, 3, d)
+
+        def proj(j):
+            w = np.ascontiguousarray(qkv_w[:, j].reshape(h * d, config.hidden_size).T)
+            b = np.ascontiguousarray(qkv_b[:, j].reshape(h * d))
+            return {"kernel": w, "bias": b}
+
+        inner[f"layer_{i}"] = {
+            "input_norm": ln(p + "input_layernorm"),
+            "post_attn_norm": ln(p + "post_attention_layernorm"),
+            "attention": {
+                "wq": proj(0),
+                "wk": proj(1),
+                "wv": proj(2),
+                "wo": {
+                    "kernel": T(p + "attention.dense.weight"),
+                    "bias": np.asarray(flat[p + "attention.dense.bias"]),
+                },
+            },
+            "mlp": {
+                "dense_h_to_4h": {
+                    "kernel": T(p + "mlp.dense_h_to_4h.weight"),
+                    "bias": np.asarray(flat[p + "mlp.dense_h_to_4h.bias"]),
+                },
+                "dense_4h_to_h": {
+                    "kernel": T(p + "mlp.dense_4h_to_h.weight"),
+                    "bias": np.asarray(flat[p + "mlp.dense_4h_to_h.bias"]),
+                },
+            },
+        }
+    return {"params": inner}
+
+
+def _gpt_neox_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
+    inner = params["params"]
+    h, d = config.num_attention_heads, config.head_dim
+
+    def T(x):
+        return np.ascontiguousarray(np.asarray(x).T)
+
+    flat = {
+        "gpt_neox.embed_in.weight": np.asarray(inner["embed_in"]["embedding"]),
+        "gpt_neox.final_layer_norm.weight": np.asarray(inner["final_norm"]["scale"]),
+        "gpt_neox.final_layer_norm.bias": np.asarray(inner["final_norm"]["bias"]),
+        "embed_out.weight": T(inner["embed_out"]["kernel"]),
+    }
+    for i in range(config.num_hidden_layers):
+        lp = inner[f"layer_{i}"]
+        p = f"gpt_neox.layers.{i}."
+        for ours, theirs in [("input_norm", "input_layernorm"), ("post_attn_norm", "post_attention_layernorm")]:
+            flat[p + theirs + ".weight"] = np.asarray(lp[ours]["scale"])
+            flat[p + theirs + ".bias"] = np.asarray(lp[ours]["bias"])
+        # Re-fuse QKV into HF's per-head [h, 3, d] layout.
+        w = np.stack(
+            [np.asarray(lp["attention"][k]["kernel"]).T.reshape(h, d, config.hidden_size) for k in ("wq", "wk", "wv")],
+            axis=1,
+        )  # [h, 3, d, H]
+        b = np.stack([np.asarray(lp["attention"][k]["bias"]).reshape(h, d) for k in ("wq", "wk", "wv")], axis=1)
+        flat[p + "attention.query_key_value.weight"] = np.ascontiguousarray(
+            w.reshape(3 * config.hidden_size, config.hidden_size)
+        )
+        flat[p + "attention.query_key_value.bias"] = np.ascontiguousarray(b.reshape(3 * config.hidden_size))
+        flat[p + "attention.dense.weight"] = T(lp["attention"]["wo"]["kernel"])
+        flat[p + "attention.dense.bias"] = np.asarray(lp["attention"]["wo"]["bias"])
+        for name in ("dense_h_to_4h", "dense_4h_to_h"):
+            flat[p + f"mlp.{name}.weight"] = T(lp["mlp"][name]["kernel"])
+            flat[p + f"mlp.{name}.bias"] = np.asarray(lp["mlp"][name]["bias"])
+    return flat
+
+
+# ----------------------------------------------------------------------- opt mapping
+def _opt_from_hf(flat: Dict[str, np.ndarray], config) -> dict:
+    def T(name):
+        return np.ascontiguousarray(flat[name].T)
+
+    def dense(name):
+        return {"kernel": T(name + ".weight"), "bias": np.asarray(flat[name + ".bias"])}
+
+    def ln(name):
+        return {"scale": np.asarray(flat[name + ".weight"]), "bias": np.asarray(flat[name + ".bias"])}
+
+    inner: dict = {
+        "embed_tokens": {"embedding": np.asarray(flat["model.decoder.embed_tokens.weight"])},
+        "embed_positions": {"embedding": np.asarray(flat["model.decoder.embed_positions.weight"])},
+        "final_norm": ln("model.decoder.final_layer_norm"),
+    }
+    for i in range(config.num_hidden_layers):
+        p = f"model.decoder.layers.{i}."
+        inner[f"layer_{i}"] = {
+            "self_attn_norm": ln(p + "self_attn_layer_norm"),
+            "final_norm": ln(p + "final_layer_norm"),
+            "attention": {
+                "wq": dense(p + "self_attn.q_proj"),
+                "wk": dense(p + "self_attn.k_proj"),
+                "wv": dense(p + "self_attn.v_proj"),
+                "wo": dense(p + "self_attn.out_proj"),
+            },
+            "fc1": dense(p + "fc1"),
+            "fc2": dense(p + "fc2"),
+        }
+    return {"params": inner}
+
+
+def _opt_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
+    inner = params["params"]
+
+    def T(x):
+        return np.ascontiguousarray(np.asarray(x).T)
+
+    flat = {
+        "model.decoder.embed_tokens.weight": np.asarray(inner["embed_tokens"]["embedding"]),
+        "model.decoder.embed_positions.weight": np.asarray(inner["embed_positions"]["embedding"]),
+        "model.decoder.final_layer_norm.weight": np.asarray(inner["final_norm"]["scale"]),
+        "model.decoder.final_layer_norm.bias": np.asarray(inner["final_norm"]["bias"]),
+        "lm_head.weight": np.asarray(inner["embed_tokens"]["embedding"]),  # tied
+    }
+    for i in range(config.num_hidden_layers):
+        lp = inner[f"layer_{i}"]
+        p = f"model.decoder.layers.{i}."
+        for ours, theirs in [("self_attn_norm", "self_attn_layer_norm"), ("final_norm", "final_layer_norm")]:
+            flat[p + theirs + ".weight"] = np.asarray(lp[ours]["scale"])
+            flat[p + theirs + ".bias"] = np.asarray(lp[ours]["bias"])
+        for ours, theirs in [("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "out_proj")]:
+            flat[p + f"self_attn.{theirs}.weight"] = T(lp["attention"][ours]["kernel"])
+            flat[p + f"self_attn.{theirs}.bias"] = np.asarray(lp["attention"][ours]["bias"])
+        for name in ("fc1", "fc2"):
+            flat[p + f"{name}.weight"] = T(lp[name]["kernel"])
+            flat[p + f"{name}.bias"] = np.asarray(lp[name]["bias"])
+    return flat
+
+
+_FROM_HF = {
+    "llama": _llama_from_hf,
+    "mixtral": _mixtral_from_hf,
+    "gptj": _gptj_from_hf,
+    "gpt_neox": _gpt_neox_from_hf,
+    "opt": _opt_from_hf,
+}
+_TO_HF = {
+    "llama": _llama_to_hf,
+    "mixtral": _mixtral_to_hf,
+    "gptj": _gptj_to_hf,
+    "gpt_neox": _gpt_neox_to_hf,
+    "opt": _opt_to_hf,
+}
 
 
 def convert_hf_state_dict(flat: Dict[str, np.ndarray], model_type: str, config) -> dict:
