@@ -106,7 +106,9 @@ class Generator:
         pad_id = config.pad_token_id if config.pad_token_id is not None else (eos if eos is not None else 0)
         step_inner = self._step_inner
 
-        def decode(params, cache, first_logits, prompt_len, limit, temperature, rng):
+        def decode(params, cache, first_logits, prompt_len, limit, temperature, rng, *extra):
+            # `extra` operands (e.g. the encoder output for seq2seq models) thread
+            # through unchanged to every step_inner call.
             b = first_logits.shape[0]
             token, rng = _sample(first_logits, config, rng, temperature)
             tokens = jnp.full((b, bucket), jnp.int32(pad_id))
@@ -125,7 +127,7 @@ class Generator:
                 if eos is not None:
                     finished = finished | (token == eos)
                 position = jnp.broadcast_to(prompt_len + i - 1, (b,)).astype(jnp.int32)
-                logits, cache = step_inner(params, cache, token, position)
+                logits, cache = step_inner(params, cache, token, position, *extra)
                 token, rng = _sample(logits, config, rng, temperature)
                 if eos is not None:
                     # Rows past their EOS emit pad/eos, matching HF generate's padding.
@@ -175,6 +177,100 @@ class Generator:
             idx = np.argmax(all_finished) if all_finished.any() else max_new - 1
             generated = generated[:, : idx + 1]
         return jnp.concatenate([input_ids, generated], axis=1)
+
+
+class Seq2SeqGenerator:
+    """Compiled encode + fused decode loop for encoder-decoder Model bundles (T5):
+    the encoder runs ONCE per prompt, then the same on-device `lax.while_loop`
+    decode as `Generator`, with the encoder output riding along as a loop operand.
+
+    The decoder module must expose `encode(input_ids, attention_mask)` and
+    `decode(decoder_input_ids, encoder_hidden, positions, enc_mask)` methods plus a
+    `decode_cache_length` config field (models/t5.py is the in-tree shape)."""
+
+    def __init__(self, model, max_new_tokens: int = 32, decoder_start_token_id: int = 0):
+        module = getattr(model, "module", None)
+        if module is None or not hasattr(module, "encode"):
+            raise ValueError("Seq2SeqGenerator needs a Model bundle with an encoder-decoder flax module")
+        self.base_config = module.config
+        self.params = model.params if "params" in model.params else {"params": model.params}
+        self.max_new_tokens = max_new_tokens
+        self.start_id = decoder_start_token_id
+        decode_cfg = dataclasses.replace(module.config, decode_cache_length=max_new_tokens + 1)
+        self.module = type(module)(decode_cfg, use_cache=True)
+        mod = self.module
+
+        def encode(params, input_ids, attention_mask):
+            return mod.apply(params, input_ids, attention_mask, method="encode")
+
+        def prime(params, encoder_hidden, enc_mask, start_tokens):
+            # Write the start token at decoder position 0 and return its logits.
+            logits, mutated = mod.apply(
+                params,
+                start_tokens[:, None],
+                encoder_hidden,
+                jnp.zeros((1,), jnp.int32),
+                enc_mask,
+                mutable=["cache"],
+                method="decode",
+            )
+            return logits[:, -1, :], mutated["cache"]
+
+        def step(params, cache, token, position, encoder_hidden, enc_mask):
+            logits, mutated = mod.apply(
+                {**params, "cache": cache},
+                token[:, None],
+                encoder_hidden,
+                position[:1],  # decoder positions are shared across the batch
+                enc_mask,
+                mutable=["cache"],
+                method="decode",
+            )
+            return logits[:, -1, :], mutated["cache"]
+
+        self._encode = jax.jit(encode)
+        self._prime = jax.jit(prime)
+        self._step_inner = step  # traced inside the fused decode loop
+        self._decode_cache = {}
+
+    _decode_fn = Generator._decode_fn  # same bucketed fused-loop builder
+
+    def __call__(self, input_ids, generation_config: Optional[GenerationConfig] = None, rng=None, **kwargs):
+        attention_mask = kwargs.pop("attention_mask", None)  # before GenerationConfig(**kwargs)
+        config = generation_config or GenerationConfig(**kwargs)
+        if rng is None:
+            rng = jax.random.key(0)
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b = input_ids.shape[0]
+        enc_mask = (
+            jnp.asarray(attention_mask, bool)[:, None, None, :]
+            if attention_mask is not None
+            else jnp.ones((b, 1, 1, input_ids.shape[1]), bool)
+        )
+        max_new = min(config.max_new_tokens, self.max_new_tokens)
+        am = jnp.asarray(attention_mask, jnp.int32) if attention_mask is not None else None
+        encoder_hidden = self._encode(self.params, input_ids, am)
+        start = jnp.full((b,), jnp.int32(self.start_id))
+        first_logits, cache = self._prime(self.params, encoder_hidden, enc_mask, start)
+        bucket = 1 << (max_new - 1).bit_length()
+        generated, _cache = self._decode_fn(bucket, config)(
+            self.params,
+            cache,
+            first_logits,
+            jnp.int32(1),  # the start token occupies cache position 0
+            jnp.int32(max_new),
+            jnp.float32(config.temperature),
+            rng,
+            encoder_hidden,
+            enc_mask,
+        )
+        generated = generated[:, :max_new]
+        if config.eos_token_id is not None:
+            toks = np.asarray(generated)
+            all_finished = ((toks == config.eos_token_id).cumsum(axis=1) > 0).all(axis=0)
+            idx = np.argmax(all_finished) if all_finished.any() else max_new - 1
+            generated = generated[:, : idx + 1]
+        return generated  # decoder tokens only (HF seq2seq generate shape)
 
 
 def generate(model, input_ids, max_new_tokens: int = 32, **kwargs):

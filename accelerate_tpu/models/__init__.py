@@ -29,6 +29,7 @@ from .gpt_neox import (
     gpt_neox_tiny,
 )
 from .opt import OPTConfig, OPTForCausalLM, create_opt_model, opt_30b, opt_tiny
+from .t5 import T5Config, T5ForConditionalGeneration, create_t5_model, t0pp_11b, t5_tiny
 
 _CONFIG_REGISTRY = {
     "bert-base": lambda: _bert_cfg(bert_base()),
@@ -44,7 +45,23 @@ _CONFIG_REGISTRY = {
     "gpt-neox-tiny": lambda: _gpt_neox_cfg(gpt_neox_tiny()),
     "opt-30b": lambda: _opt_cfg(opt_30b()),
     "opt-tiny": lambda: _opt_cfg(opt_tiny()),
+    "t0pp-11b": lambda: _t5_cfg(t0pp_11b()),
+    "t5-tiny": lambda: _t5_cfg(t5_tiny()),
 }
+
+
+def _t5_cfg(c: T5Config) -> dict:
+    return {
+        "model_type": "t5",
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.d_model,
+        "d_ff": c.d_ff,
+        "d_kv": c.d_kv,
+        "num_hidden_layers": c.num_layers + c.num_decoder_layers,
+        "num_attention_heads": c.num_heads,
+        "intermediate_size": c.d_ff,
+        "tie_word_embeddings": False,
+    }
 
 
 def _gpt_neox_cfg(c: GPTNeoXConfig) -> dict:

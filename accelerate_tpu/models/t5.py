@@ -1,0 +1,317 @@
+"""T5 family encoder-decoder in flax — the reference's T0pp-11B config
+(benchmarks/README.md:35: T0pp fp32, 0.05 s/token on 2x Titan RTX). The only
+encoder-decoder in the benchmark table; brings cross-attention and relative
+position biases into the model zoo.
+
+T5 v1.1 architecture (T0pp's base): RMSNorm (no bias, pre-LN), relative position
+bias on the FIRST layer of each stack shared with the rest, gated-gelu FFN
+(wi_0/wi_1), NO absolute position embeddings, un-tied lm_head, and the decoder
+input scaled... not at all — T5 famously multiplies nothing; logits are scaled by
+d_model**-0.5 ONLY when the head is tied (v1.0); v1.1 unties, so no scale."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..modeling import Model
+from ..ops.attention import dot_product_attention, update_decode_cache
+from ..parallel.sharding import constrain_activation
+
+T5_SHARDING_RULES = [
+    (r"(wq|wk|wv)/kernel", (None, "model")),
+    (r"wo/kernel", ("model", None)),
+    (r"(wi_0|wi_1)/kernel", (None, "model")),
+    (r"wo_ff/kernel", ("model", None)),
+    (r"shared/embedding", ("model", None)),
+    (r"lm_head/kernel", (None, "model")),
+]
+
+
+@dataclass
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 4096
+    d_kv: int = 64
+    d_ff: int = 10240
+    num_layers: int = 24          # encoder layers
+    num_decoder_layers: int = 24
+    num_heads: int = 64
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_eps: float = 1e-6
+    max_position_embeddings: int = 1024  # practical bound for cache sizing; T5 has no absolute positions
+    decode_cache_length: int = 0
+    param_dtype: str = "float32"
+
+    @property
+    def _pdtype(self):
+        return jnp.dtype(self.param_dtype)
+
+
+class T5RMSNorm(nn.Module):
+    eps: float = 1e-6
+    param_dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.dtype(self.param_dtype))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+def relative_position_bucket(relative_position, bidirectional: bool, num_buckets: int, max_distance: int):
+    """T5's log-bucketed relative positions (HF modeling_t5._relative_position_bucket)."""
+    ret = 0
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class T5RelativeBias(nn.Module):
+    """The learned relative-position bias table; lives on the FIRST block of each
+    stack and is passed to the rest (T5's weight-sharing scheme)."""
+
+    config: T5Config
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, q_positions, k_positions):
+        cfg = self.config
+        table = self.param(
+            "rel_embedding",
+            nn.initializers.normal(1.0),
+            (cfg.relative_attention_num_buckets, cfg.num_heads),
+            cfg._pdtype,
+        )
+        rel = k_positions[None, :] - q_positions[:, None]  # [q, k]
+        buckets = relative_position_bucket(
+            rel, self.bidirectional, cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance
+        )
+        bias = table[buckets]  # [q, k, heads]
+        return jnp.transpose(bias, (2, 0, 1))[None]  # [1, heads, q, k]
+
+
+class T5Attention(nn.Module):
+    config: T5Config
+    causal: bool = False
+    use_cache: bool = False
+
+    @nn.compact
+    def __call__(self, hidden, kv_hidden=None, bias=None, mask=None, positions=None):
+        cfg = self.config
+        b, s, _ = hidden.shape
+        h, d = cfg.num_heads, cfg.d_kv
+        kv_src = hidden if kv_hidden is None else kv_hidden
+        q = nn.Dense(h * d, use_bias=False, param_dtype=cfg._pdtype, name="wq")(hidden).reshape(b, s, h, d)
+
+        def project_kv(src):
+            k = nn.Dense(h * d, use_bias=False, param_dtype=cfg._pdtype, name="wk")(src)
+            v = nn.Dense(h * d, use_bias=False, param_dtype=cfg._pdtype, name="wv")(src)
+            return k.reshape(b, src.shape[1], h, d), v.reshape(b, src.shape[1], h, d)
+
+        # T5 does NOT scale attention scores by 1/sqrt(d): pass scale=1.0.
+        if self.use_cache and kv_hidden is not None:
+            # Cross-attention K/V depend only on the encoder output: project ONCE
+            # (the prime call) into the cache, then every decode-loop step reads
+            # them back instead of re-running two Dense ops over the full encoder
+            # sequence per token. has_variable is trace-static: the prime program
+            # computes+stores, the step program only reads.
+            if self.has_variable("cache", "cross_key"):
+                k = self.variable("cache", "cross_key", None).value
+                v = self.variable("cache", "cross_value", None).value
+            else:
+                k, v = project_kv(kv_src)
+                self.variable("cache", "cross_key", lambda: k)
+                self.variable("cache", "cross_value", lambda: v)
+            out = dot_product_attention(q, k, v, mask=mask, bias=bias, causal=False, scale=1.0)
+        elif self.use_cache and kv_hidden is None and cfg.decode_cache_length:
+            k, v = project_kv(kv_src)
+            k_all, v_all, decode_mask = update_decode_cache(self, k, v, cfg.decode_cache_length)
+            out = dot_product_attention(
+                q, k_all, v_all, mask=decode_mask, bias=bias, causal=False, scale=1.0
+            )
+        else:
+            k, v = project_kv(kv_src)
+            out = dot_product_attention(
+                q, k, v, mask=mask, bias=bias, causal=self.causal and kv_hidden is None, scale=1.0
+            )
+        return nn.Dense(cfg.d_model, use_bias=False, param_dtype=cfg._pdtype, name="wo")(
+            out.reshape(b, s, h * d)
+        )
+
+
+class T5FF(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        gate = nn.gelu(
+            nn.Dense(cfg.d_ff, use_bias=False, param_dtype=cfg._pdtype, name="wi_0")(hidden),
+            approximate=True,
+        )
+        up = nn.Dense(cfg.d_ff, use_bias=False, param_dtype=cfg._pdtype, name="wi_1")(hidden)
+        return nn.Dense(cfg.d_model, use_bias=False, param_dtype=cfg._pdtype, name="wo_ff")(gate * up)
+
+
+class T5EncoderBlock(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, hidden, bias, mask):
+        cfg = self.config
+        attn = T5Attention(cfg, causal=False, name="attention")(
+            T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype, name="input_norm")(hidden), bias=bias, mask=mask
+        )
+        hidden = constrain_activation(hidden + attn)
+        ff = T5FF(cfg, name="ff")(T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype, name="ff_norm")(hidden))
+        return constrain_activation(hidden + ff)
+
+
+class T5DecoderBlock(nn.Module):
+    config: T5Config
+    use_cache: bool = False
+
+    @nn.compact
+    def __call__(self, hidden, encoder_hidden, self_bias, enc_mask):
+        cfg = self.config
+        attn = T5Attention(cfg, causal=True, use_cache=self.use_cache, name="self_attention")(
+            T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype, name="input_norm")(hidden), bias=self_bias
+        )
+        hidden = constrain_activation(hidden + attn)
+        cross = T5Attention(cfg, use_cache=self.use_cache, name="cross_attention")(
+            T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype, name="cross_norm")(hidden),
+            kv_hidden=encoder_hidden,
+            mask=enc_mask,
+        )
+        hidden = constrain_activation(hidden + cross)
+        ff = T5FF(cfg, name="ff")(T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype, name="ff_norm")(hidden))
+        return constrain_activation(hidden + ff)
+
+
+class T5ForConditionalGeneration(nn.Module):
+    """Encoder-decoder forward. Two entry modes:
+      - `__call__(input_ids, decoder_input_ids)`: full teacher-forced forward.
+      - `encode(input_ids)` / `decode(decoder_input_ids, encoder_hidden, positions)`:
+        the split used by cached generation (encode once, decode incrementally)."""
+
+    config: T5Config
+    use_cache: bool = False
+
+    def setup(self):
+        # setup() forbids explicit name=; attributes name the params. Lists get
+        # auto-suffixed names ("enc_blocks_0", ...) — the HF mapping uses them.
+        cfg = self.config
+        self.shared = nn.Embed(cfg.vocab_size, cfg.d_model, param_dtype=cfg._pdtype)
+        self.enc_bias = T5RelativeBias(cfg, bidirectional=True)
+        self.dec_bias = T5RelativeBias(cfg, bidirectional=False)
+        self.enc_blocks = [T5EncoderBlock(cfg) for _ in range(cfg.num_layers)]
+        self.dec_blocks = [
+            T5DecoderBlock(cfg, use_cache=self.use_cache) for _ in range(cfg.num_decoder_layers)
+        ]
+        self.enc_final_norm = T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype)
+        self.dec_final_norm = T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype)
+        self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, param_dtype=cfg._pdtype)
+
+    def encode(self, input_ids, attention_mask=None):
+        s = input_ids.shape[1]
+        pos = jnp.arange(s)
+        bias = self.enc_bias(pos, pos)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        hidden = self.shared(input_ids)
+        for block in self.enc_blocks:
+            hidden = block(hidden, bias, mask)
+        return self.enc_final_norm(hidden)
+
+    def decode(self, decoder_input_ids, encoder_hidden, positions=None, enc_mask=None):
+        cfg = self.config
+        b, s = decoder_input_ids.shape
+        if positions is None:
+            q_pos = jnp.arange(s)
+        else:
+            # Incremental decoding: absolute positions of the current tokens.
+            q_pos = positions
+        if self.use_cache and cfg.decode_cache_length:
+            k_pos = jnp.arange(cfg.decode_cache_length)
+        else:
+            k_pos = jnp.arange(s) if positions is None else positions
+        bias = self.dec_bias(q_pos, k_pos)
+        hidden = self.shared(decoder_input_ids)
+        for block in self.dec_blocks:
+            hidden = block(hidden, encoder_hidden, bias, enc_mask)
+        hidden = self.dec_final_norm(hidden)
+        return self.lm_head(hidden)
+
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
+        encoder_hidden = self.encode(input_ids, attention_mask)
+        enc_mask = None
+        if attention_mask is not None:
+            enc_mask = attention_mask[:, None, None, :].astype(bool)
+        return self.decode(decoder_input_ids, encoder_hidden, enc_mask=enc_mask)
+
+
+def seq2seq_lm_loss(params, batch, apply_fn):
+    """Teacher-forced cross-entropy on decoder targets; labels < 0 are ignored."""
+    logits = apply_fn(params, batch["input_ids"], batch["decoder_input_ids"], batch.get("attention_mask"))
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    valid = (labels >= 0).astype(jnp.float32)
+    safe = jnp.maximum(labels, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def create_t5_model(
+    config: Optional[T5Config] = None, rng=None, seq_len: int = 512, param_dtype=None
+) -> Model:
+    import dataclasses
+
+    config = config or t5_tiny()
+    if param_dtype is not None:
+        config = dataclasses.replace(config, param_dtype=str(jnp.dtype(param_dtype)))
+    if rng is None:
+        rng = jax.random.key(0)
+    module = T5ForConditionalGeneration(config)
+    s = min(seq_len, config.max_position_embeddings)
+    sample = jnp.zeros((1, s), dtype=jnp.int32)
+    params = jax.jit(module.init)(rng, sample, sample[:, : max(s // 2, 1)])
+    return Model.from_flax(module, params, loss_fn=seq2seq_lm_loss, sharding_rules=T5_SHARDING_RULES)
+
+
+def t0pp_11b() -> T5Config:
+    """bigscience/T0pp dims (T5 v1.1 xxl; reference benchmarks/README.md:35)."""
+    return T5Config()
+
+
+def t5_tiny() -> T5Config:
+    return T5Config(
+        vocab_size=512,
+        d_model=64,
+        d_kv=16,
+        d_ff=128,
+        num_layers=2,
+        num_decoder_layers=2,
+        num_heads=4,
+        max_position_embeddings=128,
+    )

@@ -96,6 +96,7 @@ def dot_product_attention(
     v,
     mask=None,
     *,
+    bias=None,
     causal: bool = False,
     scale: Optional[float] = None,
     implementation: Optional[str] = None,
@@ -106,6 +107,9 @@ def dot_product_attention(
         q: [B, Sq, Hq, D]
         k/v: [B, Skv, Hkv, D] with Hq % Hkv == 0 (GQA broadcast)
         mask: optional [B, 1|Hq, Sq, Skv] or [B, Skv] boolean; True = attend.
+        bias: optional additive [1|B, Hq, Sq, Skv] score bias (T5-style relative
+            positions), applied after scaling and before masking. Bias forces the
+            XLA path — the flash/ring kernels don't thread it.
         causal: apply a causal mask.
         scale: defaults to 1/sqrt(D).
         implementation: force "xla" (default) — the seam where flash/ring kernels hook in.
@@ -122,7 +126,7 @@ def dot_product_attention(
     # Sequence-parallel dispatch happens BEFORE GQA expansion so the ring rotates the
     # small hkv-sized K/V blocks (expansion is done per-block inside the ring).
     global LAST_DISPATCH
-    if implementation is None and mask is None and sq == skv:
+    if implementation is None and mask is None and bias is None and sq == skv:
         impl = _auto_sequence_parallel(b, sq)
         if impl is not None:
             from ..parallel.ring_attention import sequence_parallel_attention
@@ -136,8 +140,8 @@ def dot_product_attention(
 
     # Flash kernel: explicit, or automatic on TPU for long unmasked sequences where
     # the [S,S] score materialization would dominate HBM traffic.
-    use_flash = implementation == "flash"
-    if implementation is None and mask is None and sq >= 1024 and sq % 128 == 0 and skv % 128 == 0:
+    use_flash = implementation == "flash" and bias is None
+    if implementation is None and mask is None and bias is None and sq >= 1024 and sq % 128 == 0 and skv % 128 == 0:
         import jax
 
         use_flash = jax.default_backend() == "tpu"
@@ -157,6 +161,8 @@ def dot_product_attention(
 
     # [B, H, Sq, Skv]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
     neg = jnp.finfo(scores.dtype).min
     if causal:
         cm = make_causal_mask(sq, skv)

@@ -136,8 +136,19 @@ def cpu_mesh_env(num_devices: int = 8) -> dict:
     # the process whenever another process holds the (single, serialized) chip.
     # CPU children must never load it.
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    # The caller's num_devices must WIN over an inherited device-count flag
+    # (pytest's conftest bakes 8 into XLA_FLAGS; a 4-device request would
+    # otherwise be silently ignored).
+    import re as _re
+
     flags = env.get("XLA_FLAGS", "")
-    if "--xla_force_host_platform_device_count" not in flags:
+    if "--xla_force_host_platform_device_count" in flags:
+        env["XLA_FLAGS"] = _re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            f"--xla_force_host_platform_device_count={num_devices}",
+            flags,
+        )
+    else:
         env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={num_devices}").strip()
     # Children must resolve the package even when it's driven from a source checkout.
     pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

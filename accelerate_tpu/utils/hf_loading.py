@@ -407,12 +407,125 @@ def _opt_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
     return flat
 
 
+# ------------------------------------------------------------------------ t5 mapping
+def _t5_from_hf(flat: Dict[str, np.ndarray], config) -> dict:
+    """HF T5 v1.1 layout: per-stack blocks with numbered sublayers (0=self-attn,
+    [1=cross-attn decoder-only], last=FF); the relative-bias table lives on block 0
+    of each stack. Our modules share ONE bias module per stack — same weight."""
+
+    def T(name):
+        return np.ascontiguousarray(flat[name].T)
+
+    def attn(prefix):
+        return {
+            "wq": {"kernel": T(prefix + ".q.weight")},
+            "wk": {"kernel": T(prefix + ".k.weight")},
+            "wv": {"kernel": T(prefix + ".v.weight")},
+            "wo": {"kernel": T(prefix + ".o.weight")},
+        }
+
+    def ff(prefix):
+        return {
+            "wi_0": {"kernel": T(prefix + ".wi_0.weight")},
+            "wi_1": {"kernel": T(prefix + ".wi_1.weight")},
+            "wo_ff": {"kernel": T(prefix + ".wo.weight")},
+        }
+
+    def norm(name):
+        return {"scale": np.asarray(flat[name])}
+
+    inner: dict = {
+        "shared": {"embedding": np.asarray(flat["shared.weight"])},
+        "enc_final_norm": norm("encoder.final_layer_norm.weight"),
+        "dec_final_norm": norm("decoder.final_layer_norm.weight"),
+        "lm_head": {"kernel": T("lm_head.weight")},
+        "enc_bias": {
+            "rel_embedding": np.asarray(
+                flat["encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
+            )
+        },
+        "dec_bias": {
+            "rel_embedding": np.asarray(
+                flat["decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
+            )
+        },
+    }
+    for i in range(config.num_layers):
+        p = f"encoder.block.{i}."
+        inner[f"enc_blocks_{i}"] = {
+            "attention": attn(p + "layer.0.SelfAttention"),
+            "input_norm": norm(p + "layer.0.layer_norm.weight"),
+            "ff": ff(p + "layer.1.DenseReluDense"),
+            "ff_norm": norm(p + "layer.1.layer_norm.weight"),
+        }
+    for i in range(config.num_decoder_layers):
+        p = f"decoder.block.{i}."
+        inner[f"dec_blocks_{i}"] = {
+            "self_attention": attn(p + "layer.0.SelfAttention"),
+            "input_norm": norm(p + "layer.0.layer_norm.weight"),
+            "cross_attention": attn(p + "layer.1.EncDecAttention"),
+            "cross_norm": norm(p + "layer.1.layer_norm.weight"),
+            "ff": ff(p + "layer.2.DenseReluDense"),
+            "ff_norm": norm(p + "layer.2.layer_norm.weight"),
+        }
+    return {"params": inner}
+
+
+def _t5_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
+    inner = params["params"]
+
+    def T(x):
+        return np.ascontiguousarray(np.asarray(x).T)
+
+    flat = {
+        "shared.weight": np.asarray(inner["shared"]["embedding"]),
+        "encoder.embed_tokens.weight": np.asarray(inner["shared"]["embedding"]),
+        "decoder.embed_tokens.weight": np.asarray(inner["shared"]["embedding"]),
+        "encoder.final_layer_norm.weight": np.asarray(inner["enc_final_norm"]["scale"]),
+        "decoder.final_layer_norm.weight": np.asarray(inner["dec_final_norm"]["scale"]),
+        "lm_head.weight": T(inner["lm_head"]["kernel"]),
+        "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight": np.asarray(
+            inner["enc_bias"]["rel_embedding"]
+        ),
+        "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight": np.asarray(
+            inner["dec_bias"]["rel_embedding"]
+        ),
+    }
+
+    def put_attn(prefix, sub):
+        for ours, theirs in [("wq", "q"), ("wk", "k"), ("wv", "v"), ("wo", "o")]:
+            flat[f"{prefix}.{theirs}.weight"] = T(sub[ours]["kernel"])
+
+    def put_ff(prefix, sub):
+        for ours, theirs in [("wi_0", "wi_0"), ("wi_1", "wi_1"), ("wo_ff", "wo")]:
+            flat[f"{prefix}.{theirs}.weight"] = T(sub[ours]["kernel"])
+
+    for i in range(config.num_layers):
+        lp = inner[f"enc_blocks_{i}"]
+        p = f"encoder.block.{i}."
+        put_attn(p + "layer.0.SelfAttention", lp["attention"])
+        flat[p + "layer.0.layer_norm.weight"] = np.asarray(lp["input_norm"]["scale"])
+        put_ff(p + "layer.1.DenseReluDense", lp["ff"])
+        flat[p + "layer.1.layer_norm.weight"] = np.asarray(lp["ff_norm"]["scale"])
+    for i in range(config.num_decoder_layers):
+        lp = inner[f"dec_blocks_{i}"]
+        p = f"decoder.block.{i}."
+        put_attn(p + "layer.0.SelfAttention", lp["self_attention"])
+        flat[p + "layer.0.layer_norm.weight"] = np.asarray(lp["input_norm"]["scale"])
+        put_attn(p + "layer.1.EncDecAttention", lp["cross_attention"])
+        flat[p + "layer.1.layer_norm.weight"] = np.asarray(lp["cross_norm"]["scale"])
+        put_ff(p + "layer.2.DenseReluDense", lp["ff"])
+        flat[p + "layer.2.layer_norm.weight"] = np.asarray(lp["ff_norm"]["scale"])
+    return flat
+
+
 _FROM_HF = {
     "llama": _llama_from_hf,
     "mixtral": _mixtral_from_hf,
     "gptj": _gptj_from_hf,
     "gpt_neox": _gpt_neox_from_hf,
     "opt": _opt_from_hf,
+    "t5": _t5_from_hf,
 }
 _TO_HF = {
     "llama": _llama_to_hf,
@@ -420,6 +533,7 @@ _TO_HF = {
     "gptj": _gptj_to_hf,
     "gpt_neox": _gpt_neox_to_hf,
     "opt": _opt_to_hf,
+    "t5": _t5_to_hf,
 }
 
 

@@ -21,9 +21,15 @@ STRATEGIES = ["dp", "full_shard", "shard_grad_op", "offload"]
 
 
 def launch_gate(strategy: str, extra_args=()):
+    import time
+
     import accelerate_tpu
 
     script = str(Path(accelerate_tpu.__file__).parent / "test_utils" / "scripts" / "test_performance.py")
+    # 4 virtual devices, not 8: every device is a thread competing for the host's
+    # cores, and XLA:CPU's collective rendezvous has a hard ~40s deadline — on a
+    # small/loaded host 8 threads starve each other past it. 4 still exercises
+    # real multi-device sharding for every strategy.
     cmd = [
         sys.executable,
         "-m",
@@ -31,7 +37,7 @@ def launch_gate(strategy: str, extra_args=()):
         "launch",
         "--cpu",
         "--num_cpu_devices",
-        "8",
+        "4",
         script,
         "--strategy",
         strategy,
@@ -39,15 +45,17 @@ def launch_gate(strategy: str, extra_args=()):
         "0.82",
         *extra_args,
     ]
-    try:
-        return execute_subprocess(cmd, env=cpu_mesh_env(), timeout=900)
-    except RuntimeError as e:
-        # On a loaded single-core host the 8-virtual-device in-process collective
-        # rendezvous (40s hard timeout in XLA:CPU) can spuriously trip. One retry
-        # distinguishes that environment flake from a real gate failure.
-        if "Termination timeout" in str(e) or "rendezvous" in str(e).lower():
-            return execute_subprocess(cmd, env=cpu_mesh_env(), timeout=900)
-        raise
+    attempts = 3
+    for attempt in range(attempts):
+        try:
+            return execute_subprocess(cmd, env=cpu_mesh_env(num_devices=4), timeout=900)
+        except RuntimeError as e:
+            # The rendezvous deadline trips spuriously under transient host load;
+            # retries with backoff distinguish that from a real gate failure.
+            transient = "Termination timeout" in str(e) or "rendezvous" in str(e).lower()
+            if not transient or attempt == attempts - 1:
+                raise
+            time.sleep(15 * (attempt + 1))
 
 
 @pytest.mark.slow_launch
