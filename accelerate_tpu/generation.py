@@ -50,6 +50,22 @@ def _sample(logits, config: GenerationConfig, rng, temperature=None):
     return jax.random.categorical(sub, logits, axis=-1).astype(jnp.int32), rng
 
 
+def _trim_at_eos(generated, eos_token_id, max_new: int):
+    """HF generate's output contract: the fused loop emits a fixed [B, max_new]
+    buffer (pad after EOS); return only up to the step where every row had
+    finished. One host read of the small token matrix."""
+    if eos_token_id is None:
+        return generated
+    toks = np.asarray(generated)
+    all_finished = ((toks == eos_token_id).cumsum(axis=1) > 0).all(axis=0)
+    idx = np.argmax(all_finished) if all_finished.any() else max_new - 1
+    return generated[:, : idx + 1]
+
+
+def _bucket_for(max_new: int) -> int:
+    return 1 << (max_new - 1).bit_length()  # next power of two >= max_new
+
+
 class Generator:
     """Compiled prefill + decode-step pair for a causal-LM Model bundle.
 
@@ -157,8 +173,7 @@ class Generator:
         positions = jnp.broadcast_to(jnp.arange(prompt_len)[None, :], (b, prompt_len))
         params = self.params if "params" in self.params else {"params": self.params}
         logits, cache = self._prefill(params, input_ids, positions)
-        bucket = 1 << (max_new - 1).bit_length()  # next power of two >= max_new
-        generated, _cache = self._decode_fn(bucket, config)(
+        generated, _cache = self._decode_fn(_bucket_for(max_new), config)(
             params,
             cache,
             logits,
@@ -167,15 +182,7 @@ class Generator:
             jnp.float32(config.temperature),
             rng,
         )
-        generated = generated[:, :max_new]
-        if config.eos_token_id is not None:
-            # The fused loop emits a fixed [B, max_new] buffer (pad after EOS); keep
-            # the eager contract of returning only up to the step where every row
-            # had finished (HF generate shape). One host read of the small matrix.
-            toks = np.asarray(generated)
-            all_finished = ((toks == config.eos_token_id).cumsum(axis=1) > 0).all(axis=0)
-            idx = np.argmax(all_finished) if all_finished.any() else max_new - 1
-            generated = generated[:, : idx + 1]
+        generated = _trim_at_eos(generated[:, :max_new], config.eos_token_id, max_new)
         return jnp.concatenate([input_ids, generated], axis=1)
 
 
@@ -252,8 +259,7 @@ class Seq2SeqGenerator:
         encoder_hidden = self._encode(self.params, input_ids, am)
         start = jnp.full((b,), jnp.int32(self.start_id))
         first_logits, cache = self._prime(self.params, encoder_hidden, enc_mask, start)
-        bucket = 1 << (max_new - 1).bit_length()
-        generated, _cache = self._decode_fn(bucket, config)(
+        generated, _cache = self._decode_fn(_bucket_for(max_new), config)(
             self.params,
             cache,
             first_logits,
@@ -264,12 +270,7 @@ class Seq2SeqGenerator:
             encoder_hidden,
             enc_mask,
         )
-        generated = generated[:, :max_new]
-        if config.eos_token_id is not None:
-            toks = np.asarray(generated)
-            all_finished = ((toks == config.eos_token_id).cumsum(axis=1) > 0).all(axis=0)
-            idx = np.argmax(all_finished) if all_finished.any() else max_new - 1
-            generated = generated[:, : idx + 1]
+        generated = _trim_at_eos(generated[:, :max_new], config.eos_token_id, max_new)
         return generated  # decoder tokens only (HF seq2seq generate shape)
 
 

@@ -57,9 +57,14 @@ def _t5_cfg(c: T5Config) -> dict:
         "hidden_size": c.d_model,
         "d_ff": c.d_ff,
         "d_kv": c.d_kv,
+        "head_dim": c.d_kv,
         "num_hidden_layers": c.num_layers + c.num_decoder_layers,
+        "num_encoder_layers": c.num_layers,
+        "num_decoder_layers": c.num_decoder_layers,
         "num_attention_heads": c.num_heads,
         "intermediate_size": c.d_ff,
+        "is_encoder_decoder": True,
+        "feed_forward_proj": "gated-gelu",
         "tie_word_embeddings": False,
     }
 

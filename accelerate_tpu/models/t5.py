@@ -116,7 +116,7 @@ class T5Attention(nn.Module):
     use_cache: bool = False
 
     @nn.compact
-    def __call__(self, hidden, kv_hidden=None, bias=None, mask=None, positions=None):
+    def __call__(self, hidden, kv_hidden=None, bias=None, mask=None):
         cfg = self.config
         b, s, _ = hidden.shape
         h, d = cfg.num_heads, cfg.d_kv

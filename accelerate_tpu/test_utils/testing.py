@@ -139,17 +139,9 @@ def cpu_mesh_env(num_devices: int = 8) -> dict:
     # The caller's num_devices must WIN over an inherited device-count flag
     # (pytest's conftest bakes 8 into XLA_FLAGS; a 4-device request would
     # otherwise be silently ignored).
-    import re as _re
+    from ..utils.environment import set_host_device_count_flag
 
-    flags = env.get("XLA_FLAGS", "")
-    if "--xla_force_host_platform_device_count" in flags:
-        env["XLA_FLAGS"] = _re.sub(
-            r"--xla_force_host_platform_device_count=\d+",
-            f"--xla_force_host_platform_device_count={num_devices}",
-            flags,
-        )
-    else:
-        env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={num_devices}").strip()
+    env["XLA_FLAGS"] = set_host_device_count_flag(env.get("XLA_FLAGS", ""), num_devices)
     # Children must resolve the package even when it's driven from a source checkout.
     pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")

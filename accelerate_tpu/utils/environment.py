@@ -124,6 +124,23 @@ def get_device_peak_flops(device_kind: str, dtype: str = "bf16") -> float:
 
 
 @contextmanager
+def set_host_device_count_flag(flags: str, num_devices: int, override: bool = True) -> str:
+    """Return XLA_FLAGS with `--xla_force_host_platform_device_count=N` set.
+    `override=False` keeps an existing count (explicit-beats-inherited contract
+    shared by the launch CLI and the test harness)."""
+    import re
+
+    if "--xla_force_host_platform_device_count" not in flags:
+        return (flags + f" --xla_force_host_platform_device_count={num_devices}").strip()
+    if not override:
+        return flags
+    return re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        f"--xla_force_host_platform_device_count={num_devices}",
+        flags,
+    )
+
+
 def clear_environment():
     """Temporarily empty os.environ (parity: reference utils/other.py:211)."""
     _old = os.environ.copy()

@@ -411,7 +411,18 @@ def _opt_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
 def _t5_from_hf(flat: Dict[str, np.ndarray], config) -> dict:
     """HF T5 v1.1 layout: per-stack blocks with numbered sublayers (0=self-attn,
     [1=cross-attn decoder-only], last=FF); the relative-bias table lives on block 0
-    of each stack. Our modules share ONE bias module per stack — same weight."""
+    of each stack. Our modules share ONE bias module per stack — same weight.
+
+    v1.0 checkpoints (tied head, non-gated `wi` FFN) are a different architecture
+    (relu FF + d_model**-0.5 logit scale), not just a different layout — reject
+    them explicitly rather than crash on a missing key."""
+    if "lm_head.weight" not in flat or "encoder.block.0.layer.1.DenseReluDense.wi_0.weight" not in flat:
+        raise ValueError(
+            "model_type='t5' supports the T5 v1.1 layout (un-tied lm_head, gated "
+            "wi_0/wi_1 FFN — t5-v1_1-*, T0pp, flan-t5). This checkpoint looks like "
+            "T5 v1.0 (tied head / single `wi` FFN), which is a different "
+            "architecture the in-tree model does not implement."
+        )
 
     def T(name):
         return np.ascontiguousarray(flat[name].T)
