@@ -16,7 +16,7 @@ def get_command_parser():
 
     # Subcommand modules are imported lazily so `--help` stays fast and optional deps
     # (yaml, rich) are only touched by the commands that need them.
-    from . import config, env, estimate, launch, test, tpu
+    from . import config, convert, env, estimate, launch, test, tpu
 
     config.register_subcommand(subparsers)
     env.register_subcommand(subparsers)
@@ -24,6 +24,7 @@ def get_command_parser():
     launch.register_subcommand(subparsers)
     test.register_subcommand(subparsers)
     tpu.register_subcommand(subparsers)
+    convert.register_subcommand(subparsers)
     return parser
 
 

@@ -1,0 +1,77 @@
+"""`accelerate-tpu convert` + `accelerate-tpu merge` — checkpoint tooling around
+the two formats the framework speaks:
+
+- convert: HF torch layout (safetensors / sharded index / .bin) <-> the native
+  pytree checkpoint written by `save_pytree` (npz + structure manifest), using
+  the per-family interchange maps (utils/hf_loading.py). The reference never
+  needs this because it IS torch; a TPU framework whose users arrive with HF
+  checkpoints does. `to_hf` writes real HF-layout safetensors.
+- merge: consolidate a SHARDED_STATE_DICT checkpoint directory (one file per
+  host + manifest, checkpointing.save_sharded) into a single-file native
+  checkpoint for serving/export.
+"""
+
+import os
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser("convert", help="Convert between HF torch and native checkpoint layouts")
+    parser.add_argument("input", help="Input checkpoint (file or HF sharded dir)")
+    parser.add_argument("output", help="Output path (native: .npz + manifest; to_hf: .safetensors)")
+    parser.add_argument(
+        "--model_type",
+        required=True,
+        choices=["llama", "mixtral", "gptj", "gpt_neox", "opt", "t5"],
+        help="Interchange family",
+    )
+    parser.add_argument(
+        "--model",
+        required=True,
+        help="In-tree config name (e.g. llama-1b, gptj-6b, t5-tiny) the layout is validated against",
+    )
+    parser.add_argument(
+        "--direction",
+        default="from_hf",
+        choices=["from_hf", "to_hf"],
+        help="from_hf: HF torch layout -> native pytree; to_hf: native -> HF layout",
+    )
+    parser.set_defaults(func=convert_command)
+
+    merge = subparsers.add_parser("merge", help="Consolidate a sharded native checkpoint into one file")
+    merge.add_argument("input_dir", help="Directory written by sharded save (manifest + shards)")
+    merge.add_argument("output", help="Output path (native .npz + manifest)")
+    merge.set_defaults(func=merge_command)
+    return parser
+
+
+def convert_command(args):
+    from ..checkpointing import load_pytree, save_pytree
+    from ..models import get_model_family
+    from ..utils.hf_loading import (
+        convert_hf_state_dict,
+        load_hf_state_dict,
+        save_hf_checkpoint,
+    )
+
+    family, config = get_model_family(args.model)
+    if family != args.model_type:
+        raise ValueError(f"--model {args.model} is a {family!r} config, not {args.model_type!r}")
+    if args.direction == "from_hf":
+        flat = load_hf_state_dict(args.input)
+        params = convert_hf_state_dict(flat, args.model_type, config)
+        save_pytree(params, args.output)
+        written = args.output if args.output.endswith(".npz") else args.output + ".npz"
+    else:
+        params = load_pytree(args.input)
+        save_hf_checkpoint(params, args.model_type, config, args.output)
+        written = args.output
+    print(f"Wrote {written} ({os.path.getsize(written) / 1e6:.1f} MB, {args.direction}, {args.model_type})")
+
+
+def merge_command(args):
+    from ..checkpointing import load_sharded, save_pytree
+
+    tree = load_sharded(args.input_dir)
+    save_pytree(tree, args.output)
+    written = args.output if args.output.endswith(".npz") else args.output + ".npz"
+    print(f"Merged {args.input_dir} -> {written} ({os.path.getsize(written) / 1e6:.1f} MB)")

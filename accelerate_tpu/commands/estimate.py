@@ -111,9 +111,10 @@ def estimate_parameters_from_hf_config(cfg: dict) -> tuple:
     embed = vocab * hidden
     if cfg.get("is_encoder_decoder"):
         # Encoder layers: 1 attention; decoder layers: self + cross attention and
-        # a third norm (T5-family accounting — t0pp-11b is within ~2%).
-        enc_layers = cfg.get("num_encoder_layers", layers // 2)
-        dec_layers = cfg.get("num_decoder_layers", layers - enc_layers)
+        # a third norm (T5-family accounting — t0pp-11b is within ~2%). In real HF
+        # T5 configs `num_layers` IS the encoder count (decoder has its own key).
+        enc_layers = cfg.get("num_encoder_layers") or cfg.get("num_layers") or layers // 2
+        dec_layers = cfg.get("num_decoder_layers", enc_layers)
         enc_per_layer = attn + mlp + 2 * hidden
         dec_per_layer = 2 * attn + mlp + 3 * hidden
         total = embed + enc_layers * enc_per_layer + dec_layers * dec_per_layer + 2 * hidden

@@ -31,23 +31,50 @@ from .gpt_neox import (
 from .opt import OPTConfig, OPTForCausalLM, create_opt_model, opt_30b, opt_tiny
 from .t5 import T5Config, T5ForConditionalGeneration, create_t5_model, t0pp_11b, t5_tiny
 
-_CONFIG_REGISTRY = {
-    "bert-base": lambda: _bert_cfg(bert_base()),
-    "bert-tiny": lambda: _bert_cfg(bert_tiny()),
-    "llama-3-8b": lambda: _llama_cfg(llama3_8b()),
-    "llama-1b": lambda: _llama_cfg(llama_1b()),
-    "llama-tiny": lambda: _llama_cfg(llama_tiny()),
-    "mixtral-8x7b": lambda: _mixtral_cfg(mixtral_8x7b()),
-    "mixtral-tiny": lambda: _mixtral_cfg(mixtral_tiny()),
-    "gptj-6b": lambda: _gptj_cfg(gptj_6b()),
-    "gptj-tiny": lambda: _gptj_cfg(gptj_tiny()),
-    "gpt-neox-20b": lambda: _gpt_neox_cfg(gpt_neox_20b()),
-    "gpt-neox-tiny": lambda: _gpt_neox_cfg(gpt_neox_tiny()),
-    "opt-30b": lambda: _opt_cfg(opt_30b()),
-    "opt-tiny": lambda: _opt_cfg(opt_tiny()),
-    "t0pp-11b": lambda: _t5_cfg(t0pp_11b()),
-    "t5-tiny": lambda: _t5_cfg(t5_tiny()),
+# The single source of truth for named in-tree models: name -> (interchange
+# family, dataclass-config factory). The estimate registry and the convert CLI
+# both derive from this, so a new model registers exactly once.
+MODEL_REGISTRY = {
+    "bert-base": ("bert", bert_base),
+    "bert-tiny": ("bert", bert_tiny),
+    "llama-3-8b": ("llama", llama3_8b),
+    "llama-1b": ("llama", llama_1b),
+    "llama-tiny": ("llama", llama_tiny),
+    "mixtral-8x7b": ("mixtral", mixtral_8x7b),
+    "mixtral-tiny": ("mixtral", mixtral_tiny),
+    "gptj-6b": ("gptj", gptj_6b),
+    "gptj-tiny": ("gptj", gptj_tiny),
+    "gpt-neox-20b": ("gpt_neox", gpt_neox_20b),
+    "gpt-neox-tiny": ("gpt_neox", gpt_neox_tiny),
+    "opt-30b": ("opt", opt_30b),
+    "opt-tiny": ("opt", opt_tiny),
+    "t0pp-11b": ("t5", t0pp_11b),
+    "t5-tiny": ("t5", t5_tiny),
 }
+
+_CFG_BUILDERS = {
+    "bert": lambda c: _bert_cfg(c),
+    "llama": lambda c: _llama_cfg(c),
+    "mixtral": lambda c: _mixtral_cfg(c),
+    "gptj": lambda c: _gptj_cfg(c),
+    "gpt_neox": lambda c: _gpt_neox_cfg(c),
+    "opt": lambda c: _opt_cfg(c),
+    "t5": lambda c: _t5_cfg(c),
+}
+
+_CONFIG_REGISTRY = {
+    name: (lambda fam=fam, factory=factory: _CFG_BUILDERS[fam](factory()))
+    for name, (fam, factory) in MODEL_REGISTRY.items()
+}
+
+
+def get_model_family(name: str):
+    """(interchange family, dataclass config) for a named in-tree model."""
+    key = name.lower()
+    if key not in MODEL_REGISTRY:
+        raise ValueError(f"Unknown in-tree model {name!r}; known: {sorted(MODEL_REGISTRY)}")
+    family, factory = MODEL_REGISTRY[key]
+    return family, factory()
 
 
 def _t5_cfg(c: T5Config) -> dict:

@@ -123,7 +123,6 @@ def get_device_peak_flops(device_kind: str, dtype: str = "bf16") -> float:
     return 0.0
 
 
-@contextmanager
 def set_host_device_count_flag(flags: str, num_devices: int, override: bool = True) -> str:
     """Return XLA_FLAGS with `--xla_force_host_platform_device_count=N` set.
     `override=False` keeps an existing count (explicit-beats-inherited contract
@@ -141,6 +140,7 @@ def set_host_device_count_flag(flags: str, num_devices: int, override: bool = Tr
     )
 
 
+@contextmanager
 def clear_environment():
     """Temporarily empty os.environ (parity: reference utils/other.py:211)."""
     _old = os.environ.copy()
