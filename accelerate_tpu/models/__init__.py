@@ -52,22 +52,6 @@ MODEL_REGISTRY = {
     "t5-tiny": ("t5", t5_tiny),
 }
 
-_CFG_BUILDERS = {
-    "bert": lambda c: _bert_cfg(c),
-    "llama": lambda c: _llama_cfg(c),
-    "mixtral": lambda c: _mixtral_cfg(c),
-    "gptj": lambda c: _gptj_cfg(c),
-    "gpt_neox": lambda c: _gpt_neox_cfg(c),
-    "opt": lambda c: _opt_cfg(c),
-    "t5": lambda c: _t5_cfg(c),
-}
-
-_CONFIG_REGISTRY = {
-    name: (lambda fam=fam, factory=factory: _CFG_BUILDERS[fam](factory()))
-    for name, (fam, factory) in MODEL_REGISTRY.items()
-}
-
-
 def get_model_family(name: str):
     """(interchange family, dataclass config) for a named in-tree model."""
     key = name.lower()
@@ -176,9 +160,19 @@ def _llama_cfg(c: LlamaConfig) -> dict:
     }
 
 
+# family -> HF-shaped dict builder (bare references; defined above this point).
+_CFG_BUILDERS = {
+    "bert": _bert_cfg,
+    "llama": _llama_cfg,
+    "mixtral": _mixtral_cfg,
+    "gptj": _gptj_cfg,
+    "gpt_neox": _gpt_neox_cfg,
+    "opt": _opt_cfg,
+    "t5": _t5_cfg,
+}
+
+
 def get_model_config(name: str) -> dict:
     """HF-config.json-shaped dict for a named in-tree model (estimate CLI)."""
-    key = name.lower()
-    if key not in _CONFIG_REGISTRY:
-        raise ValueError(f"Unknown model {name!r}; known: {sorted(_CONFIG_REGISTRY)}")
-    return _CONFIG_REGISTRY[key]()
+    family, config = get_model_family(name)
+    return _CFG_BUILDERS[family](config)

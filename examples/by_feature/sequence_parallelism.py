@@ -1,0 +1,70 @@
+"""by_feature/sequence_parallelism — long-context training with the sequence
+dimension sharded over the `seq` mesh axis and ring attention rotating K/V blocks
+via ppermute. This is the capability the reference only reaches through an external
+Megatron flag (SURVEY §5); here it is a plugin plus one mesh axis, and the same
+script runs unsharded when seq_degree=1."""
+
+import argparse
+import os
+import sys
+
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from nlp_example import get_dataset  # noqa: E402,F401  (canonical dataset seam)
+
+import numpy as np
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler
+from accelerate_tpu.models import create_llama_model, llama_tiny
+from accelerate_tpu.utils import ParallelismConfig, SequenceParallelPlugin, set_seed
+
+
+def get_lm_dataset(vocab_size: int, seq_len: int, n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"input_ids": rng.integers(1, vocab_size, size=(seq_len,)).astype(np.int32)} for _ in range(n)
+    ]
+
+
+def training_function(args):
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(data=-1, seq=args.seq_degree),
+        sequence_parallel_plugin=SequenceParallelPlugin(
+            seq_degree=args.seq_degree, mode=args.sp_mode, block_size=args.block_size
+        ),
+    )
+    set_seed(args.seed)
+    config = llama_tiny()
+    model = create_llama_model(config, seq_len=args.seq_len)
+    data = get_lm_dataset(config.vocab_size, args.seq_len, args.train_size, args.seed)
+    train_dl = SimpleDataLoader(data, BatchSampler(range(len(data)), args.batch_size, drop_last=True))
+    model, optimizer, train_dl = accelerator.prepare(model, optax.adamw(args.lr), train_dl)
+
+    step = accelerator.train_step()
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            loss = step(batch)
+        accelerator.print(f"epoch {epoch}: loss {float(loss):.4f}")
+
+    from accelerate_tpu.ops.attention import LAST_DISPATCH
+
+    accelerator.print(
+        f"sequence-parallel training done: seq axis={args.seq_degree}, "
+        f"attention dispatch={LAST_DISPATCH}"
+    )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seq_degree", type=int, default=2, help="Mesh axis size for `seq`")
+    parser.add_argument("--sp_mode", default="ring", choices=["ring", "allgather"])
+    parser.add_argument("--block_size", type=int, default=16, help="Ring attention block size")
+    parser.add_argument("--seq_len", type=int, default=64)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=32)
+    training_function(parser.parse_args())

@@ -79,6 +79,20 @@ def test_convert_rejects_family_mismatch(tmp_path):
     assert "is a 'gptj' config" in result.stderr
 
 
+def test_cli_model_type_choices_match_interchange_registry():
+    """The argparse choices list is a static copy of the interchange keys (kept
+    static so --help stays lazy-import fast); this pins them together."""
+    import argparse
+
+    from accelerate_tpu.commands.convert import register_subcommand
+    from accelerate_tpu.utils.hf_loading import _FROM_HF, _TO_HF
+
+    parser = argparse.ArgumentParser()
+    sub = register_subcommand(parser.add_subparsers())
+    choices = next(a for a in sub._actions if a.dest == "model_type").choices
+    assert set(choices) == set(_FROM_HF) == set(_TO_HF)
+
+
 def test_merge_consolidates_sharded_checkpoint(tmp_path):
     from accelerate_tpu.checkpointing import load_pytree, save_sharded
 

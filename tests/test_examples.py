@@ -58,6 +58,7 @@ def test_by_feature_examples(script, args, marker):
         ("schedule_free.py", ["--train_size", "64", "--eval_size", "32", "--epochs", "1"], "schedule-free eval params"),
         ("deepspeed_with_config_support.py", ["--train_size", "64", "--epochs", "1"], "zero_stage=2 -> SHARD_GRAD_OP"),
         ("megatron_lm_gpt_pretraining.py", ["--steps", "12", "--train_size", "64"], "pretraining loss"),
+        ("sequence_parallelism.py", ["--train_size", "32"], "attention dispatch=ring"),
     ],
 )
 def test_new_by_feature_examples(script, args, marker):
@@ -94,6 +95,7 @@ FEATURE_MARKERS = {
     "schedule_free.py": ["schedule_free_adamw", "schedule_free_eval_params"],
     "deepspeed_with_config_support.py": ["DeepSpeedPlugin", "hf_ds_config"],
     "megatron_lm_gpt_pretraining.py": ["prepare_pipeline", "num_microbatches"],
+    "sequence_parallelism.py": ["SequenceParallelPlugin", "seq_degree"],
 }
 
 
