@@ -150,7 +150,7 @@ class LlamaForCausalLM(nn.Module):
             # compile-time answer to deep stacks (XLA sees a single layer).
             scan_layer = nn.scan(
                 _ScanLayerBody,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.num_hidden_layers,

@@ -124,7 +124,7 @@ class OPTForCausalLM(nn.Module):
         if cfg.scan_layers:
             scan_block = nn.scan(
                 _ScanBlockBody,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.num_hidden_layers,

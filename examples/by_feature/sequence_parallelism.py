@@ -11,7 +11,6 @@ import sys
 import optax
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-from nlp_example import get_dataset  # noqa: E402,F401  (canonical dataset seam)
 
 import numpy as np
 
@@ -21,7 +20,7 @@ from accelerate_tpu.models import create_llama_model, llama_tiny
 from accelerate_tpu.utils import ParallelismConfig, SequenceParallelPlugin, set_seed
 
 
-def get_lm_dataset(vocab_size: int, seq_len: int, n: int, seed: int = 0):
+def get_corpus(vocab_size: int, seq_len: int, n: int, seed: int = 0):
     rng = np.random.default_rng(seed)
     return [
         {"input_ids": rng.integers(1, vocab_size, size=(seq_len,)).astype(np.int32)} for _ in range(n)
@@ -38,7 +37,7 @@ def training_function(args):
     set_seed(args.seed)
     config = llama_tiny()
     model = create_llama_model(config, seq_len=args.seq_len)
-    data = get_lm_dataset(config.vocab_size, args.seq_len, args.train_size, args.seed)
+    data = get_corpus(config.vocab_size, args.seq_len, args.train_size, args.seed)
     train_dl = SimpleDataLoader(data, BatchSampler(range(len(data)), args.batch_size, drop_last=True))
     model, optimizer, train_dl = accelerator.prepare(model, optax.adamw(args.lr), train_dl)
 

@@ -111,3 +111,40 @@ def test_cache_capacity_validation():
     prompt = np.random.default_rng(6).integers(1, 128, (1, 8)).astype(np.int32)
     with pytest.raises(ValueError, match="no room"):
         gen(prompt, GenerationConfig(max_new_tokens=4))
+
+
+def _scan_model(family):
+    import dataclasses
+
+    if family == "llama":
+        from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+
+        cfg = LlamaConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=256, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+            rope_theta=10000.0, scan_layers=True,
+        )
+        return create_llama_model(cfg, seq_len=32)
+    if family == "gptj":
+        from accelerate_tpu.models.gptj import create_gptj_model, gptj_tiny
+
+        return create_gptj_model(dataclasses.replace(gptj_tiny(), scan_layers=True), seq_len=32)
+    if family == "gpt_neox":
+        from accelerate_tpu.models.gpt_neox import create_gpt_neox_model, gpt_neox_tiny
+
+        return create_gpt_neox_model(dataclasses.replace(gpt_neox_tiny(), scan_layers=True), seq_len=32)
+    from accelerate_tpu.models.opt import create_opt_model, opt_tiny
+
+    return create_opt_model(dataclasses.replace(opt_tiny(), scan_layers=True), seq_len=32)
+
+
+@pytest.mark.parametrize("family", ["llama", "gptj", "gpt_neox", "opt"])
+def test_scan_layers_cached_decode_matches_full_context(family):
+    """nn.scan-stacked layers must compose with the KV cache (every family's scan
+    declares a cache axis); decode through it equals argmax over the full-context
+    forward. Regression: the scans previously omitted the cache collection and
+    decode raised ScopeCollectionNotFound."""
+    model = _scan_model(family)
+    prompt = np.random.default_rng(0).integers(1, 512, (2, 8)).astype(np.int32)
+    out = np.asarray(generate(model, prompt, max_new_tokens=4))
+    np.testing.assert_array_equal(out, _greedy_no_cache(model, prompt, 4))

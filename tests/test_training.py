@@ -304,3 +304,41 @@ def test_fsdp_param_sharding_applied():
     loss = accelerator.backward(pmodel.loss, batch)
     popt.step()
     assert np.isfinite(float(loss))
+
+
+def test_hybrid_shard_trains_and_shards_over_fsdp_only():
+    """HYBRID_SHARD: parameters shard over the `fsdp` axis and replicate over
+    `data` (the two-level pod layout). Pins that the strategy activates, the
+    specs name only `fsdp`, and training runs (was previously untested)."""
+    from accelerate_tpu.models import bert_tiny, create_bert_model
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin, ParallelismConfig
+
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(data=2, fsdp=4),
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            sharding_strategy="HYBRID_SHARD", min_num_params=128
+        ),
+    )
+    model = create_bert_model(bert_tiny(), seq_len=16)
+    rng = np.random.default_rng(0)
+    data = [
+        {
+            "input_ids": rng.integers(1, 500, size=(16,)).astype(np.int32),
+            "labels": np.int64(rng.integers(0, 2)),
+        }
+        for _ in range(16)
+    ]
+    dl = SimpleDataLoader(data, BatchSampler(range(16), 16))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.adamw(1e-3), dl)
+
+    specs = [
+        str(leaf.sharding.spec)
+        for leaf in jax.tree_util.tree_leaves(pmodel.params)
+        if hasattr(leaf, "sharding")
+    ]
+    assert any("fsdp" in s for s in specs), "no parameter sharded over fsdp"
+    assert not any("'data'" in s for s in specs), f"params must replicate over data: {specs}"
+
+    step = accelerator.train_step()
+    losses = [float(step(b)) for b in pdl]
+    assert np.isfinite(losses).all()
