@@ -299,6 +299,95 @@ def create_t5_model(
     return Model.from_flax(module, params, loss_fn=seq2seq_lm_loss, sharding_rules=T5_SHARDING_RULES)
 
 
+class T5LayeredApply:
+    """LayeredApply protocol for tier-streamed encoder-decoder execution — the
+    route by which the reference's T0pp-11B fp32 device_map row runs inside
+    bounded HBM. The layer list is the encoder stack followed by the decoder
+    stack; entries are structure-keyed ({"enc": ...} vs {"dec": ...}) so the
+    streaming loop's jit compiles one executable per block kind, and the first
+    decoder entry additionally carries `enc_final_norm` (applied to the encoder
+    output exactly once, before any cross-attention reads it)."""
+
+    def __init__(self, config: T5Config):
+        self.config = config
+
+    def split(self, params):
+        cfg = self.config
+        inner = params["params"]
+        prelude = {"params": {k: inner[k] for k in ("shared", "enc_bias", "dec_bias")}}
+        layers = []
+        for i in range(cfg.num_layers):
+            layers.append({"params": {"enc": inner[f"enc_blocks_{i}"]}})
+        for i in range(cfg.num_decoder_layers):
+            entry = {"params": {"dec": inner[f"dec_blocks_{i}"]}}
+            if i == 0:
+                entry["params"]["enc_final_norm"] = inner["enc_final_norm"]
+            layers.append(entry)
+        tail = {"params": {k: inner[k] for k in ("dec_final_norm", "lm_head")}}
+        return prelude, layers, tail
+
+    def join(self, prelude, layers, tail):
+        cfg = self.config
+        inner = dict(prelude["params"])
+        for i in range(cfg.num_layers):
+            inner[f"enc_blocks_{i}"] = layers[i]["params"]["enc"]
+        for i in range(cfg.num_decoder_layers):
+            entry = layers[cfg.num_layers + i]["params"]
+            inner[f"dec_blocks_{i}"] = entry["dec"]
+            if "enc_final_norm" in entry:
+                inner["enc_final_norm"] = entry["enc_final_norm"]
+        inner.update(tail["params"])
+        return {"params": inner}
+
+    def apply_prelude(self, prelude_params, input_ids, decoder_input_ids, attention_mask=None):
+        cfg = self.config
+        inner = prelude_params["params"]
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model)
+        enc = embed.apply({"params": {"embedding": inner["shared"]["embedding"]}}, input_ids)
+        dec = embed.apply({"params": {"embedding": inner["shared"]["embedding"]}}, decoder_input_ids)
+        enc_pos = jnp.arange(input_ids.shape[1])
+        dec_pos = jnp.arange(decoder_input_ids.shape[1])
+        enc_bias = T5RelativeBias(cfg, bidirectional=True).apply(
+            {"params": inner["enc_bias"]}, enc_pos, enc_pos
+        )
+        dec_bias = T5RelativeBias(cfg, bidirectional=False).apply(
+            {"params": inner["dec_bias"]}, dec_pos, dec_pos
+        )
+        if attention_mask is not None:
+            enc_mask = attention_mask[:, None, None, :].astype(bool)
+        else:
+            # The carry must have a stable pytree structure across layer calls, so
+            # "no mask" is an all-ones mask rather than None.
+            enc_mask = jnp.ones((input_ids.shape[0], 1, 1, input_ids.shape[1]), bool)
+        return {"enc": enc, "dec": dec, "enc_bias": enc_bias, "dec_bias": dec_bias, "enc_mask": enc_mask}
+
+    def apply_layer(self, layer_params, carry):
+        cfg = self.config
+        inner = layer_params["params"]
+        carry = dict(carry)
+        if "enc" in inner:
+            carry["enc"] = T5EncoderBlock(cfg).apply(
+                {"params": inner["enc"]}, carry["enc"], carry["enc_bias"], carry["enc_mask"]
+            )
+            return carry
+        if "enc_final_norm" in inner:
+            carry["enc"] = T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype).apply(
+                {"params": inner["enc_final_norm"]}, carry["enc"]
+            )
+        carry["dec"] = T5DecoderBlock(cfg).apply(
+            {"params": inner["dec"]}, carry["dec"], carry["enc"], carry["dec_bias"], carry["enc_mask"]
+        )
+        return carry
+
+    def apply_tail(self, tail_params, carry):
+        cfg = self.config
+        inner = tail_params["params"]
+        hidden = T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype).apply(
+            {"params": inner["dec_final_norm"]}, carry["dec"]
+        )
+        return nn.Dense(cfg.vocab_size, use_bias=False).apply({"params": inner["lm_head"]}, hidden)
+
+
 def t0pp_11b() -> T5Config:
     """bigscience/T0pp dims (T5 v1.1 xxl; reference benchmarks/README.md:35)."""
     return T5Config()

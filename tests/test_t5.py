@@ -147,6 +147,52 @@ def test_real_transformers_t5_matches():
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
 
 
+def test_layered_apply_matches_monolithic():
+    """Encoder-then-decoder streaming through the LayeredApply protocol (the
+    T0pp-11B device_map route) must match the monolithic forward; split/join
+    round-trips the params."""
+    from accelerate_tpu.models.t5 import T5LayeredApply
+
+    cfg = t5_tiny()
+    model = create_t5_model(cfg, seq_len=16)
+    layered = T5LayeredApply(cfg)
+    rng = np.random.default_rng(6)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 12)), jnp.int32)
+    dec = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 6)), jnp.int32)
+    ref = np.asarray(model.apply_fn(model.params, ids, dec))
+
+    prelude, layers, tail = layered.split(model.params)
+    assert len(layers) == cfg.num_layers + cfg.num_decoder_layers
+    carry = layered.apply_prelude(prelude, ids, dec)
+    for lp in layers:
+        carry = layered.apply_layer(lp, carry)
+    out = np.asarray(layered.apply_tail(tail, carry))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    rejoined = layered.join(prelude, layers, tail)
+    out2 = np.asarray(model.apply_fn(rejoined, ids, dec))
+    np.testing.assert_array_equal(out2, ref)
+
+
+def test_dispatched_cpu_offload_matches_monolithic():
+    """The full big-model path: cpu_offload + streamed execution on a T5 bundle
+    equals the monolithic forward (the reference's T0pp CPU-offload benchmark
+    configuration, shrunk)."""
+    from accelerate_tpu.big_modeling import cpu_offload
+    from accelerate_tpu.models.t5 import T5LayeredApply
+
+    cfg = t5_tiny()
+    model = create_t5_model(cfg, seq_len=16)
+    rng = np.random.default_rng(7)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 12)), jnp.int32)
+    dec = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 6)), jnp.int32)
+    ref = np.asarray(model.apply_fn(model.params, ids, dec))
+
+    dispatched = cpu_offload(model, T5LayeredApply(cfg))
+    out = np.asarray(dispatched(ids, dec))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
 def test_registry_entry():
     from accelerate_tpu.models import get_model_config
 
