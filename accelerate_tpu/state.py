@@ -276,13 +276,19 @@ class PartialState:
                         result = list(result) + list(result[-1:])
             return result
 
-        if isinstance(inputs, dict):
-            lengths = {len(v) for v in inputs.values()}
-            if len(lengths) != 1:
-                raise ValueError("All values in a dict passed to `split_between_processes` must be equal length")
-            yield {k: _split(v) for k, v in inputs.items()}
-        else:
-            yield _split(inputs)
+        def _split_values(obj):
+            # Dicts split recursively (reference state.py:462-465: nested dicts are
+            # walked, every non-dict value slices by the same index range).
+            if isinstance(obj, dict):
+                lengths = {len(v) for v in obj.values() if not isinstance(v, dict)}
+                if len(lengths) > 1:
+                    raise ValueError(
+                        "All values in a dict passed to `split_between_processes` must be equal length"
+                    )
+                return {k: _split_values(v) for k, v in obj.items()}
+            return _split(obj)
+
+        yield _split_values(inputs)
 
     def destroy_process_group(self):
         """Shut down the coordination service (reference destroys the torch pg)."""

@@ -140,6 +140,41 @@ def _dispatch_worker(out_dir):
     acc.wait_for_everyone()
 
 
+def _split_worker(out_dir):
+    import json
+    import os
+
+    import numpy as np
+
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    nested = {"outer": {"x": np.arange(16).reshape(16, 1), "y": list(range(16))}}
+    with state.split_between_processes(nested) as mine:
+        shapes = [int(mine["outer"]["x"].shape[0]), len(mine["outer"]["y"])]
+    with state.split_between_processes(np.arange(10), apply_padding=True) as arr:
+        shapes.append(int(arr.shape[0]))
+    with open(os.path.join(out_dir, f"rank{state.process_index}.json"), "w") as f:
+        json.dump(shapes, f)
+    state.wait_for_everyone()
+
+
+@pytest.mark.slow_launch
+def test_debug_launcher_nested_split():
+    """split_between_processes must recurse into nested dicts at real
+    num_processes > 1 (reference state.py:462-465 contract; previously only the
+    num_processes == 1 short-circuit was exercised)."""
+    with tempfile.TemporaryDirectory() as out_dir:
+        debug_launcher(_split_worker, args=(out_dir,), num_processes=2)
+        results = []
+        for i in range(2):
+            with open(os.path.join(out_dir, f"rank{i}.json")) as f:
+                results.append(json.load(f))
+        assert results[0][0] + results[1][0] == 16  # nested x splits
+        assert results[0][0] == results[0][1]  # x and y split identically
+        assert results[0][2] == results[1][2] == 5  # padded tensor split
+
+
 @pytest.mark.slow_launch
 def test_debug_launcher_training():
     with tempfile.TemporaryDirectory() as out_dir:
