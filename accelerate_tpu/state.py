@@ -276,18 +276,28 @@ class PartialState:
                         result = list(result) + list(result[-1:])
             return result
 
+        def _leaf_lengths(obj):
+            if isinstance(obj, dict):
+                out = []
+                for v in obj.values():
+                    out.extend(_leaf_lengths(v))
+                return out
+            return [len(obj)]
+
         def _split_values(obj):
             # Dicts split recursively (reference state.py:462-465: nested dicts are
             # walked, every non-dict value slices by the same index range).
             if isinstance(obj, dict):
-                lengths = {len(v) for v in obj.values() if not isinstance(v, dict)}
-                if len(lengths) > 1:
-                    raise ValueError(
-                        "All values in a dict passed to `split_between_processes` must be equal length"
-                    )
                 return {k: _split_values(v) for k, v in obj.items()}
             return _split(obj)
 
+        if isinstance(inputs, dict):
+            # Row alignment must hold across the WHOLE tree (a nested value with a
+            # different length would silently desynchronize shards).
+            if len(set(_leaf_lengths(inputs))) > 1:
+                raise ValueError(
+                    "All values in a dict passed to `split_between_processes` must be equal length"
+                )
         yield _split_values(inputs)
 
     def destroy_process_group(self):

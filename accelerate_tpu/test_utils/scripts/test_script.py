@@ -336,10 +336,12 @@ def seedable_sampler_in_shard_check(state):
     # appears at least once and nothing out of range appears.
     assert set(all_indices) == set(range(n)), "sharded seedable sampler must cover the dataset"
     assert len(all_indices) >= n
-    # Same seed+epoch => identical permutation on every process: re-walk locally.
+    # Same seed+epoch => identical permutation on EVERY process: gather each
+    # rank's full local walk and compare against rank 0's.
     sampler2 = SeedableRandomSampler(num_samples=n, seed=7)
     sampler2.set_epoch(3)
-    assert list(sampler2) == list(SeedableRandomSampler(num_samples=n, seed=7, epoch=3))
+    walks = ops.gather_object([list(sampler2)])
+    assert all(w == walks[0] for w in walks), "seedable permutation differs across processes"
     state.wait_for_everyone()
 
 

@@ -33,12 +33,40 @@ def log(msg):
 
 
 # --------------------------------------------------------------------------- supervisor
+def _backend_preflight(timeout_s: int) -> bool:
+    """Can the accelerator backend run ONE tiny op right now? A hung TPU tunnel
+    makes backend init block forever; without this probe the supervisor would
+    burn attempts x full timeouts (an hour-plus) before its CPU fallback. Cost on
+    the healthy path: one extra backend init (~a minute warm) — cheap insurance
+    for a once-per-round benchmark; tune with BENCH_PREFLIGHT_TIMEOUT (0 skips)."""
+    probe = (
+        "import jax, jax.numpy as jnp; x = jnp.ones((8, 8)) @ jnp.ones((8, 8)); "
+        "import numpy as np; print(float(np.asarray(x)[0, 0]))"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", probe], timeout=timeout_s, capture_output=True, text=True
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def supervise(argv, total_steps: int = 0):
     """Run the worker with retry/backoff/timeout; last resort falls back to CPU."""
     attempts = int(os.environ.get("BENCH_MAX_ATTEMPTS", "3"))
     # Scale the per-attempt timeout with the requested workload so a user-set
     # --steps/--trials can't silently turn every attempt into a timeout kill.
     timeout_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", str(max(1500, 300 + 2 * total_steps))))
+    preflight_timeout = int(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "300"))
+    if preflight_timeout > 0 and not _backend_preflight(preflight_timeout):
+        # Backend is down/hung RIGHT NOW. Keep one real attempt (it may recover),
+        # but with a tight timeout so a dead tunnel costs minutes, not hours. A
+        # merely-slow backend that trips this still gets that attempt + the CPU
+        # fallback; raise BENCH_PREFLIGHT_TIMEOUT on known-cold pods.
+        log("preflight: accelerator backend unresponsive; shortening attempts")
+        attempts = 1
+        timeout_s = min(timeout_s, 900)
     cmd = [sys.executable, os.path.abspath(__file__), "--_worker"] + argv
     for attempt in range(attempts + 1):  # final extra attempt = CPU fallback
         env = dict(os.environ)

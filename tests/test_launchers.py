@@ -154,6 +154,13 @@ def _split_worker(out_dir):
         shapes = [int(mine["outer"]["x"].shape[0]), len(mine["outer"]["y"])]
     with state.split_between_processes(np.arange(10), apply_padding=True) as arr:
         shapes.append(int(arr.shape[0]))
+    # Misaligned nested lengths must be rejected, not silently desynchronized.
+    try:
+        with state.split_between_processes({"a": list(range(8)), "sub": {"b": list(range(3))}}):
+            pass
+        shapes.append("no-error")
+    except ValueError:
+        shapes.append("raised")
     with open(os.path.join(out_dir, f"rank{state.process_index}.json"), "w") as f:
         json.dump(shapes, f)
     state.wait_for_everyone()
@@ -173,6 +180,7 @@ def test_debug_launcher_nested_split():
         assert results[0][0] + results[1][0] == 16  # nested x splits
         assert results[0][0] == results[0][1]  # x and y split identically
         assert results[0][2] == results[1][2] == 5  # padded tensor split
+        assert results[0][3] == results[1][3] == "raised"  # misaligned lengths rejected
 
 
 @pytest.mark.slow_launch
