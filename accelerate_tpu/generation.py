@@ -66,6 +66,22 @@ def _bucket_for(max_new: int) -> int:
     return 1 << (max_new - 1).bit_length()  # next power of two >= max_new
 
 
+def _params_resolver(model):
+    """params -> params preprocessing for the compiled programs. Quantized bundles
+    (load_and_quantize_model) carry QuantTensor leaves that the raw flax module
+    can't consume; dequantize INSIDE the program so XLA keeps the int8/packed
+    buffers in HBM and fuses `scale * q` into each consumer — serving stays at the
+    quantized footprint (the reference's bnb int8 inference path)."""
+    from .utils.quantization import dequantize_params, is_quant_entry
+
+    leaves = jax.tree_util.tree_leaves(model.params, is_leaf=is_quant_entry)
+    if not any(is_quant_entry(l) for l in leaves):
+        return lambda p: p
+    qc = getattr(model, "quantization_config", None)
+    compute_dtype = getattr(qc, "compute_dtype", None) or jnp.bfloat16
+    return lambda p: dequantize_params(p, compute_dtype)
+
+
 class Generator:
     """Compiled prefill + decode-step pair for a causal-LM Model bundle.
 
@@ -84,16 +100,21 @@ class Generator:
         self.decode_module = type(model.module)(decode_cfg)
 
         module = self.decode_module
+        resolve = _params_resolver(model)
 
         def prefill(params, input_ids, positions):
             logits, mutated = module.apply(
-                params, input_ids, None, positions, mutable=["cache"]
+                resolve(params), input_ids, None, positions, mutable=["cache"]
             )
             return logits[:, -1, :], mutated["cache"]
 
         def step(params, cache, token, position):
             logits, mutated = module.apply(
-                {**params, "cache": cache}, token[:, None], None, position[:, None], mutable=["cache"]
+                {**resolve(params), "cache": cache},
+                token[:, None],
+                None,
+                position[:, None],
+                mutable=["cache"],
             )
             return logits[:, -1, :], mutated["cache"]
 
@@ -206,14 +227,15 @@ class Seq2SeqGenerator:
         decode_cfg = dataclasses.replace(module.config, decode_cache_length=max_new_tokens + 1)
         self.module = type(module)(decode_cfg, use_cache=True)
         mod = self.module
+        resolve = _params_resolver(model)
 
         def encode(params, input_ids, attention_mask):
-            return mod.apply(params, input_ids, attention_mask, method="encode")
+            return mod.apply(resolve(params), input_ids, attention_mask, method="encode")
 
         def prime(params, encoder_hidden, enc_mask, start_tokens):
             # Write the start token at decoder position 0 and return its logits.
             logits, mutated = mod.apply(
-                params,
+                resolve(params),
                 start_tokens[:, None],
                 encoder_hidden,
                 jnp.zeros((1,), jnp.int32),
@@ -225,7 +247,7 @@ class Seq2SeqGenerator:
 
         def step(params, cache, token, position, encoder_hidden, enc_mask):
             logits, mutated = mod.apply(
-                {**params, "cache": cache},
+                {**resolve(params), "cache": cache},
                 token[:, None],
                 encoder_hidden,
                 position[:1],  # decoder positions are shared across the batch

@@ -47,8 +47,11 @@ def _backend_preflight(timeout_s: int) -> bool:
         r = subprocess.run(
             [sys.executable, "-c", probe], timeout=timeout_s, capture_output=True, text=True
         )
+        if r.returncode != 0:
+            log(f"preflight probe crashed rc={r.returncode}; stderr tail: {(r.stderr or '')[-800:]!r}")
         return r.returncode == 0
     except subprocess.TimeoutExpired:
+        log(f"preflight probe hung >{timeout_s}s (backend init blocked)")
         return False
 
 

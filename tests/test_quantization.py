@@ -120,3 +120,25 @@ def test_quantization_config_validation():
     with pytest.raises(ValueError):
         QuantizationConfig(load_in_4bit=True, quant_type="fp3")
     assert not QuantizationConfig().enabled
+
+
+def test_quantized_generation_matches_dense_greedy():
+    """Generation straight off a quantized bundle (the reference's bnb int8
+    serving path): the Generator must dequantize inside its compiled programs.
+    Regression: QuantTensor leaves previously hit the raw flax module and raised
+    TypeError."""
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.llama import create_llama_model, llama_tiny
+
+    model = create_llama_model(llama_tiny(), seq_len=32)
+    qmodel = load_and_quantize_model(
+        model, QuantizationConfig(load_in_8bit=True, compute_dtype=jnp.float32)
+    )
+    prompt = np.random.default_rng(0).integers(1, 500, (2, 8)).astype(np.int32)
+    q_out = np.asarray(generate(qmodel, prompt, max_new_tokens=4))
+    dense_out = np.asarray(generate(model, prompt, max_new_tokens=4))
+    assert q_out.shape == dense_out.shape
+    # compare only the GENERATED suffix (the echoed prompt always matches);
+    # int8 per-channel keeps greedy decoding close on a tiny model
+    q_gen, dense_gen = q_out[:, 8:], dense_out[:, 8:]
+    assert (q_gen == dense_gen).mean() > 0.6, (q_gen, dense_gen)
