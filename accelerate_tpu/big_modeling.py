@@ -196,6 +196,40 @@ class DispatchedModel:
         )
         return self.apply_fn(self.materialize_params(), *args, **kwargs)
 
+    def generate(self, input_ids, max_new_tokens: int = 32, eos_token_id=None):
+        """Greedy generation through the tiered forward — the reference's
+        big-model-inference benchmark shape (load + per-token generation with
+        CPU/disk-offloaded weights, benchmarks/big_model_inference.py). Each token
+        re-streams the offloaded layers over the full context; that IS the cost
+        model the reference publishes (2.4-34 s/token for OPT-30B offload,
+        benchmarks/README.md:36-37) — for fast decoding keep weights resident and
+        use `accelerate_tpu.generation.Generator`."""
+        import jax.numpy as jnp
+
+        from .generation import _bucket_for
+
+        ids = jnp.asarray(input_ids, jnp.int32)
+        finished = jnp.zeros((ids.shape[0],), bool)
+        for _ in range(max_new_tokens):
+            cur = ids.shape[1]
+            # Right-pad the context to a power-of-two bucket: padding after the
+            # last real token is invisible under causal masking, and it keeps the
+            # streamed programs' shapes stable (O(log n) compiles, not O(n)).
+            bucket = _bucket_for(cur)
+            padded = jnp.pad(ids, ((0, 0), (0, bucket - cur)))
+            logits = self(padded)
+            nxt = jnp.argmax(logits[:, cur - 1, :], axis=-1).astype(jnp.int32)
+            if eos_token_id is not None:
+                # Per-row EOS: finished rows emit pad/eos (HF generate padding),
+                # and the loop stops as soon as EVERY row has finished — each
+                # extra step re-streams the whole offloaded model.
+                nxt = jnp.where(finished, jnp.int32(eos_token_id), nxt)
+                finished = finished | (nxt == eos_token_id)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+            if eos_token_id is not None and bool(finished.all()):
+                break
+        return ids
+
     def _fetch_block_pytree(self, subtree):
         """device_put a sub-pytree whose leaves may live on host/disk (async transfer)."""
         import jax
