@@ -52,6 +52,18 @@ MODEL_REGISTRY = {
     "t5-tiny": ("t5", t5_tiny),
 }
 
+# family -> Model-bundle creator (the `create_*` entry points above).
+CREATE_BY_FAMILY = {
+    "bert": create_bert_model,
+    "llama": create_llama_model,
+    "mixtral": create_mixtral_model,
+    "gptj": create_gptj_model,
+    "gpt_neox": create_gpt_neox_model,
+    "opt": create_opt_model,
+    "t5": create_t5_model,
+}
+
+
 def get_model_family(name: str):
     """(interchange family, dataclass config) for a named in-tree model."""
     key = name.lower()
@@ -59,6 +71,12 @@ def get_model_family(name: str):
         raise ValueError(f"Unknown in-tree model {name!r}; known: {sorted(MODEL_REGISTRY)}")
     family, factory = MODEL_REGISTRY[key]
     return family, factory()
+
+
+def create_named_model(name: str, **kwargs):
+    """Build the Model bundle for a registry name (create fn resolved by family)."""
+    family, config = get_model_family(name)
+    return CREATE_BY_FAMILY[family](config, **kwargs)
 
 
 def _t5_cfg(c: T5Config) -> dict:

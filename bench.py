@@ -174,22 +174,22 @@ def inference_bench(args):
     from accelerate_tpu.generation import GenerationConfig, Generator
 
     on_accel = jax.devices()[0].platform in ("tpu", "gpu")
-    model_name = args.model if args.model.startswith(("llama", "gptj")) else "llama-1b"
+    families = ("llama", "gptj", "gpt-neox", "opt")
+    model_name = args.model if args.model.startswith(families) else "llama-1b"
     if not on_accel:
-        model_name = "gptj-tiny" if model_name.startswith("gptj") else "llama-tiny"
+        # CPU smoke: same family, tiny size.
+        fam = next(f for f in families if model_name.startswith(f))
+        model_name = f"{fam}-tiny"
     t_load = time.perf_counter()
-    if model_name.startswith("gptj"):
-        # The reference's own headline config: GPT-J-6B, benchmarks/README.md:31
-        # (0.05 s/token fp16 on 2x Titan RTX).
-        from accelerate_tpu.models.gptj import create_gptj_model, gptj_6b, gptj_tiny
+    # Every decoder family in the reference's benchmark table (benchmarks/
+    # README.md:27-37: GPT-J-6B headline 0.05 s/token fp16 on 2x Titan RTX,
+    # GPT-NeoX-20B, OPT-30B) is constructible here; bf16 storage on accelerators.
+    from accelerate_tpu.models import create_named_model, get_model_family
 
-        cfg = gptj_6b() if model_name == "gptj-6b" else gptj_tiny()
-        model = create_gptj_model(cfg, seq_len=args.seq_len, param_dtype="bfloat16" if on_accel else None)
-    else:
-        from accelerate_tpu.models.llama import create_llama_model, llama_1b, llama_tiny
-
-        cfg = llama_1b() if model_name == "llama-1b" else llama_tiny()
-        model = create_llama_model(cfg, seq_len=args.seq_len, param_dtype="bfloat16" if on_accel else None)
+    _fam, cfg = get_model_family(model_name)
+    model = create_named_model(
+        model_name, seq_len=args.seq_len, param_dtype="bfloat16" if on_accel else None
+    )
     load_s = time.perf_counter() - t_load
 
     batch = args.batch_size or 1
@@ -415,7 +415,18 @@ def parse_args(argv):
     parser.add_argument(
         "--model",
         default="bert-base",
-        choices=["bert-base", "bert-tiny", "llama-1b", "llama-tiny", "gptj-6b", "gptj-tiny"],
+        choices=[
+            "bert-base",
+            "bert-tiny",
+            "llama-1b",
+            "llama-tiny",
+            "gptj-6b",
+            "gptj-tiny",
+            "gpt-neox-20b",
+            "gpt-neox-tiny",
+            "opt-30b",
+            "opt-tiny",
+        ],
     )
     parser.add_argument("--mode", default="train", choices=["train", "inference"])
     parser.add_argument("--batch_size", type=int, default=None, help="per-chip batch size")
@@ -437,13 +448,13 @@ def parse_args(argv):
 def main():
     argv = sys.argv[1:]
     args = parse_args(argv)
-    if args.mode == "train" and args.model == "gptj-6b":
-        # 6B can't TRAIN on one 16GB chip (bf16 params 12GB + Adam state 24GB);
-        # it exists for --mode inference, where it is the reference benchmark's
-        # own model. Checked BEFORE any jax import so the message is immediate.
+    if args.mode == "train" and args.model in ("gptj-6b", "gpt-neox-20b", "opt-30b"):
+        # These sizes can't TRAIN on one 16GB chip (params + Adam state alone
+        # exceed HBM); they exist for --mode inference, where they are the
+        # reference benchmark's own models. Checked BEFORE any jax import.
         raise SystemExit(
-            "gptj-6b is inference-only on a single chip: "
-            "run `python bench.py --mode inference --model gptj-6b`"
+            f"{args.model} is inference-only on a single chip: "
+            f"run `python bench.py --mode inference --model {args.model}`"
         )
     if not args._worker and not args.no_supervise:
         sys.exit(supervise([a for a in argv if a != "--no-supervise"], total_steps=args.trials * args.steps))

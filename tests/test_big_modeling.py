@@ -252,10 +252,11 @@ def test_dispatched_generate_eos_per_row():
     prompt = rng.integers(1, cfg.vocab_size, (2, 5)).astype(np.int32)
     dispatched = cpu_offload(model, LlamaLayeredApply(cfg))
 
-    # Find what each row greedily emits first, then use row 0's first token as EOS:
+    # Use an identical prompt for both rows: they emit the same first token, so
+    # picking it as EOS finishes EVERY row at step 1 — the loop must early-exit.
+    prompt = np.broadcast_to(prompt[:1], prompt.shape).copy()
     first = np.asarray(dispatched.generate(prompt, max_new_tokens=1))[:, -1]
     eos = int(first[0])
     out = np.asarray(dispatched.generate(prompt, max_new_tokens=6, eos_token_id=eos))
-    row0_gen = out[0, 5:]
-    assert (row0_gen == eos).all(), "finished row must pad with eos"
-    assert out.shape[1] <= 5 + 6
+    assert (out[:, 5:] == eos).all(), "finished rows must pad with eos"
+    assert out.shape[1] == 5 + 1, f"loop must stop once every row finished: {out.shape}"

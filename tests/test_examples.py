@@ -72,6 +72,7 @@ def test_new_by_feature_examples(script, args, marker):
     [
         ("distributed_inference.py", ["--num_prompts", "4", "--prompt_len", "16", "--max_new_tokens", "8"], "completions across"),
         ("pippy_pipeline.py", ["--batch_size", "4"], "pipeline inference"),
+        ("quantized_inference.py", ["--bits", "8"], "at the quantized footprint"),
     ],
 )
 def test_inference_examples(script, args, marker):
