@@ -1,8 +1,11 @@
 """Managed-cloud launch path (the reference's SageMaker equivalent, GCP-shaped:
 sagemaker_launcher commands/launch.py:880 + config questionnaire sagemaker.py).
-Everything is asserted through the dry-run plan — no gcloud/network in CI."""
+The plan is asserted through dry-run; the executor through a recorded fake
+subprocess.run — no gcloud/network in CI."""
 
 import argparse
+import os
+import subprocess
 
 import pytest
 
@@ -143,3 +146,79 @@ def test_questionnaire_cloud_flow(tmp_path):
         "output_gcs": "gs://bkt/out",
         "teardown": True,
     }
+
+
+class _FakeRun:
+    """Records executed commands; scripted failures by tag substring."""
+
+    def __init__(self, fail_containing=(), describe_states=()):
+        self.calls = []
+        self.fail_containing = list(fail_containing)
+        self.describe_states = list(describe_states)
+
+    def __call__(self, cmd, **kwargs):
+        joined = " ".join(cmd)
+        self.calls.append(joined)
+        rc = 0
+        stdout = ""
+        if "describe" in joined:
+            stdout = self.describe_states.pop(0) if self.describe_states else "ACTIVE"
+        for marker in self.fail_containing:
+            if marker in joined:
+                rc = 1
+        if rc and kwargs.get("check"):
+            raise subprocess.CalledProcessError(rc, cmd)
+        return subprocess.CompletedProcess(cmd, rc, stdout=stdout, stderr="")
+
+
+def _run_launcher(tmp_path, monkeypatch, fake, **block):
+    import yaml
+
+    from accelerate_tpu.commands import cloud
+
+    monkeypatch.setattr(cloud.subprocess, "run", fake)
+    monkeypatch.setattr(cloud.time, "sleep", lambda s: None)
+    monkeypatch.chdir(tmp_path)
+    config_file = tmp_path / "c.yaml"
+    config_file.write_text(
+        yaml.safe_dump(
+            {
+                "compute_environment": "GCP_CLOUD",
+                "cloud_config": {"project": "p", "name": "j", "output_gcs": "gs://b/o", **block},
+            }
+        )
+    )
+    args = _args(["--config_file", str(config_file)])
+    from accelerate_tpu.commands.launch import launch_command
+
+    return launch_command(args)
+
+
+def test_executor_failure_still_collects_and_tears_down(tmp_path, monkeypatch):
+    """A failed remote run must NOT skip artifact collection or slice teardown
+    (billing + diagnosis), and the ORIGINAL failure propagates (not a wrapper)."""
+    fake = _FakeRun(fail_containing=["accelerate_tpu.commands.launch"])
+    with pytest.raises(subprocess.CalledProcessError):
+        _run_launcher(tmp_path, monkeypatch, fake)
+    assert any("gsutil -m rsync" in c for c in fake.calls), "collect must run on failure"
+    assert any("delete" in c for c in fake.calls), "teardown must run on failure"
+    # ordering: collect before teardown
+    collect_i = next(i for i, c in enumerate(fake.calls) if "gsutil" in c)
+    delete_i = next(i for i, c in enumerate(fake.calls) if "delete" in c)
+    assert collect_i < delete_i
+    # the staged config must not linger in cwd
+    assert not os.path.exists(tmp_path / ".accelerate_tpu_job_config.yaml")
+
+
+def test_executor_collect_failure_fails_launcher_after_teardown(tmp_path, monkeypatch):
+    fake = _FakeRun(fail_containing=["gsutil"])
+    with pytest.raises(RuntimeError, match="artifact collection failed"):
+        _run_launcher(tmp_path, monkeypatch, fake)
+    assert any("delete" in c for c in fake.calls), "teardown must still run"
+
+
+def test_executor_poll_waits_for_active(tmp_path, monkeypatch):
+    fake = _FakeRun(describe_states=["PROVISIONING", "PROVISIONING", "ACTIVE"])
+    _run_launcher(tmp_path, monkeypatch, fake)
+    assert sum("describe" in c for c in fake.calls) == 3
+    assert any("ssh" in c and "accelerate_tpu.commands.launch" in c for c in fake.calls)
