@@ -343,6 +343,21 @@ class PipelinedModel:
 
         prelude, layers, tail = layered.split(model.params)
         self.num_layers = len(layers)
+        # Stages scan ONE layer body, so every layer entry must share a pytree
+        # structure. Encoder-decoder decompositions (T5LayeredApply) are
+        # heterogeneous by design — fail with guidance instead of a cryptic
+        # stack/scan structure mismatch. (PyTreeDefs compare directly.)
+        import jax
+
+        structures = {jax.tree_util.tree_structure(lp) for lp in layers}
+        if len(structures) > 1:
+            raise NotImplementedError(
+                "Pipeline parallelism requires homogeneous layer blocks (one "
+                "scanned body); this LayeredApply yields mixed structures "
+                "(encoder-decoder). Use tier-streamed execution instead: "
+                "accelerate_tpu.big_modeling.dispatch_model/cpu_offload with the "
+                "same LayeredApply."
+            )
         n_stages = mesh.shape["stage"]
         if self.num_layers % n_stages != 0:
             raise ValueError(

@@ -197,3 +197,15 @@ def test_registry_entry():
     from accelerate_tpu.models import get_model_config
 
     assert get_model_config("t0pp-11b")["hidden_size"] == 4096
+
+
+def test_pipeline_inference_rejects_heterogeneous_layers():
+    """Encoder-decoder stage decompositions can't scan as one pipeline body;
+    prepare_pippy must say so clearly and point at the streamed path."""
+    from accelerate_tpu.inference import prepare_pippy
+    from accelerate_tpu.models.t5 import T5LayeredApply
+
+    cfg = t5_tiny()
+    model = create_t5_model(cfg, seq_len=16)
+    with pytest.raises(NotImplementedError, match="tier-streamed"):
+        prepare_pippy(model, layered=T5LayeredApply(cfg))
