@@ -96,10 +96,10 @@ def estimate_parameters_from_hf_config(cfg: dict) -> tuple:
     vocab = cfg.get("vocab_size", 32000)
     hidden = cfg.get("hidden_size", cfg.get("n_embd", cfg.get("d_model", 768)))
     layers = cfg.get("num_hidden_layers", cfg.get("n_layer", cfg.get("num_layers", 12)))
-    inter = cfg.get("intermediate_size", cfg.get("n_inner") or 4 * hidden)
-    heads = cfg.get("num_attention_heads", cfg.get("n_head", hidden // 64))
+    inter = cfg.get("intermediate_size", cfg.get("n_inner") or cfg.get("d_ff") or 4 * hidden)
+    heads = cfg.get("num_attention_heads", cfg.get("n_head") or cfg.get("num_heads") or hidden // 64)
     kv_heads = cfg.get("num_key_value_heads", heads)
-    head_dim = cfg.get("head_dim", hidden // heads)
+    head_dim = cfg.get("head_dim", cfg.get("d_kv") or hidden // heads)
     attn = hidden * heads * head_dim + 2 * hidden * kv_heads * head_dim + heads * head_dim * hidden
     gated = (
         "llama" in str(cfg.get("model_type", "")).lower()

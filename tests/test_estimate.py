@@ -113,3 +113,27 @@ def test_offline_hub_id_fails_cleanly(monkeypatch):
     monkeypatch.setattr(transformers.AutoConfig, "from_pretrained", _offline)
     with pytest.raises(RuntimeError, match="Hub is unreachable|Could not resolve"):
         gather_data(_Args("some-org/nonexistent-model-xyz"))
+
+
+def test_closed_form_flan_t5_encoder_decoder():
+    """A real HF flan-t5-xl-shaped config.json (num_layers = ENCODER count, no
+    num_encoder_layers key) must estimate ~2.85B params, not the ~1.9B a halved
+    encoder produced before the encoder-decoder accounting fix."""
+    from accelerate_tpu.commands.estimate import estimate_parameters_from_hf_config
+
+    cfg = {
+        "model_type": "t5",
+        "vocab_size": 32128,
+        "d_model": 2048,
+        "d_kv": 64,
+        "d_ff": 5120,
+        "num_layers": 24,
+        "num_decoder_layers": 24,
+        "num_heads": 32,
+        "is_encoder_decoder": True,
+        "feed_forward_proj": "gated-gelu",
+        "tie_word_embeddings": False,
+    }
+    # flan-t5-xl is 2.85B params
+    total, _largest = estimate_parameters_from_hf_config(cfg)
+    assert 2.6e9 < total < 3.1e9, total
