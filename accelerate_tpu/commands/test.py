@@ -11,20 +11,50 @@ def register_subcommand(subparsers):
     parser = subparsers.add_parser("test", help="Run the end-to-end sanity test")
     parser.add_argument("--config_file", default=None)
     parser.add_argument("--cpu", action="store_true", help="Run on the virtual CPU mesh")
+    parser.add_argument(
+        "--num_processes",
+        type=int,
+        default=None,
+        help="Also run the script across N REAL coordinated processes (debug launcher)",
+    )
     parser.set_defaults(func=test_command)
     return parser
 
 
-def test_command(args):
+def _script_path() -> str:
     import accelerate_tpu.test_utils.scripts as scripts_mod
 
-    script = os.path.join(os.path.dirname(scripts_mod.__file__), "test_script.py")
+    return os.path.join(os.path.dirname(scripts_mod.__file__), "test_script.py")
+
+
+def _script_main():
+    """Module-level worker (spawn-picklable) running the bundled everything-script."""
+    import runpy
+
+    runpy.run_path(_script_path(), run_name="__main__")
+
+
+def test_command(args):
+    script = _script_path()
     env = os.environ.copy()
     if args.cpu:
         env["JAX_PLATFORMS"] = "cpu"
         flags = env.get("XLA_FLAGS", "")
         if "--xla_force_host_platform_device_count" not in flags:
             env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    if args.num_processes and args.num_processes > 1:
+        # Multi-process leg: the same checks across N REAL coordinated processes
+        # (cross-process RNG sync, object plane, trigger visibility — contracts a
+        # single process can't falsify).
+        from ..launchers import debug_launcher
+
+        print(f"Running the test script across {args.num_processes} coordinated processes...")
+        try:
+            debug_launcher(_script_main, num_processes=args.num_processes)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            raise SystemExit(1) from e
+        print("Multi-process run passed.")
     print("Running:  " + " ".join([sys.executable, script]))
     result = subprocess.run([sys.executable, script], env=env)
     if result.returncode == 0:

@@ -9,6 +9,8 @@ import sys
 
 import numpy as np
 
+from accelerate_tpu.utils.operations import fetch_global
+
 
 def init_state_check():
     from accelerate_tpu.state import PartialState
@@ -86,14 +88,16 @@ def dl_preparation_check(state):
     prepared = prepare_data_loader(dl, use_seedable_sampler=False)
     seen = []
     for batch in prepared:
-        arr = np.asarray(batch["x"])  # global array: every process sees the full batch
+        # global array: the full batch is visible everywhere, but on true
+        # multi-host topologies reading it requires the allgather-backed fetch.
+        arr = fetch_global(batch["x"])
         seen.extend(arr[:, 0].tolist())
     assert sorted(int(v) for v in seen) == list(range(n)), "prepared loader lost/duplicated samples"
 
     # split_batches: global batch == inner batch size
     prepared = prepare_data_loader(dl, split_batches=True, use_seedable_sampler=False)
     for batch in prepared:
-        assert np.asarray(batch["x"]).shape[0] == bs
+        assert batch["x"].shape[0] == bs  # shape is global metadata; no fetch needed
         break
     state.wait_for_everyone()
 
@@ -107,7 +111,7 @@ def central_dl_preparation_check(state):
     prepared = prepare_data_loader(dl, dispatch_batches=True, use_seedable_sampler=False)
     seen = []
     for batch in prepared:
-        seen.extend(np.asarray(batch["x"])[:, 0].tolist())
+        seen.extend(fetch_global(batch["x"])[:, 0].tolist())
     assert sorted(int(v) for v in seen) == list(range(n)), "dispatch loader lost/duplicated samples"
     state.wait_for_everyone()
 
@@ -129,7 +133,7 @@ def seedable_sampler_check(state):
         prepared = prepare_data_loader(dl, use_seedable_sampler=True, data_seed=seed)
         order = []
         for batch in prepared:
-            order.extend(np.asarray(batch["x"])[:, 0].astype(int).tolist())
+            order.extend(fetch_global(batch["x"])[:, 0].astype(int).tolist())
         return order
 
     assert epoch_order(42) == epoch_order(42), "seedable sampler not deterministic"
@@ -172,8 +176,10 @@ def training_check(state):
             params = optax.apply_updates(params, updates)
             baseline_losses.append(float(loss))
 
-    # framework run (sharded over whatever topology this script landed on)
-    accelerator = Accelerator()
+    # framework run (sharded over whatever topology this script landed on).
+    # split_batches makes the GLOBAL batch process-count invariant, so the loss
+    # trajectory matches the single-device baseline at any num_processes.
+    accelerator = Accelerator(split_batches=True)
     fw_model = RegressionModel()
     dl = SimpleDataLoader(data, BatchSampler(range(64), 16))
     pmodel, popt, pdl = accelerator.prepare(fw_model, optax.sgd(0.1), dl)
@@ -232,6 +238,9 @@ def training_variants_check(state):
     def framework(batch_size, **acc_kwargs):
         AcceleratorState._reset_state()
         GradientState._reset_state()
+        # Global-batch invariance across process counts (same rationale as
+        # training_check); explicit split_batches tests still override it.
+        acc_kwargs.setdefault("split_batches", True)
         accelerator = Accelerator(**acc_kwargs)
         dl = SimpleDataLoader(data, BatchSampler(range(64), batch_size))
         pmodel, popt, pdl = accelerator.prepare(RegressionModel(), optax.sgd(0.1), dl)
@@ -266,8 +275,8 @@ def resume_check(state):
     accelerator = Accelerator()
     dl = SimpleDataLoader(data, BatchSampler(range(n), bs))
     pdl = accelerator.prepare_data_loader(dl)
-    full = [np.asarray(b["x"])[:, 0].tolist() for b in pdl]
-    resumed = [np.asarray(b["x"])[:, 0].tolist() for b in accelerator.skip_first_batches(pdl, 3)]
+    full = [fetch_global(b["x"])[:, 0].tolist() for b in pdl]
+    resumed = [fetch_global(b["x"])[:, 0].tolist() for b in accelerator.skip_first_batches(pdl, 3)]
     assert resumed == full[3:], (resumed, full[3:])
     AcceleratorState._reset_state()
     GradientState._reset_state()

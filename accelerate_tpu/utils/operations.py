@@ -264,6 +264,12 @@ def _fetch_global(t):
     return np.asarray(t)
 
 
+def fetch_global(t):
+    """Public alias of `_fetch_global`: materialize a (possibly multi-host sharded)
+    jax.Array on host as numpy — the portable way to read a global batch/output."""
+    return _fetch_global(t)
+
+
 @verify_operation
 def gather(tensor):
     """All-gather along dim 0 across processes (reference operations.py:425).

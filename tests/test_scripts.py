@@ -43,6 +43,18 @@ def test_ops_script_multiprocess():
 
 
 @pytest.mark.slow_launch
+def test_everything_script_multiprocess():
+    """The FULL everything-script across 2 real coordinated processes — training
+    loss-parity, dispatch loader, resume, gather_for_metrics, trigger, sharded
+    sampler: the whole contract surface across actual process boundaries, not
+    just a topology check."""
+    from accelerate_tpu import debug_launcher
+    from accelerate_tpu.commands.test import _script_main
+
+    debug_launcher(_script_main, num_processes=2)
+
+
+@pytest.mark.slow_launch
 def test_cli_test_command():
     result = subprocess.run(
         [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "test", "--cpu"],
