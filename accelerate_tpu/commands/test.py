@@ -46,6 +46,13 @@ def test_command(args):
         # Multi-process leg: the same checks across N REAL coordinated processes
         # (cross-process RNG sync, object plane, trigger visibility — contracts a
         # single process can't falsify).
+        if 8 % args.num_processes != 0:
+            # The scripts use global batch sizes of 8/16; a non-divisor N would
+            # fail with misleading in-script assertions rather than a usage error.
+            raise SystemExit(
+                f"--num_processes must divide 8 (the test scripts' global batch "
+                f"size); got {args.num_processes}. Use 2, 4, or 8."
+            )
         from ..launchers import debug_launcher
 
         print(f"Running the test script across {args.num_processes} coordinated processes...")

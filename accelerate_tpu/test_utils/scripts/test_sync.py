@@ -5,6 +5,8 @@ accumulation boundaries and at end-of-dataloader."""
 
 import numpy as np
 
+from accelerate_tpu.utils.operations import fetch_global
+
 
 def _fresh_accelerator(**kwargs):
     from accelerate_tpu import Accelerator
@@ -12,6 +14,10 @@ def _fresh_accelerator(**kwargs):
 
     AcceleratorState._reset_state()
     GradientState._reset_state()
+    # Global-batch invariance across process counts: every check's step count and
+    # loss values must not depend on how many coordinated processes run this
+    # script (the multi-process leg of `accelerate-tpu test`).
+    kwargs.setdefault("split_batches", True)
     return Accelerator(**kwargs)
 
 
@@ -116,16 +122,17 @@ def grad_equality_at_boundaries_check():
     dl = SimpleDataLoader(data, BatchSampler(range(32), 8))
     pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.1), dl)
 
-    # Independent per-microbatch grads at the CURRENT params, for comparison.
+    # Independent per-microbatch grads at the CURRENT params, for comparison
+    # (fetch_global: batches/params are global arrays on multi-process runs).
     def manual_grad(params, batch):
         def loss_fn(p):
-            pred = pmodel._mp_apply(p, np.asarray(batch["x"]))
-            return jnp.mean((pred[:, 0] - jnp.asarray(np.asarray(batch["y"]))) ** 2)
+            pred = pmodel._mp_apply(p, fetch_global(batch["x"]))
+            return jnp.mean((pred[:, 0] - jnp.asarray(fetch_global(batch["y"]))) ** 2)
 
         return jax.grad(loss_fn)(params)
 
     batches = list(pdl)
-    params_before = jax.tree_util.tree_map(np.asarray, pmodel.params)
+    params_before = jax.tree_util.tree_map(fetch_global, pmodel.params)
     expected = None
     for i, batch in enumerate(batches):
         with accelerator.accumulate(pmodel):
@@ -137,11 +144,11 @@ def grad_equality_at_boundaries_check():
             if accelerator.sync_gradients:
                 acc_grads = popt._grads
                 for a, b in zip(jax.tree_util.tree_leaves(acc_grads), jax.tree_util.tree_leaves(expected)):
-                    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+                    np.testing.assert_allclose(fetch_global(a), fetch_global(b), rtol=1e-4, atol=1e-6)
                 expected = None
             popt.step()
             popt.zero_grad()
-        params_now = jax.tree_util.tree_map(np.asarray, pmodel.params)
+        params_now = jax.tree_util.tree_map(fetch_global, pmodel.params)
         moved = any(
             not np.allclose(a, b)
             for a, b in zip(jax.tree_util.tree_leaves(params_before), jax.tree_util.tree_leaves(params_now))

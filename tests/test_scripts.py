@@ -43,6 +43,16 @@ def test_ops_script_multiprocess():
 
 
 @pytest.mark.slow_launch
+def test_sync_script_multiprocess():
+    """Gradient accumulation / sync semantics across 2 real coordinated processes
+    (grad-equality at boundaries with allgather-backed reads)."""
+    from accelerate_tpu import debug_launcher
+    from accelerate_tpu.test_utils.scripts.test_sync import main
+
+    debug_launcher(main, num_processes=2)
+
+
+@pytest.mark.slow_launch
 def test_everything_script_multiprocess():
     """The FULL everything-script across 2 real coordinated processes — training
     loss-parity, dispatch loader, resume, gather_for_metrics, trigger, sharded
