@@ -196,39 +196,73 @@ class DispatchedModel:
         )
         return self.apply_fn(self.materialize_params(), *args, **kwargs)
 
-    def generate(self, input_ids, max_new_tokens: int = 32, eos_token_id=None):
+    def generate(self, input_ids, max_new_tokens: int = 32, eos_token_id=None, attention_mask=None):
         """Greedy generation through the tiered forward — the reference's
         big-model-inference benchmark shape (load + per-token generation with
         CPU/disk-offloaded weights, benchmarks/big_model_inference.py). Each token
         re-streams the offloaded layers over the full context; that IS the cost
         model the reference publishes (2.4-34 s/token for OPT-30B offload,
         benchmarks/README.md:36-37) — for fast decoding keep weights resident and
-        use `accelerate_tpu.generation.Generator`."""
+        use `accelerate_tpu.generation.Generator`.
+
+        `attention_mask` (right-padded, HF convention) enables batches of
+        unequal-length prompts: each row advances at its own frontier — the next
+        token is read at column `len_r - 1` and written in place of the first pad
+        — so every row stays a contiguous prefix and causal attention never sees
+        another row's padding. Rows shorter than the longest finish their last
+        `max_new_tokens` at the same step count; output is right-padded with 0.
+        """
         import jax.numpy as jnp
 
         from .generation import _bucket_for
 
         ids = jnp.asarray(input_ids, jnp.int32)
-        finished = jnp.zeros((ids.shape[0],), bool)
-        for _ in range(max_new_tokens):
-            cur = ids.shape[1]
-            # Right-pad the context to a power-of-two bucket: padding after the
-            # last real token is invisible under causal masking, and it keeps the
-            # streamed programs' shapes stable (O(log n) compiles, not O(n)).
-            bucket = _bucket_for(cur)
-            padded = jnp.pad(ids, ((0, 0), (0, bucket - cur)))
-            logits = self(padded)
-            nxt = jnp.argmax(logits[:, cur - 1, :], axis=-1).astype(jnp.int32)
+        b, prompt_len = ids.shape
+        if attention_mask is not None:
+            am = jnp.asarray(attention_mask).astype(bool)
+            lengths = am.sum(axis=1).astype(jnp.int32)
+            # Per-row frontier writes assume right-padding (a contiguous prefix of
+            # real tokens); a left-padded or holey mask would interleave garbage,
+            # and an empty row would read its first logits at column -1 (wraparound).
+            valid_prefixes = bool(jnp.all(am == (jnp.arange(prompt_len)[None, :] < lengths[:, None])))
+            if not valid_prefixes or not bool(jnp.all(lengths >= 1)):
+                raise ValueError(
+                    "attention_mask must be right-padded (each row a non-empty prefix of "
+                    "ones); re-tokenize with padding_side='right'"
+                )
+            ids = jnp.where(am, ids, 0)  # canonicalize pad slots; they get overwritten
+            max_len = int(lengths.max())
+        else:
+            lengths = jnp.full((b,), prompt_len, jnp.int32)
+            max_len = prompt_len
+        cur = lengths  # per-row next write position
+        finished = jnp.zeros((b,), bool)
+        buf = ids
+        for step in range(max_new_tokens):
+            # The forward only needs to cover the read columns (cur-1 < max_len +
+            # step); bucket that width to powers of two — padding after each row's
+            # last real token is invisible under causal masking, and stable shapes
+            # keep compiles O(log n), not O(n). `max_len + step` tracks cur.max()
+            # on the host, avoiding a device sync per token.
+            bucket = _bucket_for(max_len + step)
+            if buf.shape[1] < bucket + 1:  # +1: room for this step's frontier write
+                buf = jnp.pad(buf, ((0, 0), (0, bucket + 1 - buf.shape[1])))
+            logits = self(buf[:, :bucket])
+            nxt = jnp.argmax(logits[jnp.arange(b), cur - 1, :], axis=-1).astype(jnp.int32)
             if eos_token_id is not None:
                 # Per-row EOS: finished rows emit pad/eos (HF generate padding),
                 # and the loop stops as soon as EVERY row has finished — each
                 # extra step re-streams the whole offloaded model.
                 nxt = jnp.where(finished, jnp.int32(eos_token_id), nxt)
                 finished = finished | (nxt == eos_token_id)
-            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+            buf = buf.at[jnp.arange(b), cur].set(nxt)
+            cur = cur + 1
+            steps_taken = step + 1
             if eos_token_id is not None and bool(finished.all()):
                 break
-        return ids
+        # Never return narrower than the input (callers slice continuations with
+        # out[:, input_ids.shape[1]:], the HF right-padding idiom).
+        return buf[:, : max(max_len + steps_taken, prompt_len)]
 
     def _fetch_block_pytree(self, subtree):
         """device_put a sub-pytree whose leaves may live on host/disk (async transfer)."""

@@ -247,13 +247,17 @@ def cloud_launcher(args, config: dict):
     collect_failed = None
     try:
         for tag, cmd in steps:
+            if tag == "provision":
+                # Flag BEFORE executing: `gcloud ... create` can create the queued
+                # resource/tpu-vm and still exit non-zero (client timeout, transient
+                # API error after creation) — the partially-created billing slice
+                # must still be torn down below.
+                provisioned = True
             if tag == "poll":
                 _wait_active(cfg, cmd)
             else:
                 print(f"[cloud] {tag}: {shlex.join(cmd)}", flush=True)
                 subprocess.run(cmd, check=True)
-            if tag == "provision":
-                provisioned = True
     finally:
         try:
             os.unlink(staged_path)

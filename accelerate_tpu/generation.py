@@ -266,6 +266,7 @@ class Seq2SeqGenerator:
 
     def __call__(self, input_ids, generation_config: Optional[GenerationConfig] = None, rng=None, **kwargs):
         attention_mask = kwargs.pop("attention_mask", None)  # before GenerationConfig(**kwargs)
+        explicit_request = generation_config is not None or "max_new_tokens" in kwargs
         config = generation_config or GenerationConfig(**kwargs)
         if rng is None:
             rng = jax.random.key(0)
@@ -276,7 +277,16 @@ class Seq2SeqGenerator:
             if attention_mask is not None
             else jnp.ones((b, 1, 1, input_ids.shape[1]), bool)
         )
-        max_new = min(config.max_new_tokens, self.max_new_tokens)
+        max_new = config.max_new_tokens
+        if not explicit_request:
+            # Bare call: the dataclass default (32) is not a user request — fill
+            # whatever budget this generator was built with.
+            max_new = min(max_new, self.max_new_tokens)
+        elif max_new > self.max_new_tokens:
+            raise ValueError(
+                f"Requested {max_new} new tokens but this generator's decoder "
+                f"cache was sized for {self.max_new_tokens}; rebuild with a larger max_new_tokens"
+            )
         am = jnp.asarray(attention_mask, jnp.int32) if attention_mask is not None else None
         encoder_hidden = self._encode(self.params, input_ids, am)
         start = jnp.full((b,), jnp.int32(self.start_id))

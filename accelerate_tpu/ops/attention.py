@@ -140,7 +140,14 @@ def dot_product_attention(
 
     # Flash kernel: explicit, or automatic on TPU for long unmasked sequences where
     # the [S,S] score materialization would dominate HBM traffic.
-    use_flash = implementation == "flash" and bias is None
+    if implementation == "flash" and (bias is not None or mask is not None):
+        blocked = "bias" if bias is not None else "mask"
+        raise ValueError(
+            f"implementation='flash' cannot honor a {blocked} argument — the Pallas "
+            "kernel threads only `causal`. Drop implementation= to let the dispatcher "
+            "pick the XLA path, or pass implementation='xla'."
+        )
+    use_flash = implementation == "flash"
     if implementation is None and mask is None and bias is None and sq >= 1024 and sq % 128 == 0 and skv % 128 == 0:
         import jax
 

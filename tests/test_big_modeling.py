@@ -260,3 +260,49 @@ def test_dispatched_generate_eos_per_row():
     out = np.asarray(dispatched.generate(prompt, max_new_tokens=6, eos_token_id=eos))
     assert (out[:, 5:] == eos).all(), "finished rows must pad with eos"
     assert out.shape[1] == 5 + 1, f"loop must stop once every row finished: {out.shape}"
+
+
+def test_dispatched_generate_padded_batch_matches_per_row():
+    """A right-padded batch of unequal-length prompts with attention_mask must
+    produce, row for row, the same continuations as generating each prompt alone
+    (round-3 advice: padding was silently attended before)."""
+    import numpy as np
+
+    from accelerate_tpu.big_modeling import cpu_offload
+    from accelerate_tpu.models.llama import LlamaLayeredApply, create_llama_model, llama_tiny
+
+    cfg = llama_tiny()
+    model = create_llama_model(cfg, seq_len=32)
+    rng = np.random.default_rng(7)
+    dispatched = cpu_offload(model, LlamaLayeredApply(cfg))
+
+    long_p = rng.integers(1, cfg.vocab_size, (1, 7)).astype(np.int32)
+    short_p = rng.integers(1, cfg.vocab_size, (1, 4)).astype(np.int32)
+    ref_long = np.asarray(dispatched.generate(long_p, max_new_tokens=3))
+    ref_short = np.asarray(dispatched.generate(short_p, max_new_tokens=3))
+
+    batch = np.zeros((2, 7), np.int32)
+    batch[0] = long_p[0]
+    batch[1, :4] = short_p[0]
+    mask = np.zeros((2, 7), np.int32)
+    mask[0] = 1
+    mask[1, :4] = 1
+    out = np.asarray(dispatched.generate(batch, max_new_tokens=3, attention_mask=mask))
+    np.testing.assert_array_equal(out[0, :10], ref_long[0])
+    np.testing.assert_array_equal(out[1, :7], ref_short[0])
+
+
+def test_dispatched_generate_left_padded_mask_rejected():
+    import numpy as np
+    import pytest
+
+    from accelerate_tpu.big_modeling import cpu_offload
+    from accelerate_tpu.models.llama import LlamaLayeredApply, create_llama_model, llama_tiny
+
+    cfg = llama_tiny()
+    model = create_llama_model(cfg, seq_len=32)
+    dispatched = cpu_offload(model, LlamaLayeredApply(cfg))
+    batch = np.ones((1, 6), np.int32)
+    mask = np.array([[0, 0, 1, 1, 1, 1]], np.int32)  # left-padded
+    with pytest.raises(ValueError, match="right-padded"):
+        dispatched.generate(batch, max_new_tokens=2, attention_mask=mask)

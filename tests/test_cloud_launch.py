@@ -222,3 +222,13 @@ def test_executor_poll_waits_for_active(tmp_path, monkeypatch):
     _run_launcher(tmp_path, monkeypatch, fake)
     assert sum("describe" in c for c in fake.calls) == 3
     assert any("ssh" in c and "accelerate_tpu.commands.launch" in c for c in fake.calls)
+
+
+def test_executor_provision_failure_still_tears_down(tmp_path, monkeypatch):
+    """`gcloud ... create` can create the resource and still exit non-zero (client
+    timeout, transient API error after creation): the partially-created billing
+    slice must be torn down anyway (round-3 advice, medium)."""
+    fake = _FakeRun(fail_containing=["create"])
+    with pytest.raises(subprocess.CalledProcessError):
+        _run_launcher(tmp_path, monkeypatch, fake)
+    assert any("delete" in c for c in fake.calls), "teardown must run after a failed provision"
