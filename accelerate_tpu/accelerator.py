@@ -420,8 +420,21 @@ class Accelerator:
         from .parallel.sharding import derive_param_shardings
 
         mesh = self.mesh
+        fsdp = self.state.fsdp_plugin
+        if (
+            fsdp is not None
+            and fsdp.sync_module_states
+            and self.num_processes > 1
+            and not evaluation_mode
+        ):
+            # Reference FSDP sync_module_states (accelerator.py:1431+): rank 0's
+            # initial weights win, so per-host random init or racy loads can't
+            # diverge the replicas. Runs on host arrays before placement.
+            from .utils.operations import broadcast
+
+            model.params = broadcast(model.params, from_process=0)
         param_sharding = derive_param_shardings(
-            model.params, mesh, fsdp_plugin=self.state.fsdp_plugin, rules=model.sharding_rules
+            model.params, mesh, fsdp_plugin=fsdp, rules=model.sharding_rules
         )
         compute_dtype = None
         autocast = True
@@ -432,6 +445,11 @@ class Accelerator:
         fp8_recipe = None
         if self.state.mixed_precision == "fp8":
             fp8_recipe = self.fp8_recipe_handler or FP8RecipeKwargs()
+        # Activation checkpointing: the CompilationConfig policy (expert knob)
+        # wins; the FSDP boolean maps to classic full per-layer remat.
+        remat_policy = self.compilation_config.remat_policy
+        if remat_policy is None and fsdp is not None and fsdp.activation_checkpointing:
+            remat_policy = "full"
         prepared = PreparedModel(
             model,
             mesh=mesh,
@@ -439,7 +457,10 @@ class Accelerator:
             compute_dtype=compute_dtype,
             autocast=autocast,
             fp8_recipe=fp8_recipe,
-            offload_params=bool(getattr(self.state.fsdp_plugin, "offload_params", False)),
+            offload_params=bool(getattr(fsdp, "offload_params", False)),
+            param_dtype=getattr(fsdp, "param_dtype", None),
+            reduce_dtype=getattr(fsdp, "reduce_dtype", None),
+            remat_policy=remat_policy,
         )
         self._models.append(prepared)
         return prepared
@@ -881,6 +902,8 @@ class Accelerator:
             self._dataloaders,
             rng_key=rng_key,
             save_on_each_node=self.project_configuration.save_on_each_node,
+            state_dict_type=getattr(self.state.fsdp_plugin, "state_dict_type", None)
+            or "SHARDED_STATE_DICT",
         )
         for i, obj in enumerate(self._custom_objects):
             if self.is_main_process:
@@ -918,13 +941,38 @@ class Accelerator:
         for i, obj in enumerate(self._custom_objects):
             load_custom_state(obj, input_dir, i)
 
-    def save_model(self, model: PreparedModel, save_directory: str, safe_serialization: bool = True):
-        """Save just the weights (reference save_model accelerator.py:2691)."""
-        from .checkpointing import save_pytree
+    def save_model(
+        self,
+        model: PreparedModel,
+        save_directory: str,
+        max_shard_size="5GB",
+        safe_serialization: bool = True,
+    ):
+        """Export just the weights (reference save_model accelerator.py:2691).
+
+        `safe_serialization=True` (default) writes (sharded) safetensors with an
+        HF-style index via `save_model_safetensors` — parameters stream to host
+        one tensor at a time, so a fully-sharded model never gathers whole.
+        `FullyShardedDataParallelPlugin.state_dict_type` picks the multi-host
+        behavior: FULL_STATE_DICT allgathers non-addressable params per-tensor
+        and writes one logical state dict from the main process;
+        SHARDED_STATE_DICT (default) keeps non-addressable params distributed
+        and writes per-shard via orbax/tensorstore (the
+        torch.distributed.checkpoint equivalent, reference utils/fsdp_utils.py:85).
+        """
+        from .checkpointing import _all_addressable, save_model_safetensors, save_pytree, save_sharded
 
         os.makedirs(save_directory, exist_ok=True)
-        if self.is_main_process:
-            save_pytree(model.state_dict(), os.path.join(save_directory, "model.npz"))
+        params = model.state_dict()
+        if not safe_serialization:
+            if self.is_main_process:
+                save_pytree(params, os.path.join(save_directory, "model.npz"))
+            return
+        state_dict_type = getattr(self.state.fsdp_plugin, "state_dict_type", None) or "FULL_STATE_DICT"
+        if not _all_addressable(params) and state_dict_type == "SHARDED_STATE_DICT":
+            save_sharded(params, os.path.join(save_directory, "model.sharded"))
+            return
+        save_model_safetensors(params, save_directory, max_shard_size=max_shard_size)
 
     def skip_first_batches(self, dataloader, num_batches: int = 0):
         """(reference accelerator.py:3274)"""

@@ -238,6 +238,7 @@ class DispatchedModel:
         cur = lengths  # per-row next write position
         finished = jnp.zeros((b,), bool)
         buf = ids
+        steps_taken = 0
         for step in range(max_new_tokens):
             # The forward only needs to cover the read columns (cur-1 < max_len +
             # step); bucket that width to powers of two — padding after each row's

@@ -136,6 +136,118 @@ def _all_addressable(tree) -> bool:
     return True
 
 
+# ------------------------------------------------------------------ safetensors export
+def _parse_size(size) -> int:
+    """'5GB' / '500MB' / int -> bytes."""
+    if isinstance(size, int):
+        return size
+    s = str(size).strip().upper()
+    for suffix, mult in (("GIB", 2**30), ("MIB", 2**20), ("KIB", 2**10), ("GB", 10**9), ("MB", 10**6), ("KB", 10**3)):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * mult)  # float first: '0.5GB' != 0
+    return int(s)
+
+
+def _leaf_to_host(leaf):
+    """One leaf -> numpy on host. Non-addressable (multi-host sharded) arrays are
+    allgathered process-wide — the per-PARAM gather keeps host memory bounded by
+    one tensor, not the model (the reference's sharded save_model concern,
+    accelerator.py:2691)."""
+    import jax
+
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(jax.device_get(leaf))
+
+
+def save_model_safetensors(params, save_directory: str, max_shard_size="5GB") -> list:
+    """Write a params pytree as (sharded) safetensors with an HF-style index
+    (reference save_model accelerator.py:2691 / shard_checkpoint utils/modeling.py:206).
+
+    Tensor names are the '/'-joined pytree paths, so `load_model_safetensors`
+    rebuilds the exact tree. One file under `max_shard_size` is written as
+    `model.safetensors`; larger exports split into `model-00001-of-000NN.safetensors`
+    plus `model.safetensors.index.json` (`utils/constants.py` SAFE_WEIGHTS_*).
+    Parameters stream to host ONE AT A TIME — a fully-sharded model never
+    materializes whole on any single host.
+
+    Call on EVERY process (the non-addressable gather is a collective); only the
+    main process writes. Returns the list of files written (empty on non-main).
+    """
+    import jax
+    from safetensors.numpy import save_file
+
+    from .utils.constants import SAFE_WEIGHTS_INDEX_NAME, SAFE_WEIGHTS_NAME
+
+    is_main = jax.process_index() == 0
+    os.makedirs(save_directory, exist_ok=True)
+    flat, _ = _flatten_with_paths(params)
+    budget = _parse_size(max_shard_size)
+
+    # Plan shards greedily by byte size (no data movement yet).
+    shards, current, current_bytes = [], [], 0
+    sizes = {}
+    for path, leaf in flat:
+        nbytes = int(np.prod(getattr(leaf, "shape", ()) or ())) * np.dtype(leaf.dtype).itemsize
+        sizes[path] = nbytes
+        if current and current_bytes + nbytes > budget:
+            shards.append(current)
+            current, current_bytes = [], 0
+        current.append((path, leaf))
+        current_bytes += nbytes
+    if current:
+        shards.append(current)
+
+    written = []
+    if len(shards) == 1:
+        tensors = {p: _leaf_to_host(leaf) for p, leaf in shards[0]}
+        target = os.path.join(save_directory, SAFE_WEIGHTS_NAME)
+        if is_main:
+            save_file(tensors, target)
+            written.append(target)
+        return written
+
+    weight_map = {}
+    for i, shard in enumerate(shards):
+        fname = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
+        tensors = {p: _leaf_to_host(leaf) for p, leaf in shard}
+        if is_main:
+            save_file(tensors, os.path.join(save_directory, fname))
+            written.append(os.path.join(save_directory, fname))
+        for p, _ in shard:
+            weight_map[p] = fname
+        del tensors  # free the host copies before gathering the next shard
+    if is_main:
+        index = {
+            "metadata": {"total_size": int(sum(sizes.values()))},
+            "weight_map": weight_map,
+        }
+        index_path = os.path.join(save_directory, SAFE_WEIGHTS_INDEX_NAME)
+        with open(index_path, "w") as f:
+            json.dump(index, f, indent=2)
+        written.append(index_path)
+    return written
+
+
+def load_model_safetensors(directory: str):
+    """Inverse of `save_model_safetensors`: rebuild the params pytree (nested dicts)
+    from a safetensors file/shard directory. Leaves come back as numpy (bf16 via
+    ml_dtypes); place with `PreparedModel.load_state_dict` or `place_params`."""
+    from .utils.hf_loading import load_hf_state_dict
+
+    flat = load_hf_state_dict(directory)
+    tree: dict = {}
+    for path, arr in flat.items():
+        parts = path.split("/")
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = arr
+    return tree
+
+
 def save_accelerator_state(
     output_dir: str,
     models: list,
@@ -145,32 +257,43 @@ def save_accelerator_state(
     rng_key=None,
     scaler=None,
     save_on_each_node: bool = False,
+    state_dict_type: str = "SHARDED_STATE_DICT",
 ) -> str:
-    """Save the complete training state (reference checkpointing.py:51-149)."""
+    """Save the complete training state (reference checkpointing.py:51-149).
+
+    `state_dict_type` (FSDP plugin knob) governs multi-host layout: with
+    SHARDED_STATE_DICT (default) non-addressable trees write per-shard via
+    orbax/tensorstore; FULL_STATE_DICT consolidates them — each tensor is
+    allgathered ONE AT A TIME and the main process writes a single npz
+    (reference fsdp_utils.py:54-209 FULL vs SHARDED state dict extraction)."""
     from .state import PartialState
 
     state = PartialState()
     output_dir = Path(output_dir)
     os.makedirs(output_dir, exist_ok=True)
 
+    def _save_tree(tree, name):
+        if _all_addressable(tree):
+            if state.is_main_process or save_on_each_node:
+                save_pytree(tree, str(output_dir / name))
+        elif state_dict_type == "FULL_STATE_DICT":
+            import jax
+
+            flat, treedef = _flatten_with_paths(tree)
+            leaves = [_leaf_to_host(leaf) for _, leaf in flat]  # collective: all procs
+            if state.is_main_process or save_on_each_node:
+                save_pytree(jax.tree_util.tree_unflatten(treedef, leaves), str(output_dir / name))
+        else:
+            save_sharded(tree, str(output_dir / f"{name}.sharded"))
+
     for i, model in enumerate(models):
         name = f"{MODEL_NAME}.npz" if i == 0 else f"{MODEL_NAME}_{i}.npz"
-        params = model.state_dict()
-        if _all_addressable(params):
-            if state.is_main_process or save_on_each_node:
-                save_pytree(params, str(output_dir / name))
-        else:
-            save_sharded(params, str(output_dir / f"{name}.sharded"))
+        _save_tree(model.state_dict(), name)
         logger.info("Model weights saved in %s", output_dir / name)
 
     for i, opt in enumerate(optimizers):
         name = f"{OPTIMIZER_NAME}.npz" if i == 0 else f"{OPTIMIZER_NAME}_{i}.npz"
-        opt_state = opt.state_dict()["opt_state"]
-        if _all_addressable(opt_state):
-            if state.is_main_process or save_on_each_node:
-                save_pytree(opt_state, str(output_dir / name))
-        else:
-            save_sharded(opt_state, str(output_dir / f"{name}.sharded"))
+        _save_tree(opt.state_dict()["opt_state"], name)
         if opt.scaler is not None and (state.is_main_process or save_on_each_node):
             with open(output_dir / f"{SCALER_NAME}_{i}.json", "w") as f:
                 json.dump(opt.scaler.state_dict(), f)

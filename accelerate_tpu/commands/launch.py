@@ -96,11 +96,20 @@ def build_launch_env(args, config: dict) -> dict:
             "cpu_offload": "OFFLOAD_PARAMS",
             "activation_checkpointing": "ACTIVATION_CHECKPOINTING",
             "state_dict_type": "STATE_DICT_TYPE",
+            "auto_wrap_policy": "AUTO_WRAP_POLICY",
+            "transformer_cls_names_to_wrap": "TRANSFORMER_CLS_TO_WRAP",
+            "param_dtype": "PARAM_DTYPE",
+            "reduce_dtype": "REDUCE_DTYPE",
+            "sync_module_states": "SYNC_MODULE_STATES",
         }
         for key, suffix in mapping.items():
             if key in fsdp_cfg and fsdp_cfg[key] is not None:
                 val = fsdp_cfg[key]
-                env[f"ACCELERATE_TPU_FSDP_{suffix}"] = str(val) if not isinstance(val, bool) else str(val).lower()
+                if isinstance(val, bool):
+                    val = str(val).lower()
+                elif isinstance(val, (list, tuple)):
+                    val = ",".join(str(v) for v in val)
+                env[f"ACCELERATE_TPU_FSDP_{suffix}"] = str(val)
     sp_cfg = config.get("sequence_parallel_config") or {}
     if sp_cfg:
         env["ACCELERATE_TPU_SP_MODE"] = str(sp_cfg.get("mode", "ring"))

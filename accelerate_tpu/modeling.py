@@ -96,8 +96,12 @@ class PreparedModel:
         autocast: bool = True,
         fp8_recipe=None,
         offload_params: bool = False,
+        param_dtype=None,
+        reduce_dtype=None,
+        remat_policy: Optional[str] = None,
     ):
         import jax
+        import jax.numpy as jnp
 
         self.module = model.module
         self.apply_fn = model.apply_fn
@@ -107,6 +111,22 @@ class PreparedModel:
         self.compute_dtype = compute_dtype
         self.autocast_enabled = autocast and compute_dtype is not None
         self.fp8_recipe = fp8_recipe
+        # FSDP MixedPrecision parity (reference accelerator.py:1486-1540 +
+        # dataclasses MixedPrecision fields), GSPMD semantics:
+        #   param_dtype — STORAGE dtype of the parameters. Under jax.grad the
+        #     gradient (and therefore the on-wire grad reduction XLA inserts)
+        #     carries the parameter dtype, so this is also the reduce dtype of
+        #     the implicit cross-device psum.
+        #   reduce_dtype — arithmetic dtype of explicit gradient accumulation
+        #     (the microbatch scan buffer in FusedTrainStep and the eager
+        #     accumulate path), where bf16 roll-off across many adds is the
+        #     real hazard.
+        self.param_dtype = jnp.dtype(param_dtype) if param_dtype is not None else None
+        self.reduce_dtype = jnp.dtype(reduce_dtype) if reduce_dtype is not None else None
+        # Per-layer activation checkpointing (reference accelerator.py:1460-1474):
+        # forward traces under remat_scope, so every in-tree model's layer stack
+        # recomputes instead of saving intermediates.
+        self.remat_policy = remat_policy
         self._jit_cache: dict = {}
 
         # Host-offloaded parameters (ZeRO-offload param tier): weights live in
@@ -129,6 +149,8 @@ class PreparedModel:
         from .parallel.sharding import place_params
 
         params = model.params
+        if self.param_dtype is not None:
+            params = _cast_floating(params, self.param_dtype)
         if param_sharding is not None:
             params = place_params(params, param_sharding)
         elif mesh is not None:
@@ -178,7 +200,12 @@ class PreparedModel:
         # Activation constraints (constrain_activation at the models' residual
         # seams) are active only when the model actually sits on a mesh.
         act_ctx = activation_sharding_scope(self.mesh) if self.mesh is not None else contextlib.nullcontext()
-        with ctx, act_ctx:
+        remat_ctx = contextlib.nullcontext()
+        if self.remat_policy is not None:
+            from .ops.remat import remat_scope
+
+            remat_ctx = remat_scope(self.remat_policy)
+        with ctx, act_ctx, remat_ctx:
             if self.autocast_enabled:
                 params = _cast_floating(params, self.compute_dtype)
                 args = _cast_floating(args, self.compute_dtype)

@@ -21,6 +21,7 @@ from ..modeling import Model
 from ..ops.attention import dot_product_attention
 
 from ..parallel.sharding import constrain_activation
+from ..ops.remat import maybe_remat
 
 # Megatron-layout TP rules: fused qkv/mlp-up column-parallel, out/mlp-down row-parallel,
 # vocab embedding sharded on the vocab dim. Consumed by parallel/sharding.py.
@@ -108,8 +109,9 @@ class BertEncoder(nn.Module):
                 words + positions + types
             )
         )
+        Layer = maybe_remat(BertLayer)
         for i in range(cfg.num_hidden_layers):
-            hidden = BertLayer(cfg, name=f"layer_{i}")(hidden, attention_mask)
+            hidden = Layer(cfg, name=f"layer_{i}")(hidden, attention_mask)
         pooled = nn.tanh(nn.Dense(cfg.hidden_size, name="pooler")(hidden[:, 0]))
         return hidden, pooled
 

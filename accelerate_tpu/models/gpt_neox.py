@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from ..modeling import Model
 from ..ops.attention import dot_product_attention, update_decode_cache
 from ..parallel.sharding import constrain_activation
+from ..ops.remat import maybe_remat
 from .llama import causal_lm_loss
 
 GPT_NEOX_SHARDING_RULES = [
@@ -159,7 +160,7 @@ class GPTNeoXForCausalLM(nn.Module):
         )
         if cfg.scan_layers:
             scan_block = nn.scan(
-                _ScanBlockBody,
+                maybe_remat(_ScanBlockBody),
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, nn.broadcast),
@@ -167,8 +168,9 @@ class GPTNeoXForCausalLM(nn.Module):
             )
             hidden, _ = scan_block(cfg, name="blocks")(hidden, positions, attention_mask)
         else:
+            Block = maybe_remat(GPTNeoXBlock)
             for i in range(cfg.num_hidden_layers):
-                hidden = GPTNeoXBlock(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
+                hidden = Block(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
         hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, param_dtype=cfg._pdtype, name="final_norm")(hidden)
         return nn.Dense(cfg.vocab_size, use_bias=False, param_dtype=cfg._pdtype, name="embed_out")(hidden)
 

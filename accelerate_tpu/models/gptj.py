@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from ..modeling import Model
 from ..ops.attention import dot_product_attention, update_decode_cache
 from ..parallel.sharding import constrain_activation
+from ..ops.remat import maybe_remat
 from .llama import causal_lm_loss
 
 GPTJ_SHARDING_RULES = [
@@ -146,7 +147,7 @@ class GPTJForCausalLM(nn.Module):
         )
         if cfg.scan_layers:
             scan_block = nn.scan(
-                _ScanBlockBody,
+                maybe_remat(_ScanBlockBody),
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, nn.broadcast),
@@ -154,8 +155,9 @@ class GPTJForCausalLM(nn.Module):
             )
             hidden, _ = scan_block(cfg, name="blocks")(hidden, positions, attention_mask)
         else:
+            Block = maybe_remat(GPTJBlock)
             for i in range(cfg.num_hidden_layers):
-                hidden = GPTJBlock(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
+                hidden = Block(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
         hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, param_dtype=cfg._pdtype, name="ln_f")(hidden)
         return nn.Dense(cfg.vocab_size, param_dtype=cfg._pdtype, name="lm_head")(hidden)  # biased, per GPT-J
 

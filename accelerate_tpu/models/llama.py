@@ -22,6 +22,7 @@ from ..modeling import Model
 from ..ops.attention import dot_product_attention, update_decode_cache
 
 from ..parallel.sharding import constrain_activation
+from ..ops.remat import maybe_remat
 
 LLAMA_SHARDING_RULES = [
     (r"(wq|wk|wv)/kernel", (None, "model")),
@@ -149,7 +150,7 @@ class LlamaForCausalLM(nn.Module):
             # One compiled layer body scanned over a stacked param axis — the
             # compile-time answer to deep stacks (XLA sees a single layer).
             scan_layer = nn.scan(
-                _ScanLayerBody,
+                maybe_remat(_ScanLayerBody),
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, nn.broadcast),
@@ -157,8 +158,9 @@ class LlamaForCausalLM(nn.Module):
             )
             hidden, _ = scan_layer(cfg, name="layers")(hidden, positions, attention_mask)
         else:
+            Layer = maybe_remat(LlamaLayer)
             for i in range(cfg.num_hidden_layers):
-                hidden = LlamaLayer(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
+                hidden = Layer(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
         hidden = RMSNorm(cfg.rms_norm_eps, name="final_norm")(hidden)
         if cfg.tie_word_embeddings:
             embed = self.variables["params"]["embed_tokens"]["embedding"]

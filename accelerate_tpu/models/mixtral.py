@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from ..modeling import Model
 from ..parallel.expert import EXPERT_SHARDING_RULES, MoEBlock
+from ..ops.remat import maybe_remat
 from .llama import LlamaAttention, LlamaConfig, RMSNorm
 
 MIXTRAL_SHARDING_RULES = [
@@ -96,8 +97,9 @@ class MixtralForCausalLM(nn.Module):
             positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
         hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens")(input_ids)
         total_aux = {"load_balance_loss": jnp.float32(0.0), "router_z_loss": jnp.float32(0.0)}
+        Layer = maybe_remat(MixtralLayer)
         for i in range(cfg.num_hidden_layers):
-            hidden, aux = MixtralLayer(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
+            hidden, aux = Layer(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
             total_aux = {k: total_aux[k] + aux[k] for k in total_aux}
         hidden = RMSNorm(cfg.rms_norm_eps, name="final_norm")(hidden)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head")(hidden)

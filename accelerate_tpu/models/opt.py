@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from ..modeling import Model
 from ..ops.attention import dot_product_attention, update_decode_cache
 from ..parallel.sharding import constrain_activation
+from ..ops.remat import maybe_remat
 from .llama import causal_lm_loss
 
 OPT_SHARDING_RULES = [
@@ -123,7 +124,7 @@ class OPTForCausalLM(nn.Module):
         hidden = constrain_activation(embed(input_ids) + pos_embed(positions + POSITION_OFFSET))
         if cfg.scan_layers:
             scan_block = nn.scan(
-                _ScanBlockBody,
+                maybe_remat(_ScanBlockBody),
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, nn.broadcast),
@@ -131,8 +132,9 @@ class OPTForCausalLM(nn.Module):
             )
             hidden, _ = scan_block(cfg, name="blocks")(hidden, positions, attention_mask)
         else:
+            Block = maybe_remat(OPTBlock)
             for i in range(cfg.num_hidden_layers):
-                hidden = OPTBlock(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
+                hidden = Block(cfg, name=f"layer_{i}")(hidden, positions, attention_mask)
         hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, param_dtype=cfg._pdtype, name="final_norm")(hidden)
         # Tied head: logits against the token embedding (OPT ties by default).
         embedding = self.variables["params"]["embed_tokens"]["embedding"]

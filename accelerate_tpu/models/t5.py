@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from ..modeling import Model
 from ..ops.attention import dot_product_attention, update_decode_cache
 from ..parallel.sharding import constrain_activation
+from ..ops.remat import maybe_remat
 
 T5_SHARDING_RULES = [
     (r"(wq|wk|wv)/kernel", (None, "model")),
@@ -224,9 +225,10 @@ class T5ForConditionalGeneration(nn.Module):
         self.shared = nn.Embed(cfg.vocab_size, cfg.d_model, param_dtype=cfg._pdtype)
         self.enc_bias = T5RelativeBias(cfg, bidirectional=True)
         self.dec_bias = T5RelativeBias(cfg, bidirectional=False)
-        self.enc_blocks = [T5EncoderBlock(cfg) for _ in range(cfg.num_layers)]
+        self.enc_blocks = [maybe_remat(T5EncoderBlock)(cfg) for _ in range(cfg.num_layers)]
         self.dec_blocks = [
-            T5DecoderBlock(cfg, use_cache=self.use_cache) for _ in range(cfg.num_decoder_layers)
+            maybe_remat(T5DecoderBlock)(cfg, use_cache=self.use_cache)
+            for _ in range(cfg.num_decoder_layers)
         ]
         self.enc_final_norm = T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype)
         self.dec_final_norm = T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype)

@@ -1,0 +1,83 @@
+"""Per-layer activation checkpointing (rematerialization).
+
+The reference applies torch's `checkpoint_wrapper` to each FSDP-wrapped block
+(reference accelerator.py:1460-1474). The TPU-native equivalent is flax
+`nn.remat` (jax.checkpoint) around each transformer layer: the backward pass
+recomputes one layer's internals at a time, so peak memory holds only layer
+-boundary activations instead of every intermediate.
+
+Models cannot be rewrapped after construction (flax modules bind structure at
+trace time), so the seam is a trace-time contextvar scope — the exact pattern
+`activation_sharding_scope` uses: model families route their layer classes
+through `maybe_remat`, which is the identity unless a `remat_scope` is active.
+`PreparedModel` enters the scope when
+`FullyShardedDataParallelPlugin.activation_checkpointing` is set (or a
+`CompilationConfig.remat_policy` asks for it), so the knob acts on any in-tree
+model with zero per-model configuration.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Optional
+
+# Active remat policy name, or None (no remat). Set at trace time.
+_REMAT_POLICY: contextvars.ContextVar = contextvars.ContextVar("remat_policy", default=None)
+
+#: CompilationConfig.remat_policy / plugin values -> jax.checkpoint policies.
+#: "full" saves nothing (classic activation checkpointing: only layer inputs
+#: survive); "dots" keeps MXU outputs and recomputes the elementwise chain —
+#: cheaper recompute, smaller saving.
+POLICY_NAMES = ("full", "nothing_saveable", "dots", "dots_saveable", "dots_with_no_batch_dims")
+
+
+def _resolve_policy(name: str):
+    import jax
+
+    cp = jax.checkpoint_policies
+    return {
+        "full": None,  # jax.checkpoint default: save nothing
+        "nothing_saveable": cp.nothing_saveable,
+        "dots": cp.dots_saveable,
+        "dots_saveable": cp.dots_saveable,
+        "dots_with_no_batch_dims": cp.dots_with_no_batch_dims_saveable,
+    }[name]
+
+
+@contextlib.contextmanager
+def remat_scope(policy: Optional[str] = "full"):
+    """Enable per-layer remat for models traced inside this scope.
+
+    `policy` is one of POLICY_NAMES (None disables — convenient for callers
+    threading a config value straight through)."""
+    if policy is not None and policy not in POLICY_NAMES:
+        raise ValueError(f"remat policy must be one of {POLICY_NAMES}, got {policy!r}")
+    token = _REMAT_POLICY.set(policy)
+    try:
+        yield
+    finally:
+        _REMAT_POLICY.reset(token)
+
+
+def active_remat_policy() -> Optional[str]:
+    return _REMAT_POLICY.get()
+
+
+def maybe_remat(module_cls):
+    """Layer-class wrapper used by every in-tree model at its stack loop:
+    `Layer = maybe_remat(LlamaLayer)` — identity unless a remat_scope is active.
+
+    Called at trace time (inside @nn.compact), so the same model object honors
+    whatever scope each forward runs under; lifted `nn.remat` preserves the
+    parameter structure, so checkpoints and shardings are unaffected.
+    """
+    name = _REMAT_POLICY.get()
+    if name is None:
+        return module_cls
+    import flax.linen as nn
+
+    policy = _resolve_policy(name)
+    if policy is None:
+        return nn.remat(module_cls)
+    return nn.remat(module_cls, policy=policy)

@@ -70,7 +70,10 @@ def unscale_and_clip(grads, inv_scale, max_norm: Optional[float], use_scaler: bo
     import jax
     import jax.numpy as jnp
 
-    grads = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
+    # Preserve the gradient dtype: inv_scale is a strong fp32 scalar and would
+    # silently promote bf16 grads (and through them the whole update + params)
+    # to fp32, breaking param_dtype storage.
+    grads = jax.tree_util.tree_map(lambda g: (g * inv_scale).astype(g.dtype), grads)
     finite = jnp.array(True)
     if use_scaler:
         finite = jnp.all(
@@ -95,7 +98,7 @@ def update_and_revert(tx, params, opt_state, grads, lr_override, finite, use_sca
     if lr_override is not None and hasattr(opt_state, "hyperparams"):
         opt_state = opt_state._replace(hyperparams={**opt_state.hyperparams, "learning_rate": lr_override})
     updates, new_opt_state = tx.update(grads, opt_state, params)
-    new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    new_params = jax.tree_util.tree_map(lambda p, u: (p + u).astype(p.dtype), params, updates)
     if use_scaler:
         # Skipped step on non-finite grads: keep the old state untouched.
         new_params = jax.tree_util.tree_map(
@@ -463,7 +466,13 @@ class AcceleratedOptimizer:
                     s_g = jax.device_put(s_g, _sh)
                     if _psh is not None:
                         p_g = jax.device_put(p_g, _psh)
-                    g_g = jax.tree_util.tree_map(lambda g: g * inv, g_g)
+                    # Match the param dtype (same two hazards as _update_fn /
+                    # unscale_and_clip): the fp32 `inv` scalar would promote bf16
+                    # grads, and a reduce_dtype fp32 accumulation buffer must not
+                    # leak fp32 moments into the (offload-halved) opt state.
+                    g_g = jax.tree_util.tree_map(
+                        lambda g, p: (g * inv).astype(p.dtype), g_g, p_g
+                    )
                     return update_and_revert(
                         tx, p_g, s_g, g_g, lr if with_lr else None, finite, use_scaler
                     )
@@ -496,14 +505,21 @@ class AcceleratedOptimizer:
         if "acc" not in self._jit_cache:
 
             def _add(acc, new):
-                return jax.tree_util.tree_map(lambda a, b: a + b, acc, new)
+                return jax.tree_util.tree_map(lambda a, b: a + b.astype(a.dtype), acc, new)
 
             self._jit_cache["acc"] = jax.jit(_add, donate_argnums=(0,))
         return self._jit_cache["acc"]
 
     def accumulate_grads(self, grads):
-        """Add a microbatch's gradients into the accumulation buffer."""
+        """Add a microbatch's gradients into the accumulation buffer (held in the
+        model's reduce_dtype when set — FSDP MixedPrecision parity; cast back to
+        the param dtype at step time by _update's grads.astype)."""
         if self._grads is None:
+            reduce_dtype = getattr(self.model, "reduce_dtype", None)
+            if reduce_dtype is not None:
+                import jax
+
+                grads = jax.tree_util.tree_map(lambda g: g.astype(reduce_dtype), grads)
             self._grads = grads
             self._grads_unscaled = False
         else:
@@ -571,14 +587,29 @@ class AcceleratedOptimizer:
             use_scaler = self.scaler is not None and self.scaler.enabled
             to_compute = getattr(self.model, "to_compute_memory", lambda p: p)
 
+            param_out = getattr(self.model, "param_compute_sharding", None)
+            opt_out = self._opt_compute_sharding or self.opt_state_sharding
+
             def _update(params, opt_state, grads, inv_scale, lr_override):
                 # Host-offloaded tiers stream into device memory for the update;
                 # the caller writes the results back to pinned host.
                 opt_state = self.opt_to_compute_memory(opt_state)
                 params = to_compute(params)
-                return apply_update_core(
+                # The accumulation buffer may be in reduce_dtype (fp32 over bf16
+                # params); the optimizer state mirrors the params, so bring the
+                # grads back to the param dtype for the update arithmetic.
+                grads = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), grads, params)
+                new_params, new_opt_state, finite = apply_update_core(
                     self.tx, params, opt_state, grads, inv_scale, lr_override, use_scaler=use_scaler
                 )
+                # Pin outputs to the derived shardings — an unconstrained donated
+                # jit lets XLA re-layout params after the first step (sharding
+                # drift away from the configured wrap policy).
+                if param_out is not None:
+                    new_params = jax.lax.with_sharding_constraint(new_params, param_out)
+                if opt_out is not None:
+                    new_opt_state = jax.lax.with_sharding_constraint(new_opt_state, opt_out)
+                return new_params, new_opt_state, finite
 
             donate = (0, 1, 2)
             self._jit_cache["update"] = jax.jit(_update, donate_argnums=donate)

@@ -108,9 +108,24 @@ def spec_for_param(
 
     fsdp_size = mesh.shape.get("fsdp", 1)
     shards_params = fsdp_plugin is not None and fsdp_plugin.shards_params
+    # auto_wrap_policy decides WHICH params join the fsdp shard group (the GSPMD
+    # reading of reference set_auto_wrap_policy, dataclasses.py:1173-1203):
+    #   SIZE_BASED_WRAP / None — size threshold (min_num_params);
+    #   TRANSFORMER_BASED_WRAP — only params whose path matches one of
+    #     transformer_cls_names_to_wrap (path regexes, e.g. "layer_"); the rest
+    #     (embeddings/head/norms) stay replicated, exactly like unwrapped root
+    #     modules in the reference;
+    #   NO_WRAP — one root unit: every divisible param shards, no threshold.
+    policy = getattr(fsdp_plugin, "auto_wrap_policy", None) if fsdp_plugin else None
     threshold = min_shard_size
     if threshold is None:
         threshold = fsdp_plugin.min_num_params if (fsdp_plugin and fsdp_plugin.min_num_params) else _SMALL_PARAM_DEFAULT
+    if policy == "NO_WRAP":
+        threshold = 1
+    elif policy == "TRANSFORMER_BASED_WRAP" and shards_params:
+        wrap_names = getattr(fsdp_plugin, "transformer_cls_names_to_wrap", None) or []
+        if not any(re.search(pat, path) for pat in wrap_names):
+            shards_params = False
     if fsdp_size > 1 and shards_params and size >= threshold and "fsdp" not in _axes_free(spec, mesh):
         taken = {i for i, s in enumerate(spec) if s is not None}
         extended = False

@@ -354,6 +354,43 @@ def seedable_sampler_in_shard_check(state):
     state.wait_for_everyone()
 
 
+def sync_module_states_check(state):
+    """FSDP sync_module_states: rank-divergent initial weights must come out of
+    prepare() identical everywhere (rank 0 wins) — and with the knob off they
+    must stay divergent (proves the broadcast is the knob's doing)."""
+    import jax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.test_utils.training import RegressionModel
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+    from accelerate_tpu.utils.operations import fetch_global, gather_object
+
+    if state.num_processes == 1:
+        return
+
+    def first_leaf_value(prepared):
+        leaf = jax.tree_util.tree_leaves(prepared.params)[0]
+        return float(np.asarray(fetch_global(leaf)).reshape(-1)[0])
+
+    for sync, expect_equal in ((True, True), (False, False)):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        accelerator = Accelerator(
+            fsdp_plugin=FullyShardedDataParallelPlugin(sync_module_states=sync)
+        )
+        model = RegressionModel(a=float(state.process_index), b=1.0)  # divergent init
+        prepared = accelerator.prepare(model)
+        values = gather_object([first_leaf_value(prepared)])
+        equal = all(v == values[0] for v in values)
+        assert equal == expect_equal, (
+            f"sync_module_states={sync}: expected equal={expect_equal}, got {values}"
+        )
+    state.print("sync_module_states_check: rank-0 weights win when on, stay local when off ✓")
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+
+
 def trigger_check(state):
     from accelerate_tpu import Accelerator
     from accelerate_tpu.state import AcceleratorState, GradientState
@@ -387,6 +424,8 @@ def main():
     gather_for_metrics_check(state)
     state.print("**Trigger**")
     trigger_check(state)
+    state.print("**FSDP sync_module_states**")
+    sync_module_states_check(state)
     state.print("**State reinstantiation / sharded sampler**")
     reinstantiated_state_check(state)
     seedable_sampler_in_shard_check(state)

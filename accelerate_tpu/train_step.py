@@ -112,13 +112,24 @@ class FusedTrainStep:
                         "accumulation_steps > 1 takes exactly one positional batch pytree"
                     )
                 microbatches = split_microbatches(args[0])
+                # reduce_dtype (FSDP MixedPrecision parity): the accumulation
+                # buffer dtype. With bf16 params, k bf16 adds roll off mantissa
+                # bits; an fp32 buffer keeps the accumulated gradient exact, cast
+                # back to the param dtype only at the update.
+                reduce_dtype = getattr(self.model, "reduce_dtype", None)
 
                 def body(acc, mbatch):
                     g, (loss, _aux) = grads_of(params, scale, mbatch)
+                    if reduce_dtype is not None:
+                        g = jax.tree_util.tree_map(lambda x: x.astype(reduce_dtype), g)
                     return jax.tree_util.tree_map(jnp.add, acc, g), loss
 
-                zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, reduce_dtype or p.dtype), params
+                )
                 grads, losses = jax.lax.scan(body, zeros, microbatches)
+                if reduce_dtype is not None:
+                    grads = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), grads, params)
                 return grads, jnp.mean(losses), None
             grads, (loss, aux) = grads_of(params, scale, *args, **kwargs)
             return grads, loss, aux
@@ -139,6 +150,16 @@ class FusedTrainStep:
 
             return jax.jit(grads_program)
 
+        # Pin updated params/opt-state to their DERIVED shardings: the jit has no
+        # out_shardings, so without constraints XLA may re-layout outputs (e.g.
+        # shard a replicated embedding over fsdp after step 1), silently drifting
+        # from the wrap policy the user configured and changing the collective
+        # pattern between the first and later steps.
+        param_out_sharding = getattr(self.model, "param_compute_sharding", None)
+        opt_out_sharding = getattr(self.optimizer, "_opt_compute_sharding", None) or getattr(
+            self.optimizer, "opt_state_sharding", None
+        )
+
         def fused(params, opt_state, scale, inv_scale, lr, *args, **kwargs):
             # Host-offloaded tiers stream to device memory at the top of the
             # program; the caller writes results back to pinned host.
@@ -158,6 +179,10 @@ class FusedTrainStep:
                 use_scaler=use_scaler,
                 max_norm=max_norm,
             )
+            if param_out_sharding is not None:
+                new_params = jax.lax.with_sharding_constraint(new_params, param_out_sharding)
+            if opt_out_sharding is not None:
+                new_opt_state = jax.lax.with_sharding_constraint(new_opt_state, opt_out_sharding)
             return new_params, new_opt_state, loss, aux, finite
 
         return jax.jit(fused, donate_argnums=(0, 1))

