@@ -945,8 +945,8 @@ class Accelerator:
         self,
         model: PreparedModel,
         save_directory: str,
-        max_shard_size="5GB",
         safe_serialization: bool = True,
+        max_shard_size="5GB",
     ):
         """Export just the weights (reference save_model accelerator.py:2691).
 

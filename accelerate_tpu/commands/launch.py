@@ -108,6 +108,11 @@ def build_launch_env(args, config: dict) -> dict:
                 if isinstance(val, bool):
                     val = str(val).lower()
                 elif isinstance(val, (list, tuple)):
+                    if any("," in str(v) for v in val):
+                        raise ValueError(
+                            f"fsdp_config.{key} entries cannot contain ',' (the env-protocol "
+                            f"separator): {val}. Use a comma-free regex (e.g. 'layer_[0-9]+')."
+                        )
                     val = ",".join(str(v) for v in val)
                 env[f"ACCELERATE_TPU_FSDP_{suffix}"] = str(val)
     sp_cfg = config.get("sequence_parallel_config") or {}

@@ -390,6 +390,101 @@ class T5LayeredApply:
         return nn.Dense(cfg.vocab_size, use_bias=False).apply({"params": inner["lm_head"]}, hidden)
 
 
+class T5PipelineApply:
+    """Encoder-decoder pipeline decomposition (consumed by
+    `parallel.pipeline.PipelinedModel`'s two-phase ring schedule — the in-tree
+    replacement for Megatron's T5 pipeline, reference utils/megatron_lm.py:702
+    `T5TrainStep` + :1004-1010 schedule selection).
+
+    Each pipeline stage holds a chunk of BOTH stacks; a microbatch rides the
+    stage ring twice — encoder chunks on the first pass, then `apply_promote`
+    (the encoder final norm, applied exactly once before any cross-attention)
+    at stage 0, then decoder chunks on the second pass. The carry holds both
+    streams ({"enc", "dec", biases, mask}), so its pytree structure is uniform
+    across every hop."""
+
+    def __init__(self, config: T5Config):
+        self.config = config
+
+    def split(self, params):
+        cfg = self.config
+        inner = params["params"]
+        prelude = {
+            "params": {k: inner[k] for k in ("shared", "enc_bias", "dec_bias", "enc_final_norm")}
+        }
+        enc_layers = [{"params": inner[f"enc_blocks_{i}"]} for i in range(cfg.num_layers)]
+        dec_layers = [{"params": inner[f"dec_blocks_{i}"]} for i in range(cfg.num_decoder_layers)]
+        tail = {"params": {k: inner[k] for k in ("dec_final_norm", "lm_head")}}
+        return prelude, enc_layers, dec_layers, tail
+
+    def join(self, prelude, enc_layers, dec_layers, tail):
+        inner = dict(prelude["params"])
+        for i, lp in enumerate(enc_layers):
+            inner[f"enc_blocks_{i}"] = lp["params"]
+        for i, lp in enumerate(dec_layers):
+            inner[f"dec_blocks_{i}"] = lp["params"]
+        inner.update(tail["params"])
+        return {"params": inner}
+
+    def apply_prelude(self, prelude_params, input_ids, decoder_input_ids, attention_mask=None):
+        cfg = self.config
+        inner = prelude_params["params"]
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model)
+        enc = embed.apply({"params": {"embedding": inner["shared"]["embedding"]}}, input_ids)
+        dec = embed.apply({"params": {"embedding": inner["shared"]["embedding"]}}, decoder_input_ids)
+        enc_pos = jnp.arange(input_ids.shape[1])
+        dec_pos = jnp.arange(decoder_input_ids.shape[1])
+        enc_bias = T5RelativeBias(cfg, bidirectional=True).apply(
+            {"params": inner["enc_bias"]}, enc_pos, enc_pos
+        )
+        dec_bias = T5RelativeBias(cfg, bidirectional=False).apply(
+            {"params": inner["dec_bias"]}, dec_pos, dec_pos
+        )
+        if attention_mask is not None:
+            enc_mask = attention_mask[:, None, None, :].astype(bool)
+        else:
+            # Stable carry structure: "no mask" is all-ones, not None.
+            enc_mask = jnp.ones((input_ids.shape[0], 1, 1, input_ids.shape[1]), bool)
+        return {"enc": enc, "dec": dec, "enc_bias": enc_bias, "dec_bias": dec_bias, "enc_mask": enc_mask}
+
+    def apply_enc_layer(self, layer_params, carry):
+        cfg = self.config
+        carry = dict(carry)
+        carry["enc"] = T5EncoderBlock(cfg).apply(
+            {"params": layer_params["params"]}, carry["enc"], carry["enc_bias"], carry["enc_mask"]
+        )
+        return carry
+
+    def apply_promote(self, prelude_params, carry):
+        """Encoder -> decoder phase handoff: the final encoder norm, exactly once."""
+        cfg = self.config
+        carry = dict(carry)
+        carry["enc"] = T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype).apply(
+            {"params": prelude_params["params"]["enc_final_norm"]}, carry["enc"]
+        )
+        return carry
+
+    def apply_dec_layer(self, layer_params, carry):
+        cfg = self.config
+        carry = dict(carry)
+        carry["dec"] = T5DecoderBlock(cfg).apply(
+            {"params": layer_params["params"]},
+            carry["dec"],
+            carry["enc"],
+            carry["dec_bias"],
+            carry["enc_mask"],
+        )
+        return carry
+
+    def apply_tail(self, tail_params, carry):
+        cfg = self.config
+        inner = tail_params["params"]
+        hidden = T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype).apply(
+            {"params": inner["dec_final_norm"]}, carry["dec"]
+        )
+        return nn.Dense(cfg.vocab_size, use_bias=False).apply({"params": inner["lm_head"]}, hidden)
+
+
 def t0pp_11b() -> T5Config:
     """bigscience/T0pp dims (T5 v1.1 xxl; reference benchmarks/README.md:35)."""
     return T5Config()

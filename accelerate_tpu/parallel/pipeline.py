@@ -32,7 +32,8 @@ import numpy as np
 
 # Path rules consumed by parallel/sharding.py: stacked layer params (and their optimizer
 # moments, whose paths nest under e.g. "0/mu/layers/...") shard dim 0 over "stage".
-PIPELINE_SHARDING_RULES = [(r"(^|/)layers(/|$)", ("stage",))]
+# enc_layers/dec_layers are the encoder-decoder pipeline's two stacked bodies.
+PIPELINE_SHARDING_RULES = [(r"(^|/)(enc_|dec_)?layers(/|$)", ("stage",))]
 
 
 def _shard_map():
@@ -124,6 +125,25 @@ def _default_batch_to_args(batch):
     return (batch,)
 
 
+def default_seq2seq_logits_loss(logits, batch):
+    """Teacher-forced cross-entropy on decoder targets from logits, as a
+    `(loss_sum, weight)` pair (mirrors models.t5.seq2seq_lm_loss; labels align
+    with decoder positions — no shift)."""
+    import jax
+    import jax.numpy as jnp
+
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    valid = (labels >= 0).astype(jnp.float32)
+    safe = jnp.maximum(labels, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return (nll * valid).sum(), valid.sum()
+
+
+def _default_seq2seq_batch_to_args(batch):
+    return (batch["input_ids"], batch["decoder_input_ids"], batch.get("attention_mask"))
+
+
 from ..modeling import _cast_floating
 
 
@@ -152,6 +172,35 @@ class PipelineSpec:
         return self.layered.apply_tail(tail_params, carry)
 
 
+class EncoderDecoderPipelineSpec(PipelineSpec):
+    """Stage functions for a two-stack (encoder-decoder) model, over the
+    `T5PipelineApply`-shaped protocol: split -> (prelude, enc_layers, dec_layers,
+    tail), apply_prelude/apply_enc_layer/apply_promote/apply_dec_layer/apply_tail.
+    The reference reaches this only through Megatron's T5 schedule
+    (utils/megatron_lm.py:702,1004-1010)."""
+
+    def __init__(
+        self,
+        layered,
+        loss_on_logits: Optional[Callable] = None,
+        batch_to_args: Optional[Callable] = None,
+    ):
+        super().__init__(
+            layered,
+            loss_on_logits or default_seq2seq_logits_loss,
+            batch_to_args or _default_seq2seq_batch_to_args,
+        )
+
+    def promote(self, prelude_params, carry):
+        return self.layered.apply_promote(prelude_params, carry)
+
+    def enc_layer(self, layer_params, carry):
+        return self.layered.apply_enc_layer(layer_params, carry)
+
+    def dec_layer(self, layer_params, carry):
+        return self.layered.apply_dec_layer(layer_params, carry)
+
+
 def _split_microbatches(batch, num_microbatches: int):
     import jax
 
@@ -165,17 +214,34 @@ def _split_microbatches(batch, num_microbatches: int):
     return jax.tree_util.tree_map(_split, batch)
 
 
-def _build_local_fns(spec: PipelineSpec, num_microbatches: int, compute_dtype=None, remat: bool = True):
-    """The per-device (shard_map-level) pipelined loss and forward."""
+def _build_local_fns(
+    spec, num_microbatches: int, compute_dtype=None, remat: bool = True, encoder_decoder: bool = False
+):
+    """Per-device (shard_map-level) pipelined loss and forward — ONE implementation
+    for both schedules, parameterized by the tick body:
+
+    - single-body (decoder-only): one stream; a microbatch rides the ring once
+      (drain S-1, schedule M + S - 1 ticks), each stage scanning its local chunk
+      of the one stacked layer body.
+    - encoder-decoder (`encoder_decoder=True`): every stage holds a chunk of BOTH
+      stacks and two streams are in flight; a microbatch rides the ring twice —
+      encoder chunks on hops [0, S), `spec.promote` (the encoder final norm) as it
+      re-enters stage 0, decoder chunks with cross-attention on hops [S, 2S) — so
+      the drain is 2S-1 and the schedule M + 2S - 1 ticks. The carry pytree holds
+      both hidden streams, making it uniform across every hop.
+    """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     M = num_microbatches
 
-    layer_fn = spec.layer
-    if remat:
-        layer_fn = jax.checkpoint(spec.layer)
+    if encoder_decoder:
+        enc_fn, dec_fn = spec.enc_layer, spec.dec_layer
+        if remat:
+            enc_fn, dec_fn = jax.checkpoint(spec.enc_layer), jax.checkpoint(spec.dec_layer)
+    else:
+        layer_fn = jax.checkpoint(spec.layer) if remat else spec.layer
 
     def _prep(params, batch):
         if compute_dtype is not None:
@@ -189,36 +255,50 @@ def _build_local_fns(spec: PipelineSpec, num_microbatches: int, compute_dtype=No
         )
 
     def _pipeline_scan(params, batch, fold_output):
-        """Runs the tick scan; `fold_output(acc, x, out_mb, valid)` folds the last
-        stage's carry for in-range microbatches into an accumulator."""
-        prelude_p, layers_p, tail_p = params["prelude"], params["layers"], params["tail"]
+        """Builds (tick, init_streams, total_ticks); `fold_output(acc, tail_p, x,
+        out_mb, out_i, valid)` folds the last stage's finished carry into an
+        accumulator. The scan carry is (streams_tuple, acc)."""
+        prelude_p, tail_p = params["prelude"], params["tail"]
         S = lax.axis_size("stage")
         idx = lax.axis_index("stage")
         mbs = _split_microbatches(batch, M)
         mb0 = _index_mb(mbs, jnp.int32(0))
         carry_struct = jax.eval_shape(spec.prelude, prelude_p, mb0)
-        state0 = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), carry_struct)
+        zeros = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), carry_struct)
         perm = [(i, (i + 1) % S) for i in range(S)]
+        drain = (2 * S - 1) if encoder_decoder else (S - 1)
+
+        def rotate(x):
+            return jax.tree_util.tree_map(lambda a: lax.ppermute(a, "stage", perm), x)
 
         def tick(carry, t):
-            state, acc = carry
+            streams, acc = carry
             mb = _index_mb(mbs, jnp.clip(t, 0, M - 1))
-            # Only stage 0 pays the prelude FLOPs; everyone else keeps the carry it
-            # received last tick.
-            x = lax.cond(idx == 0, lambda s: spec.prelude(prelude_p, mb), lambda s: s, state)
+            if encoder_decoder:
+                s0, s1 = streams
+                # Stage 0 retires both incoming carries: the enc-stream carry that
+                # just completed its S encoder chunks promotes into the dec stream
+                # (replacing the dec carry that folded last tick), and a fresh
+                # microbatch injects into the enc stream.
+                x1 = lax.cond(idx == 0, lambda s: spec.promote(prelude_p, s), lambda s: s1, s0)
+                x0 = lax.cond(idx == 0, lambda s: spec.prelude(prelude_p, mb), lambda s: s, s0)
+                x0, _ = lax.scan(lambda h, lp: (enc_fn(lp, h), None), x0, params["enc_layers"])
+                x1, _ = lax.scan(lambda h, lp: (dec_fn(lp, h), None), x1, params["dec_layers"])
+                out_x, new_streams = x1, (rotate(x0), rotate(x1))
+            else:
+                (s0,) = streams
+                # Only stage 0 pays the prelude FLOPs; everyone else keeps the
+                # carry it received last tick.
+                x = lax.cond(idx == 0, lambda s: spec.prelude(prelude_p, mb), lambda s: s, s0)
+                x, _ = lax.scan(lambda h, lp: (layer_fn(lp, h), None), x, params["layers"])
+                out_x, new_streams = x, (rotate(x),)
+            out_i = jnp.clip(t - drain, 0, M - 1)
+            valid = jnp.logical_and(t >= drain, idx == S - 1)
+            acc = fold_output(acc, tail_p, out_x, _index_mb(mbs, out_i), out_i, valid)
+            return (new_streams, acc), None
 
-            def scan_layer(h, lp):
-                return layer_fn(lp, h), None
-
-            x, _ = lax.scan(scan_layer, x, layers_p)
-            out_i = jnp.clip(t - (S - 1), 0, M - 1)
-            out_mb = _index_mb(mbs, out_i)
-            valid = jnp.logical_and(t >= S - 1, idx == S - 1)
-            acc = fold_output(acc, tail_p, x, out_mb, out_i, valid)
-            state = jax.tree_util.tree_map(lambda a: lax.ppermute(a, "stage", perm), x)
-            return (state, acc), None
-
-        return lax.scan, tick, state0, S
+        init_streams = (zeros, zeros) if encoder_decoder else (zeros,)
+        return tick, init_streams, M + drain, (prelude_p, tail_p)
 
     def _loss_pair(tail_p, carry, mb):
         """Normalize loss_on_logits output to a (loss_sum, weight) pair: fns returning a
@@ -243,9 +323,9 @@ def _build_local_fns(spec: PipelineSpec, num_microbatches: int, compute_dtype=No
             )
             return (acc[0] + s, acc[1] + w)
 
-        scan, tick, state0, S = _pipeline_scan(params, batch, fold)
-        (final_state, (loss_sum, weight)), _ = scan(
-            tick, (state0, (jnp.float32(0.0), jnp.float32(0.0))), jnp.arange(M + S - 1)
+        tick, init_streams, total, _ = _pipeline_scan(params, batch, fold)
+        (_, (loss_sum, weight)), _ = lax.scan(
+            tick, (init_streams, (jnp.float32(0.0), jnp.float32(0.0))), jnp.arange(total)
         )
         axes = ("stage", "data", "fsdp")
         loss_sum = lax.psum(loss_sum, axes)
@@ -281,8 +361,8 @@ def _build_local_fns(spec: PipelineSpec, num_microbatches: int, compute_dtype=No
                 out,
             )
 
-        scan, tick, state0, S = _pipeline_scan(params, batch, fold)
-        (final_state, buf), _ = scan(tick, (state0, buf0), jnp.arange(M + S - 1))
+        tick, init_streams, total, _ = _pipeline_scan(params, batch, fold)
+        (_, buf), _ = lax.scan(tick, (init_streams, buf0), jnp.arange(total))
         # Outputs live on the last stage only; psum broadcasts them (zeros elsewhere).
         buf = jax.tree_util.tree_map(lambda b: lax.psum(b, "stage"), buf)
         return jax.tree_util.tree_map(lambda b: b.reshape((-1,) + b.shape[2:]), buf)
@@ -339,30 +419,48 @@ class PipelinedModel:
         self.autocast_enabled = autocast and compute_dtype is not None
         self.num_microbatches = num_microbatches
         self.sharding_rules = PIPELINE_SHARDING_RULES
-        self.spec = PipelineSpec(layered, loss_on_logits, batch_to_args)
+        # Two-stack (encoder-decoder) decompositions implement the
+        # T5PipelineApply-shaped protocol and run the two-phase ring schedule.
+        self.is_encoder_decoder = hasattr(layered, "apply_enc_layer")
+        self.spec = (
+            EncoderDecoderPipelineSpec(layered, loss_on_logits, batch_to_args)
+            if self.is_encoder_decoder
+            else PipelineSpec(layered, loss_on_logits, batch_to_args)
+        )
 
-        prelude, layers, tail = layered.split(model.params)
-        self.num_layers = len(layers)
-        # Stages scan ONE layer body, so every layer entry must share a pytree
-        # structure. Encoder-decoder decompositions (T5LayeredApply) are
-        # heterogeneous by design — fail with guidance instead of a cryptic
-        # stack/scan structure mismatch. (PyTreeDefs compare directly.)
         import jax
 
-        structures = {jax.tree_util.tree_structure(lp) for lp in layers}
-        if len(structures) > 1:
-            raise NotImplementedError(
-                "Pipeline parallelism requires homogeneous layer blocks (one "
-                "scanned body); this LayeredApply yields mixed structures "
-                "(encoder-decoder). Use tier-streamed execution instead: "
-                "accelerate_tpu.big_modeling.dispatch_model/cpu_offload with the "
-                "same LayeredApply."
-            )
         n_stages = mesh.shape["stage"]
-        if self.num_layers % n_stages != 0:
-            raise ValueError(
-                f"{self.num_layers} layers not divisible by {n_stages} pipeline stages"
-            )
+        if self.is_encoder_decoder:
+            prelude, enc_layers, dec_layers, tail = layered.split(model.params)
+            self.num_layers = (len(enc_layers), len(dec_layers))
+            for kind, stack in (("encoder", enc_layers), ("decoder", dec_layers)):
+                if len(stack) % n_stages != 0:
+                    raise ValueError(
+                        f"{len(stack)} {kind} layers not divisible by {n_stages} pipeline stages"
+                    )
+            layer_groups = {"enc_layers": enc_layers, "dec_layers": dec_layers}
+        else:
+            prelude, layers, tail = layered.split(model.params)
+            self.num_layers = len(layers)
+            # Stages scan ONE layer body, so every layer entry must share a pytree
+            # structure. Mixed-structure streaming decompositions (T5LayeredApply)
+            # can't scan — point at the pipeline protocol instead.
+            structures = {jax.tree_util.tree_structure(lp) for lp in layers}
+            if len(structures) > 1:
+                raise NotImplementedError(
+                    "Pipeline parallelism requires homogeneous layer blocks (one "
+                    "scanned body); this LayeredApply yields mixed structures "
+                    "(encoder-decoder). Use the two-stack pipeline protocol instead "
+                    "(e.g. models.t5.T5PipelineApply), or tier-streamed execution: "
+                    "accelerate_tpu.big_modeling.dispatch_model/cpu_offload with the "
+                    "same LayeredApply."
+                )
+            if self.num_layers % n_stages != 0:
+                raise ValueError(
+                    f"{self.num_layers} layers not divisible by {n_stages} pipeline stages"
+                )
+            layer_groups = {"layers": layers}
         # Tied weights (e.g. embed_tokens reused by a tied lm head) appear in both the
         # prelude and the tail after split. Store them ONCE (in the prelude) and
         # re-inject the prelude's copy into the tail view inside the differentiated
@@ -374,29 +472,34 @@ class PipelinedModel:
         # Stack the per-layer pytrees directly into stage-sharded buffers: jitting the
         # stack with sharded out_shardings keeps each device to its own [L/S, ...]
         # slice instead of materializing the full stacked model on one device.
-        stacked_struct = jax.eval_shape(stack_layer_params, layers)
-        layers_sharding = jax.tree_util.tree_map(
-            lambda _: NamedSharding(mesh, P("stage")), stacked_struct
-        )
-        stacked = jax.jit(stack_layer_params, out_shardings=layers_sharding)(layers)
         self.param_sharding = {
             "prelude": jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), prelude),
-            "layers": layers_sharding,
             "tail": jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), tail),
         }
+        stacked_groups = {}
+        for group_name, stack in layer_groups.items():
+            stacked_struct = jax.eval_shape(stack_layer_params, stack)
+            group_sharding = jax.tree_util.tree_map(
+                lambda _: NamedSharding(mesh, P("stage")), stacked_struct
+            )
+            stacked_groups[group_name] = jax.jit(
+                stack_layer_params, out_shardings=group_sharding
+            )(stack)
+            self.param_sharding[group_name] = group_sharding
         from .sharding import place_params
 
         placed = place_params(
             {"prelude": prelude, "tail": tail},
             {"prelude": self.param_sharding["prelude"], "tail": self.param_sharding["tail"]},
         )
-        self.params = {"prelude": placed["prelude"], "layers": stacked, "tail": placed["tail"]}
+        self.params = {"prelude": placed["prelude"], "tail": placed["tail"], **stacked_groups}
 
         local_loss, local_forward = _build_local_fns(
             self.spec,
             num_microbatches,
             compute_dtype=compute_dtype if self.autocast_enabled else None,
             remat=remat,
+            encoder_decoder=self.is_encoder_decoder,
         )
         from .sharding import data_spec as _data_spec
 
@@ -404,8 +507,8 @@ class PipelinedModel:
         data_spec = _data_spec(mesh)
         param_specs = {
             "prelude": P(),
-            "layers": P("stage"),
             "tail": P(),
+            **{name: P("stage") for name in layer_groups},
         }
         # check_vma off: the scan carry deliberately mixes device-varying values (the
         # rotating activations) with unvarying zeros at t=0, which the VMA type system
@@ -463,6 +566,11 @@ class PipelinedModel:
     def merged_params(self):
         """Params back in the original (unstacked) model layout — for saving checkpoints
         interchangeable with the non-pipelined model."""
+        if self.is_encoder_decoder:
+            n_enc, n_dec = self.num_layers
+            enc = unstack_layer_params(self.params["enc_layers"], n_enc)
+            dec = unstack_layer_params(self.params["dec_layers"], n_dec)
+            return self.layered.join(self.params["prelude"], enc, dec, self.params["tail"])
         layers = unstack_layer_params(self.params["layers"], self.num_layers)
         return self.layered.join(self.params["prelude"], layers, self.params["tail"])
 
@@ -491,10 +599,24 @@ def prepare_pipeline(
 ) -> PipelinedModel:
     """Build a PipelinedModel from a Model bundle + its LayeredApply decomposition
     (the user-facing PP entry, Megatron `pp_degree` / PiPPy `prepare_pippy` parity)."""
-    from ..state import AcceleratorState
+    from ..state import AcceleratorState, PartialState
 
     if mesh is None:
         mesh = AcceleratorState().mesh
+    # FSDP sync_module_states applies to pipelined models too (prepare_model's
+    # broadcast can't reach them — they arrive at Accelerator.prepare already
+    # placed): rank 0's initial weights win BEFORE stage placement.
+    shared = AcceleratorState._shared_state
+    fsdp = shared.get("fsdp_plugin") if shared else None
+    if (
+        fsdp is not None
+        and getattr(fsdp, "sync_module_states", False)
+        and PartialState._shared_state
+        and PartialState().num_processes > 1
+    ):
+        from ..utils.operations import broadcast
+
+        model.params = broadcast(model.params, from_process=0)
     if compute_dtype is None:
         # Inherit the Accelerator's mixed-precision policy (prepare_model parity —
         # accelerator.py sets compute_dtype from state for non-pipelined models).

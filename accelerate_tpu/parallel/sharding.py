@@ -177,10 +177,17 @@ def derive_opt_state_shardings(opt_state_shapes, mesh, fsdp_plugin=None, rules=N
     from jax.sharding import NamedSharding, PartitionSpec
 
     shards_opt = fsdp_plugin is not None and fsdp_plugin.shards_opt_state
-    # For opt-state derivation under ZeRO-2, treat params as sharded.
+    # For opt-state derivation under ZeRO-2, treat params as sharded — but carry
+    # the wrap-policy knobs through, so a moment shards exactly when its
+    # parameter would (mismatched param/moment shardings would insert a reshard
+    # collective into every update step).
     class _OptPlugin:
         shards_params = True
         min_num_params = getattr(fsdp_plugin, "min_num_params", 0) if fsdp_plugin else 0
+        auto_wrap_policy = getattr(fsdp_plugin, "auto_wrap_policy", None) if fsdp_plugin else None
+        transformer_cls_names_to_wrap = (
+            getattr(fsdp_plugin, "transformer_cls_names_to_wrap", None) if fsdp_plugin else None
+        )
 
     plugin = _OptPlugin() if shards_opt else None
 

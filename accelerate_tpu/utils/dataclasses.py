@@ -328,7 +328,7 @@ class FullyShardedDataParallelPlugin:
             raise ValueError(f"auto_wrap_policy must be one of {FSDP_AUTO_WRAP_POLICY}")
         if prefix + "TRANSFORMER_CLS_TO_WRAP" in env:
             self.transformer_cls_names_to_wrap = [
-                s for s in env[prefix + "TRANSFORMER_CLS_TO_WRAP"].split(",") if s
+                s.strip() for s in env[prefix + "TRANSFORMER_CLS_TO_WRAP"].split(",") if s.strip()
             ]
         if self.auto_wrap_policy == "TRANSFORMER_BASED_WRAP" and not self.transformer_cls_names_to_wrap:
             raise ValueError(
