@@ -962,6 +962,14 @@ class Accelerator:
         """
         from .checkpointing import _all_addressable, save_model_safetensors, save_pytree, save_sharded
 
+        if not isinstance(safe_serialization, bool):
+            # HF-reference positional order, save_model(model, dir, max_shard_size,
+            # safe_serialization): a non-bool third argument is a shard size from
+            # code ported off the reference — honor it instead of silently
+            # truth-testing a string.
+            shard_size = safe_serialization
+            safe_serialization = max_shard_size if isinstance(max_shard_size, bool) else True
+            max_shard_size = shard_size
         os.makedirs(save_directory, exist_ok=True)
         params = model.state_dict()
         if not safe_serialization:

@@ -427,11 +427,28 @@ class T5PipelineApply:
         return {"params": inner}
 
     def apply_prelude(self, prelude_params, input_ids, decoder_input_ids, attention_mask=None):
+        """Per-MICROBATCH carry only ({"enc","dec","enc_mask"}): the relative-
+        position biases are input-independent and come from `apply_static_carry`
+        — computed once per stage from the replicated prelude instead of riding
+        the ppermute ring on every hop."""
         cfg = self.config
         inner = prelude_params["params"]
         embed = nn.Embed(cfg.vocab_size, cfg.d_model)
         enc = embed.apply({"params": {"embedding": inner["shared"]["embedding"]}}, input_ids)
         dec = embed.apply({"params": {"embedding": inner["shared"]["embedding"]}}, decoder_input_ids)
+        if attention_mask is not None:
+            enc_mask = attention_mask[:, None, None, :].astype(bool)
+        else:
+            # Stable carry structure: "no mask" is all-ones, not None.
+            enc_mask = jnp.ones((input_ids.shape[0], 1, 1, input_ids.shape[1]), bool)
+        return {"enc": enc, "dec": dec, "enc_mask": enc_mask}
+
+    def apply_static_carry(self, prelude_params, input_ids, decoder_input_ids, attention_mask=None):
+        """Input-independent carry entries (the relative-position bias tables over
+        the static sequence lengths). Every stage holds the replicated prelude, so
+        each computes these locally — they never rotate over ICI."""
+        cfg = self.config
+        inner = prelude_params["params"]
         enc_pos = jnp.arange(input_ids.shape[1])
         dec_pos = jnp.arange(decoder_input_ids.shape[1])
         enc_bias = T5RelativeBias(cfg, bidirectional=True).apply(
@@ -440,12 +457,7 @@ class T5PipelineApply:
         dec_bias = T5RelativeBias(cfg, bidirectional=False).apply(
             {"params": inner["dec_bias"]}, dec_pos, dec_pos
         )
-        if attention_mask is not None:
-            enc_mask = attention_mask[:, None, None, :].astype(bool)
-        else:
-            # Stable carry structure: "no mask" is all-ones, not None.
-            enc_mask = jnp.ones((input_ids.shape[0], 1, 1, input_ids.shape[1]), bool)
-        return {"enc": enc, "dec": dec, "enc_bias": enc_bias, "dec_bias": dec_bias, "enc_mask": enc_mask}
+        return {"enc_bias": enc_bias, "dec_bias": dec_bias}
 
     def apply_enc_layer(self, layer_params, carry):
         cfg = self.config

@@ -116,6 +116,17 @@ def dot_product_attention(
     """
     import jax.numpy as jnp
 
+    if implementation is None:
+        # Benchmark/debug override (bench.py --attention): force one backend for
+        # every model-internal call without touching model code. "xla" also
+        # bypasses the sequence-parallel auto-dispatch (it requires an
+        # unconstrained call), so A/B runs compare exactly the two kernels.
+        import os
+
+        forced = os.environ.get("ACCELERATE_TPU_ATTENTION_IMPL")
+        if forced in ("xla", "flash"):
+            implementation = forced
+
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
     if scale is None:

@@ -200,6 +200,15 @@ class EncoderDecoderPipelineSpec(PipelineSpec):
     def dec_layer(self, layer_params, carry):
         return self.layered.apply_dec_layer(layer_params, carry)
 
+    def static_carry(self, prelude_params, batch):
+        """Input-independent carry entries (e.g. T5's relative-position biases):
+        computed once per stage from the replicated prelude, merged into the carry
+        before each layer application, and NEVER rotated over ICI."""
+        fn = getattr(self.layered, "apply_static_carry", None)
+        if fn is None:
+            return {}
+        return fn(prelude_params, *self.batch_to_args(batch))
+
 
 def _split_microbatches(batch, num_microbatches: int):
     import jax
@@ -263,7 +272,21 @@ def _build_local_fns(
         idx = lax.axis_index("stage")
         mbs = _split_microbatches(batch, M)
         mb0 = _index_mb(mbs, jnp.int32(0))
-        carry_struct = jax.eval_shape(spec.prelude, prelude_p, mb0)
+        # Input-independent carry entries (spec.static_carry, e.g. T5's relative
+        # biases): every stage computes them locally from the replicated prelude;
+        # they merge into the carry before each layer application and never ride
+        # the ppermute ring.
+        static = {}
+        if encoder_decoder and hasattr(spec, "static_carry"):
+            static = spec.static_carry(prelude_p, mb0)
+
+        def _strip(c):
+            return {k: v for k, v in c.items() if k not in static} if static else c
+
+        def _merge(c):
+            return {**c, **static} if static else c
+
+        carry_struct = jax.eval_shape(lambda p, m: _strip(spec.prelude(p, m)), prelude_p, mb0)
         zeros = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), carry_struct)
         perm = [(i, (i + 1) % S) for i in range(S)]
         drain = (2 * S - 1) if encoder_decoder else (S - 1)
@@ -280,11 +303,19 @@ def _build_local_fns(
                 # just completed its S encoder chunks promotes into the dec stream
                 # (replacing the dec carry that folded last tick), and a fresh
                 # microbatch injects into the enc stream.
-                x1 = lax.cond(idx == 0, lambda s: spec.promote(prelude_p, s), lambda s: s1, s0)
-                x0 = lax.cond(idx == 0, lambda s: spec.prelude(prelude_p, mb), lambda s: s, s0)
-                x0, _ = lax.scan(lambda h, lp: (enc_fn(lp, h), None), x0, params["enc_layers"])
-                x1, _ = lax.scan(lambda h, lp: (dec_fn(lp, h), None), x1, params["dec_layers"])
-                out_x, new_streams = x1, (rotate(x0), rotate(x1))
+                x1 = lax.cond(
+                    idx == 0, lambda s: _strip(spec.promote(prelude_p, _merge(s))), lambda s: s1, s0
+                )
+                x0 = lax.cond(
+                    idx == 0, lambda s: _strip(spec.prelude(prelude_p, mb)), lambda s: s, s0
+                )
+                x0, _ = lax.scan(
+                    lambda h, lp: (_strip(enc_fn(lp, _merge(h))), None), x0, params["enc_layers"]
+                )
+                x1, _ = lax.scan(
+                    lambda h, lp: (_strip(dec_fn(lp, _merge(h))), None), x1, params["dec_layers"]
+                )
+                out_x, new_streams = _merge(x1), (rotate(x0), rotate(x1))
             else:
                 (s0,) = streams
                 # Only stage 0 pays the prelude FLOPs; everyone else keeps the

@@ -1,0 +1,84 @@
+"""Round-4 measurement suite (run manually on hardware; the driver contract stays
+`bench.py` = one JSON line).
+
+Covers the round-3 verdict's evidence list:
+  1. sustained >= 500-step headline (bert-base, seq 128, bs 32/chip)
+  2. batch-size sweep at EQUAL step counts (bs 32/64/128, 500 steps each)
+  3. second-architecture MFU cross-check (llama-1b, seq 1024)
+  4. flash-vs-XLA A/B where the kernel dispatches (llama-1b @ seq 1024)
+  5. inference headline (llama-1b latency; gptj-6b when HBM allows)
+
+Each config runs as `python bench.py --no-supervise --_worker ...` in a fresh
+process (clean singletons, one backend init per config) with a hard timeout.
+Results append to bench_suite_r04.jsonl; summarize into MEASUREMENTS_r04.md.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+CONFIGS = [
+    # (tag, argv, timeout_s)
+    ("headline bs32", ["--steps", "500", "--trials", "3", "--batch_size", "32"], 2400),
+    ("sweep bs64", ["--steps", "500", "--trials", "3", "--batch_size", "64"], 2400),
+    ("sweep bs128", ["--steps", "500", "--trials", "3", "--batch_size", "128"], 3000),
+    (
+        "llama-1b seq1024 flash",
+        ["--model", "llama-1b", "--seq_len", "1024", "--batch_size", "4", "--steps", "100",
+         "--trials", "3", "--attention", "flash"],
+        3000,
+    ),
+    (
+        "llama-1b seq1024 xla",
+        ["--model", "llama-1b", "--seq_len", "1024", "--batch_size", "4", "--steps", "100",
+         "--trials", "3", "--attention", "xla"],
+        3000,
+    ),
+    ("inference llama-1b", ["--mode", "inference", "--model", "llama-1b"], 1800),
+    ("inference gptj-6b", ["--mode", "inference", "--model", "gptj-6b"], 2700),
+]
+
+
+def main():
+    out_path = "bench_suite_r04.jsonl"
+    results = []
+    for tag, argv, timeout_s in CONFIGS:
+        cmd = [sys.executable, "bench.py", "--no-supervise"] + argv
+        print(f"[suite] {tag}: {' '.join(cmd)}", file=sys.stderr, flush=True)
+        t0 = time.time()
+        try:
+            proc = subprocess.run(cmd, timeout=timeout_s, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            print(f"[suite] {tag}: TIMEOUT >{timeout_s}s", file=sys.stderr, flush=True)
+            results.append({"tag": tag, "error": f"timeout>{timeout_s}s"})
+            continue
+        line = None
+        for out_line in (proc.stdout or "").strip().splitlines():
+            try:
+                parsed = json.loads(out_line)
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    line = parsed
+            except json.JSONDecodeError:
+                continue
+        if proc.returncode != 0 or line is None:
+            print(
+                f"[suite] {tag}: FAILED rc={proc.returncode}; stderr tail: "
+                f"{(proc.stderr or '')[-600:]!r}",
+                file=sys.stderr,
+                flush=True,
+            )
+            results.append({"tag": tag, "error": f"rc={proc.returncode}"})
+            continue
+        line["tag"] = tag
+        line["wall_s"] = round(time.time() - t0, 1)
+        results.append(line)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+        print(f"[suite] {tag}: {json.dumps(line)}", flush=True)
+    ok = sum(1 for r in results if "error" not in r)
+    print(f"[suite] done: {ok}/{len(CONFIGS)} configs captured -> {out_path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
