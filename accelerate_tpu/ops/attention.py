@@ -100,6 +100,7 @@ def dot_product_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     implementation: Optional[str] = None,
+    segment_ids=None,
 ):
     """Multi-head (optionally grouped-query) scaled dot-product attention.
 
@@ -113,6 +114,10 @@ def dot_product_attention(
         causal: apply a causal mask.
         scale: defaults to 1/sqrt(D).
         implementation: force "xla" (default) — the seam where flash/ring kernels hook in.
+        segment_ids: optional [B, S] int ids for packed sequences (requires
+            Sq == Skv); attention is restricted to equal ids. Unlike `mask`, this
+            RIDES the sequence-parallel dispatch — the ring rotates the id blocks
+            — so packed long-context batches still run distributed.
     """
     import jax.numpy as jnp
 
@@ -134,6 +139,9 @@ def dot_product_attention(
     if hq % hkv != 0:
         raise ValueError(f"GQA requires query heads ({hq}) divisible by kv heads ({hkv})")
 
+    if segment_ids is not None and sq != skv:
+        raise ValueError(f"segment_ids requires Sq == Skv (self-attention packing), got ({sq}, {skv})")
+
     # Sequence-parallel dispatch happens BEFORE GQA expansion so the ring rotates the
     # small hkv-sized K/V blocks (expansion is done per-block inside the ring).
     global LAST_DISPATCH
@@ -143,7 +151,9 @@ def dot_product_attention(
             from ..parallel.ring_attention import sequence_parallel_attention
 
             mesh, mode = impl
-            out = sequence_parallel_attention(q, k, v, mesh=mesh, causal=causal, scale=scale, mode=mode)
+            out = sequence_parallel_attention(
+                q, k, v, mesh=mesh, causal=causal, scale=scale, mode=mode, segment_ids=segment_ids
+            )
             # Record AFTER the call: allgather mode re-enters this function with
             # implementation="xla" internally, which would overwrite the record.
             LAST_DISPATCH = mode
@@ -151,15 +161,23 @@ def dot_product_attention(
 
     # Flash kernel: explicit, or automatic on TPU for long unmasked sequences where
     # the [S,S] score materialization would dominate HBM traffic.
-    if implementation == "flash" and (bias is not None or mask is not None):
-        blocked = "bias" if bias is not None else "mask"
+    if implementation == "flash" and (bias is not None or mask is not None or segment_ids is not None):
+        blocked = "bias" if bias is not None else ("mask" if mask is not None else "segment_ids")
         raise ValueError(
             f"implementation='flash' cannot honor a {blocked} argument — the Pallas "
             "kernel threads only `causal`. Drop implementation= to let the dispatcher "
             "pick the XLA path, or pass implementation='xla'."
         )
     use_flash = implementation == "flash"
-    if implementation is None and mask is None and bias is None and sq >= 1024 and sq % 128 == 0 and skv % 128 == 0:
+    if (
+        implementation is None
+        and mask is None
+        and bias is None
+        and segment_ids is None
+        and sq >= 1024
+        and sq % 128 == 0
+        and skv % 128 == 0
+    ):
         import jax
 
         use_flash = jax.default_backend() == "tpu"
@@ -189,6 +207,10 @@ def dot_product_attention(
         if mask.ndim == 2:  # [B, Skv] padding mask
             mask = mask[:, None, None, :]
         scores = jnp.where(mask.astype(bool), scores, neg)
+    if segment_ids is not None:
+        from ..parallel.ring_attention import segment_mask
+
+        scores = jnp.where(segment_mask(segment_ids, segment_ids), scores, neg)
     # Softmax in fp32 for stability under bf16 compute.
     probs = jnp.asarray(
         jnp.exp(

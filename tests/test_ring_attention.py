@@ -117,3 +117,145 @@ def test_sequence_parallel_training_step():
             popt.step()
             popt.zero_grad()
     assert np.isfinite(float(loss))
+
+
+# ------------------------------------------------------------- segment-id masking
+@pytest.mark.parametrize("mode", ["ring", "allgather"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_segment_ids_match_dense(mode, causal):
+    """Packed-sequence masking must ride the sequence-parallel path (round-3
+    verdict: masked variants used to silently fall back) and equal the dense
+    segment-masked reference."""
+    mesh = build_mesh(ParallelismConfig(data=2, seq=4))
+    q, k, v = _qkv(s=32)
+    rng = np.random.default_rng(3)
+    # 2-4 packed segments per row, contiguous (sorted) ids
+    seg = np.sort(rng.integers(0, 3, size=(2, 32)), axis=1).astype(np.int32)
+    seg = jnp.asarray(seg)
+    dense = dot_product_attention(q, k, v, causal=causal, implementation="xla", segment_ids=seg)
+    ring = sequence_parallel_attention(
+        q, k, v, mesh=mesh, causal=causal, mode=mode, segment_ids=seg
+    )
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_segment_ids_grads_match_dense():
+    mesh = build_mesh(ParallelismConfig(data=2, seq=4))
+    q, k, v = _qkv(s=16)
+    seg = jnp.asarray(np.repeat([[0, 1]], 2, axis=0).repeat(8, axis=1))  # two segments
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            sequence_parallel_attention(q, k, v, mesh=mesh, causal=True, segment_ids=seg) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            dot_product_attention(q, k, v, causal=True, implementation="xla", segment_ids=seg) ** 2
+        )
+
+    g_ring = jax.jit(jax.grad(loss_ring))(q, k, v)
+    g_dense = jax.grad(loss_dense)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense), rtol=1e-4, atol=1e-4)
+
+
+def test_segment_ids_dispatch_through_model_seam():
+    """dot_product_attention with segment_ids on a seq mesh must dispatch to the
+    ring (LAST_DISPATCH), not silently fall back to dense."""
+    from accelerate_tpu.ops import attention as attn_mod
+    from accelerate_tpu.state import AcceleratorState
+
+    mesh = build_mesh(ParallelismConfig(data=2, seq=4))
+    AcceleratorState._shared_state["_mesh"] = mesh
+    try:
+        q, k, v = _qkv(s=32)
+        seg = jnp.asarray(np.zeros((2, 32), np.int32))
+        out = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+        assert attn_mod.LAST_DISPATCH == "ring", attn_mod.LAST_DISPATCH
+        dense = dot_product_attention(q, k, v, causal=True, implementation="xla", segment_ids=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(dense), rtol=2e-5, atol=2e-5)
+    finally:
+        AcceleratorState._reset_state()
+
+
+# ------------------------------------------------------------- flash-through ring
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_dense(causal):
+    """The flash-through ring (Pallas per-block kernels + lse combine) must equal
+    dense attention — forward."""
+    mesh = build_mesh(ParallelismConfig(data=2, seq=4))
+    q, k, v = _qkv(s=64)
+    dense = dot_product_attention(q, k, v, causal=causal, implementation="xla")
+    ring = sequence_parallel_attention(q, k, v, mesh=mesh, causal=causal, use_flash=True)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_gqa_matches_dense():
+    mesh = build_mesh(ParallelismConfig(data=2, seq=4))
+    q, k, v = _qkv(s=64, h=4, hkv=2)
+    dense = dot_product_attention(q, k, v, causal=True, implementation="xla")
+    ring = sequence_parallel_attention(q, k, v, mesh=mesh, causal=True, use_flash=True)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_grads_match_dense(causal):
+    """The custom-VJP ring backward (per-block flash bwd against the global lse,
+    dk/dv rotating home) must equal dense-attention gradients."""
+    mesh = build_mesh(ParallelismConfig(data=2, seq=4))
+    q, k, v = _qkv(s=32, h=2, d=16)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            sequence_parallel_attention(q, k, v, mesh=mesh, causal=causal, use_flash=True) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=causal, implementation="xla") ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_flash_at_128_aligned_locals_matches_dense():
+    """Forced flash-through at real (128-aligned) local lengths matches dense.
+    (Auto-dispatch additionally requires a TPU backend — on CPU the interpret-mode
+    kernel would be slower than the einsum ring, so auto stays einsum here.)"""
+    mesh = build_mesh(ParallelismConfig(data=1, seq=8))
+    q, k, v = _qkv(b=1, s=1024, h=1, d=8, seed=9)
+    dense = dot_product_attention(q, k, v, causal=True, implementation="xla")
+    ring = sequence_parallel_attention(q, k, v, mesh=mesh, causal=True, use_flash=True)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), rtol=2e-5, atol=2e-5)
+
+
+def test_use_flash_with_allgather_mode_rejected():
+    mesh = build_mesh(ParallelismConfig(data=2, seq=4))
+    q, k, v = _qkv(s=32)
+    with pytest.raises(ValueError, match="mode='ring'"):
+        sequence_parallel_attention(q, k, v, mesh=mesh, mode="allgather", use_flash=True)
+
+
+def test_long_context_8k_ring_correctness():
+    """Long-context correctness at 8k tokens over an 8-way virtual seq axis: the
+    einsum ring (segment-masked) and the dense reference agree. Small head dims
+    keep the dense reference feasible on the CPU host."""
+    mesh = build_mesh(ParallelismConfig(data=1, seq=8))
+    rng = np.random.default_rng(0)
+    s = 8192
+    q = jnp.asarray(rng.normal(size=(1, s, 1, 8)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, s, 1, 8)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(1, s, 1, 8)).astype(np.float32))
+    seg = jnp.asarray(np.sort(rng.integers(0, 4, size=(1, s)), axis=1).astype(np.int32))
+    dense = dot_product_attention(q, k, v, causal=True, implementation="xla", segment_ids=seg)
+    ring = sequence_parallel_attention(q, k, v, mesh=mesh, causal=True, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), rtol=3e-5, atol=3e-5)
+
+
+def test_use_flash_with_segments_rejected():
+    mesh = build_mesh(ParallelismConfig(data=2, seq=4))
+    q, k, v = _qkv(s=32)
+    seg = jnp.asarray(np.zeros((2, 32), np.int32))
+    with pytest.raises(ValueError, match="use_flash"):
+        sequence_parallel_attention(q, k, v, mesh=mesh, segment_ids=seg, use_flash=True)
