@@ -185,11 +185,7 @@ def _ring_flash_fwd_impl(qt, kt, vt, axis_name, causal, scale, block_q, block_k,
     return o_acc, lse_acc
 
 
-import jax as _jax
-
-
-@functools.partial(_jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring_flash(qt, kt, vt, axis_name, causal, scale, block_q, block_k, interpret):
+def _ring_flash_primal(qt, kt, vt, axis_name, causal, scale, block_q, block_k, interpret):
     out, _ = _ring_flash_fwd_impl(qt, kt, vt, axis_name, causal, scale, block_q, block_k, interpret)
     return out
 
@@ -260,7 +256,20 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k, interpret, r
     return dq_acc.astype(qt.dtype), dk_cur.astype(kt.dtype), dv_cur.astype(vt.dtype)
 
 
-_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+_RING_FLASH = None
+
+
+def _get_ring_flash():
+    """Build the custom-VJP wrapper on first use (keeps module import jax-free,
+    matching the file's lazy-import convention)."""
+    global _RING_FLASH
+    if _RING_FLASH is None:
+        import jax
+
+        fn = jax.custom_vjp(_ring_flash_primal, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+        fn.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+        _RING_FLASH = fn
+    return _RING_FLASH
 
 
 def ring_flash_attention(
@@ -300,7 +309,7 @@ def ring_flash_attention(
     qt = q.transpose(0, 2, 1, 3).reshape(b * hq, s, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * hq, skv, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * hq, skv, d)
-    out = _ring_flash(qt, kt, vt, axis_name, bool(causal), float(scale), block_q, block_k, interpret)
+    out = _get_ring_flash()(qt, kt, vt, axis_name, bool(causal), float(scale), block_q, block_k, interpret)
     return out.reshape(b, hq, s, d).transpose(0, 2, 1, 3).astype(q.dtype)
 
 
@@ -415,15 +424,18 @@ def sequence_parallel_attention(
         use_flash = False
 
     if mode == "ring" and use_flash:
-        # check_vma off: pallas_call inside shard_map can't annotate its outputs'
-        # varying-mesh-axes; correctness is covered by the parity tests.
-        fn = shard_map(
-            functools.partial(ring_flash_attention, axis_name=seq_axis, causal=causal, scale=scale),
-            mesh=mesh,
-            in_specs=(q_spec, kv_spec, kv_spec),
-            out_specs=q_spec,
-            check_vma=False,
+        # Varying-mesh-axes checking off: pallas_call inside shard_map can't
+        # annotate its outputs; correctness is covered by the parity tests. The
+        # kwarg is check_vma on current jax, check_rep on the older experimental
+        # shard_map the import fallback serves.
+        inner_flash = functools.partial(
+            ring_flash_attention, axis_name=seq_axis, causal=causal, scale=scale
         )
+        smap = dict(mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec)
+        try:
+            fn = shard_map(inner_flash, check_vma=False, **smap)
+        except TypeError:
+            fn = shard_map(inner_flash, check_rep=False, **smap)
         return fn(q, k, v)
 
     inner = ring_attention if mode == "ring" else allgather_attention
