@@ -101,6 +101,8 @@ def build_launch_env(args, config: dict) -> dict:
             "param_dtype": "PARAM_DTYPE",
             "reduce_dtype": "REDUCE_DTYPE",
             "sync_module_states": "SYNC_MODULE_STATES",
+            "offload_optimizer_device": "OFFLOAD_OPTIMIZER_DEVICE",
+            "offload_dir": "OFFLOAD_DIR",
         }
         for key, suffix in mapping.items():
             if key in fsdp_cfg and fsdp_cfg[key] is not None:

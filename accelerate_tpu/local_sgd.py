@@ -114,6 +114,15 @@ class LocalSGD:
 
         opt = self._bound_optimizer()
         if opt is not None and opt.opt_state is not None:
+            from .optimizer import DiskOptState
+
+            if isinstance(opt.opt_state, DiskOptState):
+                raise NotImplementedError(
+                    "LocalSGD stacks a replica axis into device-resident optimizer "
+                    "state; offload_optimizer_device='disk' keeps that state on disk. "
+                    "Use the pinned-host tier (offload_optimizer_state=True) or no "
+                    "offload with LocalSGD."
+                )
             from jax.sharding import NamedSharding, PartitionSpec
 
             # Moments mirror params and get the replica axis; SCALAR leaves (step

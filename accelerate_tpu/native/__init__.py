@@ -81,6 +81,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.atl_store_read.restype = c.c_int
     lib.atl_store_prefetch.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int64, c.c_void_p]
     lib.atl_store_prefetch.restype = c.c_int64
+    lib.atl_store_read_many.argtypes = [
+        c.c_void_p,
+        c.c_void_p,
+        c.c_int64,
+        c.POINTER(c.c_int64),
+        c.POINTER(c.c_int64),
+        c.POINTER(c.c_void_p),
+        c.POINTER(c.c_int32),
+    ]
+    lib.atl_store_read_many.restype = c.c_int64
     return lib
 
 

@@ -22,6 +22,10 @@ class NativeOffloadStore:
     INDEX_NAME = "index.json"
     BLOB_NAME = "weights.bin"
 
+    # Single-chunk reads (below the C++ stripe floor) run inline on the calling
+    # thread: the pool adds only wakeup latency for them (~1ms on a busy host).
+    INLINE_READ_BYTES = 8 << 20
+
     def __init__(self, directory: str, num_threads: int = 4):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
@@ -34,13 +38,25 @@ class NativeOffloadStore:
         from . import load_library
 
         self.lib = load_library()
+        # More workers than cores is pure context-switch overhead (pread from the
+        # page cache is CPU/memory-bandwidth work, not blocking I/O waits).
+        num_threads = max(1, min(int(num_threads), os.cpu_count() or 1))
         self._pool = self.lib.atl_pool_create(int(num_threads)) if self.lib else None
+        self._read_fd: Optional[int] = None
+        # Readahead needs a core for the worker to run on; on a 1-core host a
+        # background read cannot overlap anything and just adds handoffs, so
+        # group prefetch degrades to (fast) inline reads at read() time.
+        self._allow_prefetch = (os.cpu_count() or 1) > 1
         self._store = None
         self._tickets: Dict[str, tuple] = {}
 
     # -- write --------------------------------------------------------------------
-    def save(self, tensors: Dict[str, np.ndarray]):
-        """Append tensors to the blob and update the index."""
+    def save(self, tensors: Dict[str, np.ndarray], flush_index: bool = True):
+        """Append tensors to the blob and update the index.
+
+        Callers spilling many tensors one at a time (to bound host RAM) pass
+        `flush_index=False` and call `flush_index()` once at the end — the index
+        rewrite is O(total tensors), so flushing per call would be O(n²)."""
         self._close_store()
         mode = "ab" if os.path.exists(self.blob_path) else "wb"
         with open(self.blob_path, mode) as f:
@@ -53,8 +69,52 @@ class NativeOffloadStore:
                     "shape": list(arr.shape),
                     "dtype": str(arr.dtype),
                 }
+        if flush_index:
+            self.flush_index()
+
+    def flush_index(self):
         with open(self.index_path, "w") as f:
             json.dump(self.index, f)
+
+    def reset(self):
+        """Start a fresh blob, discarding any existing contents in the directory.
+
+        Writers that re-create a store over an existing directory (re-dispatching
+        a model, optimizer re-init) must start clean: `save`'s append-then-repoint
+        layout would orphan the old bytes and grow the blob by a full copy per run."""
+        self._close_store()
+        for path in (self.blob_path, self.index_path):
+            if os.path.exists(path):
+                os.unlink(path)
+        if self._read_fd is not None:
+            os.close(self._read_fd)
+            self._read_fd = None
+        self.index = {}
+
+    def write(self, name: str, arr: np.ndarray):
+        """In-place update of an existing tensor (same byte size), else append.
+
+        The update path that makes the store usable for MUTABLE state (the disk
+        optimizer tier rewrites every group each step — `save`'s append-only
+        layout would grow the blob without bound)."""
+        arr = np.ascontiguousarray(arr)
+        meta = self.index.get(name)
+        if meta is not None:
+            shape = tuple(meta["shape"])
+            dtype = np.dtype(meta["dtype"])
+            nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+            if arr.nbytes == nbytes:
+                # Same slot: overwrite bytes at the recorded offset. Readers use
+                # pread on the same file, so subsequent reads see the new data.
+                with open(self.blob_path, "r+b") as f:
+                    f.seek(meta["offset"])
+                    f.write(arr.tobytes())
+                if list(arr.shape) != meta["shape"] or str(arr.dtype) != meta["dtype"]:
+                    meta["shape"], meta["dtype"] = list(arr.shape), str(arr.dtype)
+                    with open(self.index_path, "w") as f:
+                        json.dump(self.index, f)
+                return
+        self.save({name: arr})
 
     # -- read ---------------------------------------------------------------------
     def _open_store(self):
@@ -80,21 +140,35 @@ class NativeOffloadStore:
         nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
         return meta["offset"], shape, dtype, nbytes
 
+    def _pread_into(self, out: np.ndarray, offset: int, nbytes: int):
+        """Inline positional read on the calling thread (no pool handoff)."""
+        if self._read_fd is None:
+            self._read_fd = os.open(self.blob_path, os.O_RDONLY)
+        view = memoryview(out.reshape(-1).view(np.uint8))
+        done = 0
+        while done < nbytes:
+            got = os.preadv(self._read_fd, [view[done:nbytes]], offset + done)
+            if got <= 0:
+                raise IOError(f"short read at {offset + done} in {self.blob_path}")
+            done += got
+
     def read(self, name: str) -> np.ndarray:
         """Blocking read; consumes a pending prefetch for `name` when one exists."""
         if name in self._tickets:
-            ticket, out = self._tickets.pop(name)
+            ticket, out, *group = self._tickets.pop(name)
             rc = self.lib.atl_wait_status(self._pool, ticket)
+            if group:  # shared group ticket: this region's own status governs
+                statuses, i = group
+                rc = int(statuses[i])
             if rc != 0:
                 raise IOError(f"prefetch read failed for {name!r} in {self.blob_path}")
             return out
         offset, shape, dtype, nbytes = self._meta(name)
-        store = self._open_store()
-        if store is None:
-            with open(self.blob_path, "rb") as f:
-                f.seek(offset)
-                return np.frombuffer(f.read(nbytes), dtype=dtype).reshape(shape).copy()
         out = np.empty(shape, dtype=dtype)
+        store = self._open_store()
+        if store is None or nbytes <= self.INLINE_READ_BYTES:
+            self._pread_into(out, offset, nbytes)
+            return out
         rc = self.lib.atl_store_read(
             self._pool, store, offset, nbytes, out.ctypes.data_as(__import__("ctypes").c_void_p)
         )
@@ -116,11 +190,47 @@ class NativeOffloadStore:
         )
         self._tickets[name] = (ticket, out)
 
+    def prefetch_many(self, names):
+        """Async readahead of a whole group under ONE pool ticket.
+
+        One queue handoff per layer/parameter-group instead of one per tensor —
+        per-ticket submission latency dominates small-tensor readahead on a busy
+        host. No-op without the native lib; names already in flight are skipped."""
+        store = self._open_store() if self._allow_prefetch else None
+        names = [n for n in names if n not in self._tickets] if store is not None else []
+        if not names:
+            return
+        import ctypes
+
+        n = len(names)
+        offsets = (ctypes.c_int64 * n)()
+        sizes = (ctypes.c_int64 * n)()
+        dsts = (ctypes.c_void_p * n)()
+        statuses = np.full(n, -2, np.int32)
+        outs = []
+        for i, name in enumerate(names):
+            offset, shape, dtype, nbytes = self._meta(name)
+            out = np.empty(shape, dtype=dtype)
+            outs.append(out)
+            offsets[i], sizes[i] = offset, nbytes
+            dsts[i] = out.ctypes.data_as(ctypes.c_void_p)
+        ticket = self.lib.atl_store_read_many(
+            self._pool, store, n, offsets, sizes, dsts,
+            statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        for i, name in enumerate(names):
+            # The ticket is shared; the per-region status array keeps failures
+            # attributable after the first wait has consumed the ticket.
+            self._tickets[name] = (ticket, outs[i], statuses, i)
+
     def close(self):
-        for name, (ticket, _out) in list(self._tickets.items()):
-            self.lib.atl_wait(self._pool, ticket)
+        for entry in list(self._tickets.values()):
+            self.lib.atl_wait(self._pool, entry[0])
         self._tickets.clear()
         self._close_store()
+        if self._read_fd is not None:
+            os.close(self._read_fd)
+            self._read_fd = None
         if self._pool is not None:
             self.lib.atl_pool_destroy(self._pool)
             self._pool = None

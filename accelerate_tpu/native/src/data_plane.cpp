@@ -16,6 +16,7 @@
 //
 // Everything is plain C++17 + POSIX; built with `g++ -O3 -shared -fPIC -pthread`.
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -246,7 +247,14 @@ int64_t atl_store_prefetch(void* pool, void* store, int64_t offset,
   Pool* p = static_cast<Pool*>(pool);
   Store* s = static_cast<Store*>(store);
   std::vector<std::function<int()>> tasks;
-  for (auto [start, count] : Chunks(nbytes, p->size())) {
+  // Stripe only past a floor (8 MiB per subtask): layer streaming prefetches
+  // many modest tensors at once, and splitting each of those 8 ways just
+  // multiplies queue/lock traffic — their parallelism comes from the tensors
+  // already being concurrent tickets. A single huge read still stripes.
+  constexpr int64_t kMinStripe = int64_t(8) << 20;
+  int shards = static_cast<int>(
+      std::min<int64_t>(p->size(), (nbytes + kMinStripe - 1) / kMinStripe));
+  for (auto [start, count] : Chunks(nbytes, shards < 1 ? 1 : shards)) {
     tasks.push_back([=] {
       int64_t done = 0;
       while (done < count) {
@@ -257,6 +265,50 @@ int64_t atl_store_prefetch(void* pool, void* store, int64_t offset,
         done += got;
       }
       return 0;
+    });
+  }
+  return p->Submit(std::move(tasks));
+}
+
+// Group readahead: read n regions under ONE ticket (one queue handoff for a
+// whole layer/parameter-group instead of one per tensor — the handoff, not the
+// pread, is what costs on a busy host). Regions are distributed round-robin
+// across up to pool-size subtasks; statuses[i] (caller-owned, length n) is
+// written 0/-1 per region and outlives the ticket, so a failure is still
+// attributable after the shared ticket has been waited on once.
+int64_t atl_store_read_many(void* pool, void* store, int64_t n,
+                            const int64_t* offsets, const int64_t* nbytes,
+                            void** dsts, int32_t* statuses) {
+  Pool* p = static_cast<Pool*>(pool);
+  Store* s = static_cast<Store*>(store);
+  int shards = std::max(1, std::min<int>(p->size(), static_cast<int>(n)));
+  // Copy the region tables: the caller's arrays need not outlive this call
+  // (the Python binding builds them as temporaries); `statuses` and the
+  // destination buffers are caller-owned and must stay alive until the wait.
+  auto offs = std::make_shared<std::vector<int64_t>>(offsets, offsets + n);
+  auto sizes = std::make_shared<std::vector<int64_t>>(nbytes, nbytes + n);
+  auto outs = std::make_shared<std::vector<void*>>(dsts, dsts + n);
+  std::vector<std::function<int()>> tasks;
+  for (int w = 0; w < shards; ++w) {
+    tasks.push_back([=] {
+      int bad = 0;
+      for (int64_t i = w; i < n; i += shards) {
+        int64_t done = 0;
+        int32_t st = 0;
+        while (done < (*sizes)[i]) {
+          ssize_t got = ::pread(s->fd, static_cast<char*>((*outs)[i]) + done,
+                                static_cast<size_t>((*sizes)[i] - done),
+                                (*offs)[i] + done);
+          if (got <= 0) {
+            st = -1;
+            break;
+          }
+          done += got;
+        }
+        statuses[i] = st;
+        if (st != 0) bad = 1;
+      }
+      return bad ? -1 : 0;
     });
   }
   return p->Submit(std::move(tasks));

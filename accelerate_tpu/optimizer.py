@@ -139,6 +139,88 @@ def apply_update_core(
     return new_params, new_opt_state, finite
 
 
+class DiskOptState:
+    """Optimizer state resident on DISK — the NVMe tier of ZeRO-offload
+    (reference DeepSpeed fields dataclasses.py:704-719).
+
+    Param-shaped slots (Adam moments, ...) live in one NativeOffloadStore blob
+    keyed "slot{i}/{param_path}"; shared scalar slots (step counts,
+    hyperparams) stay in memory. The chunked update loop async-prefetches group
+    N+1 while group N's program runs and writes results back in place, so peak
+    HBM *and* host RSS stay at one parameter group."""
+
+    def __init__(self, store, state_def, slot_is_param, scalars, param_paths, decompose, recompose):
+        self.store = store
+        self.state_def = state_def
+        self.slot_is_param = slot_is_param
+        self.scalars = scalars
+        self.param_paths = param_paths
+        self._decompose = decompose
+        self._recompose = recompose
+        # A step that failed after some groups' write-backs leaves the blob
+        # partially advanced relative to the params (the in-memory tier only
+        # commits after the whole loop). Poison the state so a retry fails loudly
+        # instead of silently double-applying moment updates; load() clears it.
+        self.poisoned = False
+
+    def check_usable(self):
+        if self.poisoned:
+            raise RuntimeError(
+                "disk optimizer state is inconsistent: a previous step failed after "
+                "some parameter groups were written back. Restore with load_state() "
+                "(or rebuild the optimizer) before continuing."
+            )
+
+    def prefetch_group(self, paths):
+        self.store.prefetch_many(
+            [f"slot{i}/{p}" for i, is_p in enumerate(self.slot_is_param) if is_p for p in paths]
+        )
+
+    def read_group(self, paths, scalars=None):
+        """Group state pytree; `scalars` overrides the in-memory slot values (the
+        chunked loop passes a pre-step snapshot so every group sees the ORIGINAL
+        shared scalars, not a prior group's increment)."""
+        scalars = self.scalars if scalars is None else scalars
+        vals = []
+        for i, is_p in enumerate(self.slot_is_param):
+            if is_p:
+                vals.append({p: self.store.read(f"slot{i}/{p}") for p in paths})
+            else:
+                vals.append(scalars[i])
+        return self.state_def.unflatten(vals)
+
+    def write_group(self, paths, new_group_state):
+        import jax
+
+        for i, val in enumerate(self.state_def.flatten_up_to(new_group_state)):
+            if self.slot_is_param[i]:
+                for p in paths:
+                    self.store.write(f"slot{i}/{p}", np.asarray(jax.device_get(val[p])))
+            else:
+                self.scalars[i] = val
+
+    def materialize(self):
+        """Full state pytree on host (checkpointing; costs one pass over the blob)."""
+        slots = [
+            {p: self.store.read(f"slot{i}/{p}") for p in self.param_paths} if is_p else self.scalars[i]
+            for i, is_p in enumerate(self.slot_is_param)
+        ]
+        return self._recompose(slots, self.state_def)
+
+    def load(self, full_state):
+        """Overwrite the blob from a full state pytree (checkpoint restore)."""
+        import jax
+
+        slots, _ = self._decompose(full_state)
+        for i, slot in enumerate(slots):
+            if self.slot_is_param[i]:
+                for p, arr in slot.items():
+                    self.store.write(f"slot{i}/{p}", np.asarray(jax.device_get(arr)))
+            else:
+                self.scalars[i] = slot
+        self.poisoned = False
+
+
 class AcceleratedOptimizer:
     """Wraps an `optax.GradientTransformation` bound to a `PreparedModel`
     (reference AcceleratedOptimizer optimizer.py:38).
@@ -183,14 +265,27 @@ class AcceleratedOptimizer:
             if mesh is not None:
                 state_shapes = jax.eval_shape(self.tx.init, model.params)
                 self.opt_state_sharding = derive_opt_state_shardings(state_shapes, mesh, fsdp_plugin, rules)
-                want_offload = bool(getattr(fsdp_plugin, "offload_optimizer_state", False))
+                offload_device = str(getattr(fsdp_plugin, "offload_optimizer_device", None) or "").lower()
+                want_disk = offload_device in ("disk", "nvme")
+                want_offload = bool(getattr(fsdp_plugin, "offload_optimizer_state", False)) and not want_disk
                 if want_offload and not host_memory_available():
                     logger.warning(
                         "offload_optimizer_state requested but this backend exposes no "
                         "pinned_host memory space; optimizer state stays in device memory."
                     )
                     want_offload = False
-                if want_offload:
+                if want_disk:
+                    # NVMe tier: needs no pinned_host memory space — staging runs
+                    # through host numpy around each group program.
+                    import tempfile
+
+                    directory = getattr(fsdp_plugin, "offload_dir", None) or tempfile.mkdtemp(
+                        prefix="accelerate_tpu_optstate_"
+                    )
+                    self.offload_opt_state = True
+                    self._opt_compute_sharding = self.opt_state_sharding
+                    self.opt_state = self._disk_offload_init(model.params, state_shapes, directory)
+                elif want_offload:
                     # ZeRO-offload tier (reference accelerator.py:1563-1785,
                     # dataclasses.py:704-719): optimizer state lives in pinned host
                     # memory; updates stream it through HBM one param GROUP at a
@@ -240,6 +335,56 @@ class AcceleratedOptimizer:
     # The reference reaches the same place with DeepSpeed's CPU-Adam
     # (accelerator.py:1563-1785); here each group program is still an XLA program
     # with the streaming H2D/D2H on the program boundary.
+
+    # ---- disk (NVMe) tier ------------------------------------------------------------
+    def _disk_offload_init(self, params, state_shapes, directory):
+        """Build the DISK-resident optimizer state (DeepSpeed NVMe-offload parity,
+        reference dataclasses.py:704-719): per-group tx.init on device -> host ->
+        one NativeOffloadStore blob; shared scalars (step counts, hyperparams)
+        stay in memory. Neither HBM nor host RSS ever holds more than one group."""
+        import jax
+
+        from .native.offload import NativeOffloadStore
+        from .parallel.sharding import tree_paths_and_leaves
+
+        logger.warning_once(
+            "offload_optimizer_device=disk: optimizer state lives in %s and updates "
+            "run per parameter group (chunked streaming with async prefetch). "
+            "Optax transforms needing cross-parameter statistics would compute them "
+            "per group; use max_grad_norm / clip_grad_norm_ for global clipping.",
+            directory,
+        )
+        groups = self._offload_groups(params)
+        self._jit_cache["chunk_groups"] = groups
+        self._jit_cache["chunk_slicer"] = self._state_slicer(params)
+        chunker = self._state_chunker(params)
+        self._jit_cache["chunk_chunker"] = chunker
+        decompose, _group_state, _absorb, recompose = chunker
+        slots_shapes, state_def = decompose(state_shapes)
+        slot_is_param = [isinstance(s, dict) for s in slots_shapes]
+        flat_params = dict(tree_paths_and_leaves(params)[0])
+
+        store = NativeOffloadStore(directory)
+        # Fresh state, fresh blob: a leftover store from a previous run holds
+        # stale entries whose bytes would be orphaned by the append-then-repoint
+        # save(), growing the blob by a full state copy per restart.
+        store.reset()
+        scalars = [None] * len(slots_shapes)
+        for paths in groups:
+            p_g = {p: flat_params[p] for p in paths}
+            s_g = jax.jit(self.tx.init)(p_g)
+            for i, val in enumerate(state_def.flatten_up_to(s_g)):
+                if slot_is_param[i]:
+                    store.save(
+                        {f"slot{i}/{p}": np.asarray(jax.device_get(a)) for p, a in val.items()},
+                        flush_index=False,
+                    )
+                else:
+                    scalars[i] = val
+            del s_g  # one group of device state at a time
+        store.flush_index()
+        all_paths = [p for g in groups for p in g]
+        return DiskOptState(store, state_def, slot_is_param, scalars, all_paths, decompose, recompose)
 
     def _offload_groups(self, params):
         """Partition param leaf-paths into groups under a byte budget."""
@@ -442,12 +587,28 @@ class AcceleratedOptimizer:
                 finite = self._jit_cache["chunk_finite"](grads, jnp.asarray(float(inv_scale), jnp.float32))
 
         new_flat = dict(flat_params)
-        state_slots, state_def = decompose(self.opt_state)
-        # Reads come from state_slots (every group's update must see the ORIGINAL
-        # shared scalars — e.g. Adam's count — not a prior group's increment);
-        # writes land in out_slots. Param-slot dicts are shared objects, which is
-        # safe: groups touch disjoint path sets.
-        out_slots = list(state_slots)
+        disk_state = self.opt_state if isinstance(self.opt_state, DiskOptState) else None
+        if disk_state is None:
+            state_slots, state_def = decompose(self.opt_state)
+            # Reads come from state_slots (every group's update must see the ORIGINAL
+            # shared scalars — e.g. Adam's count — not a prior group's increment);
+            # writes land in out_slots. Param-slot dicts are shared objects, which is
+            # safe: groups touch disjoint path sets.
+            out_slots = list(state_slots)
+        else:
+            disk_state.check_usable()
+            # Same original-scalars contract for the disk tier: snapshot the
+            # in-memory scalar slots before any group writes its increment back.
+            scalar_snapshot = list(disk_state.scalars)
+            disk_state.prefetch_group(groups[0])
+            if "disk_writer" not in self._jit_cache:
+                import concurrent.futures
+
+                self._jit_cache["disk_writer"] = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="optstate-writeback"
+                )
+            writer = self._jit_cache["disk_writer"]
+            write_futures = []
         # Scalars change rarely: cache their device buffers (same rationale as the
         # fused step's _scalar_bufs — no per-step H2D for constants).
         skey = (float(inv_scale), float(lr_override) if with_lr else 0.0)
@@ -455,6 +616,87 @@ class AcceleratedOptimizer:
             self._jit_cache["chunk_scalar_key"] = skey
             self._jit_cache["chunk_scalar_bufs"] = tuple(jnp.asarray(v, jnp.float32) for v in skey)
         inv_buf, lr_val = self._jit_cache["chunk_scalar_bufs"]
+        try:
+            new_params, finite = self._chunked_group_loop(
+                groups,
+                slice_state,
+                group_state,
+                absorb,
+                recompose,
+                disk_state=disk_state,
+                flat_params=flat_params,
+                flat_grads=flat_grads,
+                params_offloaded=params_offloaded,
+                p_compute_flat=p_compute_flat,
+                p_storage_flat=p_storage_flat,
+                inv_buf=inv_buf,
+                lr_val=lr_val,
+                finite=finite,
+                with_lr=with_lr,
+                use_scaler=use_scaler,
+                new_flat=new_flat,
+                state_slots=None if disk_state is not None else state_slots,
+                state_def=None if disk_state is not None else state_def,
+                out_slots=None if disk_state is not None else out_slots,
+                scalar_snapshot=None if disk_state is None else scalar_snapshot,
+                writer=None if disk_state is None else writer,
+                write_futures=None if disk_state is None else write_futures,
+                params_treedef=params_treedef,
+                param_paths=param_paths,
+            )
+        except BaseException:
+            # Group programs donate the grad buffers, so whatever accumulation
+            # produced them is dead — drop it so the next backward starts fresh.
+            self._grads = None
+            self._accum_count = 0
+            self._grads_unscaled = False
+            if disk_state is not None:
+                # Some groups' moment write-backs may already have landed while
+                # the params were never assigned — the blob is now ahead of the
+                # params. Poison so a blind retry fails loudly (load_state clears).
+                for fut in write_futures:
+                    try:
+                        fut.result()
+                    except Exception:
+                        pass
+                disk_state.poisoned = True
+            raise
+        return new_params, finite
+
+    def _chunked_group_loop(
+        self,
+        groups,
+        slice_state,
+        group_state,
+        absorb,
+        recompose,
+        *,
+        disk_state,
+        flat_params,
+        flat_grads,
+        params_offloaded,
+        p_compute_flat,
+        p_storage_flat,
+        inv_buf,
+        lr_val,
+        finite,
+        with_lr,
+        use_scaler,
+        new_flat,
+        state_slots,
+        state_def,
+        out_slots,
+        scalar_snapshot,
+        writer,
+        write_futures,
+        params_treedef,
+        param_paths,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        if disk_state is not None:
+            state_def = disk_state.state_def
         for gi, paths in enumerate(groups):
             key = ("chunk_update", gi, with_lr)
             if key not in self._jit_cache:
@@ -477,24 +719,43 @@ class AcceleratedOptimizer:
                         tx, p_g, s_g, g_g, lr if with_lr else None, finite, use_scaler
                     )
 
-                self._jit_cache[key] = jax.jit(_group_update, donate_argnums=(0, 2))
+                # Disk tier: keep the caller's param buffers alive through the
+                # step — a failed blob write-back must leave params usable for
+                # the poison -> load_state recovery path (only grads donate).
+                donate = (2,) if disk_state is not None else (0, 2)
+                self._jit_cache[key] = jax.jit(_group_update, donate_argnums=donate)
                 self._jit_cache[("chunk_store_shard", gi)] = slice_state(self.opt_state_sharding, paths)
                 self._jit_cache[("chunk_param_store", gi)] = (
                     {p: p_storage_flat[p] for p in paths} if params_offloaded else None
                 )
             p_g = {p: flat_params[p] for p in paths}
             g_g = {p: flat_grads[p] for p in paths}
-            s_g = group_state(state_slots, state_def, paths)
-            p_new, s_new = self._jit_cache[key](p_g, s_g, g_g, inv_buf, lr_val, finite)
-            # Write the group state straight back to its pinned-host tier (the D2H
-            # overlaps the next group program) and absorb into the step's slots.
-            s_new = jax.device_put(s_new, self._jit_cache[("chunk_store_shard", gi)])
+            if disk_state is not None:
+                # Disk tier: async-prefetch the NEXT group's blob reads, consume
+                # this group's (pre-step scalars from the snapshot), and hand the
+                # write-back to the background thread so D2H + pwrite overlap the
+                # next group's program.
+                if gi + 1 < len(groups):
+                    disk_state.prefetch_group(groups[gi + 1])
+                s_g = disk_state.read_group(paths, scalars=scalar_snapshot)
+                p_new, s_new = self._jit_cache[key](p_g, s_g, g_g, inv_buf, lr_val, finite)
+                write_futures.append(writer.submit(disk_state.write_group, paths, s_new))
+            else:
+                s_g = group_state(state_slots, state_def, paths)
+                p_new, s_new = self._jit_cache[key](p_g, s_g, g_g, inv_buf, lr_val, finite)
+                # Write the group state straight back to its pinned-host tier (the
+                # D2H overlaps the next group program) and absorb into the slots.
+                s_new = jax.device_put(s_new, self._jit_cache[("chunk_store_shard", gi)])
+                absorb(out_slots, state_def, s_new)
             if params_offloaded:
                 p_new = jax.device_put(p_new, self._jit_cache[("chunk_param_store", gi)])
-            absorb(out_slots, state_def, s_new)
             new_flat.update(p_new)
 
-        self.opt_state = recompose(out_slots, state_def)
+        if disk_state is not None:
+            for fut in write_futures:
+                fut.result()  # surface write errors; state stays disk-resident
+        else:
+            self.opt_state = recompose(out_slots, state_def)
         new_params = jax.tree_util.tree_unflatten(params_treedef, [new_flat[p] for p in param_paths])
         return new_params, finite
 
@@ -680,14 +941,21 @@ class AcceleratedOptimizer:
 
     # ---- checkpoint view -------------------------------------------------------------
     def state_dict(self):
-        return {"opt_state": self.opt_state, "scaler": self.scaler.state_dict() if self.scaler else None}
+        opt_state = self.opt_state
+        if isinstance(opt_state, DiskOptState):
+            # Checkpointing sees an ordinary pytree (one pass over the blob).
+            opt_state = opt_state.materialize()
+        return {"opt_state": opt_state, "scaler": self.scaler.state_dict() if self.scaler else None}
 
     def load_state_dict(self, state):
         from .parallel.sharding import place_params
 
-        # place_params (not device_put): device_put aliases buffers already placed
-        # correctly, and the donated update would delete the caller's arrays through
-        # that alias on the next step.
-        self.opt_state = place_params(state["opt_state"], self.opt_state_sharding)
+        if isinstance(self.opt_state, DiskOptState):
+            self.opt_state.load(state["opt_state"])
+        else:
+            # place_params (not device_put): device_put aliases buffers already placed
+            # correctly, and the donated update would delete the caller's arrays through
+            # that alias on the next step.
+            self.opt_state = place_params(state["opt_state"], self.opt_state_sharding)
         if self.scaler is not None and state.get("scaler") is not None:
             self.scaler.load_state_dict(state["scaler"])

@@ -306,6 +306,11 @@ class FullyShardedDataParallelPlugin:
     # None -> follow cpu_offload.
     offload_optimizer_state: Optional[bool] = None
     offload_params: Optional[bool] = None
+    # NVMe tier (DeepSpeed offload_optimizer device="nvme" parity): "disk"/"nvme"
+    # puts optimizer state in a single-blob disk store with per-group streaming +
+    # async prefetch; `offload_dir` picks the directory (tempdir default).
+    offload_optimizer_device: Optional[str] = None
+    offload_dir: Optional[str] = None
     state_dict_type: str = "SHARDED_STATE_DICT"
     activation_checkpointing: bool = False
     sync_module_states: bool = True
@@ -350,6 +355,27 @@ class FullyShardedDataParallelPlugin:
             self.offload_optimizer_state = self.cpu_offload
         if self.offload_params is None:
             self.offload_params = self.cpu_offload
+        self.offload_optimizer_device = env.get(
+            prefix + "OFFLOAD_OPTIMIZER_DEVICE", self.offload_optimizer_device
+        )
+        if self.offload_optimizer_device is not None and self.offload_optimizer_device.lower() not in (
+            "disk",
+            "nvme",
+            "cpu",
+            "pinned_host",
+        ):
+            raise ValueError(
+                f"offload_optimizer_device must be disk|nvme|cpu|pinned_host, got "
+                f"{self.offload_optimizer_device!r}"
+            )
+        if self.offload_optimizer_device is not None and self.offload_optimizer_device.lower() in (
+            "cpu",
+            "pinned_host",
+        ):
+            # The host tier is the boolean knob's behavior; normalize.
+            self.offload_optimizer_state = True
+            self.offload_optimizer_device = None
+        self.offload_dir = env.get(prefix + "OFFLOAD_DIR", self.offload_dir)
         self.state_dict_type = env.get(prefix + "STATE_DICT_TYPE", self.state_dict_type)
         if self.state_dict_type not in FSDP_STATE_DICT_TYPE:
             raise ValueError(f"state_dict_type must be one of {FSDP_STATE_DICT_TYPE}")
@@ -419,6 +445,8 @@ class DeepSpeedPlugin:
             or self.offload_optimizer_device in ("cpu", "nvme"),
             offload_optimizer_state=self.offload_optimizer_device in ("cpu", "nvme"),
             offload_params=self.offload_param_device in ("cpu", "nvme"),
+            # DeepSpeed NVMe offload -> the disk tier (per-group blob streaming).
+            offload_optimizer_device="disk" if self.offload_optimizer_device == "nvme" else None,
         )
 
 
