@@ -11,7 +11,9 @@ into HBM underneath layer N's compute. That is the AlignDevicesHook + `cpu_offlo
 hook` pipeline (reference big_modeling.py:169-302) without any hooks.
 
 Tiers: HBM (resident blocks) → host DRAM (numpy, pinned by the OS page cache) → disk
-(`utils/offload.py` mmap store). Placement comes from `infer_auto_device_map`
+(`native/offload.py` single-blob store: striped pread on C++ threads + async readahead
+tickets — the perf-bearing replacement for the reference's per-tensor mmap files,
+utils/offload.py:25-192). Placement comes from `infer_auto_device_map`
 (utils/modeling.py).
 """
 
@@ -31,9 +33,28 @@ from .utils.modeling import (
     group_into_blocks,
     infer_auto_device_map,
 )
-from .utils.offload import OffloadedWeightsLoader, offload_weight, save_offload_index
-
 logger = get_logger(__name__)
+
+
+class _DiskRef:
+    """Placeholder leaf for a disk-resident tensor: (store, name) resolved at block
+    fetch time so a streamed call only reads the layers it is about to run —
+    `_fetch_block_pytree` issues one async readahead per tensor (striped pread on the
+    store's C++ pool) before the blocking reads, so a block's tensors come off disk in
+    parallel while the previous layer computes."""
+
+    __slots__ = ("store", "name")
+
+    def __init__(self, store, name):
+        self.store = store
+        self.name = name
+
+    def read(self):
+        return self.store.read(self.name)
+
+
+def _resolve(leaf):
+    return leaf.read() if isinstance(leaf, _DiskRef) else leaf
 
 
 def init_empty_weights(module, *sample_args, **sample_kwargs):
@@ -135,31 +156,40 @@ class DispatchedModel:
                 )
             return x
 
-        offload_index: dict = {}
         self._leaves: Dict[str, Any] = {}
         self._resident_devices = set()
+        self._disk_store = None
         for path, leaf in flat:
             tier = tier_of.get(path, 0)
             if tier == "disk":
                 if offload_folder is None:
                     raise ValueError("device_map places blocks on disk; offload_folder is required")
-                offload_index = offload_weight(_maybe_cast(leaf), path, offload_folder, offload_index)
-                self._leaves[path] = None  # resolved via the offload store
+                if self._disk_store is None:
+                    from .native.offload import NativeOffloadStore
+
+                    self._disk_store = NativeOffloadStore(offload_folder)
+                    self._disk_store.reset()  # a previous run's blob would leak
+                # One tensor at a time into the blob (host RAM never holds the
+                # spilled blocks at once); index flushed once after the loop.
+                self._disk_store.save(
+                    {path: np.asarray(jax.device_get(_maybe_cast(leaf)))}, flush_index=False
+                )
+                self._leaves[path] = None  # resolved via the blob store
             elif tier == "cpu":
                 self._leaves[path] = np.asarray(jax.device_get(_maybe_cast(leaf)))
             else:
                 self._leaves[path] = jax.device_put(_maybe_cast(leaf), devices[int(tier)])
                 self._resident_devices.add(int(tier))
-        if offload_index:
-            save_offload_index(offload_index, offload_folder)
-        self._disk_store = OffloadedWeightsLoader(save_folder=offload_folder) if offload_index else None
+        if self._disk_store is not None:
+            self._disk_store.flush_index()
         self.hf_device_map = dict(device_map)  # reference exposes model.hf_device_map
 
     # -- leaf access -------------------------------------------------------------------
     def _get_leaf(self, path: str):
+        """Leaf value, with disk leaves as lazy `_DiskRef`s (read at block fetch)."""
         leaf = self._leaves[path]
         if leaf is None:
-            leaf = self._disk_store[path]
+            leaf = _DiskRef(self._disk_store, path)
         return leaf
 
     def materialize_params(self, device=None):
@@ -167,7 +197,9 @@ class DispatchedModel:
         transiently; the streamed path avoids this."""
         import jax
 
-        leaves = [jax.device_put(np.asarray(self._get_leaf(p))) for p in self._paths]
+        if self._disk_store is not None:  # one readahead ticket for the disk part
+            self._disk_store.prefetch_many([p for p in self._paths if self._leaves[p] is None])
+        leaves = [jax.device_put(np.asarray(_resolve(self._get_leaf(p)))) for p in self._paths]
         return jax.tree_util.tree_unflatten(self._treedef, leaves)
 
     @property
@@ -266,14 +298,23 @@ class DispatchedModel:
         return buf[:, : max(max_len + steps_taken, prompt_len)]
 
     def _fetch_block_pytree(self, subtree):
-        """device_put a sub-pytree whose leaves may live on host/disk (async transfer)."""
+        """device_put a sub-pytree whose leaves may live on host/disk (async transfer).
+
+        Disk leaves (`_DiskRef`) resolve here: readahead tickets for every tensor in
+        the block first (parallel striped pread on the store's C++ pool), then the
+        blocking reads consume them — and because JAX dispatch is async, even the
+        blocking part overlaps the previous layer's device compute."""
         import jax
 
         from .parallel.sharding import tree_paths_and_leaves
 
         flat, treedef = tree_paths_and_leaves(subtree)
+        disk_names = [leaf.name for _, leaf in flat if isinstance(leaf, _DiskRef)]
+        if disk_names:
+            self._disk_store.prefetch_many(disk_names)
         leaves = []
         for _, leaf in flat:
+            leaf = _resolve(leaf)
             leaves.append(jax.device_put(np.asarray(leaf) if not isinstance(leaf, jax.Array) else leaf))
         return jax.tree_util.tree_unflatten(treedef, leaves)
 

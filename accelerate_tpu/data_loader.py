@@ -330,19 +330,41 @@ class SimpleDataLoader:
     """Built-in map-style loader: dataset + batch_sampler → collated host batches.
 
     The torch-free backend for `prepare_data_loader`; torch DataLoaders are instead
-    rebuilt with a sharded batch sampler (keeping their worker pool / collate_fn)."""
+    rebuilt with a sharded batch sampler (keeping their worker pool / collate_fn).
+
+    When the dataset is columnar (`native.loader.ArrayDataset`) and the collate is
+    the default, batches are assembled by the native gather pool — the sampled rows
+    of every column copied into preallocated batch buffers on C++ threads, one batch
+    ahead (the C++ analogue of torch's worker pool; results are bit-identical to the
+    per-row Python path)."""
 
     def __init__(self, dataset, batch_sampler, collate_fn: Optional[Callable] = None):
         self.dataset = dataset
         self.batch_sampler = batch_sampler
         self.collate_fn = collate_fn or _default_collate
+        self._gather_pool = None
 
     def __len__(self):
         return len(self.batch_sampler)
 
+    def _columnar(self) -> bool:
+        from .native.loader import ArrayDataset
+
+        return isinstance(self.dataset, ArrayDataset) and self.collate_fn is _default_collate
+
     def __iter__(self):
+        if self._columnar():
+            yield from self._native_iter()
+            return
         for batch_indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in batch_indices])
+
+    def _native_iter(self):
+        from .native.loader import NativeGatherPool, iter_gather_batches
+
+        if self._gather_pool is None:
+            self._gather_pool = NativeGatherPool()
+        yield from iter_gather_batches(self._gather_pool, self.dataset.columns, self.batch_sampler)
 
 
 class _IterableAsLoader:

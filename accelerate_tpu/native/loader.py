@@ -49,12 +49,17 @@ class NativeGatherPool:
     """Thread-pool batch assembler over an ArrayDataset (or dict of columns)."""
 
     def __init__(self, num_threads: int = 4):
+        import os
+
         from . import load_library
 
         self.lib = load_library()
         self._pool = None
         if self.lib is not None:
-            self._pool = self.lib.atl_pool_create(int(num_threads))
+            # Gather is memcpy-bound: workers beyond the core count only add
+            # context switches (notably in 1-vCPU CI containers).
+            num_threads = max(1, min(int(num_threads), os.cpu_count() or 1))
+            self._pool = self.lib.atl_pool_create(num_threads)
 
     @property
     def native(self) -> bool:
@@ -123,6 +128,27 @@ class NativeGatherPool:
         return _Ticket(ticket, out, idx)
 
 
+def iter_gather_batches(pool: NativeGatherPool, columns: Dict[str, np.ndarray], batch_sampler):
+    """Double-buffered batch stream: gather batch N+1 on the pool while N is
+    consumed. The finally clause is load-bearing: if the consumer abandons the
+    iterator mid-epoch (early `break` → GeneratorExit), the in-flight ticket must
+    be waited before its destination buffers are garbage-collected, or the C++
+    threads would keep memcpy-ing into freed memory."""
+    pending = None
+    try:
+        for batch_indices in batch_sampler:
+            ticket = pool.submit(columns, list(batch_indices))
+            if pending is not None:
+                yield pool.wait(pending)
+            pending = ticket
+        if pending is not None:
+            yield pool.wait(pending)
+            pending = None
+    finally:
+        if pending is not None:
+            pool.wait(pending)
+
+
 class NativeArrayLoader:
     """SimpleDataLoader-shaped iterator: ArrayDataset + batch sampler, batches
     assembled natively one step ahead (the C++ analogue of torch's worker pool)."""
@@ -137,12 +163,4 @@ class NativeArrayLoader:
         return len(self.batch_sampler)
 
     def __iter__(self):
-        cols = self.dataset.columns
-        pending = None
-        for batch_indices in self.batch_sampler:
-            ticket = self.pool.submit(cols, list(batch_indices))
-            if pending is not None:
-                yield self.pool.wait(pending)
-            pending = ticket
-        if pending is not None:
-            yield self.pool.wait(pending)
+        yield from iter_gather_batches(self.pool, self.dataset.columns, self.batch_sampler)

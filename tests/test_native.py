@@ -159,3 +159,82 @@ def test_fallback_without_native(monkeypatch):
     # async API also works (synchronously) in fallback
     t = pool.submit(cols, [0, 2])
     np.testing.assert_array_equal(pool.wait(t)["x"], cols["x"][[0, 2]])
+
+
+def test_simple_loader_columnar_fast_path_through_prepare():
+    """The DEFAULT journey: SimpleDataLoader over an ArrayDataset routes batch
+    assembly through the native gather pool (no per-row Python loop), bit-identical
+    to the per-row path, surviving the prepare() rebuild with a sharded sampler."""
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader, prepare_data_loader
+    from accelerate_tpu.state import PartialState
+
+    PartialState()
+    cols = _columns(n=32, seed=5)
+    ds = ArrayDataset(cols)
+    loader = SimpleDataLoader(ds, BatchSampler(range(32), 8))
+    prepared = prepare_data_loader(loader)
+    batches = [ {k: np.asarray(v) for k, v in b.items()} for b in prepared ]
+    base = prepared.base_loader
+    assert isinstance(base, SimpleDataLoader) and base._columnar()
+    if native_available():
+        assert base._gather_pool is not None and base._gather_pool.native
+
+    # Per-row Python reference: identical batches.
+    rowwise = SimpleDataLoader(list(ds[i] for i in range(32)), BatchSampler(range(32), 8))
+    for got, ref in zip(batches, rowwise, strict=True):
+        for k in cols:
+            np.testing.assert_array_equal(got[k], ref[k])
+
+
+def test_simple_loader_columnar_survives_skip_first_batches():
+    from accelerate_tpu.data_loader import (
+        BatchSampler,
+        SimpleDataLoader,
+        prepare_data_loader,
+        skip_first_batches,
+    )
+    from accelerate_tpu.state import PartialState
+
+    PartialState()
+    cols = _columns(n=32, seed=6)
+    loader = SimpleDataLoader(ArrayDataset(cols), BatchSampler(range(32), 8))
+    prepared = prepare_data_loader(loader)
+    resumed = skip_first_batches(prepared, 2)
+    assert resumed.base_loader._columnar(), "index-plane skip must keep the columnar path"
+    seen = [np.asarray(b["labels"]) for b in resumed]
+    np.testing.assert_array_equal(np.concatenate(seen), cols["labels"][16:])
+
+
+def test_abandoned_iterator_waits_inflight_ticket():
+    """Early `break` out of a columnar loader must not leave an in-flight gather
+    ticket whose destination buffers get freed under the C++ threads. The finally
+    in iter_gather_batches waits it; afterwards the pool must be idle (a fresh
+    synchronous gather completes correctly)."""
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+
+    cols = _columns(n=64, seed=7)
+    loader = SimpleDataLoader(ArrayDataset(cols), BatchSampler(range(64), 8))
+    for i, batch in enumerate(loader):
+        if i == 1:
+            break  # abandon mid-epoch with a ticket in flight
+    import gc
+
+    gc.collect()  # would segfault/corrupt if the ticket were still running
+    pool = loader._gather_pool
+    got = pool.gather(loader.dataset.columns, [0, 5, 9])
+    np.testing.assert_array_equal(got["labels"], cols["labels"][[0, 5, 9]])
+
+
+def test_redispatch_same_folder_resets_blob(tmp_path):
+    """Re-dispatching into the same offload_folder must start a fresh blob, not
+    append a second copy of the spilled weights (rerun-leak guard)."""
+    from accelerate_tpu.big_modeling import disk_offload
+    from accelerate_tpu.models.llama import LlamaLayeredApply, create_llama_model, llama_tiny
+
+    model = create_llama_model(llama_tiny(), seq_len=16)
+    layered = LlamaLayeredApply(llama_tiny())
+    sizes = []
+    for _ in range(2):
+        disk_offload(model, str(tmp_path), layered=layered)
+        sizes.append((tmp_path / "weights.bin").stat().st_size)
+    assert sizes[1] == sizes[0], f"blob grew across re-dispatch: {sizes}"
