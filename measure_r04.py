@@ -35,6 +35,20 @@ CONFIGS = [
          "--trials", "3", "--attention", "xla"],
         3000,
     ),
+    # long-context scaling on the single chip (the per-device block compute the
+    # ring path runs at each hop): flash kernel at growing seq, fixed tokens/batch
+    (
+        "llama-1b seq2048 flash",
+        ["--model", "llama-1b", "--seq_len", "2048", "--batch_size", "2", "--steps", "60",
+         "--trials", "2", "--attention", "flash"],
+        3000,
+    ),
+    (
+        "llama-1b seq4096 flash",
+        ["--model", "llama-1b", "--seq_len", "4096", "--batch_size", "1", "--steps", "40",
+         "--trials", "2", "--attention", "flash"],
+        3000,
+    ),
     ("inference llama-1b", ["--mode", "inference", "--model", "llama-1b"], 1800),
     ("inference gptj-6b", ["--mode", "inference", "--model", "gptj-6b"], 2700),
 ]

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Probe the TPU tunnel every 10 min; when it answers, run the round-4
+# measurement suite once and exit. Log everything to tpu_watch.log.
+cd /root/repo
+for i in $(seq 1 60); do
+  echo "[watch] probe $i at $(date -u +%H:%M:%S)" >> tpu_watch.log
+  if timeout 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'; print(jax.devices()[0].device_kind)" >> tpu_watch.log 2>&1; then
+    echo "[watch] TPU alive; starting measurement suite" >> tpu_watch.log
+    python measure_r04.py >> tpu_watch.log 2>&1
+    echo "[watch] suite finished rc=$?" >> tpu_watch.log
+    exit 0
+  fi
+  sleep 600
+done
+echo "[watch] gave up after 60 probes" >> tpu_watch.log
