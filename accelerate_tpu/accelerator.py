@@ -595,10 +595,16 @@ class Accelerator:
         model: Optional[PreparedModel] = None,
         max_grad_norm: Optional[float] = None,
         accumulation_steps: Optional[int] = None,
+        steps_per_call: int = 1,
     ):
         """Build the fused per-step program: ONE jitted call doing
         value_and_grad + (clip) + optimizer update with donated params/opt-state,
         with `lax.scan` microbatch accumulation when `accumulation_steps > 1`.
+
+        `steps_per_call=K > 1` additionally scans K FULL optimizer steps inside
+        the one program (pass a batch stacking K step-batches along dim 0); host
+        dispatch cost is paid once per K steps — the device-training-loop mode
+        for small-step configs and high-latency (tunneled) hosts.
 
         This is the TPU performance path; `backward()`/`optimizer.step()` remain as
         the eager-feel compatibility surface (reference accelerator.py:2093-2121).
@@ -627,6 +633,7 @@ class Accelerator:
             max_grad_norm=max_grad_norm,
             accumulation_steps=accumulation_steps,
             gradient_state=self.gradient_state,
+            steps_per_call=steps_per_call,
         )
 
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: int = 2, model=None):

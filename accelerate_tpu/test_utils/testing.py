@@ -142,6 +142,15 @@ def cpu_mesh_env(num_devices: int = 8) -> dict:
     from ..utils.environment import set_host_device_count_flag
 
     env["XLA_FLAGS"] = set_host_device_count_flag(env.get("XLA_FLAGS", ""), num_devices)
+    # De-flake, not mask: all virtual devices share one intra-op thread pool, so
+    # on a loaded small host a collective can take minutes to assemble its
+    # participants — that's starvation, not a hang (XLA:CPU's default ~40s
+    # rendezvous deadline calls it a hang and kills the child). Real hangs still
+    # die at the harness subprocess timeout. NOTE: shrinking the thread pool
+    # instead DEADLOCKS the first cross-module collective (participants must run
+    # concurrently); the longer deadline is the only safe fix.
+    if "collective_call_terminate_timeout" not in env["XLA_FLAGS"]:
+        env["XLA_FLAGS"] += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
     # Children must resolve the package even when it's driven from a source checkout.
     pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")

@@ -39,6 +39,15 @@ class FusedTrainStep:
     - The learning-rate override installed by `AcceleratedScheduler.step()` via
       `optimizer.set_learning_rate` is honored (requires `optax.inject_hyperparams`,
       same as the eager path).
+    - `steps_per_call=K > 1` runs K FULL optimizer steps as one compiled program
+      (an outer `lax.scan` whose carry is (params, opt_state)): the call takes one
+      batch pytree stacking K step-batches along dim 0 (`[K*b, ...]`) and returns
+      the last step's loss. This is the device-training-loop mode: per-call host
+      work (argument processing, dispatch, a tunneled-TPU round trip) is paid once
+      per K steps instead of per step, which is where small-step configs lose
+      their MFU. LR override and loss scale are read once per call, so a
+      scheduler advances in K-step strides; dynamic fp16 scaling needs per-step
+      host decisions and is rejected (use bf16 — TPU-native — or K=1).
     """
 
     def __init__(
@@ -49,12 +58,27 @@ class FusedTrainStep:
         max_grad_norm: Optional[float] = None,
         accumulation_steps: int = 1,
         gradient_state=None,
+        steps_per_call: int = 1,
     ):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn if loss_fn is not None else model.loss
         self.max_grad_norm = max_grad_norm
         self.accumulation_steps = int(accumulation_steps or 1)
+        self.steps_per_call = int(steps_per_call or 1)
+        if self.steps_per_call > 1:
+            scaler = optimizer.scaler
+            if scaler is not None and scaler.enabled:
+                raise ValueError(
+                    "steps_per_call > 1 cannot honor dynamic fp16 loss scaling "
+                    "(scale updates are per-step host decisions); use bf16 mixed "
+                    "precision or steps_per_call=1"
+                )
+            if optimizer.offload_opt_state:
+                raise ValueError(
+                    "steps_per_call > 1 is incompatible with offloaded optimizer "
+                    "state (each step streams state through HBM group by group)"
+                )
         self.gradient_state = gradient_state
         self._jitted: dict = {}
 
@@ -79,13 +103,11 @@ class FusedTrainStep:
 
             return jax.grad(scaled, has_aux=True)(params)
 
-        def split_microbatches(batch):
+        def split_leading(batch, n, what):
             def _split(x):
-                if x.shape[0] % k:
-                    raise ValueError(
-                        f"accumulation_steps={k} must divide the batch dim ({x.shape[0]})"
-                    )
-                return x.reshape((k, x.shape[0] // k) + x.shape[1:])
+                if x.shape[0] % n:
+                    raise ValueError(f"{what}={n} must divide the batch dim ({x.shape[0]})")
+                return x.reshape((n, x.shape[0] // n) + x.shape[1:])
 
             mb = jax.tree_util.tree_map(_split, batch)
             if mesh is not None and ("data" in mesh.shape or "fsdp" in mesh.shape):
@@ -101,6 +123,9 @@ class FusedTrainStep:
 
                 mb = jax.tree_util.tree_map(_constrain, mb)
             return mb
+
+        def split_microbatches(batch):
+            return split_leading(batch, k, "accumulation_steps")
 
         to_compute = getattr(self.model, "to_compute_memory", lambda p: p)
         opt_to_compute = self.optimizer.opt_to_compute_memory
@@ -160,15 +185,10 @@ class FusedTrainStep:
             self.optimizer, "opt_state_sharding", None
         )
 
-        def fused(params, opt_state, scale, inv_scale, lr, *args, **kwargs):
-            # Host-offloaded tiers stream to device memory at the top of the
-            # program; the caller writes results back to pinned host.
-            params = to_compute(params)
-            opt_state = opt_to_compute(opt_state)
+        from .optimizer import apply_update_core
+
+        def one_step(params, opt_state, scale, inv_scale, lr, *args, **kwargs):
             grads, loss, aux = compute_grads(params, scale, *args, **kwargs)
-
-            from .optimizer import apply_update_core
-
             new_params, new_opt_state, finite = apply_update_core(
                 tx,
                 params,
@@ -184,6 +204,32 @@ class FusedTrainStep:
             if opt_out_sharding is not None:
                 new_opt_state = jax.lax.with_sharding_constraint(new_opt_state, opt_out_sharding)
             return new_params, new_opt_state, loss, aux, finite
+
+        n_steps = self.steps_per_call
+
+        def fused(params, opt_state, scale, inv_scale, lr, *args, **kwargs):
+            # Host-offloaded tiers stream to device memory at the top of the
+            # program; the caller writes results back to pinned host.
+            params = to_compute(params)
+            opt_state = opt_to_compute(opt_state)
+            if n_steps == 1:
+                return one_step(params, opt_state, scale, inv_scale, lr, *args, **kwargs)
+
+            # Device training loop: scan K full optimizer steps over K stacked
+            # step-batches. One dispatch, one donation round trip, K updates.
+            if len(args) != 1 or kwargs:
+                raise ValueError("steps_per_call > 1 takes exactly one positional batch pytree")
+            step_batches = split_leading(args[0], n_steps, "steps_per_call")
+
+            def body(carry, sbatch):
+                p, s = carry
+                new_p, new_s, loss, _aux, finite = one_step(p, s, scale, inv_scale, lr, sbatch)
+                return (new_p, new_s), (loss, finite)
+
+            (new_params, new_opt_state), (losses, finites) = jax.lax.scan(
+                body, (params, opt_state), step_batches
+            )
+            return new_params, new_opt_state, losses[-1], None, jnp.all(finites)
 
         return jax.jit(fused, donate_argnums=(0, 1))
 
@@ -205,6 +251,14 @@ class FusedTrainStep:
         # first time a scheduler installs an override). The sentinel keeps it
         # distinct from the fused program in case offload_opt_state is toggled
         # mid-run (e.g. LocalSGD collapse).
+        if opt.offload_opt_state and self.steps_per_call > 1:
+            # Guarded at construction, but offload can be toggled after (e.g.
+            # LocalSGD collapse): the offload program has no step scan and would
+            # silently consume the [K*b] stacked batch as ONE giant step.
+            raise ValueError(
+                "steps_per_call > 1 is incompatible with offloaded optimizer state "
+                "(toggled on after train_step was built); rebuild with steps_per_call=1"
+            )
         cache_key = "offload" if opt.offload_opt_state else with_lr
         if cache_key not in self._jitted:
             self._jitted[cache_key] = self._build(cache_key)

@@ -273,12 +273,38 @@ def train_bench(args):
     on_accel = jax.devices()[0].platform in ("tpu", "gpu")
     log(f"backend up in {time.time() - t0:.1f}s: {n_chips}x {device_kind}")
 
-    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    compilation_config = None
+    if args.remat:
+        from accelerate_tpu.utils import CompilationConfig
+
+        compilation_config = CompilationConfig(remat_policy=args.remat)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision, compilation_config=compilation_config
+    )
 
     if args.batch_size is None:
         args.batch_size = 32 if on_accel else 4
     if not on_accel and args.model == "bert-base":
         args.steps = min(args.steps, 8)
+    if args.steps_per_call is None:
+        # Auto: small-step configs (bert-base seq 128 runs ~10-40ms/step on one
+        # chip) pay one host dispatch + tunnel round trip PER STEP; the scanned
+        # device loop (train_step(steps_per_call=K)) pays it once per K steps.
+        # Big-step models (llama seq>=1024, ~300ms/step) don't need it. The
+        # eager path ignores the knob, and --per_step_readback is a per-STEP
+        # sync validation mode — both keep one step per call.
+        auto_loop = on_accel and args.model.startswith("bert")
+        args.steps_per_call = 10 if (auto_loop and not args.eager and not args.per_step_readback) else 1
+    if args.eager and args.steps_per_call > 1:
+        log("eager path ignores steps_per_call; forcing 1")
+        args.steps_per_call = 1
+    if args.per_step_readback and args.steps_per_call > 1:
+        log("--per_step_readback syncs every step; forcing steps_per_call=1")
+        args.steps_per_call = 1
+    spc = max(1, args.steps_per_call)
+    if args.steps % spc:
+        args.steps = (args.steps // spc + 1) * spc
+        log(f"steps rounded up to {args.steps} (multiple of steps_per_call={spc})")
 
     if args.model.startswith("bert"):
         from accelerate_tpu.models import bert_base, bert_tiny, create_bert_model
@@ -290,7 +316,7 @@ def train_bench(args):
         # Enough data that the timed region is ONE continuous loader pass: epoch
         # restarts tear down the prefetch thread and stall the device every
         # 2 steps otherwise, which benchmarks the restart cost, not training.
-        n = global_batch * (args.trials * args.steps + args.warmup + 2)
+        n = global_batch * (args.trials * args.steps + (args.warmup + 2) * spc + 2)
         data = [
             {
                 "input_ids": rng.integers(1, cfg.vocab_size, size=(args.seq_len,)).astype(np.int32),
@@ -313,14 +339,16 @@ def train_bench(args):
             model = create_llama_model(cfg, seq_len=args.seq_len)
         rng = np.random.default_rng(0)
         global_batch = args.batch_size * n_chips
-        n = global_batch * (args.trials * args.steps + args.warmup + 2)
+        n = global_batch * (args.trials * args.steps + (args.warmup + 2) * spc + 2)
         data = [
             {"input_ids": rng.integers(1, cfg.vocab_size, size=(args.seq_len,)).astype(np.int32)} for _ in range(n)
         ]
         hidden = cfg.hidden_size
         vocab = cfg.vocab_size
 
-    dl = SimpleDataLoader(data, BatchSampler(range(n), global_batch, drop_last=True))
+    # The device-loop mode consumes spc step-batches per call: the loader
+    # collates them as ONE [spc*global_batch] array (one transfer per call).
+    dl = SimpleDataLoader(data, BatchSampler(range(n), global_batch * spc, drop_last=True))
     pmodel, popt, pdl = accelerator.prepare(model, optax.adamw(1e-4), dl)
     param_count = pmodel.num_parameters
 
@@ -345,11 +373,13 @@ def train_bench(args):
             return last_loss
 
     else:
-        step_fn = accelerator.train_step()
+        step_fn = accelerator.train_step(steps_per_call=spc)
 
         def run_steps(n):
             last_loss = None
-            for _ in range(n):
+            # n is a step count, always a multiple of spc (steps are rounded up
+            # at parse time, warmup is passed as warmup*spc).
+            for _ in range(n // spc):
                 last_loss = step_fn(next(stream))
                 if args.per_step_readback:
                     float(last_loss)
@@ -357,7 +387,7 @@ def train_bench(args):
 
     # Warmup (compile)
     t0 = time.time()
-    run_steps(args.warmup)
+    run_steps(args.warmup * spc)
     force_readback(pmodel.params)
     log(f"warmup+compile {time.time() - t0:.1f}s")
 
@@ -422,6 +452,7 @@ def train_bench(args):
             "final_loss": final_loss,
             "steps": steps_done,
             "path": "eager" if args.eager else "fused",
+            "steps_per_call": spc,
             # Which attention implementation the model's trace actually used —
             # proves (or disproves) that the flash kernel is on the measured path.
             "attention_impl": _last_attention_dispatch(),
@@ -457,6 +488,13 @@ def parse_args(argv):
     parser.add_argument("--steps", type=int, default=500)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument(
+        "--steps_per_call",
+        type=int,
+        default=None,
+        help="optimizer steps scanned per compiled call (device training loop); "
+        "default: 10 for bert on accelerators, else 1",
+    )
+    parser.add_argument(
         "--attention",
         default="auto",
         choices=["auto", "xla", "flash"],
@@ -466,6 +504,12 @@ def parse_args(argv):
     )
     parser.add_argument("--trials", type=int, default=3, help="timed regions; the median is reported")
     parser.add_argument("--mixed_precision", default="bf16")
+    parser.add_argument(
+        "--remat",
+        default=None,
+        choices=["full", "dots"],
+        help="per-layer activation checkpointing policy (HBM-tight configs)",
+    )
     parser.add_argument("--eager", action="store_true", help="use the eager backward/step path instead of the fused step")
     parser.add_argument(
         "--per_step_readback",

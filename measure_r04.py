@@ -36,17 +36,20 @@ CONFIGS = [
         3000,
     ),
     # long-context scaling on the single chip (the per-device block compute the
-    # ring path runs at each hop): flash kernel at growing seq, fixed tokens/batch
+    # ring path runs at each hop): flash kernel at growing seq, fixed tokens/batch.
+    # --remat dots: llama-1b + fp32 AdamW moments is ~15 GB on the 16 GB chip, so
+    # 4096-token activation residuals must be rematerialized (the bs-4 seq-1024
+    # flash leg without remat OOM'd; measure_r04b.py re-runs it with remat).
     (
         "llama-1b seq2048 flash",
         ["--model", "llama-1b", "--seq_len", "2048", "--batch_size", "2", "--steps", "60",
-         "--trials", "2", "--attention", "flash"],
+         "--trials", "2", "--attention", "flash", "--remat", "dots"],
         3000,
     ),
     (
         "llama-1b seq4096 flash",
         ["--model", "llama-1b", "--seq_len", "4096", "--batch_size", "1", "--steps", "40",
-         "--trials", "2", "--attention", "flash"],
+         "--trials", "2", "--attention", "flash", "--remat", "dots"],
         3000,
     ),
     ("inference llama-1b", ["--mode", "inference", "--model", "llama-1b"], 1800),
@@ -56,8 +59,23 @@ CONFIGS = [
 
 def main():
     out_path = "bench_suite_r04.jsonl"
+    # Resumable: the tunnel can drop mid-suite; captured tags are skipped so the
+    # watcher can just re-run the suite until every config has a row.
+    done = set()
+    try:
+        with open(out_path) as f:
+            for row_line in f:
+                try:
+                    done.add(json.loads(row_line).get("tag"))
+                except json.JSONDecodeError:
+                    pass
+    except FileNotFoundError:
+        pass
     results = []
     for tag, argv, timeout_s in CONFIGS:
+        if tag in done:
+            print(f"[suite] {tag}: already captured, skipping", file=sys.stderr, flush=True)
+            continue
         cmd = [sys.executable, "bench.py", "--no-supervise"] + argv
         print(f"[suite] {tag}: {' '.join(cmd)}", file=sys.stderr, flush=True)
         t0 = time.time()

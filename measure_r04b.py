@@ -1,0 +1,105 @@
+"""Round-4 follow-up measurements (run after measure_r04.py).
+
+1. Device-loop A/B (`train_step(steps_per_call=K)`): the bs-32 headline config
+   lost ~21 ms/step to per-call host dispatch on the tunneled chip
+   (bs32 0.335 MFU vs bs64 0.502 in bench_suite_r04.jsonl); K=10 pays that cost
+   once per 10 steps. Captured at equal step counts against the K=1 rows.
+2. Flash-vs-XLA at seq 1024 with remat: the bs-4 flash leg OOM'd (llama-1b +
+   AdamW fp32 moments is ~15 GB before activations); `--remat dots` drops
+   attention residuals so both legs fit on the 16 GB chip at equal batch.
+3. Long-seq flash scaling with remat (seq 2048 / 4096).
+
+Appends to bench_suite_r04.jsonl like the main suite.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+CONFIGS = [
+    ("headline bs32 spc10", ["--steps", "500", "--trials", "3", "--batch_size", "32", "--steps_per_call", "10"], 2400),
+    ("sweep bs64 spc10", ["--steps", "500", "--trials", "3", "--batch_size", "64", "--steps_per_call", "10"], 2400),
+    ("sweep bs64 spc20", ["--steps", "500", "--trials", "3", "--batch_size", "64", "--steps_per_call", "20"], 2400),
+    (
+        "llama-1b seq1024 flash remat",
+        ["--model", "llama-1b", "--seq_len", "1024", "--batch_size", "4", "--steps", "100",
+         "--trials", "3", "--attention", "flash", "--remat", "dots"],
+        3000,
+    ),
+    (
+        "llama-1b seq1024 xla remat",
+        ["--model", "llama-1b", "--seq_len", "1024", "--batch_size", "4", "--steps", "100",
+         "--trials", "3", "--attention", "xla", "--remat", "dots"],
+        3000,
+    ),
+    (
+        "llama-1b seq2048 flash remat",
+        ["--model", "llama-1b", "--seq_len", "2048", "--batch_size", "2", "--steps", "60",
+         "--trials", "2", "--attention", "flash", "--remat", "dots"],
+        3000,
+    ),
+    (
+        "llama-1b seq4096 flash remat",
+        ["--model", "llama-1b", "--seq_len", "4096", "--batch_size", "1", "--steps", "40",
+         "--trials", "2", "--attention", "flash", "--remat", "dots"],
+        3000,
+    ),
+]
+
+
+def main():
+    out_path = "bench_suite_r04.jsonl"
+    done = set()
+    try:
+        with open(out_path) as f:
+            for row_line in f:
+                try:
+                    done.add(__import__("json").loads(row_line).get("tag"))
+                except ValueError:
+                    pass
+    except FileNotFoundError:
+        pass
+    results = []
+    for tag, argv, timeout_s in CONFIGS:
+        if tag in done:
+            print(f"[suite-b] {tag}: already captured, skipping", file=sys.stderr, flush=True)
+            continue
+        cmd = [sys.executable, "bench.py", "--no-supervise"] + argv
+        print(f"[suite-b] {tag}: {' '.join(cmd)}", file=sys.stderr, flush=True)
+        t0 = time.time()
+        try:
+            proc = subprocess.run(cmd, timeout=timeout_s, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            print(f"[suite-b] {tag}: TIMEOUT >{timeout_s}s", file=sys.stderr, flush=True)
+            results.append({"tag": tag, "error": f"timeout>{timeout_s}s"})
+            continue
+        line = None
+        for out_line in (proc.stdout or "").strip().splitlines():
+            try:
+                parsed = json.loads(out_line)
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    line = parsed
+            except json.JSONDecodeError:
+                continue
+        if proc.returncode != 0 or line is None:
+            print(
+                f"[suite-b] {tag}: FAILED rc={proc.returncode}; stderr tail: "
+                f"{(proc.stderr or '')[-600:]!r}",
+                file=sys.stderr,
+                flush=True,
+            )
+            results.append({"tag": tag, "error": f"rc={proc.returncode}"})
+            continue
+        line["tag"] = tag
+        line["wall_s"] = round(time.time() - t0, 1)
+        results.append(line)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+        print(f"[suite-b] {tag}: {json.dumps(line)}", flush=True)
+    ok = sum(1 for r in results if "error" not in r)
+    print(f"[suite-b] done: {ok}/{len(CONFIGS)} configs captured -> {out_path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

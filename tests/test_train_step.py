@@ -165,6 +165,78 @@ def test_fused_honors_scheduler_lr_override():
     assert popt.learning_rate is not None and popt.learning_rate < 0.1
 
 
+def _run_device_loop(data, batch_size, steps_per_call, lr=0.05, max_norm=None, steps_epochs=2):
+    _reset()
+    accelerator = Accelerator()
+    model = make_regression_model(seed=0)
+    # one call consumes steps_per_call full step-batches
+    dl = SimpleDataLoader(data, BatchSampler(range(len(data)), batch_size * steps_per_call))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(lr), dl)
+    step_fn = accelerator.train_step(max_grad_norm=max_norm, steps_per_call=steps_per_call)
+    losses = []
+    for _ in range(steps_epochs):
+        for batch in pdl:
+            losses.append(float(step_fn(batch)))
+    return losses, pmodel.params
+
+
+def test_device_loop_matches_single_step_trajectory():
+    """steps_per_call=K (the scanned device training loop) must land on the same
+    params as K separate fused calls over the same batches — and its returned
+    loss is the LAST scanned step's, i.e. the eager trajectory's K-th loss."""
+    data = make_regression_data(64, seed=12)
+    single_losses, single_params = _run_fused(data, batch_size=8)
+    loop_losses, loop_params = _run_device_loop(data, batch_size=8, steps_per_call=4)
+    _assert_params_close(loop_params, single_params)
+    # 64/8 = 8 steps/epoch -> 2 calls/epoch; call i returns step 4i+3's loss
+    np.testing.assert_allclose(
+        np.array(loop_losses), np.array(single_losses[3::4]), rtol=2e-5, atol=1e-6
+    )
+
+
+def test_device_loop_with_clipping_and_accumulation():
+    data = make_regression_data(64, seed=13)
+    _, ref_params = _run_fused(data, batch_size=4, accum=2, max_norm=0.5)
+    _reset()
+    accelerator = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=2, sync_with_dataloader=False
+        )
+    )
+    model = make_regression_model(seed=0)
+    # K=2 calls, each spanning 2 steps x (2 microbatches x 4 rows)
+    dl = SimpleDataLoader(data, BatchSampler(range(64), 4 * 2 * 2))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+    step_fn = accelerator.train_step(max_grad_norm=0.5, steps_per_call=2)
+    for _ in range(2):
+        for batch in pdl:
+            step_fn(batch)
+    _assert_params_close(pmodel.params, ref_params, rtol=1e-4)
+
+
+def test_device_loop_rejects_dynamic_loss_scaling():
+    _reset()
+    accelerator = Accelerator(mixed_precision="fp16")
+    model = make_regression_model(seed=0)
+    data = make_regression_data(16, seed=14)
+    dl = SimpleDataLoader(data, BatchSampler(range(16), 8))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+    with pytest.raises(ValueError, match="steps_per_call"):
+        accelerator.train_step(steps_per_call=2)
+
+
+def test_device_loop_requires_divisible_batch():
+    _reset()
+    accelerator = Accelerator()
+    model = make_regression_model(seed=0)
+    data = make_regression_data(16, seed=15)
+    dl = SimpleDataLoader(data, BatchSampler(range(16), 6))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+    step_fn = accelerator.train_step(steps_per_call=4)
+    with pytest.raises(ValueError, match="steps_per_call"):
+        step_fn(next(iter(pdl)))
+
+
 def test_fused_step_marks_sync_boundary():
     _reset()
     accelerator = Accelerator(gradient_accumulation_steps=2)

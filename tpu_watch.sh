@@ -1,14 +1,36 @@
 #!/bin/bash
-# Probe the TPU tunnel every 10 min; when it answers, run the round-4
-# measurement suite once and exit. Log everything to tpu_watch.log.
+# Probe the TPU tunnel every 10 min; when it answers, run the (resumable)
+# round-4 measurement suites. Both suites skip tags already captured in
+# bench_suite_r04.jsonl, so a tunnel drop mid-suite just means the next
+# probe-cycle picks up the missing configs. Exits when every config has a row.
 cd /root/repo
+want=16  # 9 suite-a + 7 suite-b tags
 for i in $(seq 1 60); do
-  echo "[watch] probe $i at $(date -u +%H:%M:%S)" >> tpu_watch.log
-  if timeout 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'; print(jax.devices()[0].device_kind)" >> tpu_watch.log 2>&1; then
-    echo "[watch] TPU alive; starting measurement suite" >> tpu_watch.log
-    python measure_r04.py >> tpu_watch.log 2>&1
-    echo "[watch] suite finished rc=$?" >> tpu_watch.log
+  have=$(python - <<'EOF'
+import json
+tags = set()
+try:
+    for line in open("bench_suite_r04.jsonl"):
+        try:
+            tags.add(json.loads(line).get("tag"))
+        except ValueError:
+            pass
+except FileNotFoundError:
+    pass
+print(len(tags))
+EOF
+)
+  if [ "$have" -ge "$want" ]; then
+    echo "[watch] all $want configs captured; exiting" >> tpu_watch.log
     exit 0
+  fi
+  echo "[watch] probe $i at $(date -u +%H:%M:%S) (captured $have/$want)" >> tpu_watch.log
+  if timeout 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'; print(jax.devices()[0].device_kind)" >> tpu_watch.log 2>&1; then
+    echo "[watch] TPU alive; running suites" >> tpu_watch.log
+    python measure_r04.py >> tpu_watch.log 2>&1
+    echo "[watch] suite a pass rc=$?" >> tpu_watch.log
+    python measure_r04b.py >> tpu_watch.log 2>&1
+    echo "[watch] suite b pass rc=$?" >> tpu_watch.log
   fi
   sleep 600
 done
