@@ -42,7 +42,8 @@ class FusedTrainStep:
     - `steps_per_call=K > 1` runs K FULL optimizer steps as one compiled program
       (an outer `lax.scan` whose carry is (params, opt_state)): the call takes one
       batch pytree stacking K step-batches along dim 0 (`[K*b, ...]`) and returns
-      the last step's loss. This is the device-training-loop mode: per-call host
+      the last step's loss (loss functions returning `(loss, aux)` are rejected —
+      the scan would drop every step's aux). This is the device-training-loop mode: per-call host
       work (argument processing, dispatch, a tunneled-TPU round trip) is paid once
       per K steps instead of per step, which is where small-step configs lose
       their MFU. LR override and loss scale are read once per call, so a
@@ -223,7 +224,15 @@ class FusedTrainStep:
 
             def body(carry, sbatch):
                 p, s = carry
-                new_p, new_s, loss, _aux, finite = one_step(p, s, scale, inv_scale, lr, sbatch)
+                new_p, new_s, loss, aux, finite = one_step(p, s, scale, inv_scale, lr, sbatch)
+                if aux is not None:
+                    # Trace-time check: the scan returns only the last step's
+                    # loss, so an aux value would be silently dropped and the
+                    # caller's `loss, aux = step_fn(batch)` unpack would break.
+                    raise ValueError(
+                        "steps_per_call > 1 does not support loss functions that "
+                        "return (loss, aux); use steps_per_call=1 for aux outputs"
+                    )
                 return (new_p, new_s), (loss, finite)
 
             (new_params, new_opt_state), (losses, finites) = jax.lax.scan(

@@ -2,11 +2,13 @@
 `bench.py` = one JSON line).
 
 Covers the round-3 verdict's evidence list:
-  1. sustained >= 500-step headline (bert-base, seq 128, bs 32/chip)
-  2. batch-size sweep at EQUAL step counts (bs 32/64/128, 500 steps each)
-  3. second-architecture MFU cross-check (llama-1b, seq 1024)
-  4. flash-vs-XLA A/B where the kernel dispatches (llama-1b @ seq 1024)
-  5. inference headline (llama-1b latency; gptj-6b when HBM allows)
+  1. batch-size sweep at EQUAL step counts and steps_per_call=1 (bs 32/64/128,
+     500 steps each) — the K=1 baselines for measure_r04b.py's device-loop A/B
+  2. second-architecture MFU cross-check + flash-vs-XLA A/B (llama-1b, seq
+     1024-4096) — lives in measure_r04b.py (`--remat dots`; the no-remat legs
+     OOM, see below)
+  3. inference headline (llama-1b latency; gptj-6b — at the end of suite-b —
+     when HBM allows)
 
 Each config runs as `python bench.py --no-supervise --_worker ...` in a fresh
 process (clean singletons, one backend init per config) with a hard timeout.
@@ -20,69 +22,62 @@ import time
 
 CONFIGS = [
     # (tag, argv, timeout_s)
-    ("headline bs32", ["--steps", "500", "--trials", "3", "--batch_size", "32"], 2400),
-    ("sweep bs64", ["--steps", "500", "--trials", "3", "--batch_size", "64"], 2400),
-    ("sweep bs128", ["--steps", "500", "--trials", "3", "--batch_size", "128"], 3000),
-    (
-        "llama-1b seq1024 flash",
-        ["--model", "llama-1b", "--seq_len", "1024", "--batch_size", "4", "--steps", "100",
-         "--trials", "3", "--attention", "flash"],
-        3000,
-    ),
-    (
-        "llama-1b seq1024 xla",
-        ["--model", "llama-1b", "--seq_len", "1024", "--batch_size", "4", "--steps", "100",
-         "--trials", "3", "--attention", "xla"],
-        3000,
-    ),
-    # long-context scaling on the single chip (the per-device block compute the
-    # ring path runs at each hop): flash kernel at growing seq, fixed tokens/batch.
-    # --remat dots: llama-1b + fp32 AdamW moments is ~15 GB on the 16 GB chip, so
-    # 4096-token activation residuals must be rematerialized (the bs-4 seq-1024
-    # flash leg without remat OOM'd; measure_r04b.py re-runs it with remat).
-    (
-        "llama-1b seq2048 flash",
-        ["--model", "llama-1b", "--seq_len", "2048", "--batch_size", "2", "--steps", "60",
-         "--trials", "2", "--attention", "flash", "--remat", "dots"],
-        3000,
-    ),
-    (
-        "llama-1b seq4096 flash",
-        ["--model", "llama-1b", "--seq_len", "4096", "--batch_size", "1", "--steps", "40",
-         "--trials", "2", "--attention", "flash", "--remat", "dots"],
-        3000,
-    ),
+    # steps_per_call pinned to 1: these are the K=1 baselines for the device-loop
+    # A/B in measure_r04b.py (bench.py now auto-defaults bert to K=10 on
+    # accelerators, which would silently capture K=10 rows under K=1 tags).
+    ("headline bs32", ["--steps", "500", "--trials", "3", "--batch_size", "32", "--steps_per_call", "1"], 2400),
+    ("sweep bs64", ["--steps", "500", "--trials", "3", "--batch_size", "64", "--steps_per_call", "1"], 2400),
+    ("sweep bs128", ["--steps", "500", "--trials", "3", "--batch_size", "128", "--steps_per_call", "1"], 3000),
+    # llama-1b seq1024 WITHOUT remat is unrunnable on the 16 GB chip at bs 4
+    # (params + fp32 AdamW moments ~= 15 GB; both the flash and XLA legs OOM'd
+    # on hardware), so the flash-vs-XLA A/B runs with `--remat dots` at equal
+    # batch in measure_r04b.py — same kernels on the measured path, both legs
+    # paying the same remat cost.
+    # Long-context scaling (flash kernel at growing seq with --remat dots) lives
+    # ONLY in measure_r04b.py ("... seq2048/4096 flash remat" tags) — listing the
+    # same argv here under different tags would run each config twice on the chip.
     ("inference llama-1b", ["--mode", "inference", "--model", "llama-1b"], 1800),
-    ("inference gptj-6b", ["--mode", "inference", "--model", "gptj-6b"], 2700),
+    # "inference gptj-6b" runs at the END of suite-b: 6B bf16 params + KV cache
+    # is ~14 GB of the 16 GB chip — if it turns out not to fit, it must not
+    # stall every watcher cycle ahead of capturable configs (it is also
+    # OPTIONAL for tpu_watch.sh's exit condition for the same reason).
 ]
 
 
-def main():
-    out_path = "bench_suite_r04.jsonl"
-    # Resumable: the tunnel can drop mid-suite; captured tags are skipped so the
-    # watcher can just re-run the suite until every config has a row.
-    done = set()
+def captured_tags(out_path="bench_suite_r04.jsonl"):
+    """Tags with a persisted result row (the resume key run_suite skips by).
+    Error rows are never written, so failed configs are absent and retry."""
+    tags = set()
     try:
         with open(out_path) as f:
             for row_line in f:
                 try:
-                    done.add(json.loads(row_line).get("tag"))
+                    tags.add(json.loads(row_line).get("tag"))
                 except json.JSONDecodeError:
                     pass
     except FileNotFoundError:
         pass
+    return tags
+
+
+def run_suite(configs, prefix="suite", out_path="bench_suite_r04.jsonl"):
+    """Shared runner (measure_r04b.py imports this): resumable — the tunnel can
+    drop mid-suite; captured tags are skipped so the watcher can just re-run the
+    suite until every config has a row. Error rows are never persisted, so
+    failed configs retry on the next pass."""
+    done = captured_tags(out_path)
     results = []
-    for tag, argv, timeout_s in CONFIGS:
+    for tag, argv, timeout_s in configs:
         if tag in done:
-            print(f"[suite] {tag}: already captured, skipping", file=sys.stderr, flush=True)
+            print(f"[{prefix}] {tag}: already captured, skipping", file=sys.stderr, flush=True)
             continue
         cmd = [sys.executable, "bench.py", "--no-supervise"] + argv
-        print(f"[suite] {tag}: {' '.join(cmd)}", file=sys.stderr, flush=True)
+        print(f"[{prefix}] {tag}: {' '.join(cmd)}", file=sys.stderr, flush=True)
         t0 = time.time()
         try:
             proc = subprocess.run(cmd, timeout=timeout_s, capture_output=True, text=True)
         except subprocess.TimeoutExpired:
-            print(f"[suite] {tag}: TIMEOUT >{timeout_s}s", file=sys.stderr, flush=True)
+            print(f"[{prefix}] {tag}: TIMEOUT >{timeout_s}s", file=sys.stderr, flush=True)
             results.append({"tag": tag, "error": f"timeout>{timeout_s}s"})
             continue
         line = None
@@ -95,7 +90,7 @@ def main():
                 continue
         if proc.returncode != 0 or line is None:
             print(
-                f"[suite] {tag}: FAILED rc={proc.returncode}; stderr tail: "
+                f"[{prefix}] {tag}: FAILED rc={proc.returncode}; stderr tail: "
                 f"{(proc.stderr or '')[-600:]!r}",
                 file=sys.stderr,
                 flush=True,
@@ -107,10 +102,10 @@ def main():
         results.append(line)
         with open(out_path, "a") as f:
             f.write(json.dumps(line) + "\n")
-        print(f"[suite] {tag}: {json.dumps(line)}", flush=True)
+        print(f"[{prefix}] {tag}: {json.dumps(line)}", flush=True)
     ok = sum(1 for r in results if "error" not in r)
-    print(f"[suite] done: {ok}/{len(CONFIGS)} configs captured -> {out_path}", flush=True)
+    print(f"[{prefix}] done: {ok}/{len(configs)} configs captured -> {out_path}", flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    run_suite(CONFIGS)

@@ -9,13 +9,11 @@
    attention residuals so both legs fit on the 16 GB chip at equal batch.
 3. Long-seq flash scaling with remat (seq 2048 / 4096).
 
-Appends to bench_suite_r04.jsonl like the main suite.
+Appends to bench_suite_r04.jsonl via measure_r04.run_suite (shared resumable
+runner).
 """
 
-import json
-import subprocess
-import sys
-import time
+from measure_r04 import run_suite
 
 CONFIGS = [
     ("headline bs32 spc10", ["--steps", "500", "--trials", "3", "--batch_size", "32", "--steps_per_call", "10"], 2400),
@@ -45,61 +43,12 @@ CONFIGS = [
          "--trials", "2", "--attention", "flash", "--remat", "dots"],
         3000,
     ),
+    # Last on purpose, and OPTIONAL for tpu_watch.sh's exit condition: 6B bf16
+    # params + KV cache is ~14 GB of the 16 GB chip, so if it doesn't fit it
+    # must not stall the capturable configs every watcher cycle.
+    ("inference gptj-6b", ["--mode", "inference", "--model", "gptj-6b"], 2700),
 ]
 
 
-def main():
-    out_path = "bench_suite_r04.jsonl"
-    done = set()
-    try:
-        with open(out_path) as f:
-            for row_line in f:
-                try:
-                    done.add(__import__("json").loads(row_line).get("tag"))
-                except ValueError:
-                    pass
-    except FileNotFoundError:
-        pass
-    results = []
-    for tag, argv, timeout_s in CONFIGS:
-        if tag in done:
-            print(f"[suite-b] {tag}: already captured, skipping", file=sys.stderr, flush=True)
-            continue
-        cmd = [sys.executable, "bench.py", "--no-supervise"] + argv
-        print(f"[suite-b] {tag}: {' '.join(cmd)}", file=sys.stderr, flush=True)
-        t0 = time.time()
-        try:
-            proc = subprocess.run(cmd, timeout=timeout_s, capture_output=True, text=True)
-        except subprocess.TimeoutExpired:
-            print(f"[suite-b] {tag}: TIMEOUT >{timeout_s}s", file=sys.stderr, flush=True)
-            results.append({"tag": tag, "error": f"timeout>{timeout_s}s"})
-            continue
-        line = None
-        for out_line in (proc.stdout or "").strip().splitlines():
-            try:
-                parsed = json.loads(out_line)
-                if isinstance(parsed, dict) and "metric" in parsed:
-                    line = parsed
-            except json.JSONDecodeError:
-                continue
-        if proc.returncode != 0 or line is None:
-            print(
-                f"[suite-b] {tag}: FAILED rc={proc.returncode}; stderr tail: "
-                f"{(proc.stderr or '')[-600:]!r}",
-                file=sys.stderr,
-                flush=True,
-            )
-            results.append({"tag": tag, "error": f"rc={proc.returncode}"})
-            continue
-        line["tag"] = tag
-        line["wall_s"] = round(time.time() - t0, 1)
-        results.append(line)
-        with open(out_path, "a") as f:
-            f.write(json.dumps(line) + "\n")
-        print(f"[suite-b] {tag}: {json.dumps(line)}", flush=True)
-    ok = sum(1 for r in results if "error" not in r)
-    print(f"[suite-b] done: {ok}/{len(CONFIGS)} configs captured -> {out_path}", flush=True)
-
-
 if __name__ == "__main__":
-    main()
+    run_suite(CONFIGS, prefix="suite-b")

@@ -82,6 +82,22 @@ def test_launched_accuracy_gate(strategy):
 
 
 @pytest.mark.slow_launch
+def test_launched_token_parity_ragged_eval():
+    """The fast-tier task keeps the ragged-eval coverage the text_pair default
+    lost (its 128-row dev set divides evenly by batch 32): token_parity builds
+    eval_size-5 = 91 rows, so the padded last eval batch forces
+    gather_for_metrics to truncate duplicates — the script asserts the gathered
+    count equals the true eval size before computing accuracy."""
+    result = launch_gate("dp", extra_args=("--task", "token_parity"))
+    assert "Performance gate passed" in result.stdout, result.stdout
+    payload = next(
+        json.loads(line) for line in result.stdout.splitlines() if line.startswith("{")
+    )
+    assert payload["task"] == "token_parity"
+    assert payload["accuracy"] >= 0.82
+
+
+@pytest.mark.slow_launch
 @pytest.mark.skipif(
     not os.environ.get("ACCELERATE_TPU_RUN_MUTATION"),
     reason="mutation audit: run explicitly with ACCELERATE_TPU_RUN_MUTATION=1",

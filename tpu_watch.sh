@@ -1,30 +1,21 @@
 #!/bin/bash
 # Probe the TPU tunnel every 10 min; when it answers, run the (resumable)
 # round-4 measurement suites. Both suites skip tags already captured in
-# bench_suite_r04.jsonl, so a tunnel drop mid-suite just means the next
-# probe-cycle picks up the missing configs. Exits when every config has a row.
+# bench_suite_r04.jsonl (measure_r04.captured_tags is the single source of
+# truth for the resume key), so a tunnel drop mid-suite just means the next
+# probe-cycle picks up the missing configs. Exits when every REQUIRED config
+# has a row: "inference gptj-6b" is optional — 6B params + KV cache is ~14 GB
+# of the 16 GB chip, and if it can't fit it must not keep the watcher (and the
+# tunnel) busy forever after everything else is captured.
 cd /root/repo
-want=16  # 9 suite-a + 7 suite-b tags
+need=11  # 4 suite-a + 8 suite-b tags, minus the optional gptj-6b
 for i in $(seq 1 60); do
-  have=$(python - <<'EOF'
-import json
-tags = set()
-try:
-    for line in open("bench_suite_r04.jsonl"):
-        try:
-            tags.add(json.loads(line).get("tag"))
-        except ValueError:
-            pass
-except FileNotFoundError:
-    pass
-print(len(tags))
-EOF
-)
-  if [ "$have" -ge "$want" ]; then
-    echo "[watch] all $want configs captured; exiting" >> tpu_watch.log
+  have=$(python -c "import measure_r04 as m; t = m.captured_tags(); print(len(t - {'inference gptj-6b'}))")
+  if [ "$have" -ge "$need" ]; then
+    echo "[watch] all $need required configs captured; exiting" >> tpu_watch.log
     exit 0
   fi
-  echo "[watch] probe $i at $(date -u +%H:%M:%S) (captured $have/$want)" >> tpu_watch.log
+  echo "[watch] probe $i at $(date -u +%H:%M:%S) (captured $have/$need required)" >> tpu_watch.log
   if timeout 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'; print(jax.devices()[0].device_kind)" >> tpu_watch.log 2>&1; then
     echo "[watch] TPU alive; running suites" >> tpu_watch.log
     python measure_r04.py >> tpu_watch.log 2>&1
