@@ -308,8 +308,17 @@ def save_accelerator_state(
             sampler = _find_seedable_sampler(dl)
             if sampler is not None:
                 name = f"{SAMPLER_NAME}.bin" if i == 0 else f"{SAMPLER_NAME}_{i}.bin"
+                # The loader's pass counter rides along: it is what
+                # `DataLoaderShard.__iter__` feeds `set_epoch()` on the next
+                # pass, and it disambiguates a mid-pass save (iteration ==
+                # sampler.epoch: replay this epoch's permutation + skip) from
+                # an epoch-boundary save (iteration == epoch + 1: the next
+                # pass must draw a FRESH permutation, not repeat the last).
+                payload = {"sampler": sampler.state_dict()}
+                if hasattr(dl, "iteration"):
+                    payload["loader_iteration"] = dl.iteration
                 with open(output_dir / name, "wb") as f:
-                    pickle.dump(sampler.state_dict(), f)
+                    pickle.dump(payload, f)
 
     # RNG states are per-process (reference saves `random_states_{i}.pkl`,
     # checkpointing.py:122-151).
@@ -394,7 +403,20 @@ def load_accelerator_state(
         name = f"{SAMPLER_NAME}.bin" if i == 0 else f"{SAMPLER_NAME}_{i}.bin"
         if sampler is not None and (input_dir / name).exists():
             with open(input_dir / name, "rb") as f:
-                sampler.load_state_dict(pickle.load(f))
+                payload = pickle.load(f)
+            if "sampler" in payload:
+                sampler.load_state_dict(payload["sampler"])
+                loader_iteration = payload.get("loader_iteration")
+            else:  # pre-round-4 checkpoint: bare sampler state_dict
+                sampler.load_state_dict(payload)
+                loader_iteration = payload.get("epoch")
+            # Realign the loader's pass counter: `DataLoaderShard.__iter__`
+            # calls `set_epoch(self.iteration)` at the top of every pass, and
+            # a fresh process's 0 would clobber the restored epoch — the
+            # resumed pass would replay epoch 0's permutation, so
+            # `skip_first_batches` would skip the WRONG samples.
+            if loader_iteration is not None and hasattr(dl, "iteration"):
+                dl.iteration = loader_iteration
 
     rng_key = None
     if load_rng:
@@ -420,5 +442,15 @@ def save_custom_state(obj, path: str, index: int = 0):
 def load_custom_state(obj, path: str, index: int = 0):
     """(reference checkpointing.py:267)"""
     location = Path(path) / f"custom_checkpoint_{index}.pkl"
+    if not location.exists():
+        # Hard failure on purpose: silently keeping the object's constructed
+        # state would resume at a wrong position (e.g. a step counter at 0 on
+        # fully-trained weights). The usual cause is actionable.
+        raise FileNotFoundError(
+            f"Checkpoint has no saved state for registered object {index} "
+            f"({type(obj).__name__}) at {location}. If this object was "
+            "registered for checkpointing AFTER the checkpoint was written, "
+            "resume once without registering it (or write a fresh checkpoint)."
+        )
     with open(location, "rb") as f:
         obj.load_state_dict(pickle.load(f))

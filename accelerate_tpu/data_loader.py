@@ -554,7 +554,21 @@ class DataLoaderShard(DataLoaderStateMixin):
     def batch_sampler(self):
         return getattr(self.base_loader, "batch_sampler", None)
 
+    def _advance_linked_loader(self):
+        """A `skip_first_batches` wrapper finishing its (partial) pass advances
+        the loader it was built from, so the caller's NEXT full pass over the
+        original loader draws a fresh permutation instead of replaying the
+        resumed epoch's order."""
+        linked = getattr(self, "_linked_loader", None)
+        if linked is not None:
+            linked.iteration = max(linked.iteration, self.iteration)
+
     def set_epoch(self, epoch: int):
+        """Pin the shuffle epoch for the NEXT pass (public resume API: also
+        realigns the loader's own pass counter, which `__iter__` would
+        otherwise feed to the sampler — so an explicit `set_epoch(E)` wins
+        over however many passes this loader object has or hasn't run)."""
+        self.iteration = epoch
         if hasattr(self.batch_sampler, "sampler") and hasattr(self.batch_sampler.sampler, "set_epoch"):
             self.batch_sampler.sampler.set_epoch(epoch)
         elif hasattr(self.batch_sampler, "batch_sampler") and hasattr(
@@ -629,6 +643,7 @@ class DataLoaderShard(DataLoaderStateMixin):
                     yield held
                 held = payload
             self.iteration += 1
+            self._advance_linked_loader()
         finally:
             stop.set()
             # Drain so a producer blocked on q.put can observe `stop`, then wait for it
@@ -696,7 +711,11 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
     def dataset(self):
         return getattr(self.base_loader, "dataset", None)
 
+    _advance_linked_loader = DataLoaderShard._advance_linked_loader
+
     def set_epoch(self, epoch: int):
+        """Pin the shuffle epoch for the NEXT pass (see DataLoaderShard.set_epoch)."""
+        self.iteration = epoch
         if hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(epoch)
 
@@ -811,6 +830,7 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
                 current = nxt
                 batch_index += 1
             self.iteration += 1
+            self._advance_linked_loader()
         finally:
             self.end()
 
@@ -855,7 +875,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             elif isinstance(base, SimpleDataLoader):
                 new_base = SimpleDataLoader(base.dataset, skip_sampler, base.collate_fn)
         if new_base is not None:
-            return DataLoaderShard(
+            skipped = DataLoaderShard(
                 new_base,
                 sharding=dataloader.sharding,
                 device_placement=dataloader.device_placement,
@@ -867,21 +887,22 @@ def skip_first_batches(dataloader, num_batches: int = 0):
                 per_host_batch_size=dataloader.per_host_batch_size,
                 even_batches=dataloader.even_batches,
             )
-        return DataLoaderShard(
-            dataloader.base_loader,
-            sharding=dataloader.sharding,
-            device_placement=dataloader.device_placement,
-            rng_types=dataloader.rng_types,
-            synchronized_generator=dataloader.synchronized_generator,
-            total_batch_size=dataloader._total_batch_size,
-            total_dataset_length=dataloader._total_dataset_length,
-            prefetch_size=dataloader.prefetch_size,
-            skip_batches=dataloader.skip_batches + num_batches,
-            per_host_batch_size=dataloader.per_host_batch_size,
-            even_batches=dataloader.even_batches,
-        )
-    if isinstance(dataloader, DataLoaderDispatcher):
-        return DataLoaderDispatcher(
+        else:
+            skipped = DataLoaderShard(
+                dataloader.base_loader,
+                sharding=dataloader.sharding,
+                device_placement=dataloader.device_placement,
+                rng_types=dataloader.rng_types,
+                synchronized_generator=dataloader.synchronized_generator,
+                total_batch_size=dataloader._total_batch_size,
+                total_dataset_length=dataloader._total_dataset_length,
+                prefetch_size=dataloader.prefetch_size,
+                skip_batches=dataloader.skip_batches + num_batches,
+                per_host_batch_size=dataloader.per_host_batch_size,
+                even_batches=dataloader.even_batches,
+            )
+    elif isinstance(dataloader, DataLoaderDispatcher):
+        skipped = DataLoaderDispatcher(
             dataloader.base_loader,
             sharding=dataloader.sharding,
             device_placement=dataloader.device_placement,
@@ -893,6 +914,19 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             per_host_batch_size=dataloader.per_host_batch_size,
             even_batches=dataloader.even_batches,
         )
+    else:
+        skipped = None
+    if skipped is not None:
+        # The resumed partial pass must shuffle with the interrupted epoch's
+        # permutation, not a fresh wrapper's pass 0 — carry the source
+        # loader's pass counter across (it was itself realigned by
+        # load_state when resuming in a fresh process), and link back so the
+        # wrapper's completed pass advances the source: the caller's next
+        # full pass over the ORIGINAL loader must draw the following epoch's
+        # permutation, not replay the resumed one.
+        skipped.iteration = dataloader.iteration
+        skipped._linked_loader = dataloader
+        return skipped
 
     # Raw iterable / torch loader: generic skipping wrapper.
     class _Skipper:

@@ -162,5 +162,13 @@ class NativeArrayLoader:
     def __len__(self):
         return len(self.batch_sampler)
 
+    def set_epoch(self, epoch: int):
+        """Loader-surface parity with SimpleDataLoader/DataLoaderShard: forward
+        to an epoch-aware index sampler (SeedableRandomSampler) if one backs
+        the batch sampler; a fixed index list has nothing to reshuffle."""
+        sampler = getattr(self.batch_sampler, "sampler", None)
+        if hasattr(sampler, "set_epoch"):
+            sampler.set_epoch(epoch)
+
     def __iter__(self):
         yield from iter_gather_batches(self.pool, self.dataset.columns, self.batch_sampler)

@@ -26,6 +26,7 @@ import numpy as np
 from .logging import get_logger
 from .state import AcceleratorState, GradientState
 from .utils.dataclasses import GradScalerKwargs
+from .utils.environment import fence_if_cpu
 
 logger = get_logger(__name__)
 
@@ -916,6 +917,8 @@ class AcceleratedOptimizer:
         else:
             self.step_was_skipped = False
         self.model.params = new_params
+        # XLA:CPU-only deadlock guard (no-op on TPU/GPU) — see fence_if_cpu.
+        fence_if_cpu(new_params)
 
     def zero_grad(self, set_to_none: bool = True):
         """Clear accumulated grads; no-op mid-accumulation (reference optimizer.py:112)."""

@@ -146,9 +146,12 @@ def cpu_mesh_env(num_devices: int = 8) -> dict:
     # on a loaded small host a collective can take minutes to assemble its
     # participants — that's starvation, not a hang (XLA:CPU's default ~40s
     # rendezvous deadline calls it a hang and kills the child). Real hangs still
-    # die at the harness subprocess timeout. NOTE: shrinking the thread pool
-    # instead DEADLOCKS the first cross-module collective (participants must run
-    # concurrently); the longer deadline is the only safe fix.
+    # die at the harness subprocess timeout. NOTE: a longer deadline cannot fix
+    # the second flake mechanism — the async-dispatch deadlock, where partitions
+    # of DIFFERENT in-flight steps hold the pool's threads waiting on different
+    # rendezvous; FusedTrainStep closes that one by fencing per call on the CPU
+    # platform. Shrinking the thread pool likewise DEADLOCKS the first
+    # cross-module collective (participants must run concurrently).
     if "collective_call_terminate_timeout" not in env["XLA_FLAGS"]:
         env["XLA_FLAGS"] += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
     # Children must resolve the package even when it's driven from a source checkout.

@@ -316,6 +316,9 @@ class FusedTrainStep:
         # eager accumulate() flow.
         if self.gradient_state is not None:
             self.gradient_state._set_sync_gradients(True)
+        from .utils.environment import fence_if_cpu
+
+        fence_if_cpu(loss)
         if aux is not None:
             return loss, aux
         return loss

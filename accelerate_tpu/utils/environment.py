@@ -64,6 +64,32 @@ def get_available_host_memory_bytes() -> int:
     return get_host_memory_bytes() // 2
 
 
+_FENCE_ON_CPU: bool | None = None
+
+
+def fence_if_cpu(tree) -> None:
+    """Host-sync `tree` when running on the XLA:CPU backend (the virtual-mesh
+    dev/test surface); no-op on TPU/GPU.
+
+    XLA:CPU deadlocks under async dispatch of partitioned programs: with K
+    optimizer steps in flight, partitions of DIFFERENT steps hold the client's
+    worker threads waiting on DIFFERENT channel-collective rendezvous, and on
+    a small host the next step's partitions can starve the previous step's
+    last participant forever (observed: 3/4 partitions joined, termination at
+    the full rendezvous deadline on an idle box). One host sync per step caps
+    in-flight programs at one step. Real TPU/GPU runtimes schedule per-device
+    queues and need (and get) no such fence."""
+    global _FENCE_ON_CPU
+    if _FENCE_ON_CPU is None:
+        import jax
+
+        _FENCE_ON_CPU = jax.devices()[0].platform == "cpu"
+    if _FENCE_ON_CPU:
+        import jax
+
+        jax.block_until_ready(tree)
+
+
 @dataclass
 class TpuTopology:
     """ICI topology discovered from the JAX device list (replaces nvidia-smi probing,

@@ -13,6 +13,7 @@ import numpy as np
 import optax
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from complete_nlp_example import StepCounter  # noqa: E402
 from nlp_example import MAX_LEN, get_dataset  # noqa: E402
 
 from accelerate_tpu import Accelerator, SimpleDataLoader
@@ -35,20 +36,29 @@ def training_function(args):
     optimizer = optax.adamw(args.lr)
     model, optimizer, train_dl = accelerator.prepare(model, optimizer, train_dl)
 
+    # The optimizer-step counter rides the checkpoint (save_iteration only
+    # counts save_state CALLS — with per-epoch saves it is the epoch count, not
+    # the batch position, so resume arithmetic must come from saved state).
+    counter = StepCounter()
+    accelerator.register_for_checkpointing(counter)
+
     start_epoch = 0
     resume_step = 0
     if args.resume_from_checkpoint:
-        path = args.resume_from_checkpoint
-        if path == "latest":
-            ckpts = sorted(os.listdir(os.path.join(args.output_dir, "checkpoints")))
-            path = os.path.join(args.output_dir, "checkpoints", ckpts[-1])
+        # 'latest' -> load_state() with no path (numeric newest-checkpoint
+        # resolution; lexicographic listdir breaks past checkpoint_9).
+        path = None if args.resume_from_checkpoint == "latest" else args.resume_from_checkpoint
         accelerator.load_state(path)
-        completed = accelerator.save_iteration
-        start_epoch = completed // len(train_dl)
-        resume_step = completed % len(train_dl)
-        accelerator.print(f"resumed from {path}: epoch {start_epoch}, step {resume_step}")
+        start_epoch = counter.overall_step // len(train_dl)
+        resume_step = counter.overall_step % len(train_dl)
+        accelerator.print(
+            f"resumed from {path or 'latest checkpoint'}: epoch {start_epoch}, step {resume_step}"
+        )
 
     for epoch in range(start_epoch, args.epochs):
+        # Pin the shuffle epoch explicitly: exact regardless of where in the
+        # epoch the checkpoint landed (the skip wrapper inherits the pin).
+        train_dl.set_epoch(epoch)
         dl = train_dl
         if epoch == start_epoch and resume_step:
             dl = accelerator.skip_first_batches(train_dl, resume_step)
@@ -57,6 +67,7 @@ def training_function(args):
                 loss = accelerator.backward(model.loss, batch)
                 optimizer.step()
                 optimizer.zero_grad()
+            counter.overall_step += 1
         accelerator.save_state()
         accelerator.print(f"epoch {epoch}: loss {float(loss):.4f} (state saved)")
 

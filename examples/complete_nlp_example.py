@@ -1,0 +1,168 @@
+"""The 'complete' NLP example (parity: reference examples/complete_nlp_example.py —
+every production knob of the canonical nlp_example in one script): CLI-selected
+checkpointing granularity (`--checkpointing_steps N|epoch`), mid-epoch resume via
+`--resume_from_checkpoint`, experiment tracking behind `--with_tracking`, an LR
+schedule stepped with the optimizer, and gathered eval metrics.
+
+    python examples/complete_nlp_example.py --checkpointing_steps epoch
+    python examples/complete_nlp_example.py --checkpointing_steps 50 \
+        --resume_from_checkpoint latest --with_tracking
+"""
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
+from accelerate_tpu.models import bert_tiny, create_bert_model
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+from nlp_example import MAX_LEN, get_dataset
+
+
+class StepCounter:
+    """Optimizer-step counter checkpointed alongside model/optimizer state via
+    `register_for_checkpointing`, so resume lands on the exact batch regardless
+    of checkpoint granularity (`save_iteration` only counts save_state calls)."""
+
+    def __init__(self):
+        self.overall_step = 0
+
+    def state_dict(self):
+        return {"overall_step": self.overall_step}
+
+    def load_state_dict(self, state):
+        self.overall_step = int(state["overall_step"])
+
+
+def training_function(args):
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        log_with="json" if args.with_tracking else None,
+        project_dir=args.output_dir,
+        project_config=ProjectConfiguration(automatic_checkpoint_naming=True, total_limit=3),
+    )
+    set_seed(args.seed)
+
+    config = bert_tiny()
+    model = create_bert_model(config, seq_len=MAX_LEN)
+    vocab = config.vocab_size - 1
+
+    train_data = get_dataset(vocab, n=args.train_size, seed=0)
+    eval_data = get_dataset(vocab, n=args.eval_size, seed=1)
+    sampler = SeedableRandomSampler(num_samples=len(train_data), seed=args.seed)
+    train_dl = SimpleDataLoader(train_data, BatchSampler(sampler, args.batch_size))
+    eval_dl = SimpleDataLoader(eval_data, BatchSampler(range(len(eval_data)), args.batch_size))
+
+    schedule = optax.linear_schedule(args.lr, 0.0, transition_steps=args.epochs * len(train_dl))
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=args.lr)
+    model, optimizer, train_dl, eval_dl, scheduler = accelerator.prepare(
+        model, optimizer, train_dl, eval_dl, schedule
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_nlp_example", config=vars(args))
+
+    # Checkpoint granularity: every N optimizer steps, or once per epoch.
+    checkpointing_steps = args.checkpointing_steps
+    if checkpointing_steps is not None and checkpointing_steps != "epoch":
+        checkpointing_steps = int(checkpointing_steps)
+
+    counter = StepCounter()
+    accelerator.register_for_checkpointing(counter)
+
+    start_epoch = 0
+    resume_step = 0
+    if args.resume_from_checkpoint:
+        # 'latest' -> load_state() with no path: the accelerator resolves the
+        # newest checkpoint NUMERICALLY (a lexicographic listdir would order
+        # checkpoint_10 before checkpoint_9 once rotation passes ten saves).
+        path = None if args.resume_from_checkpoint == "latest" else args.resume_from_checkpoint
+        accelerator.load_state(path)
+        start_epoch = counter.overall_step // len(train_dl)
+        resume_step = counter.overall_step % len(train_dl)
+        accelerator.print(
+            f"resumed from {path or 'latest checkpoint'}: epoch {start_epoch}, step {resume_step}"
+        )
+
+    if start_epoch >= args.epochs:
+        accelerator.print(
+            f"nothing to train: checkpoint is at epoch {start_epoch} of {args.epochs} — "
+            "raise --epochs to continue"
+        )
+        return None
+
+    accuracy = 0.0
+    for epoch in range(start_epoch, args.epochs):
+        # Pin the shuffle epoch explicitly: exact regardless of where in the
+        # epoch the checkpoint landed (the skip wrapper inherits the pin).
+        train_dl.set_epoch(epoch)
+        dl = train_dl
+        if epoch == start_epoch and resume_step:
+            dl = accelerator.skip_first_batches(train_dl, resume_step)
+        total_loss = 0.0
+        n_batches = 0
+        for batch in dl:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(model.loss, batch)
+                accelerator.clip_grad_norm_(max_norm=1.0)
+                optimizer.step()
+                scheduler.step()
+                optimizer.zero_grad()
+            total_loss += float(loss)
+            n_batches += 1
+            counter.overall_step += 1
+            if isinstance(checkpointing_steps, int) and counter.overall_step % checkpointing_steps == 0:
+                accelerator.save_state()
+        if checkpointing_steps == "epoch":
+            accelerator.save_state()
+
+        correct, total = 0, 0
+        for batch in eval_dl:
+            logits = model(batch["input_ids"], None, batch["token_type_ids"])
+            preds = accelerator.gather_for_metrics(np.asarray(logits).argmax(-1))
+            labels = accelerator.gather_for_metrics(np.asarray(batch["labels"]))
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            total += len(np.asarray(labels))
+        accuracy = correct / total
+        train_loss = total_loss / max(n_batches, 1)
+        accelerator.print(f"epoch {epoch}: loss {train_loss:.4f} accuracy {accuracy:.4f}")
+        if args.with_tracking:
+            accelerator.log(
+                {"train_loss": train_loss, "accuracy": accuracy, "step": counter.overall_step},
+                step=epoch,
+            )
+
+    if args.with_tracking:
+        accelerator.end_training()
+    return accuracy
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--train_size", type=int, default=512)
+    parser.add_argument("--eval_size", type=int, default=128)
+    parser.add_argument("--output_dir", default="/tmp/accelerate_tpu_complete_nlp")
+    parser.add_argument(
+        "--checkpointing_steps",
+        default=None,
+        help="checkpoint every N optimizer steps, or 'epoch' for once per epoch",
+    )
+    parser.add_argument("--resume_from_checkpoint", default=None, help="path or 'latest'")
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--performance_lower_bound", type=float, default=None)
+    args = parser.parse_args()
+    accuracy = training_function(args)
+    if args.performance_lower_bound is not None and accuracy is not None:
+        assert accuracy >= args.performance_lower_bound, (
+            f"accuracy {accuracy:.4f} below bound {args.performance_lower_bound}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -120,6 +120,50 @@ def test_example_difference_harness():
 
 
 @pytest.mark.slow_launch
+def test_complete_nlp_example_checkpoint_resume():
+    """The 'complete' variant must exercise its whole knob set in one run:
+    epoch-granular checkpointing, then a resumed continuation with tracking."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        out = _run(
+            "complete_nlp_example.py",
+            "--train_size", "128", "--eval_size", "64", "--epochs", "1",
+            "--checkpointing_steps", "epoch", "--output_dir", d,
+        )
+        assert "accuracy" in out
+        out = _run(
+            "complete_nlp_example.py",
+            "--train_size", "128", "--eval_size", "64", "--epochs", "2",
+            "--checkpointing_steps", "epoch", "--output_dir", d,
+            "--resume_from_checkpoint", "latest", "--with_tracking",
+        )
+        assert "resumed from" in out and "accuracy" in out
+
+
+@pytest.mark.slow_launch
+def test_complete_cv_example_checkpoint_resume():
+    """Exercise the CV variant's whole knob set, not just the train loop:
+    epoch-granular save, then resume + tracking. Default 512-row dataset (like
+    test_cv_example): 96 rows underfit the quadrant task and trip the script's
+    learning assert."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        out = _run(
+            "complete_cv_example.py",
+            "--epochs", "1", "--checkpointing_steps", "epoch", "--output_dir", d,
+        )
+        assert "accuracy" in out
+        out = _run(
+            "complete_cv_example.py",
+            "--epochs", "2", "--checkpointing_steps", "epoch", "--output_dir", d,
+            "--resume_from_checkpoint", "latest", "--with_tracking",
+        )
+        assert "resumed from" in out and "accuracy" in out
+
+
+@pytest.mark.slow_launch
 def test_checkpointing_example_resume():
     import tempfile
 

@@ -12,9 +12,16 @@ virtual CPU mesh: a from-scratch bert-tiny must learn the synonym-matching
 circuit to clear the floor, so broken-but-converging training (wrong LR scale,
 precision loss) FAILS — verified by the mutation audit below.
 
-No retries: the old rendezvous flake was XLA:CPU's ~40s collective deadline
-tripping under host load (starvation, not a hang); `cpu_mesh_env` now raises it
-to 600s and real hangs still die at the subprocess timeout.
+No retries: the old rendezvous flake had TWO mechanisms, both fixed at the
+source. (1) Load starvation: on a loaded small host a collective can take
+minutes to assemble its participants; `cpu_mesh_env` raises XLA:CPU's ~40s
+rendezvous deadline to 600s. (2) Async-dispatch deadlock (sharded strategies):
+with several partitioned step programs in flight, partitions of DIFFERENT
+steps hold the CPU client's worker threads waiting on different
+channel-collective rendezvous and starve each other forever — no deadline
+fixes that, so `FusedTrainStep` fences once per call on the CPU platform,
+capping in-flight programs at one step. Real hangs still die at the subprocess
+timeout.
 """
 
 import json

@@ -243,6 +243,78 @@ def test_save_load_state_roundtrip(tmp_path):
         np.testing.assert_allclose(np.asarray(a), b)
 
 
+def test_resume_restores_sampler_epoch(tmp_path):
+    """A restored checkpoint must reproduce the uninterrupted run's shuffle
+    order in a fresh process: `DataLoaderShard.__iter__` feeds its own pass
+    counter to `set_epoch()`, so `load_state` realigns that counter from the
+    checkpoint — a fresh process's 0 would silently replay epoch 0's
+    permutation for every resumed epoch."""
+    from accelerate_tpu.data_loader import SeedableRandomSampler
+
+    accelerator = Accelerator()
+    data = make_regression_data(32)
+    sampler = SeedableRandomSampler(num_samples=32, seed=11)
+    dl = SimpleDataLoader(data, BatchSampler(sampler, 8))
+    model = make_regression_model()
+    pmodel, popt, pdl = accelerator.prepare(model, optax.adam(1e-2), dl)
+
+    def run_pass_first_batch():
+        it = iter(pdl)
+        first = next(it)
+        for _ in it:  # drain so the pass completes and the counter advances
+            pass
+        return np.asarray(first["x"])
+
+    run_pass_first_batch()  # epoch 0
+    run_pass_first_batch()  # epoch 1
+    out = accelerator.save_state(str(tmp_path / "ckpt"))  # epoch-boundary save
+    expected = run_pass_first_batch()  # epoch 2's order, uninterrupted
+
+    # Simulate the fresh resuming process: pass counter and sampler reset.
+    pdl.iteration = 0
+    sampler.set_epoch(0)
+    accelerator.load_state(out)
+    resumed = run_pass_first_batch()
+    np.testing.assert_array_equal(resumed, expected)
+
+    # Distinct permutations sanity check: epoch 2 differs from epoch 0.
+    pdl.iteration = 0
+    sampler.set_epoch(0)
+    epoch0 = run_pass_first_batch()
+    assert not np.array_equal(epoch0, expected)
+
+
+def test_skip_first_batches_preserves_resumed_epoch():
+    """Mid-epoch resume must skip batches of the INTERRUPTED epoch's
+    permutation: the skip wrapper inherits the source loader's pass counter
+    (a fresh wrapper's 0 would shuffle with epoch 0's order and skip the
+    wrong samples)."""
+    from accelerate_tpu.data_loader import SeedableRandomSampler
+
+    accelerator = Accelerator()
+    data = make_regression_data(32)
+    sampler = SeedableRandomSampler(num_samples=32, seed=3)
+    dl = SimpleDataLoader(data, BatchSampler(sampler, 8))
+    pdl = accelerator.prepare(dl)
+
+    def pass_batches(loader):
+        return [np.asarray(b["x"]) for b in loader]
+
+    pass_batches(pdl)  # epoch 0
+    epoch1 = pass_batches(pdl)  # epoch 1, uninterrupted order
+
+    # Resume "mid-epoch 1, 2 batches done": pin the epoch, skip, compare.
+    pdl.set_epoch(1)
+    resumed = pass_batches(accelerator.skip_first_batches(pdl, 2))
+    np.testing.assert_array_equal(np.stack(resumed), np.stack(epoch1[2:]))
+
+    # Completing the wrapper's pass advances the ORIGINAL loader, so the next
+    # full pass draws epoch 2's permutation instead of replaying epoch 1's.
+    assert pdl.iteration == 2
+    epoch2 = pass_batches(pdl)
+    assert not np.array_equal(np.stack(epoch2), np.stack(epoch1))
+
+
 def test_gather_for_metrics_truncates_padding():
     accelerator = Accelerator()
     # 20 samples, batch 8 → final batch padded from 4 to 8; gathered eval must give 20
