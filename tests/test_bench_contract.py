@@ -43,7 +43,29 @@ def test_train_bench_contract():
 def test_inference_bench_contract():
     row = run_bench("--mode", "inference", "--model", "llama-tiny")
     assert set(row) >= {"metric", "value", "unit", "vs_baseline", "extra"}
+    assert isinstance(row["value"], (int, float)) and row["value"] > 0
     assert row["unit"] == "ms/token"
     assert row["metric"].startswith("cpu-smoke")
     assert row["vs_baseline"] == 0.0
     assert row["extra"]["ttft_p50_ms"] > 0
+
+
+@pytest.mark.slow_launch
+def test_supervised_fallback_contract():
+    """The path the driver actually invokes: supervise() with the preflight
+    disabled and zero real attempts forces the CPU-fallback leg — its re-tagged
+    single JSON line is what lands in BENCH_r{N}.json on a dead tunnel."""
+    env = cpu_mesh_env(num_devices=1)
+    env["BENCH_PREFLIGHT_TIMEOUT"] = "0"
+    env["BENCH_MAX_ATTEMPTS"] = "0"
+    proc = execute_subprocess(
+        [sys.executable, BENCH, "--model", "bert-tiny", "--steps", "2", "--trials", "1", "--warmup", "1"],
+        env=env,
+        timeout=900,
+    )
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"supervised stdout must carry exactly one line, got {lines!r}"
+    row = json.loads(lines[0])
+    assert row["metric"].startswith("cpu-fallback"), row["metric"]
+    assert row["vs_baseline"] == 0.0
+    assert row["extra"]["cpu_fallback"] is True
