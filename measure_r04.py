@@ -16,9 +16,29 @@ Results append to bench_suite_r04.jsonl; summarize into MEASUREMENTS_r04.md.
 """
 
 import json
+import signal
 import subprocess
 import sys
 import time
+
+# The in-flight bench child: when the watcher's deadline `timeout` TERMs this
+# runner, the child (which is what actually holds the TPU tunnel) must not be
+# orphaned — the handler reaps it and exits.
+_current_child = None
+
+
+def _terminate_child(signum, frame):
+    child = _current_child
+    if child is not None and child.poll() is None:
+        child.terminate()
+        try:
+            child.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            child.kill()
+    sys.exit(143)
+
+
+signal.signal(signal.SIGTERM, _terminate_child)
 
 CONFIGS = [
     # (tag, argv, timeout_s)
@@ -74,12 +94,21 @@ def run_suite(configs, prefix="suite", out_path="bench_suite_r04.jsonl"):
         cmd = [sys.executable, "bench.py", "--no-supervise"] + argv
         print(f"[{prefix}] {tag}: {' '.join(cmd)}", file=sys.stderr, flush=True)
         t0 = time.time()
+        global _current_child
+        _current_child = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True
+        )
         try:
-            proc = subprocess.run(cmd, timeout=timeout_s, capture_output=True, text=True)
+            out, err = _current_child.communicate(timeout=timeout_s)
+            proc = subprocess.CompletedProcess(cmd, _current_child.returncode, out, err)
         except subprocess.TimeoutExpired:
+            _current_child.kill()
+            _current_child.communicate()
             print(f"[{prefix}] {tag}: TIMEOUT >{timeout_s}s", file=sys.stderr, flush=True)
             results.append({"tag": tag, "error": f"timeout>{timeout_s}s"})
             continue
+        finally:
+            _current_child = None
         line = None
         for out_line in (proc.stdout or "").strip().splitlines():
             try:

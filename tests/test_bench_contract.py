@@ -14,12 +14,11 @@ from accelerate_tpu.test_utils.testing import cpu_mesh_env, execute_subprocess
 BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
 
 
-def run_bench(*args):
-    proc = execute_subprocess(
-        [sys.executable, BENCH, "--no-supervise", *args],
-        env=cpu_mesh_env(num_devices=1),
-        timeout=900,
-    )
+def run_bench(*args, supervise=False, extra_env=None):
+    env = cpu_mesh_env(num_devices=1)
+    env.update(extra_env or {})
+    cmd = [sys.executable, BENCH, *([] if supervise else ["--no-supervise"]), *args]
+    proc = execute_subprocess(cmd, env=env, timeout=900)
     lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
     assert len(lines) == 1, f"stdout must carry exactly one line, got {lines!r}"
     return json.loads(lines[0])
@@ -55,17 +54,11 @@ def test_supervised_fallback_contract():
     """The path the driver actually invokes: supervise() with the preflight
     disabled and zero real attempts forces the CPU-fallback leg — its re-tagged
     single JSON line is what lands in BENCH_r{N}.json on a dead tunnel."""
-    env = cpu_mesh_env(num_devices=1)
-    env["BENCH_PREFLIGHT_TIMEOUT"] = "0"
-    env["BENCH_MAX_ATTEMPTS"] = "0"
-    proc = execute_subprocess(
-        [sys.executable, BENCH, "--model", "bert-tiny", "--steps", "2", "--trials", "1", "--warmup", "1"],
-        env=env,
-        timeout=900,
+    row = run_bench(
+        "--model", "bert-tiny", "--steps", "2", "--trials", "1", "--warmup", "1",
+        supervise=True,
+        extra_env={"BENCH_PREFLIGHT_TIMEOUT": "0", "BENCH_MAX_ATTEMPTS": "0"},
     )
-    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
-    assert len(lines) == 1, f"supervised stdout must carry exactly one line, got {lines!r}"
-    row = json.loads(lines[0])
     assert row["metric"].startswith("cpu-fallback"), row["metric"]
     assert row["vs_baseline"] == 0.0
     assert row["extra"]["cpu_fallback"] is True

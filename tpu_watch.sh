@@ -9,7 +9,17 @@
 # tunnel) busy forever after everything else is captured.
 cd /root/repo
 need=11  # 4 suite-a + 8 suite-b tags, minus the optional gptj-6b
+# HARD deadline (epoch seconds, WATCH_DEADLINE env or 14:30 UTC today): the
+# chip is a single serialized tunnel, and the round driver runs bench.py at
+# round end — a watcher still holding the chip then would starve the official
+# capture. Both the loop and in-flight suite runs stop at the deadline.
+deadline=${WATCH_DEADLINE:-$(date -u -d "14:30" +%s)}
 for i in $(seq 1 60); do
+  now=$(date +%s)
+  if [ "$now" -ge "$deadline" ]; then
+    echo "[watch] deadline reached ($(date -u +%H:%M:%S)); exiting to free the chip for the driver" >> tpu_watch.log
+    exit 0
+  fi
   have=$(python -c "import measure_r04 as m; t = m.captured_tags(); print(len(t - {'inference gptj-6b'}))")
   if [ "$have" -ge "$need" ]; then
     echo "[watch] all $need required configs captured; exiting" >> tpu_watch.log
@@ -18,9 +28,22 @@ for i in $(seq 1 60); do
   echo "[watch] probe $i at $(date -u +%H:%M:%S) (captured $have/$need required)" >> tpu_watch.log
   if timeout 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'; print(jax.devices()[0].device_kind)" >> tpu_watch.log 2>&1; then
     echo "[watch] TPU alive; running suites" >> tpu_watch.log
-    python measure_r04.py >> tpu_watch.log 2>&1
+    # The suite runner reaps its own in-flight bench child on SIGTERM
+    # (measure_r04._terminate_child), so a deadline timeout here leaves no
+    # orphan holding the chip.
+    budget=$(( deadline - $(date +%s) ))
+    if [ "$budget" -le 60 ]; then
+      echo "[watch] deadline imminent; exiting to free the chip for the driver" >> tpu_watch.log
+      exit 0
+    fi
+    timeout "$budget" python measure_r04.py >> tpu_watch.log 2>&1
     echo "[watch] suite a pass rc=$?" >> tpu_watch.log
-    python measure_r04b.py >> tpu_watch.log 2>&1
+    budget=$(( deadline - $(date +%s) ))
+    if [ "$budget" -le 60 ]; then
+      echo "[watch] deadline imminent; exiting to free the chip for the driver" >> tpu_watch.log
+      exit 0
+    fi
+    timeout "$budget" python measure_r04b.py >> tpu_watch.log 2>&1
     echo "[watch] suite b pass rc=$?" >> tpu_watch.log
   fi
   sleep 600
