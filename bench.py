@@ -33,14 +33,44 @@ def log(msg):
 
 
 # --------------------------------------------------------------------------- supervisor
+#
+# Deadline ledger (round-5: the driver's capture window is ~30 min of wall
+# clock; round 4 set an 80-min preflight budget and the driver killed the
+# supervisor mid-backoff — BENCH_r04.json was rc=124 with NO json line).
+# Every phase below is capped by `remaining() - <reserves the later phases
+# need>`, so the one JSON line lands before BENCH_DEADLINE_S no matter what
+# the tunnel does. Worst-case path and its arithmetic:
+#
+#   probe hang          <= PREFLIGHT_TIMEOUT (120)
+#   backoff budget      <= min(BENCH_PREFLIGHT_BUDGET (600),
+#                              remaining - MIN_ATTEMPT - CPU_RESERVE - MARGIN)
+#   shortened attempt   <= remaining - CPU_RESERVE - MARGIN
+#   CPU fallback        <= remaining - MARGIN
+#   diagnostic line     ~0
+#
+# so time-to-JSON <= BENCH_DEADLINE_S (default 1500 s = 25 min < the window).
+# tests/test_bench_contract.py simulates this worst case with a fake clock.
+DRIVER_WINDOW_S = 1500  # default BENCH_DEADLINE_S: safely under the ~30-min driver window
+CPU_FALLBACK_RESERVE_S = 360  # measured CPU worker (bert-base, 8 steps, 1 vCPU) + margin
+FINAL_MARGIN_S = 30  # line emission + process teardown
+MIN_ATTEMPT_S = 180  # below this an accelerator attempt can't finish; go straight to CPU
+
+
 def _backend_preflight(timeout_s: int) -> bool:
     """Can the accelerator backend run ONE tiny op right now? A hung TPU tunnel
     makes backend init block forever; without this probe the supervisor would
     burn attempts x full timeouts (an hour-plus) before its CPU fallback. Cost on
     the healthy path: one extra backend init (~a minute warm) — cheap insurance
     for a once-per-round benchmark; tune with BENCH_PREFLIGHT_TIMEOUT (0 skips)."""
+    # Honor an explicit JAX_PLATFORMS before first backend touch: the axon
+    # PJRT plugin hooks get_backend and IGNORES the env var, so without the
+    # config.update a JAX_PLATFORMS=cpu probe still reaches for the (possibly
+    # dead) TPU tunnel and hangs its full timeout. Unset env = probe the real
+    # accelerator, which is the point of the preflight.
     probe = (
-        "import jax, jax.numpy as jnp; x = jnp.ones((8, 8)) @ jnp.ones((8, 8)); "
+        "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+        "p and jax.config.update('jax_platforms', p); "
+        "import jax.numpy as jnp; x = jnp.ones((8, 8)) @ jnp.ones((8, 8)); "
         "import numpy as np; print(float(np.asarray(x)[0, 0]))"
     )
     try:
@@ -55,95 +85,131 @@ def _backend_preflight(timeout_s: int) -> bool:
         return False
 
 
+def _run_worker(cmd, env, timeout_s, label):
+    """One worker attempt; returns the parsed-JSON stdout line or None."""
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired as e:
+        log(f"{label}: worker hung >{timeout_s:.0f}s, killed")
+        for stream in (e.stderr, e.stdout):  # forward partial logs for diagnosis
+            if stream:
+                text = stream.decode() if isinstance(stream, bytes) else stream
+                sys.stderr.write(text[-4000:])
+        return None
+    sys.stderr.write(proc.stderr)
+    line = None
+    for out_line in (proc.stdout or "").strip().splitlines():
+        try:
+            parsed = json.loads(out_line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                line = out_line
+        except json.JSONDecodeError:
+            continue
+    if proc.returncode == 0 and line:
+        return line
+    log(
+        f"{label} failed rc={proc.returncode} after {time.time() - t0:.0f}s; "
+        f"stdout tail: {(proc.stdout or '')[-300:]!r}"
+    )
+    return None
+
+
 def supervise(argv, total_steps: int = 0):
-    """Run the worker with retry/backoff/timeout; last resort falls back to CPU."""
+    """Run the worker with retry/backoff/timeout under a HARD wall-clock
+    deadline (BENCH_DEADLINE_S); last resort falls back to CPU, and the one
+    JSON line always lands before the deadline (see the ledger above)."""
+    start = time.time()
+    deadline_s = int(os.environ.get("BENCH_DEADLINE_S", str(DRIVER_WINDOW_S)))
+    hard_deadline = start + deadline_s
+
+    def remaining():
+        return hard_deadline - time.time()
+
     attempts = int(os.environ.get("BENCH_MAX_ATTEMPTS", "3"))
     # Scale the per-attempt timeout with the requested workload so a user-set
-    # --steps/--trials can't silently turn every attempt into a timeout kill.
+    # --steps/--trials can't silently turn every attempt into a timeout kill —
+    # but the deadline ledger below still caps every attempt.
     timeout_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", str(max(1500, 300 + 2 * total_steps))))
-    preflight_timeout = int(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "300"))
+    preflight_timeout = int(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "120"))
+    preflight_timeout = min(
+        preflight_timeout, max(0, int(remaining() - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S))
+    )
     if preflight_timeout > 0 and not _backend_preflight(preflight_timeout):
         # Backend is down/hung RIGHT NOW. A TPU tunnel outage is usually
-        # transient (round-3 postmortem: the tunnel came back hours later but
-        # the bench had already burned its attempts and fallen back to CPU), so
-        # keep retrying the CHEAP preflight on a backoff schedule up to a
-        # wall-clock budget before spending any full worker attempt.
-        # 80 min: round-4 observation — tunnel outages run long (hours) but
-        # have cleared within an hour-plus window more than once; the budget
-        # burns only cheap probes, and a tagged CPU fallback after 80 min
-        # beats one after 40 when the alternative is an unusable artifact.
-        budget_s = int(os.environ.get("BENCH_PREFLIGHT_BUDGET", "4800"))
-        deadline = time.time() + budget_s
+        # transient, so retry the CHEAP probe on a backoff schedule — but only
+        # up to a budget that still leaves room for one shortened accelerator
+        # attempt AND the CPU fallback before the deadline (round-4 postmortem:
+        # an 80-min budget here made the driver kill us with no output at all;
+        # a tagged CPU line at minute 24 beats a dead artifact at minute 80).
+        budget_s = min(
+            int(os.environ.get("BENCH_PREFLIGHT_BUDGET", "600")),
+            int(remaining() - MIN_ATTEMPT_S - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S),
+        )
+        backoff_deadline = time.time() + max(0, budget_s)
         delay = 60
         recovered = False
-        while time.time() < deadline:
-            wait = min(delay, max(0, deadline - time.time()))
+        while time.time() < backoff_deadline:
+            wait = min(delay, max(0, backoff_deadline - time.time()))
             log(
                 f"preflight: backend down; retrying probe in {wait:.0f}s "
-                f"({deadline - time.time():.0f}s of budget left)"
+                f"({backoff_deadline - time.time():.0f}s of budget left)"
             )
             time.sleep(wait)
-            if _backend_preflight(min(preflight_timeout, max(30, int(deadline - time.time())))):
+            # Re-probes ESCALATE past the initial 120-s cap (up to 300 s, still
+            # inside the ledger): a cold-but-healthy backend init can take
+            # minutes, and capping every re-probe at the first probe's timeout
+            # would make it permanently unreachable.
+            probe_t = min(
+                300,
+                max(30, int(backoff_deadline - time.time())),
+                max(30, int(remaining() - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S)),
+            )
+            if _backend_preflight(probe_t):
                 recovered = True
                 log("preflight: backend recovered; proceeding with full attempts")
                 break
             delay = min(delay * 2, 600)
         if not recovered:
             # Budget exhausted and still dead. Keep one real attempt (it may
-            # recover mid-run), with a tight timeout so a dead tunnel costs
-            # minutes, not hours, before the tagged CPU fallback.
+            # recover mid-run); the ledger cap below already tightens it.
             log("preflight: budget exhausted, backend still unresponsive; shortening attempts")
             attempts = 1
-            timeout_s = min(timeout_s, 900)
     cmd = [sys.executable, os.path.abspath(__file__), "--_worker"] + argv
-    for attempt in range(attempts + 1):  # final extra attempt = CPU fallback
-        env = dict(os.environ)
-        cpu_fallback = attempt == attempts
-        if cpu_fallback:
-            env["JAX_PLATFORMS"] = "cpu"
-            log("final attempt: falling back to JAX_PLATFORMS=cpu")
-        t0 = time.time()
-        try:
-            proc = subprocess.run(
-                cmd, env=env, timeout=timeout_s, capture_output=True, text=True
+    for attempt in range(attempts):
+        att_timeout = min(timeout_s, remaining() - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S)
+        if att_timeout < MIN_ATTEMPT_S:
+            log(
+                f"deadline: {remaining():.0f}s left; skipping remaining accelerator "
+                f"attempts to protect the CPU fallback"
             )
-        except subprocess.TimeoutExpired as e:
-            log(f"attempt {attempt + 1}: worker hung >{timeout_s}s, killed")
-            for stream in (e.stderr, e.stdout):  # forward partial logs for diagnosis
-                if stream:
-                    text = stream.decode() if isinstance(stream, bytes) else stream
-                    sys.stderr.write(text[-4000:])
-            continue
-        sys.stderr.write(proc.stderr)
-        line = None
-        for out_line in (proc.stdout or "").strip().splitlines():
-            try:
-                parsed = json.loads(out_line)
-                if isinstance(parsed, dict) and "metric" in parsed:
-                    line = out_line
-            except json.JSONDecodeError:
-                continue
-        if proc.returncode == 0 and line:
-            if cpu_fallback:
-                # Never let a CPU smoke number masquerade as the chip benchmark
-                # (round-2 verdict, weak #4): tag the metric and zero the ratio.
-                # (The worker also self-tags "cpu-smoke" off its actual platform;
-                # this marks that the supervisor FORCED the fallback.)
-                parsed = json.loads(line)
-                parsed["metric"] = "cpu-fallback " + parsed["metric"]
-                parsed["vs_baseline"] = 0.0
-                parsed.setdefault("extra", {})["cpu_fallback"] = True
-                line = json.dumps(parsed)
+            break
+        line = _run_worker(cmd, dict(os.environ), att_timeout, f"attempt {attempt + 1}")
+        if line:
             print(line, flush=True)
             return 0
-        log(
-            f"attempt {attempt + 1} failed rc={proc.returncode} after {time.time() - t0:.0f}s; "
-            f"stdout tail: {(proc.stdout or '')[-300:]!r}"
-        )
-        if not cpu_fallback:
-            delay = min(30 * (attempt + 1), 120)
-            log(f"retrying in {delay}s")
-            time.sleep(delay)
+        if attempt + 1 < attempts:
+            delay = min(30 * (attempt + 1), 120, max(0, remaining() - CPU_FALLBACK_RESERVE_S))
+            if delay:
+                log(f"retrying in {delay:.0f}s")
+                time.sleep(delay)
+    # CPU fallback: gets whatever time is left (at least 60s even if the
+    # deadline math went negative — a line late beats no line).
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    log("final attempt: falling back to JAX_PLATFORMS=cpu")
+    line = _run_worker(cmd, env, max(60, remaining() - FINAL_MARGIN_S), "cpu fallback")
+    if line:
+        # Never let a CPU smoke number masquerade as the chip benchmark
+        # (round-2 verdict, weak #4): tag the metric and zero the ratio.
+        # (The worker also self-tags "cpu-smoke" off its actual platform;
+        # this marks that the supervisor FORCED the fallback.)
+        parsed = json.loads(line)
+        parsed["metric"] = "cpu-fallback " + parsed["metric"]
+        parsed["vs_baseline"] = 0.0
+        parsed.setdefault("extra", {})["cpu_fallback"] = True
+        print(json.dumps(parsed), flush=True)
+        return 0
     # Even the CPU fallback failed: emit a diagnostic line so the driver parses *something*.
     print(
         json.dumps(
@@ -328,8 +394,16 @@ def train_bench(args):
         # 0.502 @ bs 64 / 0.469 @ bs 128 at equal 500-step regions — bs 32
         # steps are too short to hide the tunneled per-call host dispatch).
         args.batch_size = 64 if on_accel else 4
-    if not on_accel and args.model == "bert-base":
-        args.steps = min(args.steps, 8)
+    if not on_accel:
+        # CPU runs are smoke/fallback runs (self-tagged below): cap the step
+        # count for EVERY model so the supervisor's CPU_FALLBACK_RESERVE_S
+        # budget holds under any argv (a 1500-step llama CPU run on 1 vCPU
+        # would blow the dead-tunnel deadline and cost the round its line).
+        # BENCH_CPU_STEP_CAP overrides; 0 disables.
+        cap = int(os.environ.get("BENCH_CPU_STEP_CAP", "8"))
+        if cap > 0 and args.steps > cap:
+            log(f"cpu backend: capping steps {args.steps} -> {cap} (BENCH_CPU_STEP_CAP)")
+            args.steps = cap
     if args.steps_per_call is None:
         # Auto: small-step configs (bert-base seq 128 runs ~10-40ms/step on one
         # chip) pay one host dispatch + tunnel round trip PER STEP; the scanned

@@ -3,8 +3,10 @@
 is parsed from it, so a formatting regression silently costs the round its
 benchmark. Runs the real script as a subprocess on CPU at smoke sizes."""
 
+import importlib.util
 import json
 import os
+import subprocess
 import sys
 
 import pytest
@@ -12,6 +14,35 @@ import pytest
 from accelerate_tpu.test_utils.testing import cpu_mesh_env, execute_subprocess
 
 BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+
+def _load_bench_module():
+    spec = importlib.util.spec_from_file_location("bench_under_test", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FakeClock:
+    """Stub for bench.py's module-level `time`: sleep() advances a virtual
+    clock, so the worst-case supervisor path runs in milliseconds of real time
+    while the deadline arithmetic sees the full simulated hours."""
+
+    def __init__(self, start=1_000_000.0):
+        self.t = start
+        self.start = start
+
+    def time(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+    def perf_counter(self):
+        return self.t
+
+    def elapsed(self):
+        return self.t - self.start
 
 
 def run_bench(*args, supervise=False, extra_env=None):
@@ -47,6 +78,93 @@ def test_inference_bench_contract():
     assert row["metric"].startswith("cpu-smoke")
     assert row["vs_baseline"] == 0.0
     assert row["extra"]["ttft_p50_ms"] > 0
+
+
+def _simulate_supervise(monkeypatch, capsys, env=None, cpu_fallback_hangs=True, cpu_wall_s=300.0):
+    """Drive bench.supervise() through its WORST case on a fake clock: the
+    preflight probe hangs to its timeout every retry, every accelerator attempt
+    hangs to its cap, and (optionally) even the CPU fallback hangs. Returns
+    (simulated_elapsed_s, parsed_stdout_line)."""
+    bench = _load_bench_module()
+    clock = _FakeClock()
+    monkeypatch.setattr(bench, "time", clock)
+    for key in ("BENCH_DEADLINE_S", "BENCH_MAX_ATTEMPTS", "BENCH_ATTEMPT_TIMEOUT",
+                "BENCH_PREFLIGHT_TIMEOUT", "BENCH_PREFLIGHT_BUDGET",
+                "JAX_PLATFORMS"):  # the conftest's cpu pin would make every fake attempt look like the fallback
+        monkeypatch.delenv(key, raising=False)
+    for key, value in (env or {}).items():
+        monkeypatch.setenv(key, value)
+
+    def fake_run(cmd, timeout=None, env=None, capture_output=False, text=False, **kw):
+        is_cpu = env is not None and env.get("JAX_PLATFORMS") == "cpu"
+        if is_cpu and not cpu_fallback_hangs:
+            if timeout < cpu_wall_s:
+                # Mirror the real subprocess contract: a worker that needs more
+                # wall time than its cap gets killed, NOT silently completed —
+                # otherwise a too-small CPU reserve would stay green here while
+                # production emits bench-failed.
+                clock.sleep(timeout)
+                raise subprocess.TimeoutExpired(cmd, timeout)
+            clock.sleep(cpu_wall_s)
+            line = json.dumps({
+                "metric": "cpu-smoke samples/sec/chip (bert-base ...)",
+                "value": 1.0, "unit": "samples/sec/chip", "vs_baseline": 0.0,
+                "extra": {"device_kind": "cpu"},
+            })
+            return subprocess.CompletedProcess(cmd, 0, line + "\n", "")
+        clock.sleep(timeout)  # worst case: hang to the cap, then get killed
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    rc = bench.supervise(["--steps", "500", "--trials", "3"], total_steps=1500)
+    assert rc == 0
+    out_lines = [l for l in capsys.readouterr().out.strip().splitlines() if l.strip()]
+    assert len(out_lines) == 1, f"exactly one stdout line required, got {out_lines!r}"
+    return clock.elapsed(), json.loads(out_lines[0])
+
+
+def test_supervisor_worst_case_bounded_by_default_deadline(monkeypatch, capsys):
+    """Round-4 postmortem: the driver killed bench.py mid-preflight-backoff at
+    ~30 min and BENCH_r04.json had no JSON line at all. The ledger invariant:
+    even when EVERYTHING hangs (probe, every attempt, the CPU fallback), the
+    one JSON line lands inside BENCH_DEADLINE_S — which itself sits under the
+    driver's observed ~30-min window."""
+    bench = _load_bench_module()
+    assert bench.DRIVER_WINDOW_S <= 1680, "default deadline must stay under the ~30-min driver window"
+    elapsed, row = _simulate_supervise(monkeypatch, capsys)
+    assert elapsed <= bench.DRIVER_WINDOW_S, f"worst-case time-to-JSON {elapsed:.0f}s exceeds the deadline"
+    assert row["metric"] == "bench-failed"  # everything hung: diagnostic line
+    assert row["vs_baseline"] == 0.0
+
+
+def test_supervisor_deadline_survives_hostile_env(monkeypatch, capsys):
+    """User-set knobs (huge attempt timeout / preflight budget — round 4's
+    actual mistake was BENCH_PREFLIGHT_BUDGET=4800) must not push the line past
+    the deadline: the ledger caps every phase by remaining()."""
+    elapsed, row = _simulate_supervise(
+        monkeypatch, capsys,
+        env={"BENCH_PREFLIGHT_BUDGET": "4800", "BENCH_ATTEMPT_TIMEOUT": "7200",
+             "BENCH_MAX_ATTEMPTS": "5"},
+    )
+    assert elapsed <= 1500, f"hostile env pushed time-to-JSON to {elapsed:.0f}s"
+
+
+def test_supervisor_dead_tunnel_emits_tagged_cpu_line_in_window(monkeypatch, capsys):
+    """The realistic dead-tunnel path: probe never answers, the shortened
+    accelerator attempt hangs, the CPU fallback SUCCEEDS — the driver gets a
+    tagged cpu-fallback row well inside its window."""
+    elapsed, row = _simulate_supervise(monkeypatch, capsys, cpu_fallback_hangs=False)
+    assert elapsed <= 1500
+    assert row["metric"].startswith("cpu-fallback")
+    assert row["vs_baseline"] == 0.0
+    assert row["extra"]["cpu_fallback"] is True
+
+
+def test_supervisor_explicit_deadline_env(monkeypatch, capsys):
+    """BENCH_DEADLINE_S is honored: a 600-s deadline bounds the whole worst
+    case to 600 s (the driver can tighten the window without editing code)."""
+    elapsed, _ = _simulate_supervise(monkeypatch, capsys, env={"BENCH_DEADLINE_S": "600"})
+    assert elapsed <= 600, f"explicit BENCH_DEADLINE_S ignored: {elapsed:.0f}s"
 
 
 @pytest.mark.slow_launch
