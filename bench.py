@@ -85,6 +85,20 @@ def _backend_preflight(timeout_s: int) -> bool:
         return False
 
 
+def _env_int(name, default):
+    """Parse an int env knob, falling back (loudly) on garbage: the supervisor
+    must never die on a malformed BENCH_* value before emitting its line —
+    rc!=0 with no stdout is the exact artifact this file exists to prevent."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        log(f"ignoring malformed {name}={raw!r}; using default {default}")
+        return default
+
+
 def _run_worker(cmd, env, timeout_s, label):
     """One worker attempt; returns the parsed-JSON stdout line or None."""
     t0 = time.time()
@@ -120,18 +134,18 @@ def supervise(argv, total_steps: int = 0):
     deadline (BENCH_DEADLINE_S); last resort falls back to CPU, and the one
     JSON line always lands before the deadline (see the ledger above)."""
     start = time.time()
-    deadline_s = int(os.environ.get("BENCH_DEADLINE_S", str(DRIVER_WINDOW_S)))
+    deadline_s = _env_int("BENCH_DEADLINE_S", DRIVER_WINDOW_S)
     hard_deadline = start + deadline_s
 
     def remaining():
         return hard_deadline - time.time()
 
-    attempts = int(os.environ.get("BENCH_MAX_ATTEMPTS", "3"))
+    attempts = _env_int("BENCH_MAX_ATTEMPTS", 3)
     # Scale the per-attempt timeout with the requested workload so a user-set
     # --steps/--trials can't silently turn every attempt into a timeout kill —
     # but the deadline ledger below still caps every attempt.
-    timeout_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", str(max(1500, 300 + 2 * total_steps))))
-    preflight_timeout = int(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "120"))
+    timeout_s = _env_int("BENCH_ATTEMPT_TIMEOUT", max(1500, 300 + 2 * total_steps))
+    preflight_timeout = _env_int("BENCH_PREFLIGHT_TIMEOUT", 120)
     preflight_timeout = min(
         preflight_timeout, max(0, int(remaining() - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S))
     )
@@ -143,7 +157,7 @@ def supervise(argv, total_steps: int = 0):
         # an 80-min budget here made the driver kill us with no output at all;
         # a tagged CPU line at minute 24 beats a dead artifact at minute 80).
         budget_s = min(
-            int(os.environ.get("BENCH_PREFLIGHT_BUDGET", "600")),
+            _env_int("BENCH_PREFLIGHT_BUDGET", 600),
             int(remaining() - MIN_ATTEMPT_S - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S),
         )
         backoff_deadline = time.time() + max(0, budget_s)
@@ -159,12 +173,16 @@ def supervise(argv, total_steps: int = 0):
             # Re-probes ESCALATE past the initial 120-s cap (up to 300 s, still
             # inside the ledger): a cold-but-healthy backend init can take
             # minutes, and capping every re-probe at the first probe's timeout
-            # would make it permanently unreachable.
+            # would make it permanently unreachable. The ledger term reserves
+            # the shortened attempt too — a final-probe overshoot must not eat
+            # the one real attempt the dead-tunnel path promises.
             probe_t = min(
                 300,
                 max(30, int(backoff_deadline - time.time())),
-                max(30, int(remaining() - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S)),
+                int(remaining() - MIN_ATTEMPT_S - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S),
             )
+            if probe_t < 10:
+                break
             if _backend_preflight(probe_t):
                 recovered = True
                 log("preflight: backend recovered; proceeding with full attempts")
@@ -189,8 +207,10 @@ def supervise(argv, total_steps: int = 0):
             print(line, flush=True)
             return 0
         if attempt + 1 < attempts:
-            delay = min(30 * (attempt + 1), 120, max(0, remaining() - CPU_FALLBACK_RESERVE_S))
-            if delay:
+            delay = min(30 * (attempt + 1), 120)
+            # Sleep only if an attempt is still feasible AFTER it — otherwise
+            # the backoff just shaves the CPU fallback's reserve for nothing.
+            if remaining() - delay - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S >= MIN_ATTEMPT_S:
                 log(f"retrying in {delay:.0f}s")
                 time.sleep(delay)
     # CPU fallback: gets whatever time is left (at least 60s even if the
@@ -382,8 +402,26 @@ def train_bench(args):
         from accelerate_tpu.utils import CompilationConfig
 
         compilation_config = CompilationConfig(remat_policy=args.remat)
+    fsdp_plugin = None
+    if args.param_dtype:
+        # Storage-dtype knob (FSDP plugin; a 1-chip fsdp axis shards nothing
+        # but the dtype policy still applies): bf16 params+moments halve the
+        # optimizer-state HBM — fp32 AdamW moments alone are ~12 GB at 1B
+        # params, which is what OOM'd the round-4 llama-1b no-remat legs.
+        from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+
+        fsdp_plugin = FullyShardedDataParallelPlugin(param_dtype=args.param_dtype)
     accelerator = Accelerator(
-        mixed_precision=args.mixed_precision, compilation_config=compilation_config
+        mixed_precision=args.mixed_precision,
+        compilation_config=compilation_config,
+        fsdp_plugin=fsdp_plugin,
+    )
+    # Report the dtype the plugin actually APPLIED, not the CLI flag: the
+    # ACCELERATE_TPU_FSDP_PARAM_DTYPE env protocol overrides the constructor
+    # arg in __post_init__, and a mislabeled row would corrupt the bf16-moments
+    # A/B evidence.
+    effective_param_dtype = (
+        getattr(accelerator.state.fsdp_plugin, "param_dtype", None) or "float32"
     )
 
     if args.batch_size is None:
@@ -400,7 +438,7 @@ def train_bench(args):
         # budget holds under any argv (a 1500-step llama CPU run on 1 vCPU
         # would blow the dead-tunnel deadline and cost the round its line).
         # BENCH_CPU_STEP_CAP overrides; 0 disables.
-        cap = int(os.environ.get("BENCH_CPU_STEP_CAP", "8"))
+        cap = _env_int("BENCH_CPU_STEP_CAP", 8)
         if cap > 0 and args.steps > cap:
             log(f"cpu backend: capping steps {args.steps} -> {cap} (BENCH_CPU_STEP_CAP)")
             args.steps = cap
@@ -571,6 +609,7 @@ def train_bench(args):
             "steps": steps_done,
             "path": "eager" if args.eager else "fused",
             "steps_per_call": spc,
+            "param_dtype": effective_param_dtype,
             "peak_hbm_gb": _peak_memory_gb(),
             # Which attention implementation the model's trace actually used —
             # proves (or disproves) that the flash kernel is on the measured path.
@@ -628,6 +667,13 @@ def parse_args(argv):
         default=None,
         choices=["full", "dots"],
         help="per-layer activation checkpointing policy (HBM-tight configs)",
+    )
+    parser.add_argument(
+        "--param_dtype",
+        default=None,
+        choices=["float32", "bfloat16"],
+        help="param/optimizer-moment storage dtype (FSDP plugin knob; bf16 "
+        "halves optimizer-state HBM so llama-1b seq-1024 fits the 16 GB chip)",
     )
     parser.add_argument("--eager", action="store_true", help="use the eager backward/step path instead of the fused step")
     parser.add_argument(

@@ -1,0 +1,100 @@
+"""Round-5 measurement suite (run opportunistically on hardware by
+tpu_watch_r05.sh; the driver contract stays `bench.py` = one JSON line).
+
+Ordering is the round-4 lesson (verdict, weak #5): the tunnel was down for
+most of round 4 and the suite captured 3/11 rows — all three RE-captures of
+configs that already had numbers, while every never-before-captured config
+(flash A/B, steps_per_call A/B, long-seq scaling, inference) stayed queued.
+This list runs NEVER-CAPTURED configs first, so a short tunnel window spends
+its minutes on evidence that doesn't exist yet:
+
+  1. steps_per_call K=10 at bs 32 — the fix for the 0.335-MFU default-config
+     deficit (bench_suite_r04.jsonl bs32 K=1 row is the baseline)
+  2. flash-vs-XLA A/B at seq 1024, equal batch + remat (the Pallas kernel's
+     reason to exist; zero hardware numbers through round 4)
+  3. big-model inference TTFT/decode (half of BASELINE.json's metric)
+  4. the NO-FLAGS bench.py default (bs 64, K=10) — BASELINE.md's north star
+     is "the default config >= 0.45 MFU", not a tuned one
+  5. llama-1b with bf16 param/moment storage (verdict #6: the round-4 OOM was
+     fp32-AdamW-moments self-inflicted; this row exercises the dtype knob)
+  6. long-seq flash scaling (2048/4096)
+  7. same-day K=1 re-baselines for the A/B deltas
+  8. gptj-6b inference LAST and OPTIONAL (6B bf16 + KV cache ~14 GB of the
+     16 GB chip; if it doesn't fit it must not stall capturable configs)
+
+Appends to bench_suite_r05.jsonl via measure_r04.run_suite (shared resumable
+runner: captured tags skip, error rows never persist so failures retry).
+"""
+
+import sys
+
+from measure_r04 import captured_tags, run_suite
+
+OUT_PATH = "bench_suite_r05.jsonl"
+
+CONFIGS = [
+    # (tag, argv, timeout_s)
+    ("headline bs32 spc10", ["--steps", "500", "--trials", "3", "--batch_size", "32", "--steps_per_call", "10"], 2400),
+    (
+        "llama-1b seq1024 flash remat",
+        ["--model", "llama-1b", "--seq_len", "1024", "--batch_size", "4", "--steps", "100",
+         "--trials", "3", "--attention", "flash", "--remat", "dots"],
+        3000,
+    ),
+    (
+        "llama-1b seq1024 xla remat",
+        ["--model", "llama-1b", "--seq_len", "1024", "--batch_size", "4", "--steps", "100",
+         "--trials", "3", "--attention", "xla", "--remat", "dots"],
+        3000,
+    ),
+    ("inference llama-1b", ["--mode", "inference", "--model", "llama-1b"], 1800),
+    # bench.py with NO flags: bs 64, steps_per_call auto=10, 500 steps x 3
+    # trials — the exact config the driver's BENCH_r05 capture runs.
+    ("headline default bs64 spc10", ["--steps", "500", "--trials", "3"], 2400),
+    (
+        "llama-1b seq1024 bf16-moments remat",
+        ["--model", "llama-1b", "--seq_len", "1024", "--batch_size", "4", "--steps", "100",
+         "--trials", "3", "--param_dtype", "bfloat16", "--remat", "dots"],
+        3000,
+    ),
+    (
+        "llama-1b seq2048 flash remat",
+        ["--model", "llama-1b", "--seq_len", "2048", "--batch_size", "2", "--steps", "60",
+         "--trials", "2", "--attention", "flash", "--remat", "dots"],
+        3000,
+    ),
+    (
+        "llama-1b seq4096 flash remat",
+        ["--model", "llama-1b", "--seq_len", "4096", "--batch_size", "1", "--steps", "40",
+         "--trials", "2", "--attention", "flash", "--remat", "dots"],
+        3000,
+    ),
+    ("sweep bs64 spc20", ["--steps", "500", "--trials", "3", "--batch_size", "64", "--steps_per_call", "20"], 2400),
+    # Same-day K=1 baselines (r04 rows exist, but a same-session pair removes
+    # day-to-day tunnel variance from the K=10/20 A/B deltas).
+    ("baseline bs32 spc1", ["--steps", "500", "--trials", "3", "--batch_size", "32", "--steps_per_call", "1"], 2400),
+    ("baseline bs64 spc1", ["--steps", "500", "--trials", "3", "--batch_size", "64", "--steps_per_call", "1"], 2400),
+    ("inference gptj-6b", ["--mode", "inference", "--model", "gptj-6b"], 2700),
+]
+
+# Tags the watcher must NOT wait on (see the module docstring).
+OPTIONAL = {"inference gptj-6b"}
+
+
+def required_tags():
+    return {tag for tag, _, _ in CONFIGS} - OPTIONAL
+
+
+def missing_required(out_path=OUT_PATH):
+    """Required tags with no persisted row — the watcher's exit condition AND
+    its end-of-round 'N rows missing' marker (round-4 lesson: an incomplete
+    capture must be loud, not a quiet 'captured 3/11' buried in a log)."""
+    return sorted(required_tags() - captured_tags(out_path))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--missing":
+        missing = missing_required()
+        print("\n".join(missing))
+        sys.exit(1 if missing else 0)
+    run_suite(CONFIGS, prefix="suite-r05", out_path=OUT_PATH)
