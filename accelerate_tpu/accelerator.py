@@ -387,6 +387,10 @@ class Accelerator:
     def _is_dataloader(obj) -> bool:
         if isinstance(obj, (DataLoaderShard, DataLoaderDispatcher, SimpleDataLoader)):
             return True
+        from .native.loader import NativeArrayLoader
+
+        if isinstance(obj, NativeArrayLoader):
+            return True
         try:
             import torch.utils.data
 
@@ -525,7 +529,27 @@ class Accelerator:
             split_batches=self.dataloader_config.split_batches or self.split_batches,
         )
         self._schedulers.append(prepared)
+        # Order-independent with train_step(steps_per_call=K): whichever comes
+        # second surfaces the coarsening.
+        k = getattr(self, "_last_steps_per_call", 1)
+        if k > 1:
+            self._warn_scheduler_coarsened(k)
         return prepared
+
+    def _warn_scheduler_coarsened(self, steps_per_call: int):
+        """A scheduler's contract is one LR update per optimizer step; the
+        scanned device loop reads the LR override ONCE per compiled call, so
+        K>1 coarsens the schedule to K-step strides (documented in
+        train_step.py's docstring; this surfaces it at prepare time instead of
+        leaving it to be discovered from a training curve)."""
+        logger.warning(
+            "train_step(steps_per_call=%d) with a prepared scheduler: the LR is "
+            "read once per compiled call, so the scheduler advances in %d-step "
+            "strides instead of per optimizer step. Use steps_per_call=1 for an "
+            "exact per-step schedule, or step the scheduler once per call.",
+            steps_per_call,
+            steps_per_call,
+        )
 
     # ------------------------------------------------------------------ backward
     def _resolve_model(self, model) -> PreparedModel:
@@ -626,6 +650,12 @@ class Accelerator:
         optimizer = self._optimizer_for(model)
         if accumulation_steps is None:
             accumulation_steps = self.gradient_state.num_steps
+        # Latest build wins (not a ratchet): rebuilding with K=1 after a K>1
+        # experiment must not leave a stale warning armed for a scheduler
+        # prepared later.
+        self._last_steps_per_call = steps_per_call
+        if steps_per_call > 1 and self._schedulers:
+            self._warn_scheduler_coarsened(steps_per_call)
         return FusedTrainStep(
             model,
             optimizer,
@@ -798,6 +828,7 @@ class Accelerator:
         self._schedulers.clear()
         self._dataloaders.clear()
         self._backward_cache.clear()
+        self._last_steps_per_call = 1
         self.step = 0
         objects = list(objects)
         for i in range(len(objects)):

@@ -314,7 +314,10 @@ def save_accelerator_state(
                 # sampler.epoch: replay this epoch's permutation + skip) from
                 # an epoch-boundary save (iteration == epoch + 1: the next
                 # pass must draw a FRESH permutation, not repeat the last).
-                payload = {"sampler": sampler.state_dict()}
+                # Explicit format marker: load-side sniffing by key presence
+                # ("sampler" in payload) breaks the day a sampler's own
+                # state_dict grows a 'sampler' key — version the envelope.
+                payload = {"format": 2, "sampler": sampler.state_dict()}
                 if hasattr(dl, "iteration"):
                     payload["loader_iteration"] = dl.iteration
                 with open(output_dir / name, "wb") as f:
@@ -404,7 +407,19 @@ def load_accelerator_state(
         if sampler is not None and (input_dir / name).exists():
             with open(input_dir / name, "rb") as f:
                 payload = pickle.load(f)
-            if "sampler" in payload:
+            if payload.get("format") == 2:
+                sampler.load_state_dict(payload["sampler"])
+                loader_iteration = payload.get("loader_iteration")
+            elif "format" in payload:
+                # A versioned envelope from a NEWER writer: refuse loudly
+                # instead of feeding the whole envelope into load_state_dict
+                # and crashing on a missing key three frames deeper.
+                raise ValueError(
+                    f"unsupported sampler checkpoint format {payload['format']!r} in "
+                    f"{input_dir / name} (this version reads format 2 and earlier)"
+                )
+            elif "sampler" in payload:
+                # round-4 wrapped format (pre-marker): {"sampler": ..., "loader_iteration": ...}
                 sampler.load_state_dict(payload["sampler"])
                 loader_iteration = payload.get("loader_iteration")
             else:  # pre-round-4 checkpoint: bare sampler state_dict

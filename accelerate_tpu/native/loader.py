@@ -156,6 +156,7 @@ class NativeArrayLoader:
     def __init__(self, dataset: ArrayDataset, batch_sampler, num_threads: int = 4):
         self.dataset = dataset
         self.batch_sampler = batch_sampler
+        self.num_threads = num_threads  # kept so prepare()'s sharded rebuild preserves the tuning
         self.pool = NativeGatherPool(num_threads)
         self.collate_fn = None  # parity attribute; collation IS the gather
 

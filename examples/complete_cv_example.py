@@ -14,7 +14,7 @@ import numpy as np
 import optax
 
 from accelerate_tpu import Accelerator, Model
-from accelerate_tpu.data_loader import BatchSampler
+from accelerate_tpu.data_loader import BatchSampler, SeedableRandomSampler
 from accelerate_tpu.native.loader import NativeArrayLoader
 from accelerate_tpu.utils import ProjectConfiguration, set_seed
 from complete_nlp_example import StepCounter
@@ -38,8 +38,11 @@ def training_function(args):
 
     train_ds = get_dataset(args.train_size, seed=0)
     eval_ds = get_dataset(args.eval_size, seed=1)
-    perm = np.random.default_rng(args.seed).permutation(len(train_ds))
-    train_dl = NativeArrayLoader(train_ds, BatchSampler(perm.tolist(), args.batch_size))
+    # Epoch-aware sampler (NOT a fixed one-time permutation): set_epoch(epoch)
+    # below reseeds it, so every epoch trains in a fresh order and resume
+    # replays the exact order of the interrupted epoch.
+    sampler = SeedableRandomSampler(num_samples=len(train_ds), seed=args.seed)
+    train_dl = NativeArrayLoader(train_ds, BatchSampler(sampler, args.batch_size))
     eval_dl = NativeArrayLoader(eval_ds, BatchSampler(range(len(eval_ds)), args.batch_size))
 
     optimizer = optax.adam(args.lr)

@@ -22,9 +22,16 @@ from nlp_example import MAX_LEN, get_dataset
 
 
 class StepCounter:
-    """Optimizer-step counter checkpointed alongside model/optimizer state via
+    """BATCH counter (one increment per dataloader batch, inside accumulate())
+    checkpointed alongside model/optimizer state via
     `register_for_checkpointing`, so resume lands on the exact batch regardless
-    of checkpoint granularity (`save_iteration` only counts save_state calls)."""
+    of checkpoint granularity (`save_iteration` only counts save_state calls).
+
+    It deliberately does NOT count optimizer steps: the resume arithmetic
+    (`overall_step // len(train_dl)` epochs + `overall_step % len(train_dl)`
+    batches to skip) only works at batch granularity — under gradient
+    accumulation an optimizer-step counter would land resume mid-accumulation
+    span on the wrong batch."""
 
     def __init__(self):
         self.overall_step = 0

@@ -19,14 +19,23 @@ cd /root/repo
 # Required-row count comes from the suite itself (round-4 advisor: the
 # hand-counted need=11 went stale whenever CONFIGS changed).
 need=$(python -c "import measure_r05 as m; print(len(m.required_tags()))")
+need=${need:-0}  # a crashed probe flows to finish()'s crash arm, not a syntax error
 deadline=${WATCH_DEADLINE:-$(( $(date +%s) + 37800 ))}
 
 finish() {
-  missing=$(python measure_r05.py --missing)
-  if [ -z "$missing" ]; then
+  missing=$(python measure_r05.py --missing 2>> tpu_watch.log)
+  rc=$?
+  # --missing exits 0 = complete, 1 = incomplete (tags on stdout). Any other
+  # rc (or an empty incomplete list) is a CRASH of the probe itself — which
+  # must read as incomplete, not success: deleting the marker on a crashed
+  # probe would be the exact silent-failure mode this script exists to ban.
+  if [ "$rc" -eq 0 ]; then
     rm -f MISSING_ROWS_r05.txt
     echo "[watch] all $need required configs captured; exiting 0" >> tpu_watch.log
     exit 0
+  fi
+  if [ "$rc" -ne 1 ] || [ -z "$missing" ]; then
+    missing="(missing-row probe crashed rc=$rc; see tpu_watch.log)"
   fi
   n=$(echo "$missing" | grep -c .)
   {
@@ -45,7 +54,8 @@ for i in $(seq 1 200); do
     finish
   fi
   have=$(python -c "import measure_r04 as m4, measure_r05 as m5; print(len(m5.required_tags() & m4.captured_tags(m5.OUT_PATH)))")
-  if [ "$have" -ge "$need" ]; then
+  have=${have:-0}
+  if [ -n "$have" ] && [ "$have" -ge "$need" ] && [ "$need" -gt 0 ]; then
     finish
   fi
   echo "[watch] probe $i at $(date -u +%H:%M:%S) (captured $have/$need required)" >> tpu_watch.log
