@@ -111,6 +111,19 @@ class PreparedModel:
         self.compute_dtype = compute_dtype
         self.autocast_enabled = autocast and compute_dtype is not None
         self.fp8_recipe = fp8_recipe
+        if fp8_recipe is not None and getattr(fp8_recipe, "scaling", "dynamic") == "delayed":
+            # The prepared-model apply path has no mutable fp8_meta channel, so
+            # delayed histories would stay frozen at the cold scale (1.0)
+            # FOREVER — a silent ~25% quantization error, worse than dynamic in
+            # every way. Surface it rather than let a ported TE config degrade.
+            logger.warning(
+                "FP8RecipeKwargs(scaling='delayed') through the prepared-model "
+                "path keeps amax histories frozen at their init scale (the "
+                "apply has no mutable 'fp8_meta' channel). Use the default "
+                "dynamic scaling (tighter on TPU — see docs/limitations.md), "
+                "or thread meta explicitly via ops.fp8.fp8_matmul_delayed / "
+                "fp8_autocast with apply(..., mutable=['fp8_meta'])."
+            )
         # FSDP MixedPrecision parity (reference accelerator.py:1486-1540 +
         # dataclasses MixedPrecision fields), GSPMD semantics:
         #   param_dtype — STORAGE dtype of the parameters. Under jax.grad the

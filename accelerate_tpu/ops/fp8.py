@@ -4,10 +4,13 @@
 The reference converts `nn.Linear` → TE modules whose CUDA kernels run fp8 GEMMs with a
 *delayed* scaling recipe (amax history). On TPU, XLA exposes fp8 dtypes
 (`float8_e4m3fn`, `float8_e5m2`) directly to `dot_general`, so fp8 needs no kernel
-library — just scaled casts around the dot. Scaling here is *dynamic* (per-tensor amax
-computed in-graph): the amax reduction fuses into the preceding producer, which costs
-almost nothing on TPU and is strictly more accurate than TE's history heuristic; the
-`amax_history_len` field of `FP8RecipeKwargs` is accepted for config parity and unused.
+library — just scaled casts around the dot. The DEFAULT scaling is *dynamic*
+(per-tensor amax computed in-graph): the amax reduction fuses into the preceding
+producer, which costs almost nothing on TPU and is measurably tighter than a history
+window (docs/limitations.md). TE's delayed recipe is also implemented —
+`FP8RecipeKwargs(scaling="delayed", amax_history_len=H, amax_compute_algo=...)`
+selects it: see `fp8_matmul_delayed` (explicit meta threading, grad history via the
+meta cotangent) and the `fp8_meta` module collection under `fp8_autocast`.
 
 Format policy follows the recipe: "E4M3" uses e4m3 everywhere; "HYBRID" (default, TE
 parity) uses e4m3 for activations/weights in forward and e5m2 (wider range) for the
@@ -85,10 +88,143 @@ def _fp8_matmul_bwd(hybrid, res, g):
 fp8_matmul.defvjp(_fp8_matmul_fwd, _fp8_matmul_bwd)
 
 
+# ------------------------------------------------------------- delayed scaling
+#
+# TE DelayedScaling parity (reference utils/transformer_engine.py:24-80,
+# FP8RecipeKwargs dataclasses.py:186): scales come from a rolling amax-history
+# WINDOW of previous steps instead of the current tensor. On GPU that exists to
+# break the cast→reduce kernel dependency; on TPU the in-graph amax fuses into
+# the producer, so dynamic scaling stays the default (docs/limitations.md) —
+# delayed is provided for recipe parity and for users porting TE configs.
+#
+# The functional shape: one meta pytree of three histories per matmul, threaded
+# explicitly through the step. Forward scales read the window; the OBSERVED
+# amaxes (including the gradient's, known only in backward) leave the VJP as
+# the meta argument's "cotangent" — so `jax.grad(..., argnums=meta)` returns
+# the UPDATED meta, which the caller installs for the next step (the
+# overwrite-with-gradient pattern public flax fp8 ops use). One matmul per meta
+# per step: reuse under an accumulation scan would SUM the history cotangents.
+
+
+def init_fp8_meta(history_len: int = 16):
+    """Fresh (cold) delayed-scaling state for ONE matmul: zeros mean "no amax
+    observed", which `_history_scale` maps to scale 1.0 — TE's init — until
+    real amaxes roll in."""
+    z = jnp.zeros((int(history_len),), jnp.float32)
+    return {"x_amax_history": z, "w_amax_history": z, "g_amax_history": z}
+
+
+def _history_scale(history, fmax, algo: str = "max"):
+    """TE amax_compute_algo semantics: 'max' covers the whole window (robust to
+    spikes, coarser after them), 'most_recent' tracks the last step only."""
+    amax = history[-1] if algo == "most_recent" else jnp.max(history)
+    return jnp.where(amax > 0.0, jnp.maximum(amax, 1e-12) / fmax, 1.0)
+
+
+def _roll_amax(history, amax):
+    return jnp.concatenate([history[1:], jnp.reshape(amax, (1,)).astype(jnp.float32)])
+
+
+def _quantize_with_scale(x, scale, dtype):
+    """Cast with an EXTERNAL (history) scale. Unlike the dynamic path the scale
+    may under-estimate the current tensor, so clip to the representable range —
+    TE's saturating-cast behavior — instead of overflowing to NaN/max garbage."""
+    fmax = E4M3_MAX if dtype == E4M3 else E5M2_MAX
+    q = jnp.clip(x.astype(jnp.float32) / scale, -fmax, fmax).astype(dtype)
+    return q
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fp8_matmul_delayed(x, w, meta, hybrid: bool = True, amax_algo: str = "max"):
+    """`x @ w` in fp8 under the DELAYED recipe: forward scales from
+    `meta['x_amax_history']` / `['w_amax_history']`, backward grad scale from
+    `meta['g_amax_history']`. The gradient with respect to `meta` IS the
+    updated meta (histories rolled with this step's observed amaxes)::
+
+        grads, new_meta = jax.grad(loss, argnums=(0, 2))(x, w, meta)
+        # next step uses new_meta
+
+    x: [..., K], w: [K, N]; `hybrid` selects e5m2 for the backward cotangent
+    (TE HYBRID) else e4m3 everywhere.
+    """
+    out, _ = _fp8_delayed_fwd(x, w, meta, hybrid, amax_algo)
+    return out
+
+
+def _fp8_delayed_fwd(x, w, meta, hybrid, amax_algo="max"):
+    sx = _history_scale(meta["x_amax_history"], E4M3_MAX, amax_algo)
+    sw = _history_scale(meta["w_amax_history"], E4M3_MAX, amax_algo)
+    xq = _quantize_with_scale(x, sx, E4M3)
+    wq = _quantize_with_scale(w, sw, E4M3)
+    contract = (((x.ndim - 1,), (0,)), ((), ()))
+    out = _fp8_dot(xq, sx, wq, sw, contract).astype(x.dtype)
+    amax_x = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    amax_w = jnp.max(jnp.abs(w.astype(jnp.float32)))
+    return out, (xq, sx, wq, sw, meta, amax_x, amax_w)
+
+
+def _fp8_delayed_bwd(hybrid, amax_algo, res, g):
+    xq, sx, wq, sw, meta, amax_x, amax_w = res
+    gdtype = E5M2 if hybrid else E4M3
+    gmax = E5M2_MAX if hybrid else E4M3_MAX
+    sg = _history_scale(meta["g_amax_history"], gmax, amax_algo)
+    gq = _quantize_with_scale(g, sg, gdtype)
+    dims_dx = (((g.ndim - 1,), (1,)), ((), ()))
+    dx = _fp8_dot(gq, sg, wq, sw, dims_dx).astype(g.dtype)
+    batch_dims = tuple(range(g.ndim - 1))
+    dims_dw = ((batch_dims, batch_dims), ((), ()))
+    dw = _fp8_dot(xq, sx, gq, sg, dims_dw).astype(g.dtype)
+    amax_g = jnp.max(jnp.abs(g.astype(jnp.float32)))
+    new_meta = {
+        "x_amax_history": _roll_amax(meta["x_amax_history"], amax_x),
+        "w_amax_history": _roll_amax(meta["w_amax_history"], amax_w),
+        "g_amax_history": _roll_amax(meta["g_amax_history"], amax_g),
+    }
+    return dx, dw, new_meta
+
+
+fp8_matmul_delayed.defvjp(_fp8_delayed_fwd, _fp8_delayed_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fp8_matmul_fwd_scaled(x, w, sx, sw, hybrid: bool = True):
+    """Forward with EXTERNAL scales, backward with dynamic grad scaling — the
+    autocast delayed mode (module-owned forward histories; the grad history has
+    no flax-mutable channel in backward, and dynamic grads are strictly more
+    accurate anyway)."""
+    out, _ = _fwd_scaled(x, w, sx, sw, hybrid)
+    return out
+
+
+def _fwd_scaled(x, w, sx, sw, hybrid):
+    xq = _quantize_with_scale(x, sx, E4M3)
+    wq = _quantize_with_scale(w, sw, E4M3)
+    contract = (((x.ndim - 1,), (0,)), ((), ()))
+    out = _fp8_dot(xq, sx, wq, sw, contract).astype(x.dtype)
+    return out, (xq, sx, wq, sw)
+
+
+def _bwd_scaled(hybrid, res, g):
+    xq, sx, wq, sw = res
+    gdtype = E5M2 if hybrid else E4M3
+    gq, sg = quantize_fp8(g, gdtype)
+    dims_dx = (((g.ndim - 1,), (1,)), ((), ()))
+    dx = _fp8_dot(gq, sg, wq, sw, dims_dx).astype(g.dtype)
+    batch_dims = tuple(range(g.ndim - 1))
+    dims_dw = ((batch_dims, batch_dims), ((), ()))
+    dw = _fp8_dot(xq, sx, gq, sg, dims_dw).astype(g.dtype)
+    return dx, dw, jnp.zeros_like(sx), jnp.zeros_like(sw)
+
+
+_fp8_matmul_fwd_scaled.defvjp(_fwd_scaled, _bwd_scaled)
+
+
 def fp8_dense_apply(module, x):
     """Compute a bound `nn.Dense` with the fp8 path, reusing its own params."""
     kernel = module.get_variable("params", "kernel")
     hybrid = _RECIPE_STATE["hybrid"]
+    if _RECIPE_STATE["scaling"] == "delayed":
+        return _fp8_dense_apply_delayed(module, x, kernel, hybrid)
     y = fp8_matmul(x, kernel.astype(x.dtype), hybrid)
     if module.use_bias:
         bias = module.get_variable("params", "bias")
@@ -96,32 +232,79 @@ def fp8_dense_apply(module, x):
     return y
 
 
-_RECIPE_STATE = {"hybrid": True}
+def _fp8_dense_apply_delayed(module, x, kernel, hybrid):
+    """Autocast delayed mode: the Dense's forward amax histories live in its
+    own `fp8_meta` variable collection (TE keeps fp8 meta tensors on the
+    module the same way). Histories update when the caller's `apply` marks
+    `fp8_meta` mutable — `model.apply(vars, x, mutable=["fp8_meta"])` — and
+    freeze (scales read, no writes) otherwise, e.g. at eval."""
+    hlen = _RECIPE_STATE["history_len"]
+    algo = _RECIPE_STATE["amax_algo"]
+    cold = jnp.zeros((hlen,), jnp.float32)
+    if module.has_variable("fp8_meta", "x_amax_history"):
+        hx = module.get_variable("fp8_meta", "x_amax_history")
+        hw = module.get_variable("fp8_meta", "w_amax_history")
+    else:
+        hx = hw = cold
+    w = kernel.astype(x.dtype)
+    sx = _history_scale(hx, E4M3_MAX, algo)
+    sw = _history_scale(hw, E4M3_MAX, algo)
+    y = _fp8_matmul_fwd_scaled(x, w, sx, sw, hybrid)
+    if module.is_mutable_collection("fp8_meta"):
+        amax_x = jnp.max(jnp.abs(x.astype(jnp.float32)))
+        amax_w = jnp.max(jnp.abs(w.astype(jnp.float32)))
+        module.put_variable("fp8_meta", "x_amax_history", _roll_amax(hx, amax_x))
+        module.put_variable("fp8_meta", "w_amax_history", _roll_amax(hw, amax_w))
+    if module.use_bias:
+        bias = module.get_variable("params", "bias")
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+_RECIPE_STATE = {"hybrid": True, "scaling": "dynamic", "history_len": 16, "amax_algo": "max"}
 
 
 @contextlib.contextmanager
 def fp8_autocast(fp8_recipe=None):
     """Run flax applies under fp8: every `nn.Dense.__call__` inside this context uses
     `fp8_matmul` (reference fp8_autocast + convert_model, utils/transformer_engine.py).
+
+    `fp8_recipe.scaling="delayed"` selects history-based forward scales (see
+    `_fp8_dense_apply_delayed`); the default "dynamic" computes per-tensor amax
+    in-graph — on TPU the reduction fuses into the producer, so dynamic is both
+    cheaper than a history side-channel and strictly tighter (measured on the
+    regression task in tests/test_fp8.py: see docs/limitations.md).
     """
     import flax.linen as nn
 
     hybrid = True
-    if fp8_recipe is not None and getattr(fp8_recipe, "fp8_format", "HYBRID") == "E4M3":
-        hybrid = False
+    scaling = "dynamic"
+    history_len = 16
+    amax_algo = "max"
+    if fp8_recipe is not None:
+        if getattr(fp8_recipe, "fp8_format", "HYBRID") == "E4M3":
+            hybrid = False
+        scaling = getattr(fp8_recipe, "scaling", "dynamic")
+        history_len = int(getattr(fp8_recipe, "amax_history_len", 16) or 16)
+        amax_algo = getattr(fp8_recipe, "amax_compute_algo", "max")
 
     def interceptor(next_fun, args, kwargs, context):
         if isinstance(context.module, nn.Dense) and context.method_name == "__call__":
-            return fp8_dense_apply(context.module, args[0])
+            # init pass: params don't exist yet — run the normal path so the
+            # kernel/bias get created, fp8 takes over from the first apply.
+            if context.module.has_variable("params", "kernel"):
+                return fp8_dense_apply(context.module, args[0])
         return next_fun(*args, **kwargs)
 
-    prev = _RECIPE_STATE["hybrid"]
-    _RECIPE_STATE["hybrid"] = hybrid
+    prev = dict(_RECIPE_STATE)
+    _RECIPE_STATE.update(
+        hybrid=hybrid, scaling=scaling, history_len=history_len, amax_algo=amax_algo
+    )
     try:
         with nn.intercept_methods(interceptor):
             yield
     finally:
-        _RECIPE_STATE["hybrid"] = prev
+        _RECIPE_STATE.update(prev)
 
 
 class Fp8Dense:

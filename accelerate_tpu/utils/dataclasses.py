@@ -503,11 +503,16 @@ class SequenceParallelPlugin:
 @dataclass
 class FP8RecipeKwargs(KwargsHandler):
     """fp8 policy (parity: reference FP8RecipeKwargs → TransformerEngine DelayedScaling).
-    On TPU this selects XLA fp8 dot dtypes (e4m3 fwd / e5m2 bwd) with delayed scaling."""
+    On TPU this selects XLA fp8 dot dtypes (e4m3 fwd / e5m2 bwd); `scaling`
+    picks per-tensor dynamic amax (default — the in-graph reduction fuses into
+    the producer on TPU and tracks every tensor exactly) or TE-parity
+    "delayed" (rolling amax-history window of `amax_history_len` steps,
+    `ops/fp8.py` fp8_matmul_delayed / fp8_autocast)."""
 
     margin: int = 0
     interval: int = 1
     fp8_format: str = "HYBRID"  # E4M3 | HYBRID
+    scaling: str = "dynamic"  # dynamic | delayed
     amax_history_len: int = 1024
     amax_compute_algo: str = "most_recent"
     override_linear_precision: tuple = (False, False, False)
@@ -516,3 +521,8 @@ class FP8RecipeKwargs(KwargsHandler):
         self.fp8_format = self.fp8_format.upper()
         if self.fp8_format not in ("E4M3", "HYBRID"):
             raise ValueError("fp8_format must be E4M3 or HYBRID")
+        self.scaling = self.scaling.lower()
+        if self.scaling not in ("dynamic", "delayed"):
+            raise ValueError("scaling must be dynamic or delayed")
+        if self.amax_compute_algo not in ("max", "most_recent"):
+            raise ValueError("amax_compute_algo must be max or most_recent")
