@@ -29,7 +29,15 @@ from .gpt_neox import (
     gpt_neox_tiny,
 )
 from .opt import OPTConfig, OPTForCausalLM, create_opt_model, opt_30b, opt_tiny
-from .t5 import T5Config, T5ForConditionalGeneration, create_t5_model, t0pp_11b, t5_tiny
+from .t5 import (
+    T5Config,
+    T5ForConditionalGeneration,
+    create_t5_model,
+    t0pp_11b,
+    t5_small_v1_0,
+    t5_tiny,
+    t5_tiny_v1_0,
+)
 
 # The single source of truth for named in-tree models: name -> (interchange
 # family, dataclass-config factory). The estimate registry and the convert CLI
@@ -50,6 +58,8 @@ MODEL_REGISTRY = {
     "opt-tiny": ("opt", opt_tiny),
     "t0pp-11b": ("t5", t0pp_11b),
     "t5-tiny": ("t5", t5_tiny),
+    "t5-small": ("t5", t5_small_v1_0),
+    "t5-tiny-v1-0": ("t5", t5_tiny_v1_0),
 }
 
 # family -> Model-bundle creator (the `create_*` entry points above).
@@ -93,8 +103,8 @@ def _t5_cfg(c: T5Config) -> dict:
         "num_attention_heads": c.num_heads,
         "intermediate_size": c.d_ff,
         "is_encoder_decoder": True,
-        "feed_forward_proj": "gated-gelu",
-        "tie_word_embeddings": False,
+        "feed_forward_proj": c.feed_forward_proj,
+        "tie_word_embeddings": c.tie_word_embeddings,
     }
 
 

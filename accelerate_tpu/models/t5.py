@@ -48,6 +48,21 @@ class T5Config:
     max_position_embeddings: int = 1024  # practical bound for cache sizing; T5 has no absolute positions
     decode_cache_length: int = 0
     param_dtype: str = "float32"
+    # v1.0 (t5-small/base/large, reference loads them via load_checkpoint_in_model
+    # utils/modeling.py:1565): head tied to the shared embedding with a
+    # d_model**-0.5 logit scale, single relu `wi` FFN. v1.1 (default here:
+    # t5-v1_1-*, T0pp, flan-t5) unties the head and gates the FFN.
+    tie_word_embeddings: bool = False
+    feed_forward_proj: str = "gated-gelu"  # gated-gelu | relu
+
+    def __post_init__(self):
+        if self.feed_forward_proj not in ("gated-gelu", "relu"):
+            # 'gated-relu' etc. exist in HF configs; silently building the
+            # gated-GELU FFN for them would produce wrong logits with no error.
+            raise ValueError(
+                f"feed_forward_proj must be 'gated-gelu' (v1.1) or 'relu' (v1.0), "
+                f"got {self.feed_forward_proj!r}"
+            )
 
     @property
     def _pdtype(self):
@@ -166,12 +181,19 @@ class T5FF(nn.Module):
     @nn.compact
     def __call__(self, hidden):
         cfg = self.config
-        gate = nn.gelu(
-            nn.Dense(cfg.d_ff, use_bias=False, param_dtype=cfg._pdtype, name="wi_0")(hidden),
-            approximate=True,
-        )
-        up = nn.Dense(cfg.d_ff, use_bias=False, param_dtype=cfg._pdtype, name="wi_1")(hidden)
-        return nn.Dense(cfg.d_model, use_bias=False, param_dtype=cfg._pdtype, name="wo_ff")(gate * up)
+        if cfg.feed_forward_proj == "relu":
+            # v1.0 FFN: single projection + relu (HF T5DenseActDense).
+            mid = nn.relu(
+                nn.Dense(cfg.d_ff, use_bias=False, param_dtype=cfg._pdtype, name="wi")(hidden)
+            )
+        else:
+            gate = nn.gelu(
+                nn.Dense(cfg.d_ff, use_bias=False, param_dtype=cfg._pdtype, name="wi_0")(hidden),
+                approximate=True,
+            )
+            up = nn.Dense(cfg.d_ff, use_bias=False, param_dtype=cfg._pdtype, name="wi_1")(hidden)
+            mid = gate * up
+        return nn.Dense(cfg.d_model, use_bias=False, param_dtype=cfg._pdtype, name="wo_ff")(mid)
 
 
 class T5EncoderBlock(nn.Module):
@@ -232,7 +254,15 @@ class T5ForConditionalGeneration(nn.Module):
         ]
         self.enc_final_norm = T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype)
         self.dec_final_norm = T5RMSNorm(cfg.layer_norm_eps, cfg.param_dtype)
-        self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, param_dtype=cfg._pdtype)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, param_dtype=cfg._pdtype)
+
+    def _head(self, hidden):
+        """v1.1: separate lm_head. v1.0: tied to the shared embedding with the
+        d_model**-0.5 rescale HF applies before the tied projection."""
+        if self.config.tie_word_embeddings:
+            return self.shared.attend(hidden * (self.config.d_model ** -0.5))
+        return self.lm_head(hidden)
 
     def encode(self, input_ids, attention_mask=None):
         s = input_ids.shape[1]
@@ -263,7 +293,7 @@ class T5ForConditionalGeneration(nn.Module):
         for block in self.dec_blocks:
             hidden = block(hidden, encoder_hidden, bias, enc_mask)
         hidden = self.dec_final_norm(hidden)
-        return self.lm_head(hidden)
+        return self._head(hidden)
 
     def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
         encoder_hidden = self.encode(input_ids, attention_mask)
@@ -301,6 +331,19 @@ def create_t5_model(
     return Model.from_flax(module, params, loss_fn=seq2seq_lm_loss, sharding_rules=T5_SHARDING_RULES)
 
 
+def _reject_tied_head(config: T5Config, what: str):
+    """The layered/pipeline splits put `lm_head` in the tail stage; a v1.0
+    tied head lives inside the shared embedding (prelude), so the tail would
+    need the embedding replicated — keep the restriction explicit instead of
+    silently doubling the largest tensor."""
+    if config.tie_word_embeddings:
+        raise NotImplementedError(
+            f"{what} does not support tie_word_embeddings=True (T5 v1.0): the "
+            "tied head would replicate the shared embedding into the tail "
+            "stage. Use the resident model path, or a v1.1 checkpoint."
+        )
+
+
 class T5LayeredApply:
     """LayeredApply protocol for tier-streamed encoder-decoder execution — the
     route by which the reference's T0pp-11B fp32 device_map row runs inside
@@ -311,6 +354,7 @@ class T5LayeredApply:
     output exactly once, before any cross-attention reads it)."""
 
     def __init__(self, config: T5Config):
+        _reject_tied_head(config, "T5LayeredApply (tier-streamed execution)")
         self.config = config
 
     def split(self, params):
@@ -404,6 +448,7 @@ class T5PipelineApply:
     across every hop."""
 
     def __init__(self, config: T5Config):
+        _reject_tied_head(config, "T5PipelineApply (pipeline parallelism)")
         self.config = config
 
     def split(self, params):
@@ -513,3 +558,24 @@ def t5_tiny() -> T5Config:
         num_heads=4,
         max_position_embeddings=128,
     )
+
+
+def t5_small_v1_0() -> T5Config:
+    """google-t5/t5-small dims — the v1.0 layout (tied head, relu FFN) the
+    reference loads through load_checkpoint_in_model (utils/modeling.py:1565)."""
+    return T5Config(
+        d_model=512,
+        d_kv=64,
+        d_ff=2048,
+        num_layers=6,
+        num_decoder_layers=6,
+        num_heads=8,
+        tie_word_embeddings=True,
+        feed_forward_proj="relu",
+    )
+
+
+def t5_tiny_v1_0() -> T5Config:
+    import dataclasses
+
+    return dataclasses.replace(t5_tiny(), tie_word_embeddings=True, feed_forward_proj="relu")

@@ -409,19 +409,38 @@ def _opt_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
 
 # ------------------------------------------------------------------------ t5 mapping
 def _t5_from_hf(flat: Dict[str, np.ndarray], config) -> dict:
-    """HF T5 v1.1 layout: per-stack blocks with numbered sublayers (0=self-attn,
+    """HF T5 layout: per-stack blocks with numbered sublayers (0=self-attn,
     [1=cross-attn decoder-only], last=FF); the relative-bias table lives on block 0
     of each stack. Our modules share ONE bias module per stack — same weight.
 
-    v1.0 checkpoints (tied head, non-gated `wi` FFN) are a different architecture
-    (relu FF + d_model**-0.5 logit scale), not just a different layout — reject
-    them explicitly rather than crash on a missing key."""
-    if "lm_head.weight" not in flat or "encoder.block.0.layer.1.DenseReluDense.wi_0.weight" not in flat:
+    Both generations load (reference load_checkpoint_in_model utils/modeling.py:1565
+    accepts any layout): v1.1 (un-tied lm_head, gated wi_0/wi_1 — t5-v1_1-*, T0pp,
+    flan-t5) and v1.0 (tied head inside the shared embedding, single relu `wi` —
+    t5-small/base/large). The config must match the checkpoint's generation
+    (`tie_word_embeddings` / `feed_forward_proj`) — checked here so a mismatch is
+    one clear error instead of a missing-key crash three frames deep."""
+    # The FFN keys identify the generation unambiguously (wi vs wi_0/wi_1).
+    # Head-tying is taken from the CONFIG: .bin files and in-memory state
+    # dicts keep a tied lm_head.weight VIEW while safetensors drops shared
+    # tensors, so lm_head's presence alone proves nothing about tying.
+    ckpt_gated = "encoder.block.0.layer.1.DenseReluDense.wi_0.weight" in flat
+    cfg_gated = getattr(config, "feed_forward_proj", "gated-gelu") != "relu"
+    cfg_tied = bool(getattr(config, "tie_word_embeddings", False))
+    if ckpt_gated != cfg_gated:
         raise ValueError(
-            "model_type='t5' supports the T5 v1.1 layout (un-tied lm_head, gated "
-            "wi_0/wi_1 FFN — t5-v1_1-*, T0pp, flan-t5). This checkpoint looks like "
-            "T5 v1.0 (tied head / single `wi` FFN), which is a different "
-            "architecture the in-tree model does not implement."
+            f"T5 checkpoint/config generation mismatch: checkpoint has a "
+            f"{'gated wi_0/wi_1 (v1.1)' if ckpt_gated else 'single relu wi (v1.0)'} "
+            f"FFN but the config says feed_forward_proj="
+            f"{getattr(config, 'feed_forward_proj', 'gated-gelu')!r}. Use a "
+            f"t5_small_v1_0()-style config (tie_word_embeddings=True, relu) for "
+            f"v1.0 checkpoints (t5-small/base/large) and the default T5Config "
+            f"for v1.1 (t5-v1_1-*, T0pp, flan-t5)."
+        )
+    if not cfg_tied and "lm_head.weight" not in flat:
+        raise ValueError(
+            "config says tie_word_embeddings=False but the checkpoint has no "
+            "lm_head.weight — this is a tied-head (v1.0) checkpoint; load it "
+            "with a tie_word_embeddings=True config (e.g. t5_small_v1_0())."
         )
 
     def T(name):
@@ -436,6 +455,11 @@ def _t5_from_hf(flat: Dict[str, np.ndarray], config) -> dict:
         }
 
     def ff(prefix):
+        if not ckpt_gated:
+            return {
+                "wi": {"kernel": T(prefix + ".wi.weight")},
+                "wo_ff": {"kernel": T(prefix + ".wo.weight")},
+            }
         return {
             "wi_0": {"kernel": T(prefix + ".wi_0.weight")},
             "wi_1": {"kernel": T(prefix + ".wi_1.weight")},
@@ -449,7 +473,6 @@ def _t5_from_hf(flat: Dict[str, np.ndarray], config) -> dict:
         "shared": {"embedding": np.asarray(flat["shared.weight"])},
         "enc_final_norm": norm("encoder.final_layer_norm.weight"),
         "dec_final_norm": norm("decoder.final_layer_norm.weight"),
-        "lm_head": {"kernel": T("lm_head.weight")},
         "enc_bias": {
             "rel_embedding": np.asarray(
                 flat["encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
@@ -461,6 +484,10 @@ def _t5_from_hf(flat: Dict[str, np.ndarray], config) -> dict:
             )
         },
     }
+    if not cfg_tied:
+        inner["lm_head"] = {"kernel": T("lm_head.weight")}
+    # cfg_tied with lm_head.weight present (a .bin's tied view): ignored — the
+    # head IS shared.weight, already loaded above.
     for i in range(config.num_layers):
         p = f"encoder.block.{i}."
         inner[f"enc_blocks_{i}"] = {
@@ -494,7 +521,6 @@ def _t5_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
         "decoder.embed_tokens.weight": np.asarray(inner["shared"]["embedding"]),
         "encoder.final_layer_norm.weight": np.asarray(inner["enc_final_norm"]["scale"]),
         "decoder.final_layer_norm.weight": np.asarray(inner["dec_final_norm"]["scale"]),
-        "lm_head.weight": T(inner["lm_head"]["kernel"]),
         "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight": np.asarray(
             inner["enc_bias"]["rel_embedding"]
         ),
@@ -502,13 +528,20 @@ def _t5_to_hf(params: dict, config) -> Dict[str, np.ndarray]:
             inner["dec_bias"]["rel_embedding"]
         ),
     }
+    if "lm_head" in inner:  # v1.0 ties the head into shared.weight — nothing to write
+        flat["lm_head.weight"] = T(inner["lm_head"]["kernel"])
 
     def put_attn(prefix, sub):
         for ours, theirs in [("wq", "q"), ("wk", "k"), ("wv", "v"), ("wo", "o")]:
             flat[f"{prefix}.{theirs}.weight"] = T(sub[ours]["kernel"])
 
     def put_ff(prefix, sub):
-        for ours, theirs in [("wi_0", "wi_0"), ("wi_1", "wi_1"), ("wo_ff", "wo")]:
+        pairs = (
+            [("wi", "wi"), ("wo_ff", "wo")]
+            if "wi" in sub
+            else [("wi_0", "wi_0"), ("wi_1", "wi_1"), ("wo_ff", "wo")]
+        )
+        for ours, theirs in pairs:
             flat[f"{prefix}.{theirs}.weight"] = T(sub[ours]["kernel"])
 
     for i in range(config.num_layers):
