@@ -1093,72 +1093,15 @@ def prepare_data_loader(
             even_batches=even_batches,
         )
 
-    # --- native columnar loader -------------------------------------------------------
+    # --- built-in loaders (SimpleDataLoader / native columnar) ------------------------
+    # One contract for both: shard the batch sampler across processes and wrap
+    # in the device plane, so either loader prepared through the Accelerator
+    # gets sampler checkpointing (save_state's _find_seedable_sampler walks
+    # batch_sampler.sampler), epoch-synced reshuffles, dispatch_batches, and
+    # the end_of_dataloader boundary. Only the base rebuild differs.
     from .native.loader import NativeArrayLoader
 
-    if isinstance(dataloader, NativeArrayLoader):
-        # Same contract as the SimpleDataLoader branch below: shard the batch
-        # sampler across processes and wrap in the device plane — so a native
-        # loader prepared through the Accelerator gets sampler checkpointing
-        # (save_state's _find_seedable_sampler walks batch_sampler.sampler),
-        # epoch-synced reshuffles, and the end_of_dataloader boundary, instead
-        # of silently passing through unregistered.
-        batch_sampler = dataloader.batch_sampler
-        batch_size = getattr(batch_sampler, "batch_size", 1)
-        total_batch_size = batch_size * (1 if split_batches else num_processes)
-        per_host_bs = batch_size // num_processes if split_batches else batch_size
-        if dispatch_batches:
-            return DataLoaderDispatcher(
-                dataloader,
-                sharding=sharding,
-                device_placement=put_on_device,
-                split_batches=split_batches,
-                total_batch_size=total_batch_size,
-                slice_fn=slice_fn_for_dispatch,
-                per_host_batch_size=per_host_bs,
-                even_batches=even_batches,
-            )
-        if use_seedable_sampler and isinstance(
-            getattr(batch_sampler, "sampler", None), SeedableRandomSampler
-        ):
-            synchronized_generator = batch_sampler.sampler
-        new_batch_sampler = (
-            batch_sampler
-            if num_processes == 1
-            else BatchSamplerShard(
-                batch_sampler,
-                num_processes=num_processes,
-                process_index=process_index,
-                split_batches=split_batches,
-                even_batches=even_batches,
-            )
-        )
-        base = (
-            dataloader  # sampler unchanged: keep the existing gather pool
-            if new_batch_sampler is batch_sampler
-            else NativeArrayLoader(
-                dataloader.dataset, new_batch_sampler, num_threads=dataloader.num_threads
-            )
-        )
-        try:
-            total_len = len(dataloader.dataset)
-        except TypeError:
-            total_len = None
-        return DataLoaderShard(
-            base,
-            sharding=sharding,
-            device_placement=put_on_device,
-            rng_types=rng_types,
-            synchronized_generator=synchronized_generator,
-            total_batch_size=total_batch_size,
-            total_dataset_length=total_len,
-            prefetch_size=prefetch_size,
-            per_host_batch_size=per_host_bs,
-            even_batches=even_batches,
-        )
-
-    # --- built-in / generic paths -----------------------------------------------------
-    if isinstance(dataloader, SimpleDataLoader):
+    if isinstance(dataloader, (SimpleDataLoader, NativeArrayLoader)):
         batch_sampler = dataloader.batch_sampler
         batch_size = getattr(batch_sampler, "batch_size", 1)
         total_batch_size = batch_size * (1 if split_batches else num_processes)
@@ -1187,7 +1130,16 @@ def prepare_data_loader(
                 even_batches=even_batches,
             )
         )
-        base = SimpleDataLoader(dataloader.dataset, new_batch_sampler, collate_fn=dataloader.collate_fn)
+        if new_batch_sampler is batch_sampler:
+            base = dataloader  # sampler unchanged: keep the loader (and any native gather pool)
+        elif isinstance(dataloader, NativeArrayLoader):
+            base = NativeArrayLoader(
+                dataloader.dataset, new_batch_sampler, num_threads=dataloader.num_threads
+            )
+        else:
+            base = SimpleDataLoader(
+                dataloader.dataset, new_batch_sampler, collate_fn=dataloader.collate_fn
+            )
         try:
             total_len = len(dataloader.dataset)
         except TypeError:

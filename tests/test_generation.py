@@ -45,6 +45,7 @@ def test_cached_greedy_matches_full_context():
     np.testing.assert_array_equal(out, ref)
 
 
+@pytest.mark.slow
 def test_cached_greedy_matches_full_context_gqa_deep():
     model = _model(layers=3, heads=4, kv_heads=1)
     prompt = np.random.default_rng(1).integers(1, 128, (1, 5)).astype(np.int32)

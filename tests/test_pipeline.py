@@ -237,6 +237,7 @@ def test_t5_pipeline_loss_and_forward_match_reference():
     np.testing.assert_allclose(np.asarray(pp(batch)), logits_ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_t5_pipeline_grads_match_reference():
     from accelerate_tpu.models.t5 import T5PipelineApply, create_t5_model, seq2seq_lm_loss, t5_tiny
     from accelerate_tpu.parallel.pipeline import unstack_layer_params
