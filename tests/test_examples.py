@@ -59,6 +59,7 @@ def test_by_feature_examples(script, args, marker):
         ("deepspeed_with_config_support.py", ["--train_size", "64", "--epochs", "1"], "zero_stage=2 -> SHARD_GRAD_OP"),
         ("megatron_lm_gpt_pretraining.py", ["--steps", "12", "--train_size", "64"], "pretraining loss"),
         ("sequence_parallelism.py", ["--train_size", "32"], "attention dispatch=ring"),
+        ("device_training_loop.py", ["--train_size", "64", "--epochs", "1"], "dispatches (steps_per_call=4)"),
     ],
 )
 def test_new_by_feature_examples(script, args, marker):
@@ -97,6 +98,7 @@ FEATURE_MARKERS = {
     "deepspeed_with_config_support.py": ["DeepSpeedPlugin", "hf_ds_config"],
     "megatron_lm_gpt_pretraining.py": ["prepare_pipeline", "num_microbatches"],
     "sequence_parallelism.py": ["SequenceParallelPlugin", "seq_degree"],
+    "device_training_loop.py": ["steps_per_call"],
 }
 
 
