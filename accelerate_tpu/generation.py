@@ -31,6 +31,7 @@ class GenerationConfig:
     do_sample: bool = False
     temperature: float = 1.0
     top_k: int = 0  # 0 = full vocab
+    top_p: float = 1.0  # nucleus sampling; 1.0 = disabled (applied after top_k, HF order)
     eos_token_id: Optional[int] = None
     pad_token_id: Optional[int] = None  # fill for finished rows; defaults to eos
 
@@ -45,6 +46,20 @@ def _sample(logits, config: GenerationConfig, rng, temperature=None):
     logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
     if config.top_k:
         kth = jax.lax.top_k(logits, config.top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if config.top_p < 1.0:
+        # Nucleus: keep the smallest prefix of the descending-prob ordering
+        # whose mass reaches top_p (the top token always survives: its
+        # EXCLUSIVE cumulative mass is 0 < top_p). Sort/cumsum/threshold is
+        # jit-static — no shapes depend on the data.
+        sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+        keep = exclusive_cum < config.top_p
+        # min_tokens_to_keep=1 (HF semantics): top_p <= 0 would otherwise mask
+        # EVERYTHING and categorical over all -1e30 samples uniform gibberish.
+        keep = keep.at[..., 0].set(True)
+        kth = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
         logits = jnp.where(logits < kth, -1e30, logits)
     rng, sub = jax.random.split(rng)
     return jax.random.categorical(sub, logits, axis=-1).astype(jnp.int32), rng
@@ -133,9 +148,12 @@ class Generator:
         executable per bucket instead of recompiling the whole model."""
         key = (bucket, config.do_sample, config.eos_token_id, config.pad_token_id)
         if config.do_sample:
-            # top_k shapes the program (lax.top_k); temperature rides in as a
-            # traced operand so it never forces a recompile.
-            key += (config.top_k,)
+            # top_k and top_p shape the program (lax.top_k / the nucleus
+            # threshold are trace-time); temperature rides in as a traced
+            # operand so it never forces a recompile. Omitting a program-shaping
+            # field here silently serves a STALE sampler compiled for another
+            # config — exactly what happened when top_p first landed.
+            key += (config.top_k, config.top_p)
         if key in self._decode_cache:
             return self._decode_cache[key]
 
@@ -310,7 +328,7 @@ def generate(model, input_ids, max_new_tokens: int = 32, **kwargs):
     """One-shot convenience: build a Generator and run it (HF `model.generate` shape)."""
     gen_kwargs = {
         k: kwargs.pop(k)
-        for k in ("do_sample", "temperature", "top_k", "eos_token_id", "pad_token_id")
+        for k in ("do_sample", "temperature", "top_k", "top_p", "eos_token_id", "pad_token_id")
         if k in kwargs
     }
     generator = Generator(model, max_new_tokens=max_new_tokens, **kwargs)

@@ -149,3 +149,43 @@ def test_scan_layers_cached_decode_matches_full_context(family):
     prompt = np.random.default_rng(0).integers(1, 512, (2, 8)).astype(np.int32)
     out = np.asarray(generate(model, prompt, max_new_tokens=4))
     np.testing.assert_array_equal(out, _greedy_no_cache(model, prompt, 4))
+
+
+def test_top_p_nucleus_restricts_support():
+    """top_p keeps the smallest descending-prob prefix reaching the mass: the
+    unit-level _sample must never draw outside the nucleus, the top token must
+    always survive even with tiny top_p, and the fused decode loop accepts the
+    knob (HF order: top_k first, then top_p)."""
+    from accelerate_tpu.generation import _sample
+
+    # [1, 5] logits with probs ~ [0.57, 0.21, 0.12, 0.064, 0.035]
+    logits = jnp.asarray([[4.0, 3.0, 2.45, 1.8, 1.2]], jnp.float32)
+    cfg = GenerationConfig(do_sample=True, top_p=0.7)
+    draws = set()
+    rng = jax.random.key(0)
+    for _ in range(64):
+        tok, rng = _sample(logits, cfg, rng)
+        draws.add(int(tok[0]))
+    assert draws <= {0, 1}, draws  # 0.57+0.21 covers 0.7; token 2 is outside the nucleus
+    # degenerate top_p: the argmax always survives (min_tokens_to_keep=1,
+    # including top_p=0.0 which would otherwise mask the whole vocab)
+    for p in (1e-6, 0.0):
+        tok, _ = _sample(logits, GenerationConfig(do_sample=True, top_p=p), jax.random.key(1))
+        assert int(tok[0]) == 0, p
+    # end-to-end through the fused loop: runs, deterministic per key
+    model = _model()
+    prompt = np.random.default_rng(6).integers(1, 128, (1, 6)).astype(np.int32)
+    gen = Generator(model, max_new_tokens=6)
+    cfg = GenerationConfig(max_new_tokens=6, do_sample=True, top_k=40, top_p=0.9)
+    a = np.asarray(gen(prompt, cfg, rng=jax.random.key(7)))
+    b = np.asarray(gen(prompt, cfg, rng=jax.random.key(7)))
+    np.testing.assert_array_equal(a, b)
+    # Cache-key regression: configs differing ONLY in top_p through the SAME
+    # Generator must not share a compiled sampler (top_p shapes the program;
+    # omitting it from the decode-cache key served a stale 0.9-nucleus sampler
+    # for the 1e-9 config when this feature first landed).
+    tiny = np.asarray(
+        gen(prompt, GenerationConfig(max_new_tokens=6, do_sample=True, top_p=1e-9), rng=jax.random.key(8))
+    )
+    greedy = np.asarray(gen(prompt, GenerationConfig(max_new_tokens=6)))
+    np.testing.assert_array_equal(tiny, greedy)
