@@ -117,9 +117,11 @@ class Generator:
         module = self.decode_module
         resolve = _params_resolver(model)
 
-        def prefill(params, input_ids, positions):
+        def prefill(params, input_ids, positions, attention_mask=None):
+            # attention_mask (left-padded batch prompts): rides into the cached
+            # attention as the persistent pad mask (update_decode_cache).
             logits, mutated = module.apply(
-                resolve(params), input_ids, None, positions, mutable=["cache"]
+                resolve(params), input_ids, attention_mask, positions, mutable=["cache"]
             )
             return logits[:, -1, :], mutated["cache"]
 
@@ -161,7 +163,10 @@ class Generator:
         pad_id = config.pad_token_id if config.pad_token_id is not None else (eos if eos is not None else 0)
         step_inner = self._step_inner
 
-        def decode(params, cache, first_logits, prompt_len, limit, temperature, rng, *extra):
+        def decode(params, cache, first_logits, next_positions, limit, temperature, rng, *extra):
+            # `next_positions`: the LOGICAL position of the first generated token —
+            # a scalar (uniform prompts; Seq2Seq passes 1) or a per-row [B] vector
+            # (left-padded ragged prompts: row with r real tokens continues at r).
             # `extra` operands (e.g. the encoder output for seq2seq models) thread
             # through unchanged to every step_inner call.
             b = first_logits.shape[0]
@@ -181,7 +186,7 @@ class Generator:
                 i, tokens, cache, token, rng, finished = carry
                 if eos is not None:
                     finished = finished | (token == eos)
-                position = jnp.broadcast_to(prompt_len + i - 1, (b,)).astype(jnp.int32)
+                position = jnp.broadcast_to(next_positions + i - 1, (b,)).astype(jnp.int32)
                 logits, cache = step_inner(params, cache, token, position, *extra)
                 token, rng = _sample(logits, config, rng, temperature)
                 if eos is not None:
@@ -198,7 +203,19 @@ class Generator:
         self._decode_cache[key] = fn
         return fn
 
-    def __call__(self, input_ids, generation_config: Optional[GenerationConfig] = None, rng=None, **kwargs):
+    def __call__(
+        self,
+        input_ids,
+        generation_config: Optional[GenerationConfig] = None,
+        rng=None,
+        attention_mask=None,
+        **kwargs,
+    ):
+        """`attention_mask` ([B, prompt_len] 1/0) enables ragged batch prompts via
+        the HF LEFT-padding convention: pads go at the START of each row. Rotary/
+        learned positions come from the mask's cumsum (first real token = position
+        0) and the pad slots stay masked for the whole decode via the cache's
+        persistent pad mask."""
         config = generation_config or GenerationConfig(**kwargs)
         if rng is None:
             rng = jax.random.key(0)
@@ -209,14 +226,38 @@ class Generator:
             raise ValueError(
                 f"Prompt length {prompt_len} leaves no room in the {self.max_length}-token cache"
             )
-        positions = jnp.broadcast_to(jnp.arange(prompt_len)[None, :], (b, prompt_len))
+        if attention_mask is not None:
+            am = jnp.asarray(attention_mask, jnp.int32)
+            if am.ndim != 2 or am.shape != input_ids.shape:
+                raise ValueError(
+                    f"attention_mask must be [batch, prompt_len] matching input_ids "
+                    f"{input_ids.shape}, got {am.shape}"
+                )
+            # LEFT padding only (prefill samples from the LAST slot's logits and
+            # decode continues at each row's real length): a right-padded batch
+            # would silently continue from a pad token's logits.
+            if not bool(jnp.all(am[:, -1] == 1)):
+                raise ValueError(
+                    "attention_mask looks right-padded (a row's last slot is 0); "
+                    "Generator uses the HF LEFT-padding convention — put pads at "
+                    "the START of each row"
+                )
+            positions = jnp.clip(jnp.cumsum(am, axis=-1) - 1, 0)
+            # Per-row LOGICAL position base for decode: row with r real tokens
+            # continues at position r (physical cache slots stay uniform).
+            next_positions = am.sum(-1).astype(jnp.int32)
+            prefill_args = (input_ids, positions, am)
+        else:
+            positions = jnp.broadcast_to(jnp.arange(prompt_len)[None, :], (b, prompt_len))
+            next_positions = jnp.full((b,), prompt_len, jnp.int32)
+            prefill_args = (input_ids, positions)
         params = self.params if "params" in self.params else {"params": self.params}
-        logits, cache = self._prefill(params, input_ids, positions)
+        logits, cache = self._prefill(params, *prefill_args)
         generated, _cache = self._decode_fn(_bucket_for(max_new), config)(
             params,
             cache,
             logits,
-            jnp.int32(prompt_len),
+            next_positions,
             jnp.int32(max_new),
             jnp.float32(config.temperature),
             rng,
@@ -331,5 +372,10 @@ def generate(model, input_ids, max_new_tokens: int = 32, **kwargs):
         for k in ("do_sample", "temperature", "top_k", "top_p", "eos_token_id", "pad_token_id")
         if k in kwargs
     }
+    attention_mask = kwargs.pop("attention_mask", None)
     generator = Generator(model, max_new_tokens=max_new_tokens, **kwargs)
-    return generator(input_ids, GenerationConfig(max_new_tokens=max_new_tokens, **gen_kwargs))
+    return generator(
+        input_ids,
+        GenerationConfig(max_new_tokens=max_new_tokens, **gen_kwargs),
+        attention_mask=attention_mask,
+    )

@@ -93,7 +93,7 @@ class GPTJAttention(nn.Module):
 
         if cfg.decode_cache_length:
             L = cfg.decode_cache_length
-            k_all, v_all, decode_mask = update_decode_cache(self, k, v, L)
+            k_all, v_all, decode_mask = update_decode_cache(self, k, v, L, pad_mask=mask)
             out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
         else:
             out = dot_product_attention(q, k, v, mask=mask, causal=True)

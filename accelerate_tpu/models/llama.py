@@ -96,7 +96,7 @@ class LlamaAttention(nn.Module):
         if cfg.decode_cache_length:
             # Incremental decoding through the shared flax-cache write path
             # (ops/attention.update_decode_cache).
-            k_all, v_all, decode_mask = update_decode_cache(self, k, v, cfg.decode_cache_length)
+            k_all, v_all, decode_mask = update_decode_cache(self, k, v, cfg.decode_cache_length, pad_mask=mask)
             out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
         else:
             out = dot_product_attention(q, k, v, mask=mask, causal=True)

@@ -34,12 +34,17 @@ def make_causal_mask(q_len: int, kv_len: int, dtype=None):
     return (j <= i + (kv_len - q_len)).astype(dtype or jnp.bool_)
 
 
-def update_decode_cache(module, k, v, cache_length: int):
+def update_decode_cache(module, k, v, cache_length: int, pad_mask=None):
     """The KV-cache write path shared by every decoder family (llama/gptj/
     gpt_neox/opt): persist K/V in the flax "cache" collection with static capacity
     `cache_length`. ONE write path covers prefill (s = prompt_len at index 0) and
     decode (s = 1 at the running index); the returned mask is causal over absolute
     positions and masks unwritten slots.
+
+    `pad_mask` ([B, s] 1/0, usually the prompt's attention_mask at prefill):
+    left-padded batch prompts persist their pad slots in the cache collection, so
+    every LATER decode step keeps masking them without re-threading the mask —
+    ragged prompts batch-generate like HF's left-pad convention.
 
     Call from inside the attention module's `__call__` (needs `module.variable`).
     Returns `(k_full, v_full, decode_mask)` — feed to
@@ -63,6 +68,25 @@ def update_decode_cache(module, k, v, cache_length: int):
     cols = jnp.arange(L)[None, :]
     attend = (cols <= rows) & (cols < cur + s)
     decode_mask = jnp.broadcast_to(attend[None, None, :, :], (b, 1, s, L))
+    valid = None
+    if pad_mask is not None:
+        if pad_mask.ndim != 2:
+            # Pre-pad-support this arg was silently IGNORED on the cached path
+            # (4D callers got no masking at all); be loud rather than wrong.
+            raise ValueError(
+                f"the decode-cache path persists a [B, S] key-padding mask; got a "
+                f"rank-{pad_mask.ndim} mask. Pass attention_mask as [batch, seq] "
+                f"(1 = real token), the HF padding-mask shape."
+            )
+        pad_var = module.variable("cache", "pad_mask", jnp.ones, (b, L), bool)
+        pad_var.value = jax.lax.dynamic_update_slice(
+            pad_var.value, pad_mask.astype(bool), (0, cur)
+        )
+        valid = pad_var.value
+    elif module.has_variable("cache", "pad_mask"):
+        valid = module.get_variable("cache", "pad_mask")
+    if valid is not None:
+        decode_mask = decode_mask & valid[:, None, None, :]
     return cached_k.value, cached_v.value, decode_mask
 
 

@@ -189,3 +189,43 @@ def test_top_p_nucleus_restricts_support():
     )
     greedy = np.asarray(gen(prompt, GenerationConfig(max_new_tokens=6)))
     np.testing.assert_array_equal(tiny, greedy)
+
+
+@pytest.mark.parametrize("family", ["llama", "opt"])
+def test_left_padded_ragged_batch_matches_per_row(family):
+    """HF left-pad convention: a batch of ragged prompts padded on the LEFT with
+    attention_mask must generate, row for row, exactly what each prompt produces
+    alone (pins the persistent cache pad mask, cumsum positions — rotary for
+    llama, the learned-offset embedding for opt — and the per-row decode
+    position base)."""
+    if family == "llama":
+        model = _model()
+        vocab = 128
+    else:
+        from accelerate_tpu.models.opt import create_opt_model, opt_tiny
+
+        model = create_opt_model(opt_tiny(), seq_len=32)
+        vocab = opt_tiny().vocab_size
+    rng = np.random.default_rng(11)
+    short = rng.integers(1, vocab, (1, 5)).astype(np.int32)
+    long = rng.integers(1, vocab, (1, 9)).astype(np.int32)
+    # left-pad the short prompt to the long length
+    pad = np.zeros((1, 4), np.int32)
+    batch = np.concatenate([np.concatenate([pad, short], axis=1), long], axis=0)
+    mask = np.ones_like(batch)
+    mask[0, :4] = 0
+
+    gen = Generator(model, max_new_tokens=6)
+    out = np.asarray(gen(batch, GenerationConfig(max_new_tokens=6), attention_mask=mask))
+    ref_short = np.asarray(gen(short, GenerationConfig(max_new_tokens=6)))
+    ref_long = np.asarray(gen(long, GenerationConfig(max_new_tokens=6)))
+    np.testing.assert_array_equal(out[0, 9:], ref_short[0, 5:])
+    np.testing.assert_array_equal(out[1, 9:], ref_long[0, 9:])
+    # the one-shot convenience accepts the mask too
+    out2 = np.asarray(generate(model, batch, max_new_tokens=6, attention_mask=mask))
+    np.testing.assert_array_equal(out2, out)
+    # right-padded masks are rejected loudly, not silently wrong
+    bad = np.ones_like(mask)
+    bad[0, -2:] = 0
+    with pytest.raises(ValueError, match="LEFT-padding"):
+        gen(batch, GenerationConfig(max_new_tokens=2), attention_mask=bad)
