@@ -32,6 +32,7 @@ class GenerationConfig:
     temperature: float = 1.0
     top_k: int = 0  # 0 = full vocab
     top_p: float = 1.0  # nucleus sampling; 1.0 = disabled (applied after top_k, HF order)
+    repetition_penalty: float = 1.0  # HF CTRL-style: seen tokens' logits /p (if >0) else *p
     eos_token_id: Optional[int] = None
     pad_token_id: Optional[int] = None  # fill for finished rows; defaults to eos
 
@@ -63,6 +64,15 @@ def _sample(logits, config: GenerationConfig, rng, temperature=None):
         logits = jnp.where(logits < kth, -1e30, logits)
     rng, sub = jax.random.split(rng)
     return jax.random.categorical(sub, logits, axis=-1).astype(jnp.int32), rng
+
+
+def _apply_repetition_penalty(logits, presence, penalty: float):
+    """HF RepetitionPenaltyLogitsProcessor (CTRL) semantics: every token marked
+    in `presence` [B, V] gets its logit divided by the penalty when positive,
+    multiplied when negative — both push re-use down for penalty > 1."""
+    scores = logits.astype(jnp.float32)
+    penalized = jnp.where(scores > 0, scores / penalty, scores * penalty)
+    return jnp.where(presence, penalized, scores)
 
 
 def _trim_at_eos(generated, eos_token_id, max_new: int):
@@ -148,7 +158,11 @@ class Generator:
         `bucket` (power of two) sizes the output buffer; the actual token bound is a
         TRACED scalar, so varying prompt lengths / max_new_tokens reuse one
         executable per bucket instead of recompiling the whole model."""
-        key = (bucket, config.do_sample, config.eos_token_id, config.pad_token_id)
+        # Only WHETHER a penalty applies shapes the program (the presence carry);
+        # the penalty VALUE rides as a traced operand like temperature, so
+        # sweeping it never recompiles the fused loop.
+        key = (bucket, config.do_sample, config.eos_token_id, config.pad_token_id,
+               config.repetition_penalty != 1.0)
         if config.do_sample:
             # top_k and top_p shape the program (lax.top_k / the nucleus
             # threshold are trace-time); temperature rides in as a traced
@@ -162,41 +176,54 @@ class Generator:
         eos = config.eos_token_id
         pad_id = config.pad_token_id if config.pad_token_id is not None else (eos if eos is not None else 0)
         step_inner = self._step_inner
+        use_penalty = config.repetition_penalty != 1.0
 
-        def decode(params, cache, first_logits, next_positions, limit, temperature, rng, *extra):
+        def decode(params, cache, first_logits, next_positions, limit, temperature, penalty, rng, presence, *extra):
             # `next_positions`: the LOGICAL position of the first generated token —
             # a scalar (uniform prompts; Seq2Seq passes 1) or a per-row [B] vector
             # (left-padded ragged prompts: row with r real tokens continues at r).
+            # `presence`: [B, V] bool of already-seen tokens when the config sets a
+            # repetition penalty (the caller seeds it from the prompt; each
+            # generated token joins it on device), else None.
             # `extra` operands (e.g. the encoder output for seq2seq models) thread
             # through unchanged to every step_inner call.
             b = first_logits.shape[0]
-            token, rng = _sample(first_logits, config, rng, temperature)
+
+            def pick(logits, presence, rng):
+                if use_penalty:
+                    logits = _apply_repetition_penalty(logits, presence, penalty)
+                token, rng = _sample(logits, config, rng, temperature)
+                if use_penalty:
+                    presence = presence.at[jnp.arange(b), token].set(True)
+                return token, presence, rng
+
+            token, presence, rng = pick(first_logits, presence, rng)
             tokens = jnp.full((b, bucket), jnp.int32(pad_id))
             tokens = tokens.at[:, 0].set(token)
             finished = jnp.zeros((b,), bool)
 
             def cond(carry):
-                i, tokens, cache, token, rng, finished = carry
+                i, tokens, cache, token, rng, finished, presence = carry
                 more = i < limit
                 if eos is not None:
                     more &= ~jnp.all(finished | (token == eos))
                 return more
 
             def body(carry):
-                i, tokens, cache, token, rng, finished = carry
+                i, tokens, cache, token, rng, finished, presence = carry
                 if eos is not None:
                     finished = finished | (token == eos)
                 position = jnp.broadcast_to(next_positions + i - 1, (b,)).astype(jnp.int32)
                 logits, cache = step_inner(params, cache, token, position, *extra)
-                token, rng = _sample(logits, config, rng, temperature)
+                token, presence, rng = pick(logits, presence, rng)
                 if eos is not None:
                     # Rows past their EOS emit pad/eos, matching HF generate's padding.
                     token = jnp.where(finished, jnp.int32(pad_id), token)
                 tokens = tokens.at[:, i].set(token)
-                return (i + 1, tokens, cache, token, rng, finished)
+                return (i + 1, tokens, cache, token, rng, finished, presence)
 
-            carry = (jnp.int32(1), tokens, cache, token, rng, finished)
-            _, tokens, cache, _, _, _ = jax.lax.while_loop(cond, body, carry)
+            carry = (jnp.int32(1), tokens, cache, token, rng, finished, presence)
+            _, tokens, cache, _, _, _, _ = jax.lax.while_loop(cond, body, carry)
             return tokens, cache
 
         fn = jax.jit(decode, donate_argnums=(1,))
@@ -251,6 +278,20 @@ class Generator:
             positions = jnp.broadcast_to(jnp.arange(prompt_len)[None, :], (b, prompt_len))
             next_positions = jnp.full((b,), prompt_len, jnp.int32)
             prefill_args = (input_ids, positions)
+        presence = None
+        if config.repetition_penalty != 1.0:
+            # Seed the seen-token set from the REAL prompt tokens (pad slots of a
+            # left-padded batch must not mark token id 0 as seen).
+            real = (
+                am.astype(bool)
+                if attention_mask is not None
+                else jnp.ones((b, prompt_len), bool)
+            )
+            presence = (
+                jnp.zeros((b, self.base_config.vocab_size), bool)
+                .at[jnp.arange(b)[:, None], input_ids]
+                .max(real)
+            )
         params = self.params if "params" in self.params else {"params": self.params}
         logits, cache = self._prefill(params, *prefill_args)
         generated, _cache = self._decode_fn(_bucket_for(max_new), config)(
@@ -260,7 +301,9 @@ class Generator:
             next_positions,
             jnp.int32(max_new),
             jnp.float32(config.temperature),
+            jnp.float32(config.repetition_penalty),
             rng,
+            presence,
         )
         generated = _trim_at_eos(generated[:, :max_new], config.eos_token_id, max_new)
         return jnp.concatenate([input_ids, generated], axis=1)
@@ -350,6 +393,15 @@ class Seq2SeqGenerator:
         encoder_hidden = self._encode(self.params, input_ids, am)
         start = jnp.full((b,), jnp.int32(self.start_id))
         first_logits, cache = self._prime(self.params, encoder_hidden, enc_mask, start)
+        presence = None
+        if config.repetition_penalty != 1.0:
+            # Encoder-decoder penalty covers the DECODER context (HF semantics):
+            # seed with the start token only.
+            presence = (
+                jnp.zeros((b, self.base_config.vocab_size), bool)
+                .at[jnp.arange(b), start]
+                .set(True)
+            )
         generated, _cache = self._decode_fn(_bucket_for(max_new), config)(
             self.params,
             cache,
@@ -357,7 +409,9 @@ class Seq2SeqGenerator:
             jnp.int32(1),  # the start token occupies cache position 0
             jnp.int32(max_new),
             jnp.float32(config.temperature),
+            jnp.float32(config.repetition_penalty),
             rng,
+            presence,
             encoder_hidden,
             enc_mask,
         )
@@ -369,7 +423,8 @@ def generate(model, input_ids, max_new_tokens: int = 32, **kwargs):
     """One-shot convenience: build a Generator and run it (HF `model.generate` shape)."""
     gen_kwargs = {
         k: kwargs.pop(k)
-        for k in ("do_sample", "temperature", "top_k", "top_p", "eos_token_id", "pad_token_id")
+        for k in ("do_sample", "temperature", "top_k", "top_p", "repetition_penalty",
+                  "eos_token_id", "pad_token_id")
         if k in kwargs
     }
     attention_mask = kwargs.pop("attention_mask", None)
