@@ -45,6 +45,9 @@ class MixtralConfig:
     rms_norm_eps: float = 1e-5
     router_aux_loss_coef: float = 0.02
     router_z_loss_coef: float = 0.001
+    # Serving: >0 routes the shared LlamaAttention through the KV-cache path
+    # (Generator sets it via dataclasses.replace, same as every causal family).
+    decode_cache_length: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -62,6 +65,7 @@ class MixtralConfig:
             max_position_embeddings=self.max_position_embeddings,
             rope_theta=self.rope_theta,
             rms_norm_eps=self.rms_norm_eps,
+            decode_cache_length=self.decode_cache_length,
         )
 
 
