@@ -525,7 +525,13 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.gradient_state = GradientState()
         self._total_batch_size = total_batch_size
         self._total_dataset_length = total_dataset_length
-        self.prefetch_size = max(1, prefetch_size)
+        # prefetch_size=0 means SYNCHRONOUS: no producer thread, batches are
+        # collated + transferred inline on the consumer — the debugging mode
+        # (clean stack traces, no thread interleaving). >=1 sizes the background
+        # prefetch queue. (0 used to be silently clamped to 1.)
+        if prefetch_size < 0:
+            raise ValueError(f"prefetch_size must be >= 0 (0 = synchronous), got {prefetch_size}")
+        self.prefetch_size = prefetch_size
         self.skip_batches = skip_batches
         self.per_host_batch_size = per_host_batch_size
         self.even_batches = even_batches
@@ -602,6 +608,25 @@ class DataLoaderShard(DataLoaderStateMixin):
             synchronize_rng_states(self.rng_types, self.synchronized_generator)
         self.set_epoch(self.iteration)
         self.begin()
+        if self.prefetch_size == 0:
+            # Synchronous debug mode: no producer thread. Same one-batch
+            # lookahead so `end_of_dataloader` is still set before the final
+            # batch is yielded (the gradient-sync contract).
+            try:
+                held = None
+                for raw in self._raw_iter():
+                    batch = self._process_batch(raw)
+                    if held is not None:
+                        yield held
+                    held = batch
+                if held is not None:
+                    self.end_of_dataloader = True
+                    yield held
+                self.iteration += 1
+                self._advance_linked_loader()
+            finally:
+                self.end()
+            return
         # Background prefetch: a producer thread collates + transfers up to
         # `prefetch_size` batches ahead so host work and host→HBM DMA overlap with the
         # consumer's jitted compute (the MpDeviceLoader replacement, reference
@@ -1004,6 +1029,11 @@ def prepare_data_loader(
     Accepts a torch DataLoader (rebuilt with a sharded batch sampler), a
     `SimpleDataLoader`, a map-style dataset paired with an existing batch_sampler, or
     any iterable of batches (treated as an already-per-host stream).
+
+    `prefetch_size` sizes the background producer queue (host collation +
+    host→HBM DMA overlap with jitted compute); **0 disables the producer thread
+    entirely** — synchronous inline batches for debugging (clean stack traces,
+    no thread interleaving), at the cost of the transfer/compute overlap.
     """
     state = PartialState()
     if num_processes is None:

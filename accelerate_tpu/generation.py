@@ -16,6 +16,7 @@ path) with static capacity `max_length`.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -107,6 +108,41 @@ def _params_resolver(model):
     return lambda p: dequantize_params(p, compute_dtype)
 
 
+def make_causal_programs(module, resolve, full_prefill_logits: bool = False):
+    """(prefill, step) raw callables for a decode-cache causal-LM module — the
+    factored seam that `Generator` jits directly and `serving.ContinuousBatcher`
+    composes into its slot-insert / chunked-decode programs.
+
+    `prefill(params, input_ids, positions, attention_mask=None)` writes the whole
+    prompt into a fresh cache and returns `(last_logits, cache)` — or the full
+    `[B, S, V]` logits with `full_prefill_logits=True` (serving's bucketed insert
+    reads the logits at each prompt's REAL length, not the padded end);
+    `step(params, cache, token, position)` advances one token. Both are un-jitted
+    so callers can trace them inside larger fused programs."""
+
+    def prefill(params, input_ids, positions, attention_mask=None):
+        # attention_mask (left-padded batch prompts): rides into the cached
+        # attention as the persistent pad mask (update_decode_cache).
+        logits, mutated = module.apply(
+            resolve(params), input_ids, attention_mask, positions, mutable=["cache"]
+        )
+        if full_prefill_logits:
+            return logits, mutated["cache"]
+        return logits[:, -1, :], mutated["cache"]
+
+    def step(params, cache, token, position):
+        logits, mutated = module.apply(
+            {**resolve(params), "cache": cache},
+            token[:, None],
+            None,
+            position[:, None],
+            mutable=["cache"],
+        )
+        return logits[:, -1, :], mutated["cache"]
+
+    return prefill, step
+
+
 class Generator:
     """Compiled prefill + decode-step pair for a causal-LM Model bundle.
 
@@ -124,27 +160,7 @@ class Generator:
         decode_cfg = dataclasses.replace(self.base_config, decode_cache_length=self.max_length)
         self.decode_module = type(model.module)(decode_cfg)
 
-        module = self.decode_module
-        resolve = _params_resolver(model)
-
-        def prefill(params, input_ids, positions, attention_mask=None):
-            # attention_mask (left-padded batch prompts): rides into the cached
-            # attention as the persistent pad mask (update_decode_cache).
-            logits, mutated = module.apply(
-                resolve(params), input_ids, attention_mask, positions, mutable=["cache"]
-            )
-            return logits[:, -1, :], mutated["cache"]
-
-        def step(params, cache, token, position):
-            logits, mutated = module.apply(
-                {**resolve(params), "cache": cache},
-                token[:, None],
-                None,
-                position[:, None],
-                mutable=["cache"],
-            )
-            return logits[:, -1, :], mutated["cache"]
-
+        prefill, step = make_causal_programs(self.decode_module, _params_resolver(model))
         self._prefill = jax.jit(prefill)
         self._step_inner = step  # un-jitted: traced inside the fused decode loop
         self._decode_cache = {}
@@ -419,8 +435,60 @@ class Seq2SeqGenerator:
         return generated  # decoder tokens only (HF seq2seq generate shape)
 
 
+# Warm-executable cache for the module-level generate() convenience: keyed on the
+# MODEL'S identity (weakly — a dead model must not pin its Generator, and a reused
+# id() must not serve another model's programs) plus any Generator kwargs.
+# max_new_tokens is NOT part of the key: the Generator's cache capacity comes from
+# max_length/max_position_embeddings and the fused loop buckets per call, so one
+# cached Generator serves every budget. A hit also requires `model.params` to be
+# the SAME object the Generator holds — `model.params = new_params` (the
+# train-then-sample pattern) must rebuild, never decode with stale weights.
+# Without the cache every convenience call paid a fresh prefill+decode compile
+# (~seconds) for byte-identical programs.
+_GENERATOR_CACHE: dict = {}
+_GENERATOR_CACHE_MAX = 8
+# generate() was stateless (and so trivially thread-safe) before the cache; the
+# lock covers only dict bookkeeping — Generator construction/compilation runs
+# outside it (two racing misses both build; last insert wins).
+_GENERATOR_CACHE_LOCK = threading.Lock()
+
+
+def _evict_dead_generator_entries(dead_ref):
+    """weakref finalizer: a collected model must not pin its Generator (params
+    device buffers + compiled executables) until an id()-colliding lookup or LRU
+    overflow happens to evict it."""
+    with _GENERATOR_CACHE_LOCK:
+        for key in [k for k, (r, _) in _GENERATOR_CACHE.items() if r is dead_ref]:
+            _GENERATOR_CACHE.pop(key, None)
+
+
+def _cached_generator(model, max_new_tokens: int, **kwargs) -> Generator:
+    import weakref
+
+    key = (id(model), tuple(sorted(kwargs.items())))
+    with _GENERATOR_CACHE_LOCK:
+        hit = _GENERATOR_CACHE.get(key)
+        if hit is not None:
+            ref, generator = hit
+            if ref() is model and generator.params is model.params:
+                _GENERATOR_CACHE[key] = _GENERATOR_CACHE.pop(key)  # LRU bump
+                return generator
+            _GENERATOR_CACHE.pop(key, None)  # dead/reused id() or rebound params
+    generator = Generator(model, max_new_tokens=max_new_tokens, **kwargs)
+    try:
+        ref = weakref.ref(model, _evict_dead_generator_entries)
+    except TypeError:  # non-weakref-able bundle: don't cache rather than leak
+        return generator
+    with _GENERATOR_CACHE_LOCK:
+        _GENERATOR_CACHE[key] = (ref, generator)
+        while len(_GENERATOR_CACHE) > _GENERATOR_CACHE_MAX:
+            del _GENERATOR_CACHE[next(iter(_GENERATOR_CACHE))]
+    return generator
+
+
 def generate(model, input_ids, max_new_tokens: int = 32, **kwargs):
-    """One-shot convenience: build a Generator and run it (HF `model.generate` shape)."""
+    """One-shot convenience: build (or reuse — see `_cached_generator`) a
+    Generator and run it (HF `model.generate` shape)."""
     gen_kwargs = {
         k: kwargs.pop(k)
         for k in ("do_sample", "temperature", "top_k", "top_p", "repetition_penalty",
@@ -428,7 +496,7 @@ def generate(model, input_ids, max_new_tokens: int = 32, **kwargs):
         if k in kwargs
     }
     attention_mask = kwargs.pop("attention_mask", None)
-    generator = Generator(model, max_new_tokens=max_new_tokens, **kwargs)
+    generator = _cached_generator(model, max_new_tokens, **kwargs)
     return generator(
         input_ids,
         GenerationConfig(max_new_tokens=max_new_tokens, **gen_kwargs),

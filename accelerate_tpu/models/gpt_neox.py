@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from ..modeling import Model
-from ..ops.attention import dot_product_attention, update_decode_cache
+from ..ops.attention import dot_product_attention, update_decode_cache, update_slot_cache
 from ..parallel.sharding import constrain_activation
 from ..ops.remat import maybe_remat
 from .llama import causal_lm_loss
@@ -47,6 +47,8 @@ class GPTNeoXConfig:
     use_parallel_residual: bool = True
     scan_layers: bool = False
     decode_cache_length: int = 0
+    # Per-row slot-cache decode for continuous batching (see LlamaConfig).
+    decode_slot_cache: bool = False
     param_dtype: str = "float32"
 
     @property
@@ -91,7 +93,12 @@ class GPTNeoXAttention(nn.Module):
 
         if cfg.decode_cache_length:
             L = cfg.decode_cache_length
-            k_all, v_all, decode_mask = update_decode_cache(self, k, v, L, pad_mask=mask)
+            if cfg.decode_slot_cache:
+                # Continuous-batching decode: per-row scatter writes at each
+                # slot's own position (serving.ContinuousBatcher).
+                k_all, v_all, decode_mask = update_slot_cache(self, k, v, L, positions)
+            else:
+                k_all, v_all, decode_mask = update_decode_cache(self, k, v, L, pad_mask=mask)
             out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
         else:
             out = dot_product_attention(q, k, v, mask=mask, causal=True)

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..modeling import Model
-from ..ops.attention import dot_product_attention, update_decode_cache
+from ..ops.attention import dot_product_attention, update_decode_cache, update_slot_cache
 
 from ..parallel.sharding import constrain_activation
 from ..ops.remat import maybe_remat
@@ -50,6 +50,10 @@ class LlamaConfig:
     # When set, attention keeps a [B, decode_cache_length] KV cache in the flax
     # "cache" collection (incremental decoding); 0 = normal training/forward path.
     decode_cache_length: int = 0
+    # Slot-batched serving (serving.ContinuousBatcher): every batch row is an
+    # independent request slot whose decode position comes from the `positions`
+    # argument (per-row scatter writes) instead of the shared `cache_index`.
+    decode_slot_cache: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -94,9 +98,16 @@ class LlamaAttention(nn.Module):
         k = rotary_embedding(k, positions, cfg.rope_theta)
 
         if cfg.decode_cache_length:
-            # Incremental decoding through the shared flax-cache write path
-            # (ops/attention.update_decode_cache).
-            k_all, v_all, decode_mask = update_decode_cache(self, k, v, cfg.decode_cache_length, pad_mask=mask)
+            if cfg.decode_slot_cache:
+                # Continuous-batching decode: each slot row writes at its OWN
+                # position (per-row scatter) and attends its written prefix only.
+                k_all, v_all, decode_mask = update_slot_cache(
+                    self, k, v, cfg.decode_cache_length, positions
+                )
+            else:
+                # Incremental decoding through the shared flax-cache write path
+                # (ops/attention.update_decode_cache).
+                k_all, v_all, decode_mask = update_decode_cache(self, k, v, cfg.decode_cache_length, pad_mask=mask)
             out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
         else:
             out = dot_product_attention(q, k, v, mask=mask, causal=True)

@@ -90,6 +90,47 @@ def update_decode_cache(module, k, v, cache_length: int, pad_mask=None):
     return cached_k.value, cached_v.value, decode_mask
 
 
+def update_slot_cache(module, k, v, cache_length: int, positions):
+    """Per-ROW cache writes for slot-based continuous batching (serving.py):
+    every batch row is an independent request slot with its OWN running position,
+    so the single new K/V of row i lands at `positions[i]` instead of a shared
+    scalar `cache_index`. The scatter (`.at[rows, pos].set`) is the per-slot twin
+    of `update_decode_cache`'s `dynamic_update_slice`; the returned mask lets row
+    i attend exactly to its written prefix `cols <= positions[i]` — stale K/V
+    from a previous slot occupant above the current position is never visible,
+    which is what makes slot reuse sound without ever clearing the cache.
+
+    Decode-only (s == 1): slot PREFILL goes through the ordinary
+    `update_decode_cache` path on a batch-1 cache that the serving engine
+    scatters into the slot row (utils/operations.tree_scatter_rows), so one
+    attention code path covers both programs.
+
+    Args:
+        positions: [B, 1] int32 — each slot's absolute write/attend position.
+
+    Returns `(k_full, v_full, decode_mask)` like `update_decode_cache`.
+    """
+    import jax.numpy as jnp
+
+    b, s, h, d = k.shape
+    if s != 1:
+        raise ValueError(
+            f"update_slot_cache is the per-token decode path (seq == 1, got {s}); "
+            "prefill a slot through update_decode_cache on a batch-1 cache and "
+            "scatter it into the slot row (tree_scatter_rows)"
+        )
+    L = cache_length
+    cached_k = module.variable("cache", "cached_key", jnp.zeros, (b, L, h, d), k.dtype)
+    cached_v = module.variable("cache", "cached_value", jnp.zeros, (b, L, h, d), v.dtype)
+    pos = jnp.clip(positions[:, 0], 0, L - 1).astype(jnp.int32)
+    rows = jnp.arange(b)
+    cached_k.value = cached_k.value.at[rows, pos].set(k[:, 0])
+    cached_v.value = cached_v.value.at[rows, pos].set(v[:, 0])
+    cols = jnp.arange(L)[None, :]
+    decode_mask = (cols <= pos[:, None])[:, None, None, :]  # [B, 1, 1, L]
+    return cached_k.value, cached_v.value, decode_mask
+
+
 def _auto_sequence_parallel(batch: int, seq_len: int):
     """(mesh, mode) when an already-built mesh has a real "seq" axis and the shapes
     divide cleanly — models then get ring attention with zero code changes. None

@@ -1,0 +1,441 @@
+"""Continuous-batching serving engine: slot-based in-flight batching over the
+fused decode loop.
+
+The static `Generator` path runs one prefill + one fused decode to completion:
+short requests wait for the longest row, finished rows burn MXU cycles on masked
+work, and nothing new can join until the whole batch drains. `ContinuousBatcher`
+keeps the GSPMD single-compiled-program discipline (one decode executable, ever)
+but makes the BATCH dynamic at the host level:
+
+  - A fixed-capacity **slot batch**: `num_slots` rows sharing one static KV cache
+    of capacity `max_length`. A slot is a physical cache row; requests come and
+    go, the compiled program never changes shape.
+  - **insert** (one executable per power-of-two prompt bucket): prefill a new
+    request's prompt through the ordinary decode-cache path on a batch-1 cache,
+    then `tree_scatter_rows` it into the free slot's cache rows, read the logits
+    at the prompt's REAL length (a traced scalar — bucket pads never recompile),
+    and sample the first token. TTFT = one insert dispatch.
+  - **decode_chunk** (ONE executable per engine): a `lax.scan` stepping ALL
+    slots `chunk_size` tokens per dispatch through the models' per-row slot
+    cache (`ops/attention.update_slot_cache`). Per-slot position counters,
+    per-slot GenerationConfig scalars (temperature / repetition penalty / EOS id
+    / token budget ride as traced operands, the no-recompile discipline of
+    generation.py's fused loop), EOS + budget masking, and a packed
+    `(slot_id, token)` output buffer the host drains for streaming.
+
+Between chunks the host frees finished slots and admits queued requests — a
+late-arriving request starts decoding while earlier long requests are still
+mid-flight. Stale K/V from a slot's previous occupant is never visible: each row
+attends only `cols <= its own position`, and insert overwrites the prompt rows.
+
+Greedy outputs are token-identical to the static `Generator` path (pads
+contribute exact zeros under the f32 softmax; rows are independent in every
+layer), which is what `tests/test_serving.py` pins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .generation import (
+    GenerationConfig,
+    _apply_repetition_penalty,
+    _bucket_for,
+    _params_resolver,
+    _sample,
+    make_causal_programs,
+)
+from .utils.operations import tree_scatter_rows
+
+
+@dataclass
+class Request:
+    """One serving request. `eos_token_id`, `max_new_tokens`, `temperature` and
+    `repetition_penalty` are PER-REQUEST (traced operands of the shared decode
+    program); `do_sample`/`top_k`/`top_p` are engine-level (they shape the
+    compiled sampler, exactly as in `Generator._decode_fn`)."""
+
+    request_id: int
+    input_ids: Any  # [prompt_len] int sequence
+    max_new_tokens: int = 32
+    temperature: float = 1.0
+    repetition_penalty: float = 1.0
+    eos_token_id: Optional[int] = None
+    arrival_time: float = 0.0  # caller-defined clock, echoed into the result
+
+
+@dataclass
+class RequestResult:
+    request_id: int
+    tokens: List[int] = field(default_factory=list)
+    arrival_time: float = 0.0
+    first_token_time: Optional[float] = None  # host perf_counter at insert return
+    finish_time: Optional[float] = None
+    finished: bool = False
+    finish_reason: Optional[str] = None  # "eos" | "length"
+
+
+class ContinuousBatcher:
+    """Slot-based in-flight batching over the fused decode loop.
+
+    Typical driving loop::
+
+        engine = ContinuousBatcher(model, num_slots=8, chunk_size=16)
+        for r in requests:
+            engine.submit(r)
+        while engine.pending:
+            for request_id, new_tokens in engine.step():
+                stream(request_id, new_tokens)   # incremental drain
+
+    `step()` = admit-into-free-slots, dispatch ONE decode chunk, drain the packed
+    stream buffer. The decode executable is compiled exactly once per
+    (num_slots, chunk_size, sampler shape); admission compiles one insert
+    executable per power-of-two prompt bucket and never touches the decode
+    program (`trace_counts` proves it).
+    """
+
+    def __init__(
+        self,
+        model,
+        num_slots: int = 4,
+        max_length: Optional[int] = None,
+        chunk_size: int = 8,
+        do_sample: bool = False,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        use_repetition_penalty: bool = False,
+        rng=None,
+    ):
+        if getattr(model, "module", None) is None or not hasattr(model.module, "config"):
+            raise ValueError("ContinuousBatcher needs a Model bundle built from an in-tree flax module")
+        base = model.module.config
+        if not hasattr(base, "decode_slot_cache"):
+            raise ValueError(
+                f"{type(model.module).__name__}'s config has no `decode_slot_cache` "
+                "field — this model family doesn't support slot-batched serving yet"
+            )
+        self.base_config = base
+        self.params = model.params if "params" in model.params else {"params": model.params}
+        self.num_slots = int(num_slots)
+        self.max_length = int(max_length or base.max_position_embeddings)
+        self.chunk_size = int(chunk_size)
+        self.do_sample = do_sample
+        self.top_k = top_k
+        self.top_p = top_p
+        self.use_repetition_penalty = use_repetition_penalty
+        if self.num_slots < 1 or self.chunk_size < 1:
+            raise ValueError("num_slots and chunk_size must be >= 1")
+
+        resolve = _params_resolver(model)
+        # Prefill rides the ORDINARY decode-cache path on a batch-1 cache (shared
+        # scalar cache_index, write at 0); decode steps ride the per-row slot
+        # cache. Same cache capacity so slot rows line up for the scatter.
+        prefill_cfg = dataclasses.replace(base, decode_cache_length=self.max_length)
+        step_cfg = dataclasses.replace(
+            base, decode_cache_length=self.max_length, decode_slot_cache=True
+        )
+        prefill_module = type(model.module)(prefill_cfg)
+        step_module = type(model.module)(step_cfg)
+        self._prefill_raw, _ = make_causal_programs(prefill_module, resolve, full_prefill_logits=True)
+        _, self._step_raw = make_causal_programs(step_module, resolve)
+        self._step_module = step_module
+        self._resolve = resolve
+
+        self._sample_config = GenerationConfig(do_sample=do_sample, top_k=top_k, top_p=top_p)
+        # Python-side effects run at TRACE time: these count compiles, and the
+        # serving tests pin "decode compiled once across mixed admissions" on them.
+        self.trace_counts: Dict[str, int] = {"insert": 0, "decode_chunk": 0}
+
+        self._rng = rng if rng is not None else jax.random.key(0)
+        self._insert_fns: Dict[int, Any] = {}
+        self._chunk_fn = self._build_chunk()
+        self._cache = self._init_cache()
+        self._presence = (
+            jnp.zeros((self.num_slots, base.vocab_size), bool) if use_repetition_penalty else None
+        )
+
+        S = self.num_slots
+        # Host mirror of the per-slot device operands (small [S] vectors, pushed
+        # each dispatch; the CACHE and presence stay device-resident/donated).
+        self._token = np.zeros(S, np.int32)
+        self._pos = np.zeros(S, np.int32)
+        self._active = np.zeros(S, bool)
+        self._rem = np.zeros(S, np.int32)
+        self._eos = np.full(S, -1, np.int32)
+        self._temp = np.ones(S, np.float32)
+        self._pen = np.ones(S, np.float32)
+
+        self._slot_request: List[Optional[RequestResult]] = [None] * S
+        self._queue: deque = deque()
+        self.results: Dict[int, RequestResult] = {}
+        self.stats = {"inserts": 0, "chunks": 0, "decode_steps": 0}
+
+    # ------------------------------------------------------------------ programs
+
+    def _init_cache(self):
+        """Create the [num_slots, max_length] slot cache: `eval_shape` the
+        slot-mode module's cache variables (zero compute, zero compile — no
+        throwaway executable at engine construction) and materialize them as
+        zeros. Correct because every slot's rows are overwritten by insert
+        before they're ever attended."""
+        S = self.num_slots
+        module, resolve = self._step_module, self._resolve
+        dummy = jnp.zeros((S, 1), jnp.int32)
+        pos = jnp.zeros((S, 1), jnp.int32)
+        shapes = jax.eval_shape(
+            lambda p: module.apply(resolve(p), dummy, None, pos, mutable=["cache"])[1]["cache"],
+            self.params,
+        )
+        return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def _insert_fn(self, bucket: int):
+        """One compiled insert per power-of-two prompt bucket. The prompt's real
+        length, the slot index, temperature/penalty and the rng all ride as
+        traced operands — re-admission never recompiles anything."""
+        fn = self._insert_fns.get(bucket)
+        if fn is not None:
+            return fn
+        prefill = self._prefill_raw
+        use_pen = self.use_repetition_penalty
+        config = self._sample_config
+        V = self.base_config.vocab_size
+
+        def insert(params, cache, presence, input_ids, real_len, slot, temperature, penalty, rng):
+            self.trace_counts["insert"] += 1
+            positions = jnp.broadcast_to(jnp.arange(bucket)[None, :], (1, bucket))
+            logits, small = prefill(params, input_ids, positions)
+            cache = tree_scatter_rows(cache, small, slot)
+            # Logits at the REAL last prompt token (right-bucket pads sit above
+            # it and, being causal, never influenced it).
+            last = jax.lax.dynamic_slice_in_dim(logits, real_len - 1, 1, axis=1)[:, 0, :]
+            row = None
+            if use_pen:
+                valid = jnp.arange(bucket) < real_len
+                row = jnp.zeros((V,), bool).at[input_ids[0]].max(valid)
+                last = _apply_repetition_penalty(last, row[None, :], penalty)
+            token, rng = _sample(last, config, rng, temperature)
+            if use_pen:
+                row = row.at[token[0]].set(True)
+                presence = jax.lax.dynamic_update_slice(
+                    presence, row[None, :], (jnp.asarray(slot, jnp.int32), jnp.int32(0))
+                )
+            return token[0], cache, presence, rng
+
+        donate = (1, 2) if use_pen else (1,)
+        fn = jax.jit(insert, donate_argnums=donate)
+        self._insert_fns[bucket] = fn
+        return fn
+
+    def _build_chunk(self):
+        """THE decode executable: `chunk_size` scan steps over all slots, per-slot
+        operands, packed (slot, token) stream output. Compiled exactly once."""
+        S, L, chunk = self.num_slots, self.max_length, self.chunk_size
+        step_inner = self._step_raw
+        use_pen = self.use_repetition_penalty
+        config = self._sample_config
+
+        def decode_chunk(params, cache, presence, token, pos, active, rem, eos_ids, temperature, penalty, rng):
+            self.trace_counts["decode_chunk"] += 1
+
+            def body(carry, _):
+                cache, presence, token, pos, active, rem, rng = carry
+                logits, cache = step_inner(params, cache, token, pos)
+                if use_pen:
+                    logits = _apply_repetition_penalty(logits, presence, penalty[:, None])
+                nxt, rng = _sample(logits, config, rng, temperature[:, None])
+                nxt = jnp.where(active, nxt, jnp.int32(0))
+                if use_pen:
+                    presence = presence.at[jnp.arange(S), nxt].max(active)
+                emitted = active  # every active slot streams exactly one token
+                new_rem = jnp.where(active, rem - 1, rem)
+                hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
+                new_active = active & ~hit_eos & (new_rem > 0)
+                new_pos = jnp.where(active, pos + 1, pos)
+                return (cache, presence, nxt, new_pos, new_active, new_rem, rng), (nxt, emitted)
+
+            carry = (cache, presence, token, pos, active, rem, rng)
+            carry, (toks, valids) = jax.lax.scan(body, carry, None, length=chunk)
+            cache, presence, token, pos, active, rem, rng = carry
+            # Pack the [chunk, S] stream TIME-major so each slot's tokens stay in
+            # order, valid entries first: composite sort key = invalid*N + time.
+            n = chunk * S
+            flat_tok = toks.reshape(n)
+            flat_valid = valids.reshape(n)
+            flat_slot = jnp.broadcast_to(jnp.arange(S)[None, :], (chunk, S)).reshape(n)
+            order = jnp.argsort(jnp.where(flat_valid, 0, n) + jnp.arange(n))
+            packed = jnp.stack(
+                [
+                    jnp.where(flat_valid[order], flat_slot[order], -1),
+                    jnp.where(flat_valid[order], flat_tok[order], -1),
+                ],
+                axis=-1,
+            ).astype(jnp.int32)
+            return cache, presence, token, pos, active, rem, rng, packed, flat_valid.sum()
+
+        donate = (1, 2) if use_pen else (1,)
+        return jax.jit(decode_chunk, donate_argnums=donate)
+
+    # ---------------------------------------------------------------- host plane
+
+    @property
+    def pending(self) -> bool:
+        """Anything queued or in flight."""
+        return bool(self._queue) or bool(self._active.any()) or any(
+            r is not None for r in self._slot_request
+        )
+
+    @property
+    def free_slots(self) -> int:
+        return sum(r is None for r in self._slot_request)
+
+    def submit(self, request: Request) -> int:
+        ids = np.asarray(request.input_ids, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if ids.size + request.max_new_tokens > self.max_length:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens ({request.max_new_tokens}) "
+                f"exceeds the {self.max_length}-token slot capacity"
+            )
+        if request.request_id in self.results:
+            raise ValueError(f"duplicate request_id {request.request_id}")
+        self.results[request.request_id] = RequestResult(
+            request.request_id, arrival_time=request.arrival_time
+        )
+        self._queue.append(dataclasses.replace(request, input_ids=ids))
+        return request.request_id
+
+    def _admit(self) -> List[Tuple[int, List[int]]]:
+        """Fill free slots from the queue (FIFO). Each admission is one insert
+        dispatch; the first token streams out immediately (TTFT)."""
+        events: List[Tuple[int, List[int]]] = []
+        while self._queue and self.free_slots:
+            req = self._queue.popleft()
+            slot = self._slot_request.index(None)
+            ids = req.input_ids
+            p = int(ids.size)
+            bucket = min(_bucket_for(p), self.max_length)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p] = ids
+            fn = self._insert_fn(bucket)
+            token, self._cache, self._presence, self._rng = fn(
+                self.params,
+                self._cache,
+                self._presence,
+                jnp.asarray(padded),
+                jnp.int32(p),
+                jnp.int32(slot),
+                jnp.float32(req.temperature),
+                jnp.float32(req.repetition_penalty),
+                self._rng,
+            )
+            token = int(token)
+            now = time.perf_counter()
+            self.stats["inserts"] += 1
+            result = self.results[req.request_id]
+            result.tokens.append(token)
+            result.first_token_time = now
+            events.append((req.request_id, [token]))
+
+            eos = -1 if req.eos_token_id is None else int(req.eos_token_id)
+            rem = req.max_new_tokens - 1
+            active = rem > 0 and token != eos
+            if active:
+                self._slot_request[slot] = result
+                self._token[slot] = token
+                self._pos[slot] = p  # the first generated token's write position
+                self._active[slot] = True
+                self._rem[slot] = rem
+                self._eos[slot] = eos
+                self._temp[slot] = req.temperature
+                self._pen[slot] = req.repetition_penalty
+            else:
+                result.finished = True
+                result.finish_time = now
+                result.finish_reason = "eos" if token == eos else "length"
+        return events
+
+    def release(self, request_id: int) -> RequestResult:
+        """Drop a FINISHED request's result and free its id for reuse. `results`
+        is never evicted on its own — a long-running server must release each
+        request once its consumer has drained it, or host memory grows linearly
+        in total requests served."""
+        result = self.results[request_id]
+        if not result.finished:
+            raise ValueError(f"request {request_id} is still in flight")
+        del self.results[request_id]
+        return result
+
+    def step(self) -> List[Tuple[int, List[int]]]:
+        """One serving cycle: admit → one decode-chunk dispatch → drain the
+        packed stream. Returns `(request_id, new_tokens)` events in stream order
+        (admissions' first tokens included)."""
+        events = self._admit()
+        if not self._active.any():
+            return events
+        out = self._chunk_fn(
+            self.params,
+            self._cache,
+            self._presence,
+            jnp.asarray(self._token),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._active),
+            jnp.asarray(self._rem),
+            jnp.asarray(self._eos),
+            jnp.asarray(self._temp),
+            jnp.asarray(self._pen),
+            self._rng,
+        )
+        self._cache, self._presence = out[0], out[1]
+        # np.array (copy): np.asarray of a jax buffer is a READ-ONLY view, and
+        # these mirrors are written in-place at the next admission.
+        token, pos, active, rem = (np.array(x) for x in out[2:6])
+        self._rng = out[6]
+        packed, count = np.asarray(out[7]), int(out[8])
+        self.stats["chunks"] += 1
+        self.stats["decode_steps"] += self.chunk_size
+
+        per_slot: Dict[int, List[int]] = {}
+        for slot, tok in packed[:count]:
+            per_slot.setdefault(int(slot), []).append(int(tok))
+        now = time.perf_counter()
+        for slot, toks in per_slot.items():
+            result = self._slot_request[slot]
+            if result is None:  # defensive: stream for a freed slot
+                continue
+            result.tokens.extend(toks)
+            events.append((result.request_id, toks))
+
+        was_active = self._active
+        self._token, self._pos, self._rem = token, pos, rem
+        self._active = active
+        for slot in np.nonzero(was_active & ~active)[0]:
+            result = self._slot_request[slot]
+            if result is not None:
+                result.finished = True
+                result.finish_time = now
+                result.finish_reason = (
+                    "eos" if result.tokens and result.tokens[-1] == self._eos[slot] else "length"
+                )
+                self._slot_request[slot] = None
+        return events
+
+    def run(self, requests: Optional[List[Request]] = None) -> Dict[int, np.ndarray]:
+        """Drive to completion: submit `requests` (if given), loop `step()` until
+        the queue and every slot drain, return {request_id: generated tokens}."""
+        for req in requests or ():
+            self.submit(req)
+        while self.pending:
+            self.step()
+        return {rid: np.asarray(r.tokens, np.int32) for rid, r in self.results.items()}

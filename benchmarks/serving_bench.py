@@ -1,0 +1,249 @@
+"""Serving benchmark: static batching vs continuous (slot-based) batching on a
+mixed-length synthetic workload.
+
+Workload: `--requests` prompts with uniform lengths in [--prompt-min,
+--prompt-max], budgets in [--max-new-min, --max-new-max], Poisson arrivals
+(exponential inter-arrival, mean --mean-interarrival seconds). Both paths serve
+the SAME workload greedily on the same model and are timed against a virtual
+clock that advances by measured compute, so arrival gating is identical and
+deterministic modulo host timing noise.
+
+  - **static**: requests are batched `num_slots` at a time in arrival order
+    (left-padded to the batch's prompt bucket) through the fused `Generator`
+    loop; a batch runs to its LONGEST budget before the next one starts — the
+    convoy effect this PR removes.
+  - **continuous**: the same requests stream through `serving.ContinuousBatcher`
+    (insert-into-free-slot + chunked decode), late arrivals joining mid-flight.
+
+Emits exactly ONE JSON line on stdout (the bench-driver contract): headline is
+continuous-batching tokens/sec, with static/continuous tokens/sec, TTFT p50/p99,
+and total decode-loop iterations for both paths in `extra`.
+
+CPU smoke sizes by default off-accelerator; `python bench.py --mode serving`
+routes here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(f"[serving-bench] {msg}", file=sys.stderr, flush=True)
+
+
+def build_workload(args, vocab_size, rng):
+    prompts = [
+        rng.integers(1, vocab_size, (int(rng.integers(args.prompt_min, args.prompt_max + 1)),)).astype(np.int32)
+        for _ in range(args.requests)
+    ]
+    budgets = [int(rng.integers(args.max_new_min, args.max_new_max + 1)) for _ in range(args.requests)]
+    arrivals = np.cumsum(rng.exponential(args.mean_interarrival, size=args.requests))
+    return prompts, budgets, arrivals
+
+
+def run_static(gen, prompts, budgets, arrivals, num_slots, max_length):
+    """Arrival-order batches of `num_slots` through the fused Generator; returns
+    (tokens_per_sec, ttfts, decode_iterations, makespan). `gen` is reused across
+    warmup and timed passes so the timed pass runs warm executables."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import GenerationConfig, _bucket_for
+
+    clock = 0.0
+    ttfts, decode_iterations = [], 0
+    n = len(prompts)
+    for start in range(0, n, num_slots):
+        idx = list(range(start, min(start + num_slots, n)))
+        batch_prompts = [prompts[i] for i in idx]
+        batch_new = max(budgets[i] for i in idx)
+        width = min(_bucket_for(max(p.size for p in batch_prompts)), max_length - batch_new)
+        ids = np.zeros((len(idx), width), np.int32)
+        mask = np.zeros((len(idx), width), np.int32)
+        for r, p in enumerate(batch_prompts):
+            ids[r, width - p.size:] = p  # LEFT padding (the Generator convention)
+            mask[r, width - p.size:] = 1
+        ids, mask = jnp.asarray(ids), jnp.asarray(mask)
+        # the whole batch must have arrived before its prefill can start
+        clock = max(clock, float(arrivals[idx[-1]]))
+        # TTFT component: a 1-token run isolates prefill+first-token latency
+        # (measured outside the clock; the real serving time is the full run)
+        t0 = time.perf_counter()
+        np.asarray(gen(ids, GenerationConfig(max_new_tokens=1), attention_mask=mask))
+        t_first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(gen(ids, GenerationConfig(max_new_tokens=batch_new), attention_mask=mask))
+        t_full = time.perf_counter() - t0
+        for i in idx:
+            ttfts.append(clock - float(arrivals[i]) + t_first)
+        clock += t_full
+        # greedy, no EOS: the fused while_loop runs exactly (batch_new - 1)
+        # body iterations (the first token comes from prefill)
+        decode_iterations += batch_new - 1
+    useful = sum(budgets)
+    makespan = clock - float(arrivals[0])
+    return useful / max(makespan, 1e-9), ttfts, decode_iterations, makespan
+
+
+def run_continuous(engine, prompts, budgets, arrivals):
+    """The same workload through the slot engine; arrival-gated submission on
+    the virtual clock. Returns (tokens_per_sec, ttfts, decode_iterations,
+    makespan). Finished requests are `release()`d at the end, so the engine is
+    reusable across warmup and timed passes with the same request ids."""
+    from accelerate_tpu.serving import Request
+
+    clock = 0.0
+    n = len(prompts)
+    submitted = 0
+    first_seen = {}
+    base_steps = engine.stats["decode_steps"]
+    while submitted < n or engine.pending:
+        while submitted < n and float(arrivals[submitted]) <= clock:
+            engine.submit(Request(submitted, prompts[submitted], max_new_tokens=budgets[submitted]))
+            submitted += 1
+        if not engine.pending:
+            clock = float(arrivals[submitted])  # idle until the next arrival
+            continue
+        t0 = time.perf_counter()
+        events = engine.step()
+        clock += time.perf_counter() - t0
+        for rid, _toks in events:
+            first_seen.setdefault(rid, clock)
+    ttfts = [first_seen[i] - float(arrivals[i]) for i in range(n)]
+    useful = sum(budgets)
+    makespan = clock - float(arrivals[0])
+    for i in range(n):
+        engine.release(i)
+    return (
+        useful / max(makespan, 1e-9),
+        ttfts,
+        engine.stats["decode_steps"] - base_steps,
+        makespan,
+    )
+
+
+def pct(values, q):
+    return float(np.percentile(np.asarray(values), q))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", default=None, help="named model (accelerate_tpu.models); default llama-1b on accelerators, llama-tiny on CPU")
+    parser.add_argument("--requests", type=int, default=None)
+    parser.add_argument("--num-slots", type=int, default=4)
+    parser.add_argument("--chunk-size", type=int, default=8)
+    parser.add_argument("--prompt-min", type=int, default=8)
+    parser.add_argument("--prompt-max", type=int, default=None, help="default 256 on accelerators, 96 on CPU")
+    parser.add_argument("--max-new-min", type=int, default=8)
+    parser.add_argument("--max-new-max", type=int, default=None, help="default 128 on accelerators, 32 on CPU")
+    parser.add_argument("--max-length", type=int, default=None)
+    parser.add_argument("--mean-interarrival", type=float, default=0.02, help="Poisson arrival mean gap (virtual seconds)")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from accelerate_tpu.models import create_named_model, get_model_family
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    on_accel = jax.devices()[0].platform in ("tpu", "gpu")
+    model_name = args.model or ("llama-1b" if on_accel else "llama-tiny")
+    if args.requests is None:
+        args.requests = 32 if on_accel else 12
+    if args.prompt_max is None:
+        args.prompt_max = 256 if on_accel else 96
+    if args.max_new_max is None:
+        args.max_new_max = 128 if on_accel else 32
+    if args.prompt_min > args.prompt_max:
+        parser.error(f"--prompt-min {args.prompt_min} > --prompt-max {args.prompt_max}")
+    if args.max_new_min > args.max_new_max:
+        parser.error(f"--max-new-min {args.max_new_min} > --max-new-max {args.max_new_max}")
+
+    _fam, cfg = get_model_family(model_name)
+    max_length = args.max_length or min(
+        cfg.max_position_embeddings, args.prompt_max + args.max_new_max
+    )
+    if args.prompt_max + args.max_new_max > max_length:
+        args.prompt_max = max_length - args.max_new_max
+        log(f"capping prompt_max to {args.prompt_max} for the {max_length}-token cache")
+        if args.prompt_max < args.prompt_min:
+            parser.error(
+                f"--max-length {max_length} leaves room for prompts up to "
+                f"{args.prompt_max} after --max-new-max {args.max_new_max}, "
+                f"below --prompt-min {args.prompt_min}"
+            )
+
+    log(f"model {model_name} | slots {args.num_slots} chunk {args.chunk_size} | "
+        f"{args.requests} reqs, prompts {args.prompt_min}-{args.prompt_max}, "
+        f"max_new {args.max_new_min}-{args.max_new_max}, cache {max_length}")
+    model = create_named_model(
+        model_name, seq_len=min(128, max_length), param_dtype="bfloat16" if on_accel else None
+    )
+    rng = np.random.default_rng(args.seed)
+    prompts, budgets, arrivals = build_workload(args, cfg.vocab_size, rng)
+
+    from accelerate_tpu.generation import Generator
+
+    engine = ContinuousBatcher(
+        model, num_slots=args.num_slots, max_length=max_length, chunk_size=args.chunk_size
+    )
+    static_gen = Generator(model, max_new_tokens=max(budgets), max_length=max_length)
+
+    # Warmup pass: compile every program both paths use (static per batch shape,
+    # continuous per insert bucket + the one chunk program), then measure.
+    log("warmup (compiles)...")
+    t0 = time.perf_counter()
+    run_static(static_gen, prompts, budgets, arrivals, args.num_slots, max_length)
+    run_continuous(engine, prompts, budgets, arrivals)
+    log(f"warmup done in {time.perf_counter() - t0:.1f}s; timed runs...")
+
+    s_tps, s_ttft, s_iters, s_span = run_static(
+        static_gen, prompts, budgets, arrivals, args.num_slots, max_length
+    )
+    c_tps, c_ttft, c_iters, c_span = run_continuous(engine, prompts, budgets, arrivals)
+    assert engine.trace_counts["decode_chunk"] == 1, engine.trace_counts
+
+    speedup = c_tps / max(s_tps, 1e-9)
+    prefix = "" if on_accel else "cpu-smoke "
+    result = {
+        "metric": f"{prefix}continuous-batching serving tokens/sec "
+        f"({model_name}, slots {args.num_slots}, chunk {args.chunk_size}, "
+        f"{args.requests} mixed reqs)",
+        "value": round(c_tps, 2),
+        "unit": "tokens/sec",
+        # baseline = the static path measured in THIS run: apples-to-apples on
+        # any backend (higher is better).
+        "vs_baseline": round(speedup, 3),
+        "extra": {
+            "device_kind": jax.devices()[0].device_kind,
+            "static_tokens_per_sec": round(s_tps, 2),
+            "continuous_tokens_per_sec": round(c_tps, 2),
+            "speedup": round(speedup, 3),
+            "ttft_p50_ms_static": round(pct(s_ttft, 50) * 1000, 2),
+            "ttft_p99_ms_static": round(pct(s_ttft, 99) * 1000, 2),
+            "ttft_p50_ms_continuous": round(pct(c_ttft, 50) * 1000, 2),
+            "ttft_p99_ms_continuous": round(pct(c_ttft, 99) * 1000, 2),
+            "decode_iterations_static": s_iters,
+            "decode_iterations_continuous": c_iters,
+            "makespan_s_static": round(s_span, 3),
+            "makespan_s_continuous": round(c_span, 3),
+            "requests": args.requests,
+            "num_slots": args.num_slots,
+            "chunk_size": args.chunk_size,
+            "prompt_range": [args.prompt_min, args.prompt_max],
+            "max_new_range": [args.max_new_min, args.max_new_max],
+            "mean_interarrival_s": args.mean_interarrival,
+            "seed": args.seed,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

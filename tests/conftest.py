@@ -27,23 +27,14 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 import pytest  # noqa: E402
 
 
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers", "slow_launch: spawns real subprocesses (multi-process rendezvous tests)"
-    )
-    config.addinivalue_line(
-        "markers",
-        "slow: heavy tests excluded from the fast tier (reference @slow split, "
-        "test_utils/testing.py:239-301 pattern); run `pytest -m 'not slow'` for "
-        "the <15-min default loop — every strategy still launches once via the "
-        "smoke gates — and the full suite before a round ends",
-    )
-
-
+# Marker REGISTRATION lives in pytest.ini (the single registry, honored even for
+# files collected without this conftest); this hook only wires the implications.
 def pytest_collection_modifyitems(config, items):
-    # slow_launch implies slow: `-m "not slow"` is THE fast-tier switch.
+    # slow_launch / serving_soak imply slow: `-m "not slow"` is THE fast-tier switch.
     for item in items:
-        if item.get_closest_marker("slow_launch") and not item.get_closest_marker("slow"):
+        if (
+            item.get_closest_marker("slow_launch") or item.get_closest_marker("serving_soak")
+        ) and not item.get_closest_marker("slow"):
             item.add_marker(pytest.mark.slow)
 
 

@@ -215,6 +215,38 @@ class TestDataLoaderShard:
         assert len(rest) == 1
         np.testing.assert_array_equal(rest[0], all_batches[2])
 
+    def test_prefetch_size_zero_is_synchronous(self):
+        """prefetch_size=0 now means NO producer thread (it used to be silently
+        clamped to 1): batches are processed inline on the consumer thread, and
+        the one-batch lookahead contract (end_of_dataloader before the final
+        yield) still holds."""
+        import threading
+
+        AcceleratorState()
+        data = _toy_dataset(24)
+        loader = SimpleDataLoader(data, BatchSampler(range(24), 8))
+        dl = prepare_data_loader(loader, prefetch_size=0)
+        assert dl.prefetch_size == 0
+        gs = GradientState()
+        consumer = threading.get_ident()
+        seen_threads = set()
+        orig = dl._process_batch
+
+        def spying(batch):
+            seen_threads.add(threading.get_ident())
+            return orig(batch)
+
+        dl._process_batch = spying
+        ends = [gs.end_of_dataloader for _ in dl]
+        assert ends == [False, False, True]
+        assert seen_threads == {consumer}  # no producer thread ran
+        # and the stream is identical to the threaded path
+        dl_threaded = prepare_data_loader(
+            SimpleDataLoader(data, BatchSampler(range(24), 8)), prefetch_size=2
+        )
+        for a, b in zip(dl, dl_threaded):
+            np.testing.assert_array_equal(np.asarray(a["x"]), np.asarray(b["x"]))
+
     def test_set_epoch_reshuffles(self):
         data = _toy_dataset(16)
         sampler = SeedableRandomSampler(num_samples=16, seed=7)

@@ -288,3 +288,153 @@ def test_repetition_penalty_with_left_padded_batch():
     out = np.asarray(gen(batch, cfg, attention_mask=mask))
     np.testing.assert_array_equal(out[0, 7:], np.asarray(gen(short, cfg))[0, 4:])
     np.testing.assert_array_equal(out[1, 7:], np.asarray(gen(long, cfg))[0, 7:])
+
+
+# ---------------------------------------------------------------------------
+# Sampler semantics pinned on fixed logits (serving.ContinuousBatcher reuses
+# _sample verbatim, so these hand-computed expectations are the serving
+# sampler's contract too): top_k -> top_p -> categorical, penalty upstream.
+# ---------------------------------------------------------------------------
+
+
+def _sample_support(logits_row, config, draws=256):
+    """The set of token ids `_sample` can emit for one fixed logits row:
+    categorical draws are independent per batch row, so one tiled call gives
+    `draws` independent samples."""
+    from accelerate_tpu.generation import _sample
+
+    tiled = jnp.tile(jnp.asarray(logits_row, jnp.float32)[None, :], (draws, 1))
+    toks, _ = _sample(tiled, config, jax.random.key(0))
+    return set(np.asarray(toks).tolist())
+
+
+def test_sampler_greedy_ignores_filters():
+    from accelerate_tpu.generation import _sample
+
+    logits = jnp.asarray([[0.1, 2.0, -1.0, 0.5]])
+    cfg = GenerationConfig(do_sample=False, top_k=1, top_p=0.01, temperature=9.0)
+    tok, _ = _sample(logits, cfg, jax.random.key(0))
+    assert int(tok[0]) == 1
+
+
+def test_sampler_top_k_support_is_k_largest():
+    # distinct ascending logits: top_k=3 keeps exactly ids {3, 4, 5}
+    logits = np.log([0.02, 0.03, 0.05, 0.1, 0.3, 0.5])
+    cfg = GenerationConfig(do_sample=True, top_k=3)
+    assert _sample_support(logits, cfg) == {3, 4, 5}
+
+
+def test_sampler_top_p_uses_exclusive_cumulative_mass():
+    # probs [0.5, 0.3, 0.15, 0.05]: a token survives iff the mass STRICTLY
+    # before it (descending order) is < top_p — so top_p=0.5 keeps only id 0
+    # (id 1's exclusive mass is exactly 0.5), top_p=0.81 keeps {0, 1, 2}.
+    logits = np.log([0.5, 0.3, 0.15, 0.05])
+    assert _sample_support(logits, GenerationConfig(do_sample=True, top_p=0.5)) == {0}
+    assert _sample_support(logits, GenerationConfig(do_sample=True, top_p=0.51)) == {0, 1}
+    assert _sample_support(logits, GenerationConfig(do_sample=True, top_p=0.81)) == {0, 1, 2}
+
+
+def test_sampler_top_p_nonpositive_keeps_top_token():
+    # min_tokens_to_keep=1 (HF semantics): top_p <= 0 would otherwise mask the
+    # whole vocabulary and sample uniform gibberish from all -1e30 logits.
+    logits = np.log([0.25, 0.4, 0.2, 0.15])
+    assert _sample_support(logits, GenerationConfig(do_sample=True, top_p=0.0)) == {1}
+    assert _sample_support(logits, GenerationConfig(do_sample=True, top_p=-1.0)) == {1}
+
+
+def test_sampler_top_k_applies_before_top_p():
+    # probs [0.4, 0.3, 0.2, 0.1], top_k=3, top_p=0.75.
+    #   k first (our order): survivors {0,1,2} renormalize to [4/9, 3/9, 2/9];
+    #     exclusive cums [0, 0.444, 0.777] -> 0.777 >= 0.75 kills id 2 -> {0, 1}.
+    #   p first (the wrong order) would keep {0,1,2} (raw exclusive cums
+    #     [0, 0.4, 0.7] all < 0.75) and top_k=3 would not shrink it.
+    logits = np.log([0.4, 0.3, 0.2, 0.1])
+    cfg = GenerationConfig(do_sample=True, top_k=3, top_p=0.75)
+    assert _sample_support(logits, cfg) == {0, 1}
+
+
+def test_sampler_temperature_preserves_support_and_argmax():
+    logits = np.log([0.02, 0.03, 0.05, 0.1, 0.3, 0.5])
+    hot = GenerationConfig(do_sample=True, top_k=2, temperature=5.0)
+    cold = GenerationConfig(do_sample=True, top_k=2, temperature=0.05)
+    assert _sample_support(logits, hot) == {4, 5}
+    # near-zero temperature concentrates ALL mass on the argmax
+    assert _sample_support(logits, cold) == {5}
+
+
+def test_repetition_penalty_divides_positive_multiplies_negative():
+    from accelerate_tpu.generation import _apply_repetition_penalty
+
+    logits = jnp.asarray([[2.0, -2.0, 1.0, -1.0]])
+    seen = jnp.asarray([[True, True, False, False]])
+    out = np.asarray(_apply_repetition_penalty(logits, seen, 2.0))
+    np.testing.assert_allclose(out, [[1.0, -4.0, 1.0, -1.0]])
+
+
+def test_repetition_penalty_applies_before_filtering():
+    """Fused-loop pick order: penalty -> temperature/top_k -> draw. A penalized
+    argmax must lose to the runner-up even under top_k=1 (if filtering ran
+    first, the penalized token would be the only candidate left)."""
+    from accelerate_tpu.generation import _apply_repetition_penalty, _sample
+
+    logits = jnp.asarray([[3.0, 2.5, 0.1]])
+    seen = jnp.asarray([[True, False, False]])
+    cfg = GenerationConfig(do_sample=True, top_k=1)
+    penalized = _apply_repetition_penalty(logits, seen, 2.0)  # token 0: 3.0 -> 1.5
+    tok, _ = _sample(penalized, cfg, jax.random.key(0))
+    assert int(tok[0]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Module-level generate() executable cache
+# ---------------------------------------------------------------------------
+
+
+def test_generate_convenience_caches_warm_executables(monkeypatch):
+    """Repeated convenience `generate()` calls must NOT rebuild (and recompile)
+    a Generator: same model + same max_new_tokens bucket hits the warm cache."""
+    from accelerate_tpu import generation
+
+    generation._GENERATOR_CACHE.clear()
+    model = _model()
+    builds = []
+    orig_init = generation.Generator.__init__
+
+    def counting_init(self, *args, **kwargs):
+        builds.append(1)
+        return orig_init(self, *args, **kwargs)
+
+    monkeypatch.setattr(generation.Generator, "__init__", counting_init)
+    prompt = np.random.default_rng(20).integers(1, 128, (1, 6)).astype(np.int32)
+    a = np.asarray(generate(model, prompt, max_new_tokens=5))
+    b = np.asarray(generate(model, prompt, max_new_tokens=5))
+    assert len(builds) == 1, "second call rebuilt the Generator"
+    np.testing.assert_array_equal(a, b)
+    # ANY budget stays warm: the Generator's cache capacity doesn't depend on
+    # max_new_tokens (the fused loop buckets per call)
+    generate(model, prompt, max_new_tokens=20)
+    assert len(builds) == 1
+    # the cached generator's prefill traced exactly once across all three calls
+    (_, cached_gen), = generation._GENERATOR_CACHE.values()
+    assert cached_gen._prefill._cache_size() == 1
+    # a DIFFERENT model identity must not share programs
+    model2 = _model()
+    generate(model2, prompt, max_new_tokens=5)
+    assert len(builds) == 2
+    # a DEAD model must not pin its Generator (params + executables): the
+    # weakref finalizer evicts its entry at collection
+    import gc
+
+    del model2
+    gc.collect()
+    assert len(generation._GENERATOR_CACHE) == 1
+    # rebinding model.params (the train-then-sample pattern) must REBUILD —
+    # a cached Generator holding the old pytree would decode with stale weights
+    model.params = jax.tree_util.tree_map(lambda x: x + 0.5, model.params)
+    stale_free = np.asarray(generate(model, prompt, max_new_tokens=5))
+    assert len(builds) == 3
+    fresh = generation.Generator(model, max_new_tokens=5)(
+        jnp.asarray(prompt), GenerationConfig(max_new_tokens=5)
+    )
+    np.testing.assert_array_equal(stale_free, np.asarray(fresh))
+    generation._GENERATOR_CACHE.clear()
