@@ -37,16 +37,17 @@ import functools
 import math
 import os
 import re
-import shutil
 from typing import Any, Callable, List, Optional, Union
 
 import numpy as np
 
 from .checkpointing import (
+    CheckpointManager,
     load_accelerator_state,
     load_custom_state,
     save_accelerator_state,
     save_custom_state,
+    write_checkpoint_manifest,
 )
 from .data_loader import DataLoaderDispatcher, DataLoaderShard, SimpleDataLoader, prepare_data_loader, skip_first_batches
 from .logging import get_logger
@@ -738,7 +739,15 @@ class Accelerator:
     def register_preemption_checkpoint(self, output_dir: Optional[str] = None, exit_on_save: bool = True):
         """Install a SIGTERM latch (TPU-VM preemption); `check_preemption()` then
         saves full state at the next step boundary (SURVEY §5: the elastic/preemption
-        machinery the reference delegates to torchrun)."""
+        machinery the reference delegates to torchrun).
+
+        `output_dir` is a `CheckpointManager` BASE directory: each preemption save
+        commits an atomically-published `checkpoint_N` inside it, so a hard kill
+        racing the save can never leave a torn checkpoint, and resume via
+        `load_state(output_dir)` (or `"latest"` under automatic naming) lands on
+        the newest checkpoint that digest-verifies. Off the main thread the latch
+        degrades to a warn + no-op (the `signal` module's restriction) instead of
+        crashing the caller."""
         from .fault_tolerance import PreemptionHandler
 
         self._preemption_handler = PreemptionHandler()
@@ -759,7 +768,20 @@ class Accelerator:
             return False
         from .fault_tolerance import PREEMPTED_EXIT_CODE
 
-        path = self.save_state(getattr(self, "_preemption_dir", None))
+        preemption_dir = getattr(self, "_preemption_dir", None)
+        if preemption_dir is not None and not self.project_configuration.automatic_checkpoint_naming:
+            # The registered dir is a manager base: numbered, rotated, atomically
+            # committed — the supervisor can SIGKILL us mid-save and the previous
+            # checkpoint stays loadable.
+            manager = CheckpointManager(preemption_dir, keep_last_n=2)
+            path = manager.save(
+                manager.next_step(),
+                self._write_state_artifacts,
+                is_main=self.is_main_process,
+                barrier=self.wait_for_everyone,
+            )
+        else:
+            path = self.save_state(preemption_dir)
         self.print(f"preemption checkpoint saved to {path}")
         if getattr(self, "_preemption_exit", True):
             raise SystemExit(PREEMPTED_EXIT_CODE)
@@ -893,41 +915,18 @@ class Accelerator:
     def register_load_state_pre_hook(self, hook: Callable):
         self._load_model_hooks.append(hook)
 
-    def save_state(self, output_dir: Optional[str] = None, **save_model_kwargs) -> str:
-        """Save everything prepared + registered (reference accelerator.py:2830).
+    def checkpoint_manager(self, base_dir: Optional[str] = None) -> CheckpointManager:
+        """The crash-safe checkpoint store for this run: rooted at the project's
+        `checkpoints/` dir (or an explicit base), rotating to `total_limit`."""
+        if base_dir is None:
+            if self.project_dir is None:
+                raise ValueError("checkpoint_manager needs a project_dir or an explicit base_dir")
+            base_dir = os.path.join(self.project_dir, "checkpoints")
+        return CheckpointManager(base_dir, keep_last_n=self.project_configuration.total_limit)
 
-        With `automatic_checkpoint_naming`, writes to
-        `{project_dir}/checkpoints/checkpoint_{iteration}` and rotates to
-        `total_limit` (reference accelerator.py:2868-2894)."""
-        if self.project_configuration.automatic_checkpoint_naming:
-            output_dir = os.path.join(self.project_dir, "checkpoints")
-            folders = []
-            if os.path.isdir(output_dir):
-                folders = [os.path.join(output_dir, f) for f in os.listdir(output_dir)]
-            if (
-                self.project_configuration.total_limit is not None
-                and len(folders) + 1 > self.project_configuration.total_limit
-                and self.is_main_process
-            ):
-                def _num(f):
-                    m = re.findall(r"[\/]?([0-9]+)(?=[^\/]*$)", f)
-                    return int(m[0]) if m else -1
-
-                folders.sort(key=_num)
-                for folder in folders[: len(folders) + 1 - self.project_configuration.total_limit]:
-                    shutil.rmtree(folder, ignore_errors=True)
-            output_dir = os.path.join(output_dir, f"checkpoint_{self.save_iteration}")
-            if os.path.exists(output_dir):
-                raise ValueError(
-                    f"Checkpoint directory {output_dir} already exists; use a ProjectConfiguration "
-                    "with a different iteration or disable automatic_checkpoint_naming."
-                )
-        elif output_dir is None:
-            raise ValueError("output_dir is required when automatic_checkpoint_naming is off")
-        self.wait_for_everyone()
-        os.makedirs(output_dir, exist_ok=True)
-        logger.info("Saving current state to %s", output_dir)
-
+    def _write_state_artifacts(self, output_dir: str, save_model_kwargs: Optional[dict] = None):
+        """Write every state artifact into `output_dir` (all processes). The
+        caller owns directory-level atomicity/commit."""
         for hook in self._save_model_hooks:
             hook(self._models, None, output_dir)
 
@@ -946,20 +945,66 @@ class Accelerator:
         for i, obj in enumerate(self._custom_objects):
             if self.is_main_process:
                 save_custom_state(obj, output_dir, i)
+
+    def save_state(self, output_dir: Optional[str] = None, **save_model_kwargs) -> str:
+        """Save everything prepared + registered (reference accelerator.py:2830).
+
+        With `automatic_checkpoint_naming`, commits
+        `{project_dir}/checkpoints/checkpoint_{iteration}` through
+        `CheckpointManager`: artifacts stage in a hidden temp dir, a per-file
+        SHA-256 manifest is written, the directory is renamed into place
+        atomically, the `latest` pointer advances, and rotation keeps
+        `total_limit`. A kill at ANY byte offset leaves only committed
+        checkpoints visible. An explicit `output_dir` writes in place (each
+        artifact individually atomic) and finishes with the digest manifest so
+        `load_state` can verify it."""
+        if self.project_configuration.automatic_checkpoint_naming:
+            manager = self.checkpoint_manager()
+            logger.info(
+                "Saving current state to %s (checkpoint_%d)", manager.base_dir, self.save_iteration
+            )
+            output_dir = manager.save(
+                self.save_iteration,
+                lambda staging: self._write_state_artifacts(staging, save_model_kwargs),
+                is_main=self.is_main_process,
+                barrier=self.wait_for_everyone,
+            )
+            self.project_configuration.iteration += 1
+            return output_dir
+        if output_dir is None:
+            raise ValueError("output_dir is required when automatic_checkpoint_naming is off")
+        self.wait_for_everyone()
+        os.makedirs(output_dir, exist_ok=True)
+        logger.info("Saving current state to %s", output_dir)
+        self._write_state_artifacts(output_dir, save_model_kwargs)
+        self.wait_for_everyone()  # every process's artifacts land before the digest scan
+        if self.is_main_process:
+            write_checkpoint_manifest(output_dir)
         self.project_configuration.iteration += 1
         return output_dir
 
     def load_state(self, input_dir: Optional[str] = None, **load_model_kwargs):
-        """(reference accelerator.py:2995)"""
-        if input_dir is None and self.project_configuration.automatic_checkpoint_naming:
-            base = os.path.join(self.project_dir, "checkpoints")
-            folders = sorted(
-                (os.path.join(base, f) for f in os.listdir(base)),
-                key=lambda f: int(re.findall(r"(\d+)(?=[^\/]*$)", f)[0]) if re.findall(r"(\d+)(?=[^\/]*$)", f) else -1,
-            )
-            input_dir = folders[-1]
-        elif input_dir is None:
-            raise ValueError("input_dir is required when automatic_checkpoint_naming is off")
+        """(reference accelerator.py:2995)
+
+        `input_dir` may be: a concrete checkpoint directory (digest-verified when
+        it carries a manifest), a `CheckpointManager` base directory or the
+        literal `"latest"` / `None` (with `automatic_checkpoint_naming`) — both
+        resolve to the newest checkpoint that VERIFIES, falling back past a
+        corrupted newest one to the last good save."""
+        if input_dir == "latest":
+            input_dir = None
+        if input_dir is None:
+            if not self.project_configuration.automatic_checkpoint_naming and self.project_dir is None:
+                raise ValueError("input_dir is required when automatic_checkpoint_naming is off")
+            input_dir = self.checkpoint_manager().resolve()
+        else:
+            input_dir = str(input_dir)
+            if CheckpointManager.is_manager_dir(input_dir):
+                # A manager base (e.g. a preemption checkpoint root): newest
+                # verified checkpoint inside it.
+                input_dir = CheckpointManager(input_dir).resolve()
+            else:
+                input_dir = self.checkpoint_manager(os.path.dirname(input_dir) or ".").resolve(input_dir)
         if self.project_configuration.automatic_checkpoint_naming:
             # Resume numbering after the restored checkpoint so the next save_state
             # doesn't collide with an existing directory.

@@ -12,18 +12,34 @@ Storage format — TPU-native two-tier:
     tensorstore path (`save_sharded`/`load_sharded`) writes per-shard — the
     torch.distributed.checkpoint replacement (reference utils/fsdp_utils.py:85-147).
 
+Crash safety — every artifact commits via temp-file + fsync + `os.replace`, so a
+SIGKILL at any byte offset leaves either the previous complete file or nothing,
+never a torn one. Pytree manifests carry a SHA-256 digest of their `.npz` payload
+(verified on load); `CheckpointManager` extends the same discipline to whole
+checkpoint *directories*: artifacts land in a hidden staging dir, a checkpoint-level
+`MANIFEST.json` with per-file digests is the commit record, the staging dir is
+renamed into place atomically, a `latest` pointer is swapped, and keep-last-N
+rotation plus retry-with-backoff on transient I/O errors keep long runs bounded.
+Resolution (`resolve("latest")`) walks newest→oldest and skips any checkpoint whose
+digests don't verify — resume survives a kill mid-save by falling back to the last
+verified checkpoint.
+
 Checkpoint rotation (`ProjectConfiguration.total_limit`) is handled by the Accelerator
-(reference accelerator.py:2868-2894).
+through `CheckpointManager` (reference accelerator.py:2868-2894).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import pickle
 import random
+import shutil
+import tempfile
+import time
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -41,6 +57,71 @@ from .utils.imports import is_orbax_available
 logger = get_logger(__name__)
 
 _BF16_MARKER = "bfloat16"
+
+# Checkpoint-directory commit record written by `CheckpointManager` / `write_checkpoint_manifest`.
+CHECKPOINT_MANIFEST_NAME = "MANIFEST.json"
+LATEST_POINTER_NAME = "latest"
+_STAGING_PREFIX = ".tmp-"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """An artifact failed digest verification (torn write, bit rot, truncation)."""
+
+
+def _fsync_directory(path: str):
+    """fsync a directory so a just-committed rename survives power loss. Best
+    effort: some filesystems/platforms refuse O_RDONLY dir fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, writer: Callable, mode: str = "wb"):
+    """Commit a file via temp-in-same-dir + flush + fsync + `os.replace`.
+
+    `writer(fileobj)` produces the content. A kill at any byte offset leaves the
+    destination either absent or its previous complete version — readers never
+    observe a torn file. The temp name is randomized (mkstemp) so concurrent
+    writers in one directory can't collide."""
+    path = str(path)
+    directory = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=os.path.basename(path) + ".tmp-")
+    try:
+        with os.fdopen(fd, mode) as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_directory(directory)
+
+
+def atomic_write_bytes(path: str, data: bytes):
+    atomic_write(path, lambda f: f.write(data))
+
+
+def atomic_write_json(path: str, obj):
+    atomic_write(path, lambda f: json.dump(obj, f), mode="w")
+
+
+def file_sha256(path: str, chunk_size: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(chunk_size), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 def _flatten_with_paths(tree):
@@ -69,9 +150,13 @@ def save_pytree(tree, path: str):
     manifest["treedef"] = pickle.dumps(treedef).hex()
     path = str(path)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    np.savez_compressed(path if path.endswith(".npz") else path + ".npz", **arrays)
-    with open(_manifest_path(path), "w") as f:
-        json.dump(manifest, f)
+    npz_path = path if path.endswith(".npz") else path + ".npz"
+    # Commit order matters: payload first, then the manifest carrying its digest
+    # — the manifest is the record a loader trusts, so it must never describe a
+    # payload that isn't fully on disk.
+    atomic_write(npz_path, lambda f: np.savez_compressed(f, **arrays))
+    manifest["npz_sha256"] = file_sha256(npz_path)
+    atomic_write_json(_manifest_path(path), manifest)
 
 
 def _has_bf16(arr) -> bool:
@@ -83,8 +168,13 @@ def _manifest_path(path: str) -> str:
     return base + ".manifest.json"
 
 
-def load_pytree(path: str):
-    """Inverse of `save_pytree`; returns numpy leaves (placed by the caller)."""
+def load_pytree(path: str, verify: bool = True):
+    """Inverse of `save_pytree`; returns numpy leaves (placed by the caller).
+
+    With `verify` (default) the payload's SHA-256 is checked against the digest
+    the manifest recorded at save time; a mismatch (truncated npz, bit rot)
+    raises `CheckpointCorruptError` instead of half-reading a torn file.
+    Manifests from before the digest field load unverified."""
     import jax
     import jax.numpy as jnp
 
@@ -92,6 +182,14 @@ def load_pytree(path: str):
     npz_path = path if path.endswith(".npz") else path + ".npz"
     with open(_manifest_path(path)) as f:
         manifest = json.load(f)
+    expected = manifest.get("npz_sha256")
+    if verify and expected is not None:
+        actual = file_sha256(npz_path)
+        if actual != expected:
+            raise CheckpointCorruptError(
+                f"{npz_path}: SHA-256 mismatch (manifest {expected[:12]}…, file {actual[:12]}…) "
+                "— torn or corrupted checkpoint artifact"
+            )
     treedef = pickle.loads(bytes.fromhex(manifest["treedef"]))
     data = np.load(npz_path)
     leaves = []
@@ -181,6 +279,24 @@ def save_model_safetensors(params, save_directory: str, max_shard_size="5GB") ->
 
     from .utils.constants import SAFE_WEIGHTS_INDEX_NAME, SAFE_WEIGHTS_NAME
 
+    def _atomic_save_file(tensors, target):
+        # safetensors wants a filename, not a fileobj: write a sibling temp file,
+        # fsync it, and commit with os.replace (same torn-write guarantee as
+        # `atomic_write`).
+        tmp = f"{target}.tmp-{os.getpid()}"
+        try:
+            save_file(tensors, tmp)
+            with open(tmp, "rb+") as f:
+                os.fsync(f.fileno())
+            os.replace(tmp, target)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        _fsync_directory(os.path.dirname(target) or ".")
+
     is_main = jax.process_index() == 0
     os.makedirs(save_directory, exist_ok=True)
     flat, _ = _flatten_with_paths(params)
@@ -205,7 +321,7 @@ def save_model_safetensors(params, save_directory: str, max_shard_size="5GB") ->
         tensors = {p: _leaf_to_host(leaf) for p, leaf in shards[0]}
         target = os.path.join(save_directory, SAFE_WEIGHTS_NAME)
         if is_main:
-            save_file(tensors, target)
+            _atomic_save_file(tensors, target)
             written.append(target)
         return written
 
@@ -214,7 +330,7 @@ def save_model_safetensors(params, save_directory: str, max_shard_size="5GB") ->
         fname = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
         tensors = {p: _leaf_to_host(leaf) for p, leaf in shard}
         if is_main:
-            save_file(tensors, os.path.join(save_directory, fname))
+            _atomic_save_file(tensors, os.path.join(save_directory, fname))
             written.append(os.path.join(save_directory, fname))
         for p, _ in shard:
             weight_map[p] = fname
@@ -225,8 +341,9 @@ def save_model_safetensors(params, save_directory: str, max_shard_size="5GB") ->
             "weight_map": weight_map,
         }
         index_path = os.path.join(save_directory, SAFE_WEIGHTS_INDEX_NAME)
-        with open(index_path, "w") as f:
-            json.dump(index, f, indent=2)
+        # Index last: it references the shards, so it must never exist before
+        # every shard it names is fully committed.
+        atomic_write(index_path, lambda f: json.dump(index, f, indent=2), mode="w")
         written.append(index_path)
     return written
 
@@ -295,14 +412,13 @@ def save_accelerator_state(
         name = f"{OPTIMIZER_NAME}.npz" if i == 0 else f"{OPTIMIZER_NAME}_{i}.npz"
         _save_tree(opt.state_dict()["opt_state"], name)
         if opt.scaler is not None and (state.is_main_process or save_on_each_node):
-            with open(output_dir / f"{SCALER_NAME}_{i}.json", "w") as f:
-                json.dump(opt.scaler.state_dict(), f)
+            atomic_write_json(output_dir / f"{SCALER_NAME}_{i}.json", opt.scaler.state_dict())
 
     if state.is_main_process or save_on_each_node:
         for i, sched in enumerate(schedulers):
             name = f"{SCHEDULER_NAME}.bin" if i == 0 else f"{SCHEDULER_NAME}_{i}.bin"
-            with open(output_dir / name, "wb") as f:
-                pickle.dump(sched.state_dict(), f)
+            sched_state = sched.state_dict()
+            atomic_write(output_dir / name, lambda f, s=sched_state: pickle.dump(s, f))
 
         for i, dl in enumerate(dataloaders):
             sampler = _find_seedable_sampler(dl)
@@ -320,8 +436,7 @@ def save_accelerator_state(
                 payload = {"format": 2, "sampler": sampler.state_dict()}
                 if hasattr(dl, "iteration"):
                     payload["loader_iteration"] = dl.iteration
-                with open(output_dir / name, "wb") as f:
-                    pickle.dump(payload, f)
+                atomic_write(output_dir / name, lambda f, p=payload: pickle.dump(p, f))
 
     # RNG states are per-process (reference saves `random_states_{i}.pkl`,
     # checkpointing.py:122-151).
@@ -330,8 +445,10 @@ def save_accelerator_state(
         import jax
 
         rng_states["jax"] = np.asarray(jax.random.key_data(rng_key))
-    with open(output_dir / f"{RNG_STATE_NAME}_{state.process_index}.pkl", "wb") as f:
-        pickle.dump(rng_states, f)
+    atomic_write(
+        output_dir / f"{RNG_STATE_NAME}_{state.process_index}.pkl",
+        lambda f: pickle.dump(rng_states, f),
+    )
     return str(output_dir)
 
 
@@ -450,8 +567,293 @@ def save_custom_state(obj, path: str, index: int = 0):
     """Pickle an object exposing state_dict() (reference checkpointing.py:257)."""
     location = Path(path) / f"custom_checkpoint_{index}.pkl"
     logger.info("Saving the state of %s to %s", type(obj).__name__, location)
-    with open(location, "wb") as f:
-        pickle.dump(obj.state_dict(), f)
+    obj_state = obj.state_dict()
+    atomic_write(location, lambda f: pickle.dump(obj_state, f))
+
+
+# ------------------------------------------------------------------ crash-safe manager
+def write_checkpoint_manifest(directory: str, step: Optional[int] = None) -> str:
+    """Commit record for a checkpoint DIRECTORY: scan every artifact, digest it,
+    and atomically write `MANIFEST.json`. Written LAST — its presence asserts
+    every file it names was fully on disk first."""
+    directory = str(directory)
+    entries = []
+    for root, dirs, names in os.walk(directory):
+        dirs[:] = [d for d in dirs if not d.startswith(_STAGING_PREFIX)]
+        for name in names:
+            # Skip the commit record itself, the latest pointer, and atomic-write
+            # temp litter a killed previous writer may have left behind.
+            if name in (CHECKPOINT_MANIFEST_NAME, LATEST_POINTER_NAME) or ".tmp-" in name:
+                continue
+            entries.append((os.path.relpath(os.path.join(root, name), directory), name))
+    # Reuse the digests `save_pytree` already computed: each `X.manifest.json`
+    # records the SHA-256 of its just-written sibling `X.npz`. The npz payloads
+    # are the bulk of a checkpoint, so this turns the digest scan's second full
+    # disk read of the model/optimizer state into a JSON lookup — save latency
+    # matters most on the preemption path, where it races the hard kill.
+    known = {}
+    for rel, name in entries:
+        if not name.endswith(".manifest.json"):
+            continue
+        try:
+            with open(os.path.join(directory, rel)) as f:
+                digest = json.load(f).get("npz_sha256")
+        except (OSError, json.JSONDecodeError):
+            continue
+        if digest:
+            known[rel[: -len(".manifest.json")] + ".npz"] = digest
+    files = {
+        rel: known.get(rel) or file_sha256(os.path.join(directory, rel)) for rel, _ in entries
+    }
+    manifest_path = os.path.join(directory, CHECKPOINT_MANIFEST_NAME)
+    atomic_write_json(manifest_path, {"format": 1, "step": step, "files": files})
+    return manifest_path
+
+
+def verify_checkpoint_dir(directory: str) -> bool:
+    """True iff the directory carries a `MANIFEST.json` and every file it names
+    exists with a matching SHA-256. A directory without a manifest (killed before
+    commit, or a pre-digest legacy checkpoint) does NOT verify."""
+    manifest_path = os.path.join(str(directory), CHECKPOINT_MANIFEST_NAME)
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    for rel, digest in manifest.get("files", {}).items():
+        full = os.path.join(str(directory), rel)
+        try:
+            if file_sha256(full) != digest:
+                logger.warning("checkpoint %s: digest mismatch on %s", directory, rel)
+                return False
+        except OSError:
+            logger.warning("checkpoint %s: missing artifact %s", directory, rel)
+            return False
+    return True
+
+
+class CheckpointManager:
+    """Rotated, digest-verified, atomically-published checkpoints under one base dir.
+
+    Layout::
+
+        base_dir/
+          checkpoint_0/          # complete, committed (has MANIFEST.json)
+          checkpoint_1/
+          latest                 # text file naming the newest committed checkpoint
+          .tmp-checkpoint_2/     # in-flight staging (ignored by readers, reaped)
+
+    `save(step, write_fn)` stages everything in a hidden temp directory, writes the
+    per-file digest manifest, `os.replace`s the directory into place (the single
+    commit point — a kill before it leaves only ignorable staging litter), swaps
+    the `latest` pointer, and rotates to `keep_last_n`. Transient I/O errors in the
+    commit sequence retry with exponential backoff. `resolve("latest")` returns the
+    newest checkpoint that VERIFIES, falling back past a corrupt or torn newest one.
+
+    The `latest` pointer file is a breadcrumb for humans and external tooling
+    (and the `is_manager_dir` sniff), NOT the source of truth for resume:
+    `resolve()` always re-verifies from the directory listing, so a pointer left
+    stale by a kill between publish and pointer swap — or pointing at a
+    checkpoint that later rotted — can never misdirect a resume.
+
+    Multi-process: pass `is_main`/`barrier` so every process writes its per-process
+    artifacts into the shared staging dir while exactly one commits.
+    """
+
+    def __init__(
+        self,
+        base_dir: str,
+        keep_last_n: Optional[int] = None,
+        retries: int = 3,
+        backoff_seconds: float = 0.1,
+    ):
+        if keep_last_n is not None and keep_last_n < 1:
+            raise ValueError(f"keep_last_n must be >= 1 (got {keep_last_n})")
+        self.base_dir = str(base_dir)
+        self.keep_last_n = keep_last_n
+        self.retries = retries
+        self.backoff_seconds = backoff_seconds
+
+    # ---------------------------------------------------------------- inventory
+    def checkpoints(self) -> List[Tuple[int, str]]:
+        """(step, path) pairs sorted numerically ascending (lexicographic listdir
+        would order checkpoint_10 before checkpoint_9)."""
+        if not os.path.isdir(self.base_dir):
+            return []
+        out = []
+        for name in os.listdir(self.base_dir):
+            if name.startswith(_STAGING_PREFIX) or not name.startswith("checkpoint_"):
+                continue
+            suffix = name[len("checkpoint_"):]
+            if suffix.isdigit() and os.path.isdir(os.path.join(self.base_dir, name)):
+                out.append((int(suffix), os.path.join(self.base_dir, name)))
+        return sorted(out)
+
+    def next_step(self) -> int:
+        ckpts = self.checkpoints()
+        return ckpts[-1][0] + 1 if ckpts else 0
+
+    def latest_verified(self) -> Optional[str]:
+        """Newest checkpoint whose digests verify; corrupt/torn ones are skipped
+        with a warning (the resume-past-a-bad-newest fallback).
+
+        Legacy checkpoints (written before the manifest discipline, so they have
+        no `MANIFEST.json` to verify against) are not abandoned: when NOTHING
+        digest-verifies, the newest manifest-less one is returned as a last
+        resort — an in-place upgrade must still resume from its old saves. A
+        directory whose manifest EXISTS but fails is definitely torn and is
+        never used."""
+        legacy = None
+        for step, path in reversed(self.checkpoints()):
+            if verify_checkpoint_dir(path):
+                return path
+            if not os.path.isfile(os.path.join(path, CHECKPOINT_MANIFEST_NAME)):
+                if legacy is None:
+                    legacy = path
+            else:
+                logger.warning(
+                    "checkpoint %s failed verification (torn or corrupt); falling back", path
+                )
+        if legacy is not None:
+            logger.warning(
+                "no digest-verified checkpoint under %s; falling back to legacy "
+                "pre-manifest checkpoint %s (loaded without directory-level verification)",
+                self.base_dir, legacy,
+            )
+        return legacy
+
+    def resolve(self, spec: Optional[str] = None) -> str:
+        """'latest'/None -> newest VERIFIED checkpoint; an explicit path is
+        verified and returned. Raises FileNotFoundError when nothing usable
+        exists and CheckpointCorruptError for an explicitly-named bad one."""
+        if spec in (None, "latest"):
+            path = self.latest_verified()
+            if path is None:
+                raise FileNotFoundError(
+                    f"no verified checkpoint under {self.base_dir} "
+                    f"({len(self.checkpoints())} candidate(s) present)"
+                )
+            return path
+        spec = str(spec)
+        if not os.path.isdir(spec):
+            raise FileNotFoundError(f"checkpoint directory {spec} does not exist")
+        if os.path.isfile(os.path.join(spec, CHECKPOINT_MANIFEST_NAME)) and not verify_checkpoint_dir(spec):
+            raise CheckpointCorruptError(f"checkpoint {spec} failed digest verification")
+        return spec
+
+    @staticmethod
+    def is_manager_dir(path: str) -> bool:
+        """A base dir the manager owns (vs a concrete checkpoint dir): has a
+        `latest` pointer or `checkpoint_N` children but no own MANIFEST."""
+        path = str(path)
+        if not os.path.isdir(path) or os.path.isfile(os.path.join(path, CHECKPOINT_MANIFEST_NAME)):
+            return False
+        if os.path.isfile(os.path.join(path, LATEST_POINTER_NAME)):
+            return True
+        return bool(CheckpointManager(path).checkpoints())
+
+    # ---------------------------------------------------------------- commit path
+    def _retry(self, fn, what: str):
+        for attempt in range(self.retries + 1):
+            try:
+                return fn()
+            except OSError as exc:
+                if attempt == self.retries:
+                    raise
+                delay = self.backoff_seconds * (2**attempt)
+                logger.warning(
+                    "transient I/O error during %s (%s); retry %d/%d in %.2fs",
+                    what, exc, attempt + 1, self.retries, delay,
+                )
+                time.sleep(delay)
+
+    def clean_staging(self):
+        """Reap staging litter left by a killed save (never a committed checkpoint)."""
+        if not os.path.isdir(self.base_dir):
+            return
+        for name in os.listdir(self.base_dir):
+            if name.startswith(_STAGING_PREFIX):
+                shutil.rmtree(os.path.join(self.base_dir, name), ignore_errors=True)
+
+    def save(
+        self,
+        step: int,
+        write_fn: Callable[[str], Any],
+        is_main: bool = True,
+        barrier: Optional[Callable[[], Any]] = None,
+    ) -> str:
+        """Stage -> digest-manifest -> atomic publish -> latest pointer -> rotate.
+
+        `write_fn(staging_dir)` writes every artifact. The checkpoint only becomes
+        visible (and `latest` only advances) after everything it contains — and
+        the manifest describing it — is fully on disk."""
+        barrier = barrier or (lambda: None)
+        final = os.path.join(self.base_dir, f"checkpoint_{step}")
+        replace_torn = False
+        if os.path.exists(final):
+            # A resumed run that fell back past a torn newest checkpoint will
+            # re-save its step number: replacing a directory whose manifest
+            # FAILS is safe (it can never serve a resume). A verified one — or
+            # a manifest-less LEGACY one, which resume may still fall back to —
+            # is never clobbered.
+            has_manifest = os.path.isfile(os.path.join(final, CHECKPOINT_MANIFEST_NAME))
+            if not has_manifest or verify_checkpoint_dir(final):
+                raise ValueError(
+                    f"Checkpoint directory {final} already exists; use a different step "
+                    "or a fresh base directory."
+                )
+            logger.warning("replacing unverifiable existing checkpoint %s", final)
+            replace_torn = True
+        staging = os.path.join(self.base_dir, f"{_STAGING_PREFIX}checkpoint_{step}")
+        if is_main:
+            os.makedirs(self.base_dir, exist_ok=True)
+            shutil.rmtree(staging, ignore_errors=True)
+            os.makedirs(staging)
+        barrier()  # staging dir exists before any process writes into it
+        write_fn(staging)
+        barrier()  # every process's artifacts are in before the digest scan
+        if is_main:
+            self._retry(lambda: write_checkpoint_manifest(staging, step), "manifest write")
+            if replace_torn:
+                # Retire the torn dir just before publishing: the new checkpoint
+                # (manifest included) is already fully on disk in staging, so a
+                # kill in this window loses nothing that could have been loaded.
+                self._retry(lambda: shutil.rmtree(final), f"reap of torn {final}")
+            self._retry(lambda: self._publish(staging, final), "checkpoint publish")
+            self._rotate(keep=final)
+        barrier()
+        return final
+
+    def _publish(self, staging: str, final: str):
+        os.replace(staging, final)  # THE commit point (atomic dir rename)
+        _fsync_directory(self.base_dir)
+        atomic_write(
+            os.path.join(self.base_dir, LATEST_POINTER_NAME),
+            lambda f: f.write(os.path.basename(final)),
+            mode="w",
+        )
+
+    def _rotate(self, keep: str):
+        if self.keep_last_n is None:
+            return
+        ckpts = self.checkpoints()
+        excess = len(ckpts) - self.keep_last_n
+        if excess <= 0:
+            return
+        # Strictly oldest-first by step. Manifest-less directories are LEGACY
+        # checkpoints (in the post-manifest world a torn save never becomes a
+        # `checkpoint_N` at all — the staging rename is atomic), so they age
+        # out in step order like any other checkpoint rather than being
+        # preferentially destroyed while they may still be the only resumable
+        # state.
+        for _step, path in ckpts:
+            if excess <= 0:
+                break
+            if os.path.abspath(path) == os.path.abspath(keep):
+                continue  # never reap the checkpoint just committed
+            logger.info("rotating out checkpoint %s (keep_last_n=%d)", path, self.keep_last_n)
+            self._retry(lambda p=path: shutil.rmtree(p), f"rotation of {path}")
+            excess -= 1
 
 
 def load_custom_state(obj, path: str, index: int = 0):

@@ -45,6 +45,18 @@ def add_launch_args(parser):
         default=None,
         help="Seconds a signaled child gets to checkpoint (default 30, or the config file's value)",
     )
+    parser.add_argument(
+        "--restart_backoff",
+        type=float,
+        default=None,
+        help="Base seconds of linear restart backoff (default 1, or the config file's value)",
+    )
+    parser.add_argument(
+        "--max_backoff",
+        type=float,
+        default=None,
+        help="Backoff ceiling in seconds so a crash loop with a large budget never sleeps unboundedly (default 30)",
+    )
     parser.add_argument("--tpu_use_cluster", action="store_true", help="Launch on every worker of a TPU pod")
     parser.add_argument("--tpu_name", default=None)
     parser.add_argument("--tpu_zone", default=None)
@@ -167,7 +179,16 @@ def launch_command(args):
         from ..fault_tolerance import Supervisor
 
         grace = args.grace_period if args.grace_period is not None else float(config.get("grace_period", 30.0))
-        code = Supervisor(cmd, env=env, max_restarts=max_restarts, grace_period=grace).run()
+        backoff = args.restart_backoff if args.restart_backoff is not None else float(config.get("restart_backoff", 1.0))
+        max_backoff = args.max_backoff if args.max_backoff is not None else float(config.get("max_backoff", 30.0))
+        code = Supervisor(
+            cmd,
+            env=env,
+            max_restarts=max_restarts,
+            grace_period=grace,
+            backoff_seconds=backoff,
+            max_backoff_seconds=max_backoff,
+        ).run()
         if code != 0:
             raise SystemExit(code)
         return

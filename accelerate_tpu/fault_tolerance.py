@@ -21,10 +21,8 @@ Two pieces:
 
 from __future__ import annotations
 
-import os
 import signal
 import subprocess
-import sys
 import threading
 import time
 from typing import Callable, List, Optional
@@ -50,6 +48,7 @@ class Supervisor:
         max_restarts: int = 0,
         grace_period: float = 30.0,
         backoff_seconds: float = 1.0,
+        max_backoff_seconds: float = 30.0,
         monitor_interval: float = 0.5,
     ):
         self.cmd = cmd
@@ -57,23 +56,53 @@ class Supervisor:
         self.max_restarts = max_restarts
         self.grace_period = grace_period
         self.backoff_seconds = backoff_seconds
+        self.max_backoff_seconds = max_backoff_seconds
+        # Cadence of the monitor's timed child.wait() cycles (bounds how late a
+        # grace-period expiry can be noticed).
         self.monitor_interval = monitor_interval
         self.restart_count = 0
         self._child: Optional[subprocess.Popen] = None
         self._terminating = False
+        self._kill_deadline: Optional[float] = None
 
     def _forward_signal(self, signum, frame):
+        """Runs ON TOP of the interrupted `child.wait()` frame, which may hold
+        `Popen._waitpid_lock` — so this handler must never call poll()/wait()
+        itself (their non-blocking lock acquires would fail until the handler
+        returns, stalling the full grace period). It only latches the
+        terminating flag, stamps the kill deadline, and forwards the signal;
+        `_monitor` enforces the grace period."""
         self._terminating = True
+        if self._kill_deadline is None:
+            self._kill_deadline = time.monotonic() + self.grace_period
         child = self._child
-        if child is not None and child.poll() is None:
+        if child is not None:
             logger.info("supervisor: forwarding signal %d to pid %d", signum, child.pid)
-            child.send_signal(signum)
-            deadline = time.time() + self.grace_period
-            while child.poll() is None and time.time() < deadline:
-                time.sleep(self.monitor_interval)
-            if child.poll() is None:
-                logger.warning("supervisor: grace period expired; killing pid %d", child.pid)
-                child.kill()
+            try:
+                child.send_signal(signum)
+            except (ProcessLookupError, OSError):
+                pass  # child already gone; _monitor will reap it
+
+    def _monitor(self, child: subprocess.Popen) -> int:
+        """Timed `child.wait()` cycles (no CPU busy-poll): each cycle blocks up
+        to `monitor_interval`, so a forwarded signal's grace expiry is noticed
+        within one interval and a child exit is observed immediately."""
+        while True:
+            timeout = self.monitor_interval
+            if self._kill_deadline is not None:
+                timeout = min(timeout, max(self._kill_deadline - time.monotonic(), 0.01))
+            try:
+                return child.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                if self._kill_deadline is not None and time.monotonic() >= self._kill_deadline:
+                    logger.warning("supervisor: grace period expired; killing pid %d", child.pid)
+                    child.kill()
+                    return child.wait()
+
+    def _next_backoff(self) -> float:
+        """Linear backoff capped at `max_backoff_seconds` — a tight crash loop
+        with a large restart budget must never sleep unboundedly long."""
+        return min(self.backoff_seconds * self.restart_count, self.max_backoff_seconds)
 
     def run(self) -> int:
         prev_term = signal.signal(signal.SIGTERM, self._forward_signal)
@@ -81,9 +110,7 @@ class Supervisor:
         try:
             while True:
                 self._child = subprocess.Popen(self.cmd, env=self.env)
-                while self._child.poll() is None:
-                    time.sleep(self.monitor_interval)
-                code = self._child.returncode
+                code = self._monitor(self._child)
                 if code == 0 or code == PREEMPTED_EXIT_CODE or self._terminating:
                     return code
                 if self.restart_count >= self.max_restarts:
@@ -100,7 +127,7 @@ class Supervisor:
                     self.restart_count,
                     self.max_restarts,
                 )
-                time.sleep(self.backoff_seconds * self.restart_count)
+                time.sleep(self._next_backoff())
         finally:
             signal.signal(signal.SIGTERM, prev_term)
             signal.signal(signal.SIGINT, prev_int)
@@ -116,14 +143,35 @@ class PreemptionHandler:
             ...
             if handler.preemption_requested:
                 accelerator.save_state(ckpt_dir); sys.exit(PREEMPTED_EXIT_CODE)
+
+    CPython only allows `signal.signal` from the MAIN thread: constructed anywhere
+    else (notebook executors, launcher worker threads), the handler degrades to a
+    warn + permanently-unset latch (`installed` is False) instead of raising —
+    `register_preemption_checkpoint` must never crash the training script it is
+    trying to protect.
     """
 
     def __init__(self, catch_sigint: bool = False, on_preempt: Optional[Callable] = None):
         self._requested = threading.Event()
         self.on_preempt = on_preempt
         self._prev = {}
+        self.installed = True
         for sig in [signal.SIGTERM] + ([signal.SIGINT] if catch_sigint else []):
-            self._prev[sig] = signal.signal(sig, self._handle)
+            try:
+                self._prev[sig] = signal.signal(sig, self._handle)
+            except ValueError:
+                # signal.signal off the main thread (or an exotic interpreter
+                # state). A no-op latch keeps the caller alive; preemption then
+                # falls back to the supervisor's grace-period kill.
+                self.installed = False
+                self._prev = {}
+                logger.warning(
+                    "PreemptionHandler constructed off the main thread; SIGTERM latch "
+                    "disabled (preemption_requested will stay False). Construct the "
+                    "handler — or call register_preemption_checkpoint — from the main "
+                    "thread to enable graceful preemption checkpoints."
+                )
+                break
 
     def _handle(self, signum, frame):
         logger.warning("preemption signal %d received; will checkpoint at step boundary", signum)

@@ -31,6 +31,18 @@ attends only `cols <= its own position`, and insert overwrites the prompt rows.
 Greedy outputs are token-identical to the static `Generator` path (pads
 contribute exact zeros under the f32 softmax; rows are independent in every
 layer), which is what `tests/test_serving.py` pins.
+
+Fault isolation (the serving-runtime half of the resilience layer): the engine
+degrades PER-REQUEST, never per-process. Admission failures (a transient device
+error during an insert, a malformed prompt that slipped validation) mark only
+that request `finish_reason="error"`; per-request wall-clock deadlines are
+enforced at step boundaries (`finish_reason="timeout"`); `cancel()` frees an
+in-flight slot immediately; a bounded queue raises `QueueFull` so callers get
+explicit backpressure instead of unbounded host memory growth; and
+`drain()`/`close()` give the server a clean shutdown lifecycle. The one shared
+decode executable is the blast-radius exception: if a chunk dispatch itself
+dies, every in-flight request errors (the cache state is gone) but the engine
+stays up and keeps admitting.
 """
 
 from __future__ import annotations
@@ -54,7 +66,23 @@ from .generation import (
     _sample,
     make_causal_programs,
 )
+from .logging import get_logger
 from .utils.operations import tree_scatter_rows
+
+logger = get_logger(__name__)
+
+
+class QueueFull(RuntimeError):
+    """Bounded-queue backpressure: the engine's wait queue is at `max_queue`.
+    Callers shed load (HTTP 429 / retry-after) instead of growing host memory."""
+
+
+class EngineClosed(RuntimeError):
+    """The engine was `close()`d (or is mid-`drain()`) and takes no new work."""
+
+
+#: Every value `RequestResult.finish_reason` can take.
+FINISH_REASONS = ("eos", "length", "timeout", "error", "cancelled")
 
 
 @dataclass
@@ -62,7 +90,11 @@ class Request:
     """One serving request. `eos_token_id`, `max_new_tokens`, `temperature` and
     `repetition_penalty` are PER-REQUEST (traced operands of the shared decode
     program); `do_sample`/`top_k`/`top_p` are engine-level (they shape the
-    compiled sampler, exactly as in `Generator._decode_fn`)."""
+    compiled sampler, exactly as in `Generator._decode_fn`).
+
+    `deadline_s` is a wall-clock budget in seconds measured from `submit()`;
+    enforced at step boundaries, so a request can overrun by at most one chunk
+    before finishing with `finish_reason="timeout"` (partial tokens kept)."""
 
     request_id: int
     input_ids: Any  # [prompt_len] int sequence
@@ -71,6 +103,7 @@ class Request:
     repetition_penalty: float = 1.0
     eos_token_id: Optional[int] = None
     arrival_time: float = 0.0  # caller-defined clock, echoed into the result
+    deadline_s: Optional[float] = None  # wall-clock budget from submit; None = no deadline
 
 
 @dataclass
@@ -81,7 +114,8 @@ class RequestResult:
     first_token_time: Optional[float] = None  # host perf_counter at insert return
     finish_time: Optional[float] = None
     finished: bool = False
-    finish_reason: Optional[str] = None  # "eos" | "length"
+    finish_reason: Optional[str] = None  # one of FINISH_REASONS
+    error: Optional[str] = None  # repr of the exception when finish_reason == "error"
 
 
 class ContinuousBatcher:
@@ -114,6 +148,7 @@ class ContinuousBatcher:
         top_p: float = 1.0,
         use_repetition_penalty: bool = False,
         rng=None,
+        max_queue: Optional[int] = None,
     ):
         if getattr(model, "module", None) is None or not hasattr(model.module, "config"):
             raise ValueError("ContinuousBatcher needs a Model bundle built from an in-tree flax module")
@@ -177,7 +212,21 @@ class ContinuousBatcher:
         self._slot_request: List[Optional[RequestResult]] = [None] * S
         self._queue: deque = deque()
         self.results: Dict[int, RequestResult] = {}
-        self.stats = {"inserts": 0, "chunks": 0, "decode_steps": 0}
+        self.max_queue = None if max_queue is None else int(max_queue)
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None for unbounded)")
+        self._deadlines: Dict[int, float] = {}  # request_id -> absolute perf_counter deadline
+        self._closed = False
+        self._draining = False
+        self.stats = {
+            "inserts": 0,
+            "chunks": 0,
+            "decode_steps": 0,
+            # Queue-depth high-water mark: how close the server ran to its
+            # backpressure limit (sized against `max_queue`).
+            "queue_peak": 0,
+            "finish_reasons": {reason: 0 for reason in FINISH_REASONS},
+        }
 
     # ------------------------------------------------------------------ programs
 
@@ -297,7 +346,19 @@ class ContinuousBatcher:
     def free_slots(self) -> int:
         return sum(r is None for r in self._slot_request)
 
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
     def submit(self, request: Request) -> int:
+        """Validate + enqueue. Raises `ValueError` for malformed requests (the
+        caller's bug, reported synchronously), `QueueFull` for backpressure, and
+        `EngineClosed` after `close()`/during `drain()` — none of which disturb
+        requests already in flight."""
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        if self._draining:
+            raise EngineClosed("engine is draining; resubmit after drain() returns")
         ids = np.asarray(request.input_ids, np.int32).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty prompt")
@@ -310,15 +371,81 @@ class ContinuousBatcher:
             )
         if request.request_id in self.results:
             raise ValueError(f"duplicate request_id {request.request_id}")
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            raise QueueFull(
+                f"wait queue is at max_queue={self.max_queue}; shed load or retry later"
+            )
         self.results[request.request_id] = RequestResult(
             request.request_id, arrival_time=request.arrival_time
         )
+        if request.deadline_s is not None:
+            self._deadlines[request.request_id] = time.perf_counter() + float(request.deadline_s)
         self._queue.append(dataclasses.replace(request, input_ids=ids))
+        self.stats["queue_peak"] = max(self.stats["queue_peak"], len(self._queue))
         return request.request_id
+
+    # ------------------------------------------------------------- fault isolation
+    def _slot_of(self, request_id: int) -> Optional[int]:
+        for slot, result in enumerate(self._slot_request):
+            if result is not None and result.request_id == request_id:
+                return slot
+        return None
+
+    def _finish(self, result: RequestResult, reason: str, now: Optional[float] = None,
+                slot: Optional[int] = None, error: Optional[str] = None):
+        """The single exit path for a request: stamp the result, bump the
+        per-reason counter, drop its deadline, and free its slot (if any) so the
+        next `_admit` can reuse the cache rows."""
+        result.finished = True
+        result.finish_time = time.perf_counter() if now is None else now
+        result.finish_reason = reason
+        if error is not None:
+            result.error = error
+        self.stats["finish_reasons"][reason] += 1
+        self._deadlines.pop(result.request_id, None)
+        if slot is not None:
+            self._slot_request[slot] = None
+            self._active[slot] = False
+
+    def _drop_queued(self, request_id: int) -> bool:
+        before = len(self._queue)
+        self._queue = deque(r for r in self._queue if r.request_id != request_id)
+        return len(self._queue) != before
+
+    def _expire_deadlines(self):
+        """Step-boundary deadline sweep: queued requests time out without ever
+        occupying a slot; in-flight ones keep their partial tokens and free the slot."""
+        if not self._deadlines:
+            return
+        now = time.perf_counter()
+        for request_id in [rid for rid, t in self._deadlines.items() if now >= t]:
+            result = self.results[request_id]
+            if result.finished:
+                self._deadlines.pop(request_id, None)
+                continue
+            self._drop_queued(request_id)
+            self._finish(result, "timeout", now=now, slot=self._slot_of(request_id))
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a queued or in-flight request: its result finishes with
+        `finish_reason="cancelled"` (partial tokens kept) and its slot frees for
+        the next admission. Returns False if it already finished; raises
+        KeyError for an unknown id."""
+        result = self.results[request_id]
+        if result.finished:
+            return False
+        self._drop_queued(request_id)
+        self._finish(result, "cancelled", slot=self._slot_of(request_id))
+        return True
 
     def _admit(self) -> List[Tuple[int, List[int]]]:
         """Fill free slots from the queue (FIFO). Each admission is one insert
-        dispatch; the first token streams out immediately (TTFT)."""
+        dispatch; the first token streams out immediately (TTFT).
+
+        Error isolation: an exception from ONE request's insert (transient device
+        error, a prompt the compiled program rejects) finishes only that request
+        with `finish_reason="error"` — the queue keeps draining and every other
+        slot keeps serving."""
         events: List[Tuple[int, List[int]]] = []
         while self._queue and self.free_slots:
             req = self._queue.popleft()
@@ -328,22 +455,29 @@ class ContinuousBatcher:
             bucket = min(_bucket_for(p), self.max_length)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :p] = ids
-            fn = self._insert_fn(bucket)
-            token, self._cache, self._presence, self._rng = fn(
-                self.params,
-                self._cache,
-                self._presence,
-                jnp.asarray(padded),
-                jnp.int32(p),
-                jnp.int32(slot),
-                jnp.float32(req.temperature),
-                jnp.float32(req.repetition_penalty),
-                self._rng,
-            )
-            token = int(token)
+            result = self.results[req.request_id]
+            try:
+                fn = self._insert_fn(bucket)
+                token, self._cache, self._presence, self._rng = fn(
+                    self.params,
+                    self._cache,
+                    self._presence,
+                    jnp.asarray(padded),
+                    jnp.int32(p),
+                    jnp.int32(slot),
+                    jnp.float32(req.temperature),
+                    jnp.float32(req.repetition_penalty),
+                    self._rng,
+                )
+                token = int(token)
+            except Exception as exc:  # noqa: BLE001 — isolate, report, keep serving
+                logger.warning(
+                    "insert failed for request %s (isolated): %r", req.request_id, exc
+                )
+                self._finish(result, "error", error=repr(exc))
+                continue
             now = time.perf_counter()
             self.stats["inserts"] += 1
-            result = self.results[req.request_id]
             result.tokens.append(token)
             result.first_token_time = now
             events.append((req.request_id, [token]))
@@ -361,9 +495,7 @@ class ContinuousBatcher:
                 self._temp[slot] = req.temperature
                 self._pen[slot] = req.repetition_penalty
             else:
-                result.finished = True
-                result.finish_time = now
-                result.finish_reason = "eos" if token == eos else "length"
+                self._finish(result, "eos" if token == eos else "length", now=now)
         return events
 
     def release(self, request_id: int) -> RequestResult:
@@ -378,25 +510,43 @@ class ContinuousBatcher:
         return result
 
     def step(self) -> List[Tuple[int, List[int]]]:
-        """One serving cycle: admit → one decode-chunk dispatch → drain the
-        packed stream. Returns `(request_id, new_tokens)` events in stream order
-        (admissions' first tokens included)."""
+        """One serving cycle: expire deadlines → admit → one decode-chunk
+        dispatch → drain the packed stream. Returns `(request_id, new_tokens)`
+        events in stream order (admissions' first tokens included)."""
+        if self._closed:
+            return []
+        self._expire_deadlines()
         events = self._admit()
         if not self._active.any():
             return events
-        out = self._chunk_fn(
-            self.params,
-            self._cache,
-            self._presence,
-            jnp.asarray(self._token),
-            jnp.asarray(self._pos),
-            jnp.asarray(self._active),
-            jnp.asarray(self._rem),
-            jnp.asarray(self._eos),
-            jnp.asarray(self._temp),
-            jnp.asarray(self._pen),
-            self._rng,
-        )
+        try:
+            out = self._chunk_fn(
+                self.params,
+                self._cache,
+                self._presence,
+                jnp.asarray(self._token),
+                jnp.asarray(self._pos),
+                jnp.asarray(self._active),
+                jnp.asarray(self._rem),
+                jnp.asarray(self._eos),
+                jnp.asarray(self._temp),
+                jnp.asarray(self._pen),
+                self._rng,
+            )
+        except Exception as exc:  # noqa: BLE001
+            # The ONE shared executable covers every slot: if the dispatch itself
+            # dies the in-flight cache state is unrecoverable, so every in-flight
+            # request errors (partial tokens kept) — but the engine itself stays
+            # up: slots free, the queue keeps draining, new admissions rebuild
+            # their own cache rows from scratch.
+            logger.warning("decode chunk dispatch failed; erroring %d in-flight request(s): %r",
+                           sum(r is not None for r in self._slot_request), exc)
+            now = time.perf_counter()
+            for slot, result in enumerate(self._slot_request):
+                if result is not None:
+                    self._finish(result, "error", now=now, slot=slot, error=repr(exc))
+            self._active[:] = False
+            return events
         self._cache, self._presence = out[0], out[1]
         # np.array (copy): np.asarray of a jax buffer is a READ-ONLY view, and
         # these mirrors are written in-place at the next admission.
@@ -423,12 +573,10 @@ class ContinuousBatcher:
         for slot in np.nonzero(was_active & ~active)[0]:
             result = self._slot_request[slot]
             if result is not None:
-                result.finished = True
-                result.finish_time = now
-                result.finish_reason = (
+                reason = (
                     "eos" if result.tokens and result.tokens[-1] == self._eos[slot] else "length"
                 )
-                self._slot_request[slot] = None
+                self._finish(result, reason, now=now, slot=slot)
         return events
 
     def run(self, requests: Optional[List[Request]] = None) -> Dict[int, np.ndarray]:
@@ -439,3 +587,35 @@ class ContinuousBatcher:
         while self.pending:
             self.step()
         return {rid: np.asarray(r.tokens, np.int32) for rid, r in self.results.items()}
+
+    # ------------------------------------------------------------------ lifecycle
+    def drain(self) -> Dict[int, RequestResult]:
+        """Flush: refuse new submissions while finishing everything queued and
+        in flight, then reopen. Returns the full results map (the caller
+        `release()`s what it has consumed)."""
+        self._draining = True
+        try:
+            while self.pending:
+                self.step()
+        finally:
+            self._draining = False
+        return self.results
+
+    def close(self) -> Dict[int, RequestResult]:
+        """Terminal shutdown: everything still queued or in flight finishes with
+        `finish_reason="cancelled"` (partial tokens kept), and the engine
+        permanently refuses new work (`submit` raises `EngineClosed`, `step`
+        no-ops). Idempotent."""
+        if self._closed:
+            return self.results
+        now = time.perf_counter()
+        self._queue.clear()
+        for slot, result in enumerate(self._slot_request):
+            if result is not None:
+                self._finish(result, "cancelled", now=now, slot=slot)
+        for result in self.results.values():
+            if not result.finished:  # still queued (never admitted)
+                self._finish(result, "cancelled", now=now)
+        self._active[:] = False
+        self._closed = True
+        return self.results

@@ -230,6 +230,12 @@ def main(argv=None):
             "ttft_p99_ms_continuous": round(pct(c_ttft, 99) * 1000, 2),
             "decode_iterations_static": s_iters,
             "decode_iterations_continuous": c_iters,
+            # Engine health ledger (cumulative over warmup + timed passes):
+            # how close the queue ran to its backpressure limit, and where
+            # every request ended up (all "length" on this EOS-free workload —
+            # any timeout/error/cancelled here is a bench regression).
+            "queue_peak": engine.stats["queue_peak"],
+            "finish_reasons": dict(engine.stats["finish_reasons"]),
             "makespan_s_static": round(s_span, 3),
             "makespan_s_continuous": round(c_span, 3),
             "requests": args.requests,

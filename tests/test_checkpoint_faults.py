@@ -1,0 +1,332 @@
+"""Fault-injection tests for the crash-safe checkpoint layer.
+
+Pins the resilience contract of `checkpointing.py` + `CheckpointManager` +
+`Accelerator.save_state/load_state`:
+
+  1. a kill at ANY point during a save never publishes a checkpoint that
+     `load_state` accepts — the staging-dir rename is the single commit point;
+  2. digest verification catches torn/corrupted artifacts (truncated `.npz`,
+     flipped bytes) instead of half-reading them;
+  3. resume via `"latest"` falls back past a corrupt newest checkpoint to the
+     last verified one, and the next save replaces the torn directory and
+     rotates correctly.
+
+All tests are CPU-only, subprocess-free and fast (tier-1, `-m faults` selects
+just the fault-injection suite).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import optax
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.checkpointing import (
+    CHECKPOINT_MANIFEST_NAME,
+    LATEST_POINTER_NAME,
+    CheckpointCorruptError,
+    CheckpointManager,
+    atomic_write,
+    load_pytree,
+    save_pytree,
+    verify_checkpoint_dir,
+    write_checkpoint_manifest,
+)
+from accelerate_tpu.data_loader import BatchSampler
+from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+from accelerate_tpu.utils import ProjectConfiguration
+
+pytestmark = pytest.mark.faults
+
+
+# ------------------------------------------------------------------ file-level atomicity
+def test_atomic_write_preserves_previous_content_on_failure(tmp_path):
+    """A writer that dies mid-stream must leave the previous complete file (and
+    no temp litter) — the byte-offset half of the torn-write guarantee."""
+    target = tmp_path / "state.json"
+    atomic_write(str(target), lambda f: f.write(b"old-complete"))
+
+    class MidWriteKill(RuntimeError):
+        pass
+
+    def torn_writer(f):
+        f.write(b"new-but-")
+        raise MidWriteKill("killed mid-write")
+
+    with pytest.raises(MidWriteKill):
+        atomic_write(str(target), torn_writer)
+    assert target.read_bytes() == b"old-complete"
+    assert os.listdir(tmp_path) == ["state.json"], "temp litter left behind"
+
+
+def test_load_pytree_rejects_truncated_npz(tmp_path):
+    tree = {"w": np.arange(64, dtype=np.float32), "b": np.ones((8,), np.float32)}
+    base = str(tmp_path / "model")
+    save_pytree(tree, base)
+    npz = base + ".npz"
+    size = os.path.getsize(npz)
+    with open(npz, "r+b") as f:
+        f.truncate(size // 2)
+    with pytest.raises(CheckpointCorruptError, match="SHA-256 mismatch"):
+        load_pytree(base)
+
+
+def test_load_pytree_rejects_flipped_bytes(tmp_path):
+    """Silent bit rot (same length, different bytes) is caught too — length
+    checks alone would miss it."""
+    tree = {"w": np.arange(64, dtype=np.float32)}
+    base = str(tmp_path / "model")
+    save_pytree(tree, base)
+    npz = base + ".npz"
+    data = bytearray(open(npz, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    with open(npz, "wb") as f:
+        f.write(bytes(data))
+    with pytest.raises(CheckpointCorruptError):
+        load_pytree(base)
+
+
+# ------------------------------------------------------------------ directory-level commit
+def _write_artifacts(names):
+    def write_fn(staging):
+        for name in names:
+            with open(os.path.join(staging, name), "w") as f:
+                f.write(f"payload:{name}")
+
+    return write_fn
+
+
+def test_manager_commit_layout_and_latest_pointer(tmp_path):
+    manager = CheckpointManager(str(tmp_path))
+    path = manager.save(0, _write_artifacts(["model.npz", "optimizer.npz"]))
+    assert os.path.basename(path) == "checkpoint_0"
+    assert verify_checkpoint_dir(path)
+    with open(os.path.join(str(tmp_path), LATEST_POINTER_NAME)) as f:
+        assert f.read() == "checkpoint_0"
+    with open(os.path.join(path, CHECKPOINT_MANIFEST_NAME)) as f:
+        manifest = json.load(f)
+    assert set(manifest["files"]) == {"model.npz", "optimizer.npz"}
+    assert manager.resolve("latest") == path
+
+
+def test_manager_rotation_keeps_last_n(tmp_path):
+    manager = CheckpointManager(str(tmp_path), keep_last_n=2)
+    for step in range(4):
+        manager.save(step, _write_artifacts([f"a{step}.bin"]))
+    assert [s for s, _ in manager.checkpoints()] == [2, 3]
+    assert manager.resolve("latest").endswith("checkpoint_3")
+
+
+@pytest.mark.parametrize("artifacts_before_kill", [0, 1, 2])
+def test_kill_between_any_two_artifact_writes_never_publishes(tmp_path, artifacts_before_kill):
+    """The acceptance-criterion sweep: interrupt the save after each artifact in
+    turn. Whatever the offset, the in-flight checkpoint must never become
+    visible and `latest` must keep resolving to the previous verified save."""
+    manager = CheckpointManager(str(tmp_path))
+    good = manager.save(0, _write_artifacts(["model.npz", "optimizer.npz"]))
+
+    class Kill(BaseException):
+        """BaseException: even a SIGKILL-like non-Exception path must not commit."""
+
+    def dying_write_fn(staging):
+        _write_artifacts([f"part{i}.bin" for i in range(artifacts_before_kill)])(staging)
+        raise Kill
+
+    with pytest.raises(Kill):
+        manager.save(1, dying_write_fn)
+    # the torn save is invisible: no checkpoint_1, latest still the good one
+    assert [s for s, _ in manager.checkpoints()] == [0]
+    assert manager.resolve("latest") == good
+    with open(os.path.join(str(tmp_path), LATEST_POINTER_NAME)) as f:
+        assert f.read() == "checkpoint_0"
+    # staging litter is ignorable and reapable; a retry then lands cleanly
+    manager.clean_staging()
+    assert manager.save(1, _write_artifacts(["model.npz"])) != good
+    assert [s for s, _ in manager.checkpoints()] == [0, 1]
+
+
+def test_latest_verified_falls_back_past_torn_newest(tmp_path):
+    manager = CheckpointManager(str(tmp_path))
+    good = manager.save(0, _write_artifacts(["model.npz"]))
+    torn = manager.save(1, _write_artifacts(["model.npz"]))
+    with open(os.path.join(torn, "model.npz"), "w") as f:
+        f.write("truncat")  # digest no longer matches
+    assert not verify_checkpoint_dir(torn)
+    assert manager.latest_verified() == good
+    assert manager.resolve("latest") == good
+    # naming the bad checkpoint explicitly is a hard error, not a silent fallback
+    with pytest.raises(CheckpointCorruptError):
+        manager.resolve(torn)
+
+
+def test_missing_artifact_fails_verification(tmp_path):
+    manager = CheckpointManager(str(tmp_path))
+    path = manager.save(0, _write_artifacts(["model.npz", "optimizer.npz"]))
+    os.unlink(os.path.join(path, "optimizer.npz"))
+    assert not verify_checkpoint_dir(path)
+    assert manager.latest_verified() is None
+    with pytest.raises(FileNotFoundError, match="no verified checkpoint"):
+        manager.resolve("latest")
+
+
+def test_save_refuses_to_clobber_verified_but_replaces_torn(tmp_path):
+    manager = CheckpointManager(str(tmp_path))
+    path = manager.save(0, _write_artifacts(["model.npz"]))
+    with pytest.raises(ValueError, match="already exists"):
+        manager.save(0, _write_artifacts(["model.npz"]))
+    # tear it, and the same step becomes replaceable (the post-fallback resave)
+    with open(os.path.join(path, "model.npz"), "w") as f:
+        f.write("torn")
+    replaced = manager.save(0, _write_artifacts(["model.npz"]))
+    assert replaced == path and verify_checkpoint_dir(replaced)
+
+
+def test_legacy_pre_manifest_checkpoints_survive_an_upgrade(tmp_path):
+    """An in-place upgrade finds checkpoints written BEFORE the manifest
+    discipline (no MANIFEST.json). They must stay resumable as a last resort,
+    must not be destroyed newest-first by rotation, and must never be clobbered
+    by a colliding save — while digest-verified checkpoints always win."""
+    for step in (0, 1):  # legacy layout: bare dirs, no manifest
+        legacy = tmp_path / f"checkpoint_{step}"
+        legacy.mkdir()
+        (legacy / "model.npz").write_text(f"legacy payload {step}")
+    manager = CheckpointManager(str(tmp_path), keep_last_n=2)
+    # nothing verifies, but resume still lands on the NEWEST legacy checkpoint
+    assert manager.resolve("latest") == str(tmp_path / "checkpoint_1")
+    # a colliding save refuses to silently destroy a legacy checkpoint
+    with pytest.raises(ValueError, match="already exists"):
+        manager.save(1, _write_artifacts(["model.npz"]))
+    # new saves append; once one verifies, it wins over every legacy dir
+    new = manager.save(manager.next_step(), _write_artifacts(["model.npz"]))
+    assert manager.resolve("latest") == new
+    # rotation ages legacy checkpoints out OLDEST-first, like any checkpoint
+    assert [s for s, _ in manager.checkpoints()] == [1, 2]
+
+
+def test_transient_io_errors_retry_with_backoff(tmp_path, monkeypatch):
+    """The publish sequence retries OSErrors (full-disk blips, NFS hiccups)
+    instead of dying on the first one."""
+    manager = CheckpointManager(str(tmp_path), retries=3, backoff_seconds=0.0)
+    failures = {"n": 2}
+    real_replace = os.replace
+
+    def flaky_replace(src, dst):
+        if failures["n"] > 0 and os.path.basename(dst) == "checkpoint_0":
+            failures["n"] -= 1
+            raise OSError("transient")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", flaky_replace)
+    path = manager.save(0, _write_artifacts(["model.npz"]))
+    assert failures["n"] == 0 and verify_checkpoint_dir(path)
+
+
+def test_write_checkpoint_manifest_skips_staging_and_temp_litter(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "model.npz").write_text("payload")
+    (ckpt / "model.npz.tmp-123").write_text("litter from a killed writer")
+    (ckpt / ".tmp-checkpoint_9").mkdir()
+    (ckpt / ".tmp-checkpoint_9" / "x").write_text("staging litter")
+    write_checkpoint_manifest(str(ckpt))
+    with open(ckpt / CHECKPOINT_MANIFEST_NAME) as f:
+        manifest = json.load(f)
+    assert set(manifest["files"]) == {"model.npz"}
+    assert verify_checkpoint_dir(str(ckpt))
+
+
+# ------------------------------------------------------------------ Accelerator-level resume
+def _prepared_accelerator(project_dir, total_limit=None):
+    accelerator = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(project_dir),
+            automatic_checkpoint_naming=True,
+            total_limit=total_limit,
+        )
+    )
+    data = [RegressionDataset(length=16)[i] for i in range(16)]
+    dl = SimpleDataLoader(data, BatchSampler(range(16), 8))
+    model, opt, pdl = accelerator.prepare(RegressionModel(), optax.sgd(0.05), dl)
+    return accelerator, model, opt, pdl
+
+
+def _train_one_pass(accelerator, model, opt, pdl):
+    for batch in pdl:
+        accelerator.backward(model.loss, batch)
+        opt.step()
+        opt.zero_grad()
+
+
+def _params(model):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(model.params)]
+
+
+def test_load_state_latest_falls_back_past_torn_newest_checkpoint(tmp_path):
+    """The end-to-end resume story: train, save, train, save, tear the newest
+    checkpoint at the byte level — `load_state("latest")` must land on the
+    previous verified checkpoint's exact parameters, and the next `save_state`
+    must replace the torn directory with a verified one."""
+    accelerator, model, opt, pdl = _prepared_accelerator(tmp_path, total_limit=3)
+
+    _train_one_pass(accelerator, model, opt, pdl)
+    accelerator.save_state()  # checkpoint_0
+    params_at_0 = _params(model)
+    _train_one_pass(accelerator, model, opt, pdl)
+    accelerator.save_state()  # checkpoint_1
+    _train_one_pass(accelerator, model, opt, pdl)
+    assert not all(np.array_equal(a, b) for a, b in zip(_params(model), params_at_0))
+
+    # tear checkpoint_1: truncate its model payload mid-file
+    ckpt1 = os.path.join(str(tmp_path), "checkpoints", "checkpoint_1")
+    npz = os.path.join(ckpt1, "model.npz")
+    with open(npz, "r+b") as f:
+        f.truncate(os.path.getsize(npz) // 2)
+    assert not verify_checkpoint_dir(ckpt1)
+
+    accelerator.load_state("latest")  # falls back to checkpoint_0
+    for got, want in zip(_params(model), params_at_0):
+        np.testing.assert_array_equal(got, want)
+    # numbering resumed after the restored checkpoint: the next save replaces
+    # the torn checkpoint_1 with a verified one and latest advances onto it
+    assert accelerator.save_iteration == 1
+    path = accelerator.save_state()
+    assert path == ckpt1 and verify_checkpoint_dir(path)
+    manager = accelerator.checkpoint_manager()
+    assert manager.resolve("latest") == path
+
+
+def test_save_state_rotates_to_total_limit_and_latest_tracks(tmp_path):
+    accelerator, model, opt, pdl = _prepared_accelerator(tmp_path, total_limit=2)
+    for _ in range(3):
+        _train_one_pass(accelerator, model, opt, pdl)
+        accelerator.save_state()
+    manager = accelerator.checkpoint_manager()
+    assert [s for s, _ in manager.checkpoints()] == [1, 2]
+    assert manager.resolve("latest").endswith("checkpoint_2")
+    assert all(verify_checkpoint_dir(p) for _, p in manager.checkpoints())
+
+
+def test_explicit_dir_save_state_writes_manifest_and_verifies(tmp_path):
+    """The non-automatic path keeps the old API (write into the named dir) but
+    now finishes with a digest manifest, so explicit checkpoints verify too."""
+    accelerator, model, opt, pdl = _prepared_accelerator(tmp_path)
+    accelerator.project_configuration.automatic_checkpoint_naming = False
+    _train_one_pass(accelerator, model, opt, pdl)
+    out = accelerator.save_state(str(tmp_path / "explicit_ckpt"))
+    assert verify_checkpoint_dir(out)
+    saved = _params(model)
+    _train_one_pass(accelerator, model, opt, pdl)
+    accelerator.load_state(out)
+    for got, want in zip(_params(model), saved):
+        np.testing.assert_array_equal(got, want)
+    # corrupt it and the explicit load refuses instead of half-reading
+    npz = os.path.join(out, "model.npz")
+    with open(npz, "r+b") as f:
+        f.truncate(os.path.getsize(npz) // 2)
+    with pytest.raises(CheckpointCorruptError):
+        accelerator.load_state(out)

@@ -67,6 +67,127 @@ def test_supervisor_treats_preemption_exit_as_final():
         assert sup.restart_count == 0
 
 
+@pytest.mark.faults
+def test_preemption_handler_off_main_thread_degrades_to_noop():
+    """`signal.signal` is main-thread-only in CPython: constructing the handler
+    from a worker thread (notebook executors, launcher threads) must degrade to
+    a warn + permanently-unset latch instead of crashing the training script
+    `register_preemption_checkpoint` is trying to protect."""
+    import threading
+
+    prev_disposition = signal.getsignal(signal.SIGTERM)
+    box = {}
+
+    def build():
+        try:
+            box["handler"] = PreemptionHandler()
+        except BaseException as exc:  # pragma: no cover - the regression itself
+            box["error"] = exc
+
+    t = threading.Thread(target=build)
+    t.start()
+    t.join()
+    assert "error" not in box, f"off-main-thread construction raised {box.get('error')!r}"
+    handler = box["handler"]
+    assert handler.installed is False
+    assert handler.preemption_requested is False
+    handler.uninstall()  # no-op, must not raise
+    # the degraded handler never latched anything, so the main thread's SIGTERM
+    # disposition is untouched
+    assert signal.getsignal(signal.SIGTERM) == prev_disposition
+
+
+@pytest.mark.faults
+def test_supervisor_backoff_is_capped():
+    """A tight crash loop with a big restart budget must never sleep unboundedly:
+    linear backoff saturates at `max_backoff_seconds`."""
+    sup = Supervisor(["true"], max_restarts=1000, backoff_seconds=2.0, max_backoff_seconds=5.0)
+    sup.restart_count = 1
+    assert sup._next_backoff() == 2.0
+    sup.restart_count = 2
+    assert sup._next_backoff() == 4.0
+    sup.restart_count = 500  # would be 1000 s uncapped
+    assert sup._next_backoff() == 5.0
+
+
+def test_supervisor_wait_blocks_without_busy_polling():
+    """The monitor must block in `child.wait()` rather than poll at
+    `monitor_interval`: a child that exits instantly ends supervision in far
+    less wall time than even one poll interval would allow."""
+    t0 = time.perf_counter()
+    sup = Supervisor([sys.executable, "-c", "raise SystemExit(0)"], max_restarts=0, monitor_interval=30.0)
+    assert sup.run() == 0
+    assert time.perf_counter() - t0 < 25.0, "run() appears to sleep on monitor_interval"
+
+
+GRACEFUL_CHILD = """
+import signal, sys, time
+signal.signal(signal.SIGTERM, lambda *a: sys.exit(143))
+open(sys.argv[1], "w").close()  # handler installed: safe to preempt
+time.sleep(60)
+"""
+
+
+def _sigterm_self_once_ready(ready_path):
+    """Background thread: SIGTERM this process once the child reports its own
+    signal disposition is installed (a fixed timer races python startup)."""
+    import threading
+
+    def fire():
+        deadline = time.perf_counter() + 30
+        while not os.path.exists(ready_path) and time.perf_counter() < deadline:
+            time.sleep(0.05)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    threading.Thread(target=fire, daemon=True).start()
+
+
+@pytest.mark.faults
+def test_forwarded_sigterm_exit_observed_well_within_grace():
+    """Regression: the signal handler used to call child.wait() while the
+    interrupted monitor wait held Popen._waitpid_lock, so even a child that
+    exited instantly on SIGTERM stalled the FULL grace period and then got
+    spuriously SIGKILLed. The handler must only forward + stamp the deadline;
+    the monitor loop observes the graceful 143 within ~monitor_interval."""
+    with tempfile.TemporaryDirectory() as d:
+        script = _script(d, "graceful.py", GRACEFUL_CHILD)
+        ready = os.path.join(d, "ready")
+        sup = Supervisor(
+            [sys.executable, script, ready],
+            max_restarts=0,
+            grace_period=30.0,  # the stall the old code paid in full
+            monitor_interval=0.1,
+        )
+        _sigterm_self_once_ready(ready)
+        t0 = time.perf_counter()
+        code = sup.run()
+        elapsed = time.perf_counter() - t0
+    assert code == PREEMPTED_EXIT_CODE, f"child's graceful exit lost (got {code})"
+    assert elapsed < 15.0, f"supervisor stalled {elapsed:.1f}s — grace-period deadlock regressed"
+
+
+@pytest.mark.faults
+def test_grace_period_expiry_hard_kills_stubborn_child():
+    """A child that ignores SIGTERM is hard-killed one monitor cycle after the
+    grace deadline, not left running forever."""
+    with tempfile.TemporaryDirectory() as d:
+        script = _script(
+            d, "stubborn.py",
+            "import signal, sys, time\nsignal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            'open(sys.argv[1], "w").close()\ntime.sleep(60)\n',
+        )
+        ready = os.path.join(d, "ready")
+        sup = Supervisor(
+            [sys.executable, script, ready], max_restarts=0, grace_period=1.0, monitor_interval=0.1
+        )
+        _sigterm_self_once_ready(ready)
+        t0 = time.perf_counter()
+        code = sup.run()
+        elapsed = time.perf_counter() - t0
+    assert code == -signal.SIGKILL
+    assert elapsed < 20.0
+
+
 def test_preemption_handler_latch():
     handler = PreemptionHandler()
     try:

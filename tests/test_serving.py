@@ -245,6 +245,220 @@ def test_tree_scatter_gather_roundtrip():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+# ------------------------------------------------------------- fault isolation
+# The serving-hardening contract: the engine degrades PER-REQUEST (deadline,
+# cancel, backpressure, admission/step errors), never per-process.
+
+
+@pytest.mark.faults
+def test_queued_deadline_expires_without_occupying_a_slot():
+    model = _model()
+    rng = np.random.default_rng(10)
+    engine = ContinuousBatcher(model, num_slots=1, max_length=32, chunk_size=2)
+    prompt = rng.integers(1, 128, (4,)).astype(np.int32)
+    engine.submit(Request(0, prompt, max_new_tokens=4, deadline_s=0.0))  # already expired
+    engine.submit(Request(1, prompt, max_new_tokens=4))
+    outputs = engine.run()
+    assert engine.results[0].finish_reason == "timeout"
+    assert engine.results[0].tokens == []  # never admitted
+    assert engine.results[1].finish_reason == "length"
+    np.testing.assert_array_equal(outputs[1], _static_reference(model, prompt, 4))
+
+
+@pytest.mark.faults
+def test_inflight_deadline_keeps_partial_tokens_and_frees_slot():
+    model = _model()
+    rng = np.random.default_rng(11)
+    engine = ContinuousBatcher(model, num_slots=1, max_length=64, chunk_size=2)
+    prompt = rng.integers(1, 128, (4,)).astype(np.int32)
+    engine.submit(Request(0, prompt, max_new_tokens=24, deadline_s=1000.0))
+    engine.step()  # admitted + some decode progress
+    partial = len(engine.results[0].tokens)
+    assert partial >= 1 and not engine.results[0].finished
+    engine._deadlines[0] = 0.0  # force the wall clock past the deadline
+    engine.step()
+    result = engine.results[0]
+    assert result.finish_reason == "timeout"
+    assert len(result.tokens) >= partial  # partial output kept, never discarded
+    assert engine.free_slots == 1  # the slot is serviceable again
+    # and the freed slot serves the next request with exact greedy parity
+    engine.submit(Request(1, prompt, max_new_tokens=4))
+    outputs = engine.run()
+    np.testing.assert_array_equal(outputs[1], _static_reference(model, prompt, 4))
+
+
+@pytest.mark.faults
+def test_cancel_queued_and_inflight_requests():
+    model = _model()
+    rng = np.random.default_rng(12)
+    engine = ContinuousBatcher(model, num_slots=1, max_length=64, chunk_size=2)
+    prompt = rng.integers(1, 128, (4,)).astype(np.int32)
+    engine.submit(Request(0, prompt, max_new_tokens=24))
+    engine.submit(Request(1, prompt, max_new_tokens=4))
+    engine.step()  # 0 in flight, 1 queued
+    assert engine.cancel(1) is True  # cancel while queued: no tokens at all
+    assert engine.results[1].finish_reason == "cancelled"
+    assert engine.results[1].tokens == []
+    assert engine.cancel(0) is True  # cancel mid-flight: partial tokens kept
+    assert engine.results[0].finish_reason == "cancelled"
+    assert engine.results[0].tokens and engine.free_slots == 1
+    assert engine.cancel(0) is False  # already finished
+    with pytest.raises(KeyError):
+        engine.cancel(99)
+    engine.submit(Request(2, prompt, max_new_tokens=4))
+    outputs = engine.run()
+    np.testing.assert_array_equal(outputs[2], _static_reference(model, prompt, 4))
+
+
+@pytest.mark.faults
+def test_bounded_queue_raises_queue_full():
+    from accelerate_tpu.serving import QueueFull
+
+    model = _model()
+    rng = np.random.default_rng(13)
+    engine = ContinuousBatcher(model, num_slots=1, max_length=32, chunk_size=2, max_queue=2)
+    prompt = rng.integers(1, 128, (4,)).astype(np.int32)
+    engine.submit(Request(0, prompt, max_new_tokens=4))
+    engine.submit(Request(1, prompt, max_new_tokens=4))
+    with pytest.raises(QueueFull):
+        engine.submit(Request(2, prompt, max_new_tokens=4))
+    assert 2 not in engine.results, "rejected request must leave no result entry"
+    engine.step()  # admission drains the queue; capacity opens up
+    engine.submit(Request(2, prompt, max_new_tokens=4))
+    engine.run()
+    assert engine.stats["queue_peak"] == 2
+    assert all(engine.results[i].finish_reason == "length" for i in range(3))
+
+
+@pytest.mark.faults
+def test_insert_error_isolated_to_one_request():
+    """A device error while admitting ONE request (here: its bucket's insert
+    executable dies) errors only that request; every other request still
+    matches the static path token-for-token."""
+    model = _model()
+    rng = np.random.default_rng(14)
+    engine = ContinuousBatcher(model, num_slots=2, max_length=64, chunk_size=2)
+    good_a = rng.integers(1, 128, (4,)).astype(np.int32)   # bucket 4
+    poison = rng.integers(1, 128, (7,)).astype(np.int32)   # bucket 8
+    good_b = rng.integers(1, 128, (3,)).astype(np.int32)   # bucket 4
+
+    real_insert_fn = engine._insert_fn
+
+    def poisoned_insert_fn(bucket):
+        if bucket == 8:
+            raise RuntimeError("injected transient device error")
+        return real_insert_fn(bucket)
+
+    engine._insert_fn = poisoned_insert_fn
+    outputs = engine.run(
+        [
+            Request(0, good_a, max_new_tokens=4),
+            Request(1, poison, max_new_tokens=4),
+            Request(2, good_b, max_new_tokens=4),
+        ]
+    )
+    assert engine.results[1].finish_reason == "error"
+    assert "injected transient device error" in engine.results[1].error
+    assert engine.results[1].tokens == []
+    np.testing.assert_array_equal(outputs[0], _static_reference(model, good_a, 4))
+    np.testing.assert_array_equal(outputs[2], _static_reference(model, good_b, 4))
+    assert engine.stats["finish_reasons"]["error"] == 1
+    assert engine.stats["finish_reasons"]["length"] == 2
+
+
+@pytest.mark.faults
+def test_chunk_dispatch_failure_errors_inflight_but_engine_survives():
+    """The blast-radius exception: the ONE shared decode executable dying takes
+    every in-flight request with it — but the engine stays up and the next
+    admission serves correctly from freshly-rebuilt cache rows."""
+    model = _model()
+    rng = np.random.default_rng(15)
+    engine = ContinuousBatcher(model, num_slots=2, max_length=64, chunk_size=2)
+    prompts = [rng.integers(1, 128, (4,)).astype(np.int32) for _ in range(2)]
+    for i, p in enumerate(prompts):
+        engine.submit(Request(i, p, max_new_tokens=8))
+    engine.step()  # both admitted and decoding
+
+    real_chunk_fn = engine._chunk_fn
+    engine._chunk_fn = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("XLA dispatch died"))
+    engine.step()
+    engine._chunk_fn = real_chunk_fn
+
+    for i in range(2):
+        assert engine.results[i].finish_reason == "error"
+        assert "XLA dispatch died" in engine.results[i].error
+        assert engine.results[i].tokens, "partial tokens must be kept"
+    assert engine.free_slots == 2 and not engine.pending
+
+    engine.submit(Request(2, prompts[0], max_new_tokens=4))
+    outputs = engine.run()
+    np.testing.assert_array_equal(outputs[2], _static_reference(model, prompts[0], 4))
+
+
+@pytest.mark.faults
+def test_close_cancels_everything_and_refuses_new_work():
+    from accelerate_tpu.serving import EngineClosed
+
+    model = _model()
+    rng = np.random.default_rng(16)
+    engine = ContinuousBatcher(model, num_slots=1, max_length=64, chunk_size=2)
+    prompt = rng.integers(1, 128, (4,)).astype(np.int32)
+    engine.submit(Request(0, prompt, max_new_tokens=24))
+    engine.submit(Request(1, prompt, max_new_tokens=4))
+    engine.step()  # 0 in flight, 1 still queued
+    results = engine.close()
+    assert results[0].finish_reason == "cancelled" and results[0].tokens
+    assert results[1].finish_reason == "cancelled" and not results[1].tokens
+    assert engine.closed and not engine.pending
+    with pytest.raises(EngineClosed):
+        engine.submit(Request(2, prompt, max_new_tokens=4))
+    assert engine.step() == []  # post-close step is a no-op
+    assert engine.close() is results or engine.close() == results  # idempotent
+
+
+@pytest.mark.faults
+def test_drain_finishes_everything_then_reopens():
+    model = _model()
+    rng = np.random.default_rng(17)
+    engine = ContinuousBatcher(model, num_slots=2, max_length=32, chunk_size=2)
+    prompt = rng.integers(1, 128, (4,)).astype(np.int32)
+    engine.submit(Request(0, prompt, max_new_tokens=4))
+    results = engine.drain()
+    assert results[0].finished and not engine.pending
+    # drain is a flush, not a shutdown: the engine takes new work afterwards
+    engine.submit(Request(1, prompt, max_new_tokens=4))
+    outputs = engine.run()
+    np.testing.assert_array_equal(outputs[1], _static_reference(model, prompt, 4))
+
+
+@pytest.mark.faults
+def test_mixed_adversarial_workload_engine_stays_up():
+    """The acceptance-criterion mix: well-formed, oversized, deadline-expiring
+    and cancelled requests together. Every well-formed request finishes with
+    token-identical greedy output, the stats ledger accounts for every request,
+    and the engine ends the run alive and empty."""
+    model = _model()
+    rng = np.random.default_rng(18)
+    engine = ContinuousBatcher(model, num_slots=2, max_length=32, chunk_size=2)
+    well_formed = {i: rng.integers(1, 128, (3 + i,)).astype(np.int32) for i in range(3)}
+    for i, p in well_formed.items():
+        engine.submit(Request(i, p, max_new_tokens=4))
+    with pytest.raises(ValueError, match="slot capacity"):  # oversized: rejected synchronously
+        engine.submit(Request(10, rng.integers(1, 128, (30,)).astype(np.int32), max_new_tokens=8))
+    engine.submit(Request(11, well_formed[0], max_new_tokens=8, deadline_s=0.0))  # expires
+    engine.submit(Request(12, well_formed[1], max_new_tokens=8))
+    engine.cancel(12)  # cancelled while queued
+    outputs = engine.run()
+    for i, p in well_formed.items():
+        np.testing.assert_array_equal(outputs[i], _static_reference(model, p, 4))
+    assert engine.results[11].finish_reason == "timeout"
+    assert engine.results[12].finish_reason == "cancelled"
+    reasons = engine.stats["finish_reasons"]
+    assert reasons["length"] == 3 and reasons["timeout"] == 1 and reasons["cancelled"] == 1
+    assert sum(reasons.values()) == len(engine.results)
+    assert engine.free_slots == engine.num_slots and not engine.pending and not engine.closed
+
+
 @pytest.mark.serving_soak
 def test_serving_soak_large_mixed_workload():
     """Soak: dozens of mixed requests through few slots; everything matches the
