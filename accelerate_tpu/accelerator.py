@@ -107,10 +107,22 @@ class Accelerator:
         rng_types: Optional[List[str]] = None,
         kwargs_handlers: Optional[List[KwargsHandler]] = None,
         step_scheduler_with_optimizer: bool = True,
+        analyze: bool = False,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
             self.project_configuration.set_directories(project_dir)
+
+        # analyze=True arms the runtime half of `accelerate analyze`: every
+        # train_step() built from this Accelerator is wrapped in a TraceGuard
+        # that (after a warmup allowance) raises when a steady-state step
+        # recompiles or makes a guarded host transfer. See docs/analysis.md.
+        self.analyze = bool(analyze)
+        self.trace_guard = None
+        if self.analyze:
+            from .analysis import TraceGuard
+
+            self.trace_guard = TraceGuard(name="train-step", on_violation="raise")
 
         if mixed_precision is not None:
             mixed_precision = str(mixed_precision)
@@ -657,7 +669,7 @@ class Accelerator:
         self._last_steps_per_call = steps_per_call
         if steps_per_call > 1 and self._schedulers:
             self._warn_scheduler_coarsened(steps_per_call)
-        return FusedTrainStep(
+        step = FusedTrainStep(
             model,
             optimizer,
             loss_fn=loss_fn,
@@ -666,6 +678,13 @@ class Accelerator:
             gradient_state=self.gradient_state,
             steps_per_call=steps_per_call,
         )
+        if self.trace_guard is not None:
+            # analyze mode: steady-state steps must neither recompile nor make
+            # guarded host transfers. warmup=2 because the first scheduler step
+            # installing an lr override legitimately rebuilds the with_lr
+            # program once (train_step.py's _jitted cache).
+            return self.trace_guard.wrap(step, warmup=2)
+        return step
 
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: int = 2, model=None):
         """Clip accumulated grads by global norm; no-op while accumulating

@@ -1,0 +1,51 @@
+"""TPU-hazard analysis: a static linter for the compile-once discipline plus a
+runtime trace guard that proves it holds on a live step.
+
+Two halves, one contract:
+
+  - **Static** (`rules`, `linter`, `runner`, `report`): pure-stdlib AST lint —
+    host syncs on traced values, recompile triggers, donation misuse, import-
+    time jit. Importing these never touches jax, so ``accelerate-tpu analyze``
+    runs on lint-only CI boxes with no accelerator stack.
+  - **Runtime** (`trace_guard`): `TraceGuard` counts jit cache misses per
+    executable and arms ``jax.transfer_guard`` around steady-state steps.
+    Imported lazily (via module ``__getattr__``) so the static half stays
+    jax-free.
+"""
+
+from .linter import analyze_source
+from .report import Finding, count_by_severity, render_json, render_text, worst_severity
+from .rules import RULES, RULES_BY_ID, RULES_BY_SLUG, SEVERITIES, Rule, resolve_rule, severity_at_least
+from .runner import analyze_paths, iter_python_files
+
+_LAZY_RUNTIME = ("TraceGuard", "TraceGuardViolation", "TraceReport")
+
+
+def __getattr__(name):
+    if name in _LAZY_RUNTIME:
+        from . import trace_guard
+
+        return getattr(trace_guard, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "Rule",
+    "RULES",
+    "RULES_BY_ID",
+    "RULES_BY_SLUG",
+    "SEVERITIES",
+    "Finding",
+    "analyze_source",
+    "analyze_paths",
+    "iter_python_files",
+    "count_by_severity",
+    "render_text",
+    "render_json",
+    "worst_severity",
+    "resolve_rule",
+    "severity_at_least",
+    "TraceGuard",
+    "TraceGuardViolation",
+    "TraceReport",
+]

@@ -1,0 +1,749 @@
+"""AST-based TPU-hazard linter (stdlib `ast` only — no jax import, so the lint
+runs on CI boxes with no accelerator stack).
+
+The pass is module-local and two-phase:
+
+  1. **Index**: resolve import aliases (``jax``, ``jnp``, ``np``, bare ``jit``/
+     ``pjit``), find every *jit root* — a function jitted by decorator, by a
+     ``jax.jit(fn)`` reference, or handed to ``jax.lax`` control flow — then
+     close over module-local calls and nested defs to get the **jit-reachable**
+     set. Code outside that set is host code, where ``np.asarray``/``float()``
+     at step boundaries is the sanctioned discipline, not a hazard.
+  2. **Check**: walk each function with per-rule detectors (see `rules.py` for
+     the catalog). Traced-value tracking is a deliberately simple fixpoint over
+     assignments: a function parameter or anything computed from ``jnp``/
+     ``jax`` calls is traced; ``.shape``/``.ndim``/``.dtype`` projections are
+     static and exempt.
+
+Suppressions: a ``# tpu-lint: disable=<rule-id>[,<rule-id>]`` comment on the
+flagged line drops those findings (``all`` drops every rule); a
+``# tpu-lint: disable-file=<rule-id>`` comment anywhere silences the rule for
+the whole file. Unknown tokens are ignored rather than fatal.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .report import Finding
+from .rules import resolve_rule
+
+#: Array methods whose *call* on a traced value yields a traced value that a
+#: Python branch would then implicitly bool() (``if x.any():``).
+ARRAY_TEST_METHODS = {"any", "all", "sum", "max", "min", "mean", "prod"}
+#: Static projections of an array — branching on these is shape-level Python
+#: and perfectly jit-safe.
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+#: ``jax.lax`` combinators whose function-valued arguments get traced.
+LAX_TRACED_FN_CONSUMERS = {
+    "scan", "while_loop", "fori_loop", "cond", "switch", "map", "associative_scan",
+}
+
+_SUPPRESS_LINE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+_SUPPRESS_FILE = re.compile(r"#\s*tpu-lint:\s*disable-file=([A-Za-z0-9_,\- ]+)")
+
+
+def _parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """-> ({line: {rule ids}}, {file-wide rule ids}); tokens resolve via id or
+    slug, ``all`` means every rule."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+
+    def resolve_tokens(blob: str) -> Set[str]:
+        out: Set[str] = set()
+        for token in blob.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            if token.lower() == "all":
+                out.add("all")
+                continue
+            rule = resolve_rule(token)
+            if rule is not None:
+                out.add(rule.id)
+        return out
+
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_FILE.search(line)
+        if m:
+            file_wide |= resolve_tokens(m.group(1))
+            continue
+        m = _SUPPRESS_LINE.search(line)
+        if m:
+            tokens = resolve_tokens(m.group(1))
+            per_line.setdefault(lineno, set()).update(tokens)
+            if line.strip().startswith("#"):
+                # A standalone suppression comment covers the next line too
+                # (the statement it annotates).
+                per_line.setdefault(lineno + 1, set()).update(tokens)
+    return per_line, file_wide
+
+
+class _ModuleIndex:
+    """Import aliases + function defs + the jit-reachable set for one module."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.jax_aliases: Set[str] = set()
+        self.jnp_aliases: Set[str] = set()
+        self.np_aliases: Set[str] = set()
+        self.lax_aliases: Set[str] = set()
+        self.jit_names: Set[str] = set()  # bare names bound to jax.jit / pjit
+        self.pjit_names: Set[str] = set()
+        self.partial_names: Set[str] = set()
+        self.defs_by_name: Dict[str, List[ast.AST]] = {}
+        self.jit_calls: List[ast.Call] = []  # every jax.jit / pjit invocation
+
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                child._tpu_parent = parent  # type: ignore[attr-defined]
+
+        self._collect_imports()
+        self._collect_defs()
+        self.jit_roots = self._find_jit_roots()
+        self.reachable = self._close_reachability(self.jit_roots)
+
+    # -- indexing ---------------------------------------------------------------
+    def _collect_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name, bound = alias.name, alias.asname or alias.name.split(".")[0]
+                    if name == "jax":
+                        self.jax_aliases.add(bound)
+                    elif name in ("jax.numpy",):
+                        self.jnp_aliases.add(alias.asname or "jax")
+                    elif name in ("numpy",):
+                        self.np_aliases.add(bound)
+                    elif name == "functools":
+                        pass
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if mod == "jax" and alias.name == "numpy":
+                        self.jnp_aliases.add(bound)
+                    elif mod == "jax" and alias.name == "jit":
+                        self.jit_names.add(bound)
+                    elif mod == "jax" and alias.name == "lax":
+                        self.lax_aliases.add(bound)
+                    elif alias.name == "pjit" and "pjit" in mod:
+                        self.pjit_names.add(bound)
+                    elif mod == "functools" and alias.name == "partial":
+                        self.partial_names.add(bound)
+        # Conventional fallbacks: most sources spell these jnp/np even when the
+        # import is renamed out of our sight (e.g. injected globals in fixtures).
+        self.jnp_aliases.add("jnp")
+        self.np_aliases.add("np")
+
+    def _collect_defs(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+
+    # -- alias predicates -------------------------------------------------------
+    def _attr_root(self, node: ast.AST) -> Optional[List[str]]:
+        """Attribute/Name chain -> ['jax', 'lax', 'scan'] (None if not a chain)."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return list(reversed(parts))
+        return None
+
+    def is_jit_func(self, node: ast.AST) -> bool:
+        """Does this expression denote jax.jit (or pjit)?"""
+        chain = self._attr_root(node)
+        if chain is None:
+            return False
+        if len(chain) == 1:
+            return chain[0] in self.jit_names or chain[0] in self.pjit_names
+        if chain[0] in self.jax_aliases and chain[-1] in ("jit", "pjit"):
+            return True
+        return chain[-1] == "pjit"  # pjit.pjit / experimental chains
+
+    def is_pjit_func(self, node: ast.AST) -> bool:
+        chain = self._attr_root(node)
+        if chain is None:
+            return False
+        return chain[-1] == "pjit" or (len(chain) == 1 and chain[0] in self.pjit_names)
+
+    def is_jnp_rooted(self, node: ast.AST) -> bool:
+        chain = self._attr_root(node)
+        return bool(chain) and (chain[0] in self.jnp_aliases or chain[0] in self.jax_aliases or chain[0] in self.lax_aliases)
+
+    def is_np_rooted(self, node: ast.AST) -> bool:
+        chain = self._attr_root(node)
+        return bool(chain) and chain[0] in self.np_aliases
+
+    # -- jit roots & reachability ----------------------------------------------
+    def _jit_target_of_call(self, call: ast.Call) -> Optional[str]:
+        """`jax.jit(fn, ...)` / `partial(jax.jit, ...)` -> 'fn' when it's a bare
+        Name that resolves to a module-local def."""
+        func = call.func
+        is_jit = self.is_jit_func(func)
+        if not is_jit and isinstance(func, ast.Call):
+            # partial(jax.jit, ...) applied later — the partial call IS the jit.
+            inner = func
+            if (
+                isinstance(inner.func, ast.Name)
+                and inner.func.id in self.partial_names
+                and inner.args
+                and self.is_jit_func(inner.args[0])
+            ):
+                is_jit = True
+        if not is_jit:
+            return None
+        if call.args and isinstance(call.args[0], ast.Name):
+            return call.args[0].id
+        return None
+
+    def _find_jit_roots(self) -> Set[ast.AST]:
+        roots: Set[ast.AST] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self.is_jit_func(dec):
+                        roots.add(node)
+                    elif isinstance(dec, ast.Call):
+                        if self.is_jit_func(dec.func):
+                            roots.add(node)
+                        elif (
+                            isinstance(dec.func, ast.Name)
+                            and dec.func.id in self.partial_names
+                            and dec.args
+                            and self.is_jit_func(dec.args[0])
+                        ):
+                            roots.add(node)
+            elif isinstance(node, ast.Call):
+                if self.is_jit_func(node.func) or (
+                    isinstance(node.func, ast.Call) and self._jit_target_of_call(node) is not None
+                ):
+                    self.jit_calls.append(node)
+                    target = self._jit_target_of_call(node)
+                    if target and target in self.defs_by_name:
+                        roots.update(self.defs_by_name[target])
+                else:
+                    chain = self._attr_root(node.func)
+                    if (
+                        chain
+                        and chain[-1] in LAX_TRACED_FN_CONSUMERS
+                        and (chain[0] in self.jax_aliases or chain[0] in self.lax_aliases)
+                    ):
+                        for arg in node.args:
+                            if isinstance(arg, ast.Name) and arg.id in self.defs_by_name:
+                                roots.update(self.defs_by_name[arg.id])
+        return roots
+
+    def _close_reachability(self, roots: Set[ast.AST]) -> Set[ast.AST]:
+        """Roots + nested defs + module-local functions they call, to fixpoint."""
+        reachable = set(roots)
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            for node in ast.walk(fn):
+                new: List[ast.AST] = []
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                    new.append(node)
+                elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    new.extend(self.defs_by_name.get(node.func.id, ()))
+                for cand in new:
+                    if cand not in reachable:
+                        reachable.add(cand)
+                        frontier.append(cand)
+        return reachable
+
+
+def _enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    cur = getattr(node, "_tpu_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = getattr(cur, "_tpu_parent", None)
+    return None
+
+
+def _enclosing_loop(node: ast.AST, stop_at: Optional[ast.AST] = None) -> Optional[ast.AST]:
+    cur = getattr(node, "_tpu_parent", None)
+    while cur is not None and cur is not stop_at:
+        if isinstance(cur, (ast.For, ast.While)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None  # a nested def is a new host frame, not "inside the loop"
+        cur = getattr(cur, "_tpu_parent", None)
+    return None
+
+
+#: Annotation spellings that declare a parameter host-static: a `use_scaler:
+#: bool` or `k: int` param is a trace-time constant, not a traced array.
+_STATIC_ANNOTATION = re.compile(
+    r"^(?:typing\.)?(?:Optional\[)?(?:bool|int|float|str|bytes)\]?$"
+)
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = []
+    for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+        if p.annotation is not None:
+            try:
+                if _STATIC_ANNOTATION.match(ast.unparse(p.annotation)):
+                    continue
+            except Exception:  # noqa: BLE001 — exotic annotation, assume traced
+                pass
+        names.append(p.arg)
+    if a.vararg:
+        names.append(a.vararg.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+class _FunctionChecker:
+    """Per-function rule evaluation. `jit_reachable` switches between the
+    traced-code rule set (TPU101-104) and the host-loop rule (TPU111)."""
+
+    def __init__(self, index: _ModuleIndex, fn: ast.AST, path: str):
+        self.index = index
+        self.fn = fn
+        self.path = path
+        self.findings: List[Finding] = []
+        self.traced: Set[str] = _param_names(fn)
+        self._infer_traced_locals()
+
+    def emit(self, node: ast.AST, rule_id: str, message: str):
+        self.findings.append(
+            Finding(self.path, node.lineno, node.col_offset, rule_id, message)
+        )
+
+    # -- traced-name inference --------------------------------------------------
+    def _direct_statements(self):
+        """Statements belonging to this function, excluding nested defs (their
+        params are their own frame's business)."""
+        for node in ast.walk(self.fn):
+            owner = _enclosing_function(node) if node is not self.fn else self.fn
+            if owner is self.fn:
+                yield node
+
+    def _infer_traced_locals(self):
+        for _ in range(2):  # tiny fixpoint: handles one level of chained assigns
+            for node in self._direct_statements():
+                if isinstance(node, ast.Assign) and self._is_traced_expr(node.value):
+                    for tgt in node.targets:
+                        for name in ast.walk(tgt):
+                            if isinstance(name, ast.Name):
+                                self.traced.add(name.id)
+
+    def _is_traced_expr(self, node: ast.AST) -> bool:
+        """Does evaluating this expression yield (or require syncing) a traced
+        array? Static projections (.shape and friends), `is None` tests and
+        len() stay host-side."""
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return False  # plain attribute access (config.do_sample) is host data
+        if isinstance(node, ast.Subscript):
+            return self._is_traced_expr(node.value)
+        if isinstance(node, ast.Call):
+            func = node.func
+            if self.index.is_jnp_rooted(func):
+                return True
+            if isinstance(func, ast.Attribute) and func.attr in ARRAY_TEST_METHODS:
+                return self._is_traced_expr(func.value)
+            return False
+        if isinstance(node, ast.UnaryOp):
+            return self._is_traced_expr(node.operand)
+        if isinstance(node, ast.BinOp):
+            return self._is_traced_expr(node.left) or self._is_traced_expr(node.right)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_traced_expr(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if any(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self._is_traced_expr(node.left) or any(
+                self._is_traced_expr(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return self._is_traced_expr(node.body) or self._is_traced_expr(node.orelse)
+        return False
+
+    # -- jit-reachable rules ----------------------------------------------------
+    def check_traced_rules(self):
+        for node in self._direct_statements():
+            if isinstance(node, ast.Call):
+                self._check_item(node)
+                self._check_scalar_cast(node)
+                self._check_numpy_transfer(node)
+            elif isinstance(node, (ast.If, ast.While)):
+                if self._is_traced_expr(node.test):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    self.emit(
+                        node,
+                        "TPU104",
+                        f"`{kind}` on a traced value implicitly calls bool() — a "
+                        "host sync that fails under jit; use jnp.where/lax.cond",
+                    )
+
+    def _check_item(self, node: ast.Call):
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item" and not node.args:
+            self.emit(
+                node,
+                "TPU101",
+                ".item() inside jit-reachable code syncs the device and fails "
+                "under tracing",
+            )
+
+    def _check_scalar_cast(self, node: ast.Call):
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int", "bool")
+            and len(node.args) == 1
+            and self._is_traced_expr(node.args[0])
+        ):
+            self.emit(
+                node,
+                "TPU102",
+                f"{node.func.id}() on a traced value is a host sync (and a "
+                "TracerConversionError under jit)",
+            )
+
+    def _check_numpy_transfer(self, node: ast.Call):
+        func = node.func
+        chain = self.index._attr_root(func)
+        if chain is None:
+            return
+        if (
+            len(chain) >= 2
+            and chain[0] in self.index.np_aliases
+            and chain[-1] in ("asarray", "array")
+            and node.args
+            and self._is_traced_expr(node.args[0])
+        ):
+            self.emit(
+                node,
+                "TPU103",
+                f"{'.'.join(chain)}() on a traced value forces a device-to-host "
+                "copy inside the program",
+            )
+        elif chain[0] in self.index.jax_aliases and chain[-1] == "device_get":
+            self.emit(
+                node,
+                "TPU103",
+                "jax.device_get inside jit-reachable code is a host transfer; "
+                "return the value and read it at the step boundary",
+            )
+
+    # -- host-side rules --------------------------------------------------------
+    def check_host_loop_syncs(self):
+        """TPU111: float()/.item() on a value produced by a call in the SAME
+        loop — the per-step logging sync that serializes dispatch."""
+        for loop in self._direct_statements():
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            stepped: Set[str] = set()
+            for node in ast.walk(loop):
+                if _enclosing_loop(node, stop_at=self.fn) is not loop:
+                    continue
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    for tgt in node.targets:
+                        for name in ast.walk(tgt):
+                            if isinstance(name, ast.Name):
+                                stepped.add(name.id)
+            for node in ast.walk(loop):
+                if _enclosing_loop(node, stop_at=self.fn) is not loop:
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                synced = None
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id == "float"
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in stepped
+                ):
+                    synced = f"float({node.args[0].id})"
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in stepped
+                ):
+                    synced = f"{node.func.value.id}.item()"
+                if synced:
+                    self.emit(
+                        node,
+                        "TPU111",
+                        f"{synced} every loop iteration blocks on the device; "
+                        "accumulate on device and read once per epoch",
+                    )
+
+
+class _ModuleChecker:
+    """Module-scope rules: jit-in-loop, static_argnums misuse, donated reuse,
+    import-time jit, pjit annotations, closure scalar capture."""
+
+    def __init__(self, index: _ModuleIndex, path: str):
+        self.index = index
+        self.path = path
+        self.findings: List[Finding] = []
+
+    def emit(self, node: ast.AST, rule_id: str, message: str):
+        self.findings.append(
+            Finding(self.path, node.lineno, node.col_offset, rule_id, message)
+        )
+
+    def run(self):
+        self._check_jit_placement()
+        self._check_pjit_annotations()
+        self._check_static_argnums_and_donation()
+        self._check_closure_capture()
+        return self.findings
+
+    def _check_jit_placement(self):
+        for call in self.index.jit_calls:
+            loop = _enclosing_loop(call)
+            if loop is not None:
+                self.emit(
+                    call,
+                    "TPU106",
+                    "jax.jit inside a loop builds a fresh executable cache every "
+                    "iteration — hoist it out of the loop",
+                )
+            elif _enclosing_function(call) is None:
+                self.emit(
+                    call,
+                    "TPU109",
+                    "jax.jit at module scope runs at import time (traces/compiles "
+                    "on import); construct it lazily",
+                )
+
+    def _check_pjit_annotations(self):
+        for call in self.index.jit_calls:
+            if not self.index.is_pjit_func(call.func):
+                continue
+            kwargs = {kw.arg for kw in call.keywords if kw.arg}
+            if not kwargs & {"in_shardings", "out_shardings", "in_axis_resources", "out_axis_resources"}:
+                self.emit(
+                    call,
+                    "TPU110",
+                    "pjit without in_shardings/out_shardings replicates every "
+                    "operand — annotate the partitioning explicitly",
+                )
+
+    # -- static_argnums over loop-varying values + donated-buffer reuse ---------
+    @staticmethod
+    def _literal_argnums(call: ast.Call, kwarg: str) -> Optional[Tuple[int, ...]]:
+        for kw in call.keywords:
+            if kw.arg != kwarg:
+                continue
+            try:
+                value = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return None
+            if isinstance(value, int):
+                return (value,)
+            if isinstance(value, (tuple, list)) and all(isinstance(v, int) for v in value):
+                return tuple(value)
+        return None
+
+    @staticmethod
+    def _owned_by(node: ast.AST, scope: ast.AST) -> bool:
+        """Does `node` belong to `scope`'s own frame (not a nested function's)?"""
+        owner = _enclosing_function(node)
+        return owner is scope or (owner is None and isinstance(scope, ast.Module))
+
+    def _jitted_bindings(self, scope: ast.AST) -> Dict[str, ast.Call]:
+        """`f = jax.jit(g, ...)` assignments directly inside `scope`'s frame."""
+        out: Dict[str, ast.Call] = {}
+        for node in ast.walk(scope):
+            if (
+                isinstance(node, ast.Assign)
+                and self._owned_by(node, scope)
+                and isinstance(node.value, ast.Call)
+                and node.value in self.index.jit_calls
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                out[node.targets[0].id] = node.value
+        return out
+
+    def _scopes(self):
+        yield self.index.tree
+        for node in ast.walk(self.index.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def _check_static_argnums_and_donation(self):
+        for scope in self._scopes():
+            bindings = self._jitted_bindings(scope)
+            if not bindings:
+                continue
+            static = {
+                name: nums
+                for name, call in bindings.items()
+                if (nums := self._literal_argnums(call, "static_argnums")) is not None
+            }
+            donated = {
+                name: nums
+                for name, call in bindings.items()
+                if (nums := self._literal_argnums(call, "donate_argnums")) is not None
+            }
+            for node in ast.walk(scope):
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+                    continue
+                if not self._owned_by(node, scope):
+                    continue
+                name = node.func.id
+                if name in static:
+                    loop = _enclosing_loop(node)
+                    if loop is not None:
+                        loop_vars = {
+                            n.id
+                            for n in ast.walk(loop.target)
+                            if isinstance(n, ast.Name)
+                        } if isinstance(loop, ast.For) else set()
+                        for pos in static[name]:
+                            if pos < len(node.args) and any(
+                                isinstance(n, ast.Name) and n.id in loop_vars
+                                for n in ast.walk(node.args[pos])
+                            ):
+                                self.emit(
+                                    node,
+                                    "TPU107",
+                                    f"static_argnums position {pos} of `{name}` is fed "
+                                    "the loop variable — every iteration recompiles",
+                                )
+                if name in donated:
+                    self._check_donated_reuse(scope, node, donated[name])
+
+    def _check_donated_reuse(self, scope: ast.AST, call: ast.Call, positions: Sequence[int]):
+        donated_names = {
+            call.args[p].id
+            for p in positions
+            if p < len(call.args) and isinstance(call.args[p], ast.Name)
+        }
+        if not donated_names:
+            return
+        call_line = call.lineno
+        rebound: Set[str] = set()
+        in_call = {id(n) for n in ast.walk(call)}  # the donation site itself
+        for node in sorted(
+            (
+                n
+                for n in ast.walk(scope)
+                if hasattr(n, "lineno") and n.lineno >= call_line and id(n) not in in_call
+                # Same frame only: a nested function's own `params` is a fresh
+                # binding, not the donated buffer (and must neither be flagged
+                # nor mask a real reuse as a rebind).
+                and self._owned_by(n, scope)
+            ),
+            key=lambda n: (n.lineno, n.col_offset),
+        ):
+            if isinstance(node, ast.Name) and node.id in donated_names:
+                parent = getattr(node, "_tpu_parent", None)
+                if (
+                    isinstance(parent, ast.Attribute)
+                    and parent.value is node
+                    and parent.attr in STATIC_ATTRS
+                ):
+                    continue  # .shape/.dtype metadata stays valid after donation
+                if isinstance(node.ctx, ast.Store):
+                    rebound.add(node.id)
+                elif isinstance(node.ctx, ast.Load) and node.id not in rebound:
+                    self.emit(
+                        node,
+                        "TPU108",
+                        f"`{node.id}` was donated to the jitted call on line "
+                        f"{call_line}; its buffer is invalidated — rebind it to "
+                        "the call's output",
+                    )
+                    rebound.add(node.id)  # one finding per name is enough
+
+    # -- closure scalar capture -------------------------------------------------
+    def _check_closure_capture(self):
+        for root in self.index.jit_roots:
+            enclosing = _enclosing_function(root)
+            if enclosing is None:
+                continue
+            scalar_locals: Set[str] = set()
+            for node in ast.walk(enclosing):
+                if _enclosing_function(node) is not enclosing:
+                    continue
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+                    if isinstance(node.value.value, (int, float)) and not isinstance(
+                        node.value.value, bool
+                    ):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                scalar_locals.add(tgt.id)
+                elif (
+                    isinstance(node, ast.AugAssign)
+                    and isinstance(node.target, ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, (int, float))
+                ):
+                    # `i += 1`-style counters are Python scalars; `acc += x`
+                    # may well be a traced array accumulator — don't flag it.
+                    scalar_locals.add(node.target.id)
+            if not scalar_locals:
+                continue
+            local = _param_names(root)
+            for node in ast.walk(root):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in scalar_locals
+                    and node.id not in local
+                ):
+                    self.emit(
+                        node,
+                        "TPU105",
+                        f"`{node.id}` is a Python scalar captured from the enclosing "
+                        "scope — it is baked in at trace time; pass it as an operand",
+                    )
+                    scalar_locals.discard(node.id)  # once per name per root
+
+
+def analyze_source(source: str, path: str = "<string>") -> List[Finding]:
+    """Lint one Python source. Returns findings with suppressions applied.
+    Unparseable sources return no findings (a syntax error is the Python
+    toolchain's job, not this linter's) — they still count as scanned."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []  # unparseable files are skipped (not this linter's concern)
+
+    index = _ModuleIndex(tree)
+    findings: List[Finding] = []
+
+    seen: Set[int] = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        checker = _FunctionChecker(index, fn, path)
+        if fn in index.reachable:
+            checker.check_traced_rules()
+        else:
+            checker.check_host_loop_syncs()
+        findings.extend(checker.findings)
+
+    findings.extend(_ModuleChecker(index, path).run())
+
+    per_line, file_wide = _parse_suppressions(source)
+    kept: List[Finding] = []
+    for f in findings:
+        if "all" in file_wide or f.rule_id in file_wide:
+            continue
+        line_rules = per_line.get(f.line, set())
+        if "all" in line_rules or f.rule_id in line_rules:
+            continue
+        kept.append(f)
+    return kept

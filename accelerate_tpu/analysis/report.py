@@ -1,0 +1,90 @@
+"""Findings and their renderings (text for humans, JSON for CI).
+
+Kept free of any jax import: `accelerate-tpu analyze` must run on a machine
+with no accelerator stack at all (pre-merge CI lint boxes).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Sequence
+
+from .rules import RULES_BY_ID, SEVERITIES, severity_at_least
+
+#: Schema version stamped into --json output so downstream consumers can detect
+#: format drift.
+JSON_VERSION = 1
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One hazard at one source location."""
+
+    file: str
+    line: int
+    col: int
+    rule_id: str
+    message: str
+
+    @property
+    def rule(self):
+        return RULES_BY_ID[self.rule_id]
+
+    @property
+    def severity(self) -> str:
+        return self.rule.severity
+
+    def to_dict(self) -> Dict:
+        return {
+            "file": self.file,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule_id,
+            "slug": self.rule.slug,
+            "severity": self.severity,
+            "message": self.message,
+            "fixit": self.rule.fixit,
+        }
+
+
+def count_by_severity(findings: Sequence[Finding]) -> Dict[str, int]:
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        counts[f.severity] += 1
+    return counts
+
+
+def worst_severity(findings: Sequence[Finding]):
+    worst = None
+    for f in findings:
+        if worst is None or severity_at_least(f.severity, worst):
+            worst = f.severity
+    return worst
+
+
+def render_text(findings: Sequence[Finding], files_scanned: int) -> str:
+    """Compiler-style one-line-per-finding report plus a summary footer."""
+    lines: List[str] = []
+    for f in sorted(findings, key=lambda f: (f.file, f.line, f.col, f.rule_id)):
+        lines.append(f"{f.file}:{f.line}:{f.col}: {f.severity} {f.rule_id} [{f.rule.slug}] {f.message}")
+        lines.append(f"    fixit: {f.rule.fixit}")
+    counts = count_by_severity(findings)
+    lines.append(
+        f"{files_scanned} file(s) scanned: "
+        f"{counts['error']} error(s), {counts['warn']} warning(s), {counts['info']} info"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding], files_scanned: int) -> str:
+    payload = {
+        "version": JSON_VERSION,
+        "files_scanned": files_scanned,
+        "counts": count_by_severity(findings),
+        "findings": [
+            f.to_dict()
+            for f in sorted(findings, key=lambda f: (f.file, f.line, f.col, f.rule_id))
+        ],
+    }
+    return json.dumps(payload, indent=2)

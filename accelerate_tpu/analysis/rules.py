@@ -1,0 +1,155 @@
+"""The TPU-hazard rule registry.
+
+Each rule names one mechanical way the repo's compile-once discipline breaks:
+a host sync on a traced value, a recompile trigger, a donation misuse, or an
+in-repo convention violation. Rules are data (`Rule`), detection lives in
+`linter.py` — the registry is what the CLI catalog, the docs table, and the
+suppression parser all key on.
+
+Severity ladder:
+  - ``error``  — breaks the discipline outright (host sync inside a jitted
+    program, donated buffer reused): CI fails on these (`--fail-on error`).
+  - ``warn``   — a recompile / throughput hazard that has legitimate uses
+    (module-level jit in a script, a per-step ``float(loss)`` for logging);
+    reviewers decide, ``--fail-on warn`` opts a tree into strictness.
+  - ``info``   — style-level observations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+#: Ordered severities, weakest first. Comparisons use list position.
+SEVERITIES = ("info", "warn", "error")
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One linter rule: a stable id (``TPU1xx``), a short slug used in
+    suppression comments (`# tpu-lint: disable=<id or slug>`), the severity it
+    reports at, and a fixit hint rendered with every finding."""
+
+    id: str
+    slug: str
+    severity: str
+    summary: str
+    fixit: str
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {self.severity!r} for rule {self.id}")
+
+
+RULES = (
+    Rule(
+        id="TPU101",
+        slug="host-sync-item",
+        severity="error",
+        summary=".item() on a traced value inside jit-reachable code",
+        fixit="keep the value on device (jnp ops) or return it from the jitted "
+        "program and read it at the step boundary",
+    ),
+    Rule(
+        id="TPU102",
+        slug="host-scalar-cast",
+        severity="error",
+        summary="float()/int()/bool() on a traced array inside jit-reachable code",
+        fixit="use jnp.float32(x)/x.astype(...) to stay traced; host casts force "
+        "a device sync and fail under jit",
+    ),
+    Rule(
+        id="TPU103",
+        slug="host-transfer-numpy",
+        severity="error",
+        summary="np.asarray/np.array/jax.device_get on a traced value inside "
+        "jit-reachable code",
+        fixit="use jnp equivalents inside the program; device_get/np conversion "
+        "belongs at the step boundary",
+    ),
+    Rule(
+        id="TPU104",
+        slug="traced-bool-branch",
+        severity="error",
+        summary="Python if/while on a traced array (implicit bool()) inside "
+        "jit-reachable code",
+        fixit="branch with jnp.where / jax.lax.cond / jax.lax.select; Python "
+        "control flow on traced values raises TracerBoolConversionError",
+    ),
+    Rule(
+        id="TPU105",
+        slug="closure-scalar-capture",
+        severity="warn",
+        summary="Python scalar from an enclosing scope captured by a jitted "
+        "closure (baked in at trace time)",
+        fixit="pass the scalar as an operand (jnp.float32(x) argument) so "
+        "changing it never recompiles; closure captures are compile-time "
+        "constants",
+    ),
+    Rule(
+        id="TPU106",
+        slug="jit-in-loop",
+        severity="warn",
+        summary="jax.jit(...) called inside a loop body (fresh cache per "
+        "iteration)",
+        fixit="hoist the jax.jit call out of the loop (or memoize per static "
+        "key) so the executable cache survives iterations",
+    ),
+    Rule(
+        id="TPU107",
+        slug="static-argnums-varying",
+        severity="error",
+        summary="a static_argnums position fed a loop-varying value (recompile "
+        "every iteration)",
+        fixit="pass per-step values as traced operands; reserve static_argnums "
+        "for genuinely constant configuration",
+    ),
+    Rule(
+        id="TPU108",
+        slug="donated-reuse",
+        severity="error",
+        summary="an argument donated via donate_argnums is read again after "
+        "the call",
+        fixit="rebind the name to the call's output (the donated buffer is "
+        "invalidated in place) or drop the donation",
+    ),
+    Rule(
+        id="TPU109",
+        slug="module-level-jit",
+        severity="warn",
+        summary="jax.jit invoked at module import time",
+        fixit="build jitted callables lazily (inside a function/class) so "
+        "importing the module never traces or touches a backend",
+    ),
+    Rule(
+        id="TPU110",
+        slug="pjit-no-sharding",
+        severity="warn",
+        summary="pjit without in_shardings/out_shardings annotations",
+        fixit="annotate shardings explicitly (or use jax.jit + "
+        "with_sharding_constraint); unannotated pjit silently replicates",
+    ),
+    Rule(
+        id="TPU111",
+        slug="loop-host-sync",
+        severity="warn",
+        summary="per-iteration host sync (float()/.item()) on a stepped value "
+        "inside a host loop",
+        fixit="accumulate on device and read once at the epoch/loop boundary; "
+        "a per-step sync serializes dispatch against the device",
+    ),
+)
+
+RULES_BY_ID = {r.id: r for r in RULES}
+RULES_BY_SLUG = {r.slug: r for r in RULES}
+
+
+def resolve_rule(token: str):
+    """A suppression/CLI token -> Rule, accepting either the id or the slug
+    (case-insensitive). Returns None for unknown tokens — suppressions never
+    crash a lint run."""
+    token = token.strip()
+    return RULES_BY_ID.get(token.upper()) or RULES_BY_SLUG.get(token.lower())
+
+
+def severity_at_least(severity: str, floor: str) -> bool:
+    return SEVERITIES.index(severity) >= SEVERITIES.index(floor)

@@ -1,0 +1,45 @@
+"""Filesystem front-end for the linter: expand paths, lint every ``.py`` file,
+aggregate findings. No jax import — `accelerate-tpu analyze` stays runnable on
+lint-only CI boxes."""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Sequence, Tuple
+
+from .linter import analyze_source
+from .report import Finding
+
+#: Directory names never worth descending into.
+SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", ".venv", "node_modules", "build", "dist"}
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            raise FileNotFoundError(f"no such file or directory: {path}")
+
+
+def analyze_paths(paths: Sequence[str]) -> Tuple[List[Finding], int]:
+    """Lint every Python file under `paths` -> (findings, files_scanned).
+    Unreadable/undecodable files are skipped (count still reflects scanned)."""
+    findings: List[Finding] = []
+    scanned = 0
+    for file_path in iter_python_files(paths):
+        try:
+            with open(file_path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        scanned += 1
+        findings.extend(analyze_source(source, file_path))
+    return findings, scanned

@@ -1,0 +1,77 @@
+"""`accelerate-tpu analyze` — static TPU-hazard lint over Python trees.
+
+Scans the given files/directories with the `analysis` linter (pure stdlib
+``ast`` — no backend is ever initialized, so this runs offline on CPU-only
+lint boxes) and reports findings as compiler-style text or ``--json``.
+
+Exit codes (the CI contract):
+  0 — no findings at or above the ``--fail-on`` threshold
+  1 — at least one finding at/above the threshold
+  2 — usage error (bad path, bad threshold)
+
+`--fail-on error` (the default) gates only on discipline breaks; `--fail-on
+warn` additionally fails on recompile/throughput hazards.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "analyze",
+        help="Statically lint Python sources for TPU hazards (host syncs, recompile triggers)",
+        description=__doc__,
+    )
+    parser.add_argument(
+        "paths",
+        nargs="+",
+        help="Files or directories to scan (directories are walked for *.py)",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        dest="as_json",
+        help="Emit a machine-readable JSON report on stdout",
+    )
+    parser.add_argument(
+        "--fail-on",
+        default="error",
+        choices=("warn", "error"),
+        help="Exit 1 when any finding at/above this severity exists (default: error)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="Print the rule catalog (id, slug, severity, summary) and exit",
+    )
+    parser.set_defaults(func=analyze_command)
+    return parser
+
+
+def analyze_command(args):
+    # The static half only — never import the trace-guard (and with it jax's
+    # runtime machinery) on the lint path.
+    from ..analysis.report import count_by_severity, render_json, render_text
+    from ..analysis.rules import RULES, severity_at_least
+    from ..analysis.runner import analyze_paths
+
+    if args.list_rules:
+        for rule in RULES:
+            print(f"{rule.id}  {rule.slug:<24} {rule.severity:<5} {rule.summary}")
+        raise SystemExit(0)
+
+    try:
+        findings, scanned = analyze_paths(args.paths)
+    except FileNotFoundError as exc:
+        print(f"accelerate-tpu analyze: {exc}", file=sys.stderr)
+        raise SystemExit(2)
+
+    if args.as_json:
+        print(render_json(findings, scanned))
+    else:
+        print(render_text(findings, scanned))
+
+    failing = [f for f in findings if severity_at_least(f.severity, args.fail_on)]
+    raise SystemExit(1 if failing else 0)

@@ -92,6 +92,31 @@ def _bucket_for(max_new: int) -> int:
     return 1 << (max_new - 1).bit_length()  # next power of two >= max_new
 
 
+def _operand(value, dtype):
+    """Explicit host-to-device push of a scalar/array operand. The numpy hop
+    matters: `jnp.asarray(python_scalar)` (and eager jnp ops on Python
+    constants) are IMPLICIT transfers that an armed `jax.transfer_guard`
+    ("disallow") rejects, while `jnp.asarray(np.ndarray)` is explicit — the
+    sanctioned step-boundary pattern, with a strong dtype so jit signatures
+    never drift."""
+    return jnp.asarray(np.asarray(value, dtype))
+
+
+_DEFAULT_RNG = None
+
+
+def _default_rng():
+    """The rng=None default key, built once and reused: `jax.random.key(0)`
+    per call is an implicit host-to-device push that (a) costs a transfer per
+    generate() and (b) trips an armed TraceGuard. Key values are immutable
+    (consumers split, never mutate), so sharing is semantically identical to a
+    fresh key(0) each call. Built lazily — never at import time (TPU109)."""
+    global _DEFAULT_RNG
+    if _DEFAULT_RNG is None:
+        _DEFAULT_RNG = jax.random.key(0)
+    return _DEFAULT_RNG
+
+
 def _params_resolver(model):
     """params -> params preprocessing for the compiled programs. Quantized bundles
     (load_and_quantize_model) carry QuantTensor leaves that the raw flax module
@@ -261,8 +286,12 @@ class Generator:
         persistent pad mask."""
         config = generation_config or GenerationConfig(**kwargs)
         if rng is None:
-            rng = jax.random.key(0)
-        input_ids = jnp.asarray(input_ids, jnp.int32)
+            rng = _default_rng()
+        # Host copy first (free for numpy/list inputs, one explicit drain for
+        # device inputs): the host tail concatenates against it, so the prompt
+        # matrix is never drained a second time after decode.
+        ids_host = np.asarray(input_ids, np.int32)
+        input_ids = jnp.asarray(ids_host)
         b, prompt_len = input_ids.shape
         max_new = min(config.max_new_tokens, self.max_length - prompt_len)
         if max_new <= 0:
@@ -270,29 +299,39 @@ class Generator:
                 f"Prompt length {prompt_len} leaves no room in the {self.max_length}-token cache"
             )
         if attention_mask is not None:
-            am = jnp.asarray(attention_mask, jnp.int32)
-            if am.ndim != 2 or am.shape != input_ids.shape:
+            # Validate on the HOST copy: an implicit bool() on a device value
+            # would trip jax.transfer_guard("disallow") when a TraceGuard is
+            # armed around this call (np.asarray is an explicit, sanctioned
+            # step-boundary read).
+            am_host = np.asarray(attention_mask)
+            if am_host.ndim != 2 or am_host.shape != input_ids.shape:
                 raise ValueError(
                     f"attention_mask must be [batch, prompt_len] matching input_ids "
-                    f"{input_ids.shape}, got {am.shape}"
+                    f"{input_ids.shape}, got {am_host.shape}"
                 )
             # LEFT padding only (prefill samples from the LAST slot's logits and
             # decode continues at each row's real length): a right-padded batch
             # would silently continue from a pad token's logits.
-            if not bool(jnp.all(am[:, -1] == 1)):
+            if not (am_host[:, -1] == 1).all():
                 raise ValueError(
                     "attention_mask looks right-padded (a row's last slot is 0); "
                     "Generator uses the HF LEFT-padding convention — put pads at "
                     "the START of each row"
                 )
-            positions = jnp.clip(jnp.cumsum(am, axis=-1) - 1, 0)
+            am = jnp.asarray(am_host.astype(np.int32))
+            # Host-side (numpy) position prep + ONE explicit push each: eager
+            # jnp ops here would implicitly transfer their Python constants on
+            # every call (and trip an armed TraceGuard).
+            positions = _operand(np.clip(np.cumsum(am_host, axis=-1) - 1, 0, None), np.int32)
             # Per-row LOGICAL position base for decode: row with r real tokens
             # continues at position r (physical cache slots stay uniform).
-            next_positions = am.sum(-1).astype(jnp.int32)
+            next_positions = _operand(am_host.sum(-1), np.int32)
             prefill_args = (input_ids, positions, am)
         else:
-            positions = jnp.broadcast_to(jnp.arange(prompt_len)[None, :], (b, prompt_len))
-            next_positions = jnp.full((b,), prompt_len, jnp.int32)
+            positions = _operand(
+                np.broadcast_to(np.arange(prompt_len)[None, :], (b, prompt_len)), np.int32
+            )
+            next_positions = _operand(np.full((b,), prompt_len), np.int32)
             prefill_args = (input_ids, positions)
         presence = None
         if config.repetition_penalty != 1.0:
@@ -315,14 +354,19 @@ class Generator:
             cache,
             logits,
             next_positions,
-            jnp.int32(max_new),
-            jnp.float32(config.temperature),
-            jnp.float32(config.repetition_penalty),
+            _operand(max_new, np.int32),
+            _operand(config.temperature, np.float32),
+            _operand(config.repetition_penalty, np.float32),
             rng,
             presence,
         )
-        generated = _trim_at_eos(generated[:, :max_new], config.eos_token_id, max_new)
-        return jnp.concatenate([input_ids, generated], axis=1)
+        # Host tail entirely in numpy: even a static eager slice on a device
+        # array dispatches dynamic_slice with implicitly-pushed start indices,
+        # which an armed transfer guard rejects. One explicit drain (the host
+        # read _trim_at_eos needs anyway), trim, one explicit push back.
+        gen_host = np.asarray(generated)[:, :max_new]
+        gen_host = _trim_at_eos(gen_host, config.eos_token_id, max_new)
+        return jnp.asarray(np.concatenate([ids_host, gen_host], axis=1))
 
 
 class Seq2SeqGenerator:
@@ -387,13 +431,13 @@ class Seq2SeqGenerator:
         explicit_request = generation_config is not None or "max_new_tokens" in kwargs
         config = generation_config or GenerationConfig(**kwargs)
         if rng is None:
-            rng = jax.random.key(0)
+            rng = _default_rng()
         input_ids = jnp.asarray(input_ids, jnp.int32)
         b = input_ids.shape[0]
         enc_mask = (
-            jnp.asarray(attention_mask, bool)[:, None, None, :]
+            jnp.asarray(np.asarray(attention_mask, bool))[:, None, None, :]
             if attention_mask is not None
-            else jnp.ones((b, 1, 1, input_ids.shape[1]), bool)
+            else _operand(np.ones((b, 1, 1, input_ids.shape[1])), bool)
         )
         max_new = config.max_new_tokens
         if not explicit_request:
@@ -407,7 +451,7 @@ class Seq2SeqGenerator:
             )
         am = jnp.asarray(attention_mask, jnp.int32) if attention_mask is not None else None
         encoder_hidden = self._encode(self.params, input_ids, am)
-        start = jnp.full((b,), jnp.int32(self.start_id))
+        start = _operand(np.full((b,), self.start_id), np.int32)
         first_logits, cache = self._prime(self.params, encoder_hidden, enc_mask, start)
         presence = None
         if config.repetition_penalty != 1.0:
@@ -422,17 +466,19 @@ class Seq2SeqGenerator:
             self.params,
             cache,
             first_logits,
-            jnp.int32(1),  # the start token occupies cache position 0
-            jnp.int32(max_new),
-            jnp.float32(config.temperature),
-            jnp.float32(config.repetition_penalty),
+            _operand(1, np.int32),  # the start token occupies cache position 0
+            _operand(max_new, np.int32),
+            _operand(config.temperature, np.float32),
+            _operand(config.repetition_penalty, np.float32),
             rng,
             presence,
             encoder_hidden,
             enc_mask,
         )
-        generated = _trim_at_eos(generated[:, :max_new], config.eos_token_id, max_new)
-        return generated  # decoder tokens only (HF seq2seq generate shape)
+        # numpy host tail (see Generator.__call__): drain once, trim, push back.
+        gen_host = np.asarray(generated)[:, :max_new]
+        gen_host = _trim_at_eos(gen_host, config.eos_token_id, max_new)
+        return jnp.asarray(gen_host)  # decoder tokens only (HF seq2seq generate shape)
 
 
 # Warm-executable cache for the module-level generate() convenience: keyed on the

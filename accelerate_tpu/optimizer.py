@@ -373,7 +373,7 @@ class AcceleratedOptimizer:
         scalars = [None] * len(slots_shapes)
         for paths in groups:
             p_g = {p: flat_params[p] for p in paths}
-            s_g = jax.jit(self.tx.init)(p_g)
+            s_g = jax.jit(self.tx.init)(p_g)  # tpu-lint: disable=jit-in-loop (one-shot setup per group)
             for i, val in enumerate(state_def.flatten_up_to(s_g)):
                 if slot_is_param[i]:
                     store.save(
@@ -436,7 +436,7 @@ class AcceleratedOptimizer:
         group_states = []
         for paths in groups:
             p_g = {p: flat_params[p] for p in paths}
-            s_g = jax.jit(self.tx.init)(p_g)
+            s_g = jax.jit(self.tx.init)(p_g)  # tpu-lint: disable=jit-in-loop (one-shot setup per group)
             group_states.append(jax.device_put(s_g, slice_state(self.opt_state_sharding, paths)))
 
         def assemble(template_node, *group_nodes):
@@ -724,6 +724,7 @@ class AcceleratedOptimizer:
                 # step — a failed blob write-back must leave params usable for
                 # the poison -> load_state recovery path (only grads donate).
                 donate = (2,) if disk_state is not None else (0, 2)
+                # tpu-lint: disable=jit-in-loop (memoized in _jit_cache per group key)
                 self._jit_cache[key] = jax.jit(_group_update, donate_argnums=donate)
                 self._jit_cache[("chunk_store_shard", gi)] = slice_state(self.opt_state_sharding, paths)
                 self._jit_cache[("chunk_param_store", gi)] = (

@@ -513,7 +513,7 @@ class PipelinedModel:
             group_sharding = jax.tree_util.tree_map(
                 lambda _: NamedSharding(mesh, P("stage")), stacked_struct
             )
-            stacked_groups[group_name] = jax.jit(
+            stacked_groups[group_name] = jax.jit(  # tpu-lint: disable=jit-in-loop (one-shot layout pass per group)
                 stack_layer_params, out_shardings=group_sharding
             )(stack)
             self.param_sharding[group_name] = group_sharding

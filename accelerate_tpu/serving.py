@@ -62,6 +62,7 @@ from .generation import (
     GenerationConfig,
     _apply_repetition_penalty,
     _bucket_for,
+    _operand,
     _params_resolver,
     _sample,
     make_causal_programs,
@@ -149,6 +150,7 @@ class ContinuousBatcher:
         use_repetition_penalty: bool = False,
         rng=None,
         max_queue: Optional[int] = None,
+        trace_guard=None,
     ):
         if getattr(model, "module", None) is None or not hasattr(model.module, "config"):
             raise ValueError("ContinuousBatcher needs a Model bundle built from an in-tree flax module")
@@ -218,6 +220,11 @@ class ContinuousBatcher:
         self._deadlines: Dict[int, float] = {}  # request_id -> absolute perf_counter deadline
         self._closed = False
         self._draining = False
+        # Optional analysis.TraceGuard (assignable after construction too): the
+        # engine's fault isolation swallows per-step exceptions, so guarded
+        # transfer violations are `observe()`d before being isolated — the
+        # analysis ledger sees them even though serving keeps running.
+        self.trace_guard = trace_guard
         self.stats = {
             "inserts": 0,
             "chunks": 0,
@@ -463,14 +470,16 @@ class ContinuousBatcher:
                     self._cache,
                     self._presence,
                     jnp.asarray(padded),
-                    jnp.int32(p),
-                    jnp.int32(slot),
-                    jnp.float32(req.temperature),
-                    jnp.float32(req.repetition_penalty),
+                    _operand(p, np.int32),
+                    _operand(slot, np.int32),
+                    _operand(req.temperature, np.float32),
+                    _operand(req.repetition_penalty, np.float32),
                     self._rng,
                 )
                 token = int(token)
             except Exception as exc:  # noqa: BLE001 — isolate, report, keep serving
+                if self.trace_guard is not None:
+                    self.trace_guard.observe(exc)
                 logger.warning(
                     "insert failed for request %s (isolated): %r", req.request_id, exc
                 )
@@ -534,6 +543,8 @@ class ContinuousBatcher:
                 self._rng,
             )
         except Exception as exc:  # noqa: BLE001
+            if self.trace_guard is not None:
+                self.trace_guard.observe(exc)
             # The ONE shared executable covers every slot: if the dispatch itself
             # dies the in-flight cache state is unrecoverable, so every in-flight
             # request errors (partial tokens kept) — but the engine itself stays

@@ -1,0 +1,56 @@
+"""Pytest plumbing for the analysis trace guard: import (or `pytest_plugins`)
+this module from a conftest and any test can assert "this train loop compiles
+exactly N executables and never syncs":
+
+    def test_loop_is_compile_stable(trace_guard):
+        guard = trace_guard()           # record-mode TraceGuard
+        warmup(step_fn)
+        with guard:
+            for batch in batches:
+                step_fn(batch)
+        assert_compiles(guard, exactly=0)
+
+Lives in `test_utils` (not `tests/`) so launched scripts and downstream suites
+get the same fixture post-install, exactly like the rest of test_utils.
+
+Kept out of `test_utils/__init__` on purpose: this module imports pytest, and
+test_utils is imported by launched training scripts that must not depend on it.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from ..analysis import TraceGuard
+
+
+@pytest.fixture
+def trace_guard():
+    """Factory fixture: build record-mode TraceGuards (assertions stay in the
+    test, so a failure reports through pytest instead of raising mid-loop).
+    Pass on_violation="raise" to get the raising behavior instead."""
+
+    def make(**kwargs) -> TraceGuard:
+        kwargs.setdefault("on_violation", "record")
+        return TraceGuard(**kwargs)
+
+    return make
+
+
+def assert_compiles(guard: TraceGuard, exactly: int = None, at_most: int = None):
+    """Assert on a guard's compile ledger with a readable failure message
+    (names every executable and its miss count)."""
+    total = guard.total_recompiles
+    detail = guard.report().summary()
+    if exactly is not None:
+        assert total == exactly, (
+            f"expected exactly {exactly} compile(s) in the guarded window, saw {total} — {detail}"
+        )
+    if at_most is not None:
+        assert total <= at_most, (
+            f"expected at most {at_most} compile(s) in the guarded window, saw {total} — {detail}"
+        )
+    assert guard.host_transfers == 0, (
+        f"guarded window made {guard.host_transfers} host transfer(s): "
+        f"{guard.transfer_violations}"
+    )

@@ -174,7 +174,8 @@ def training_check(state):
             loss, grads = jax.value_and_grad(loss_fn)(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            baseline_losses.append(float(loss))
+            baseline_losses.append(loss)  # device-side; read once after the loop
+    baseline_losses = [float(l) for l in baseline_losses]
 
     # framework run (sharded over whatever topology this script landed on).
     # split_batches makes the GLOBAL batch process-count invariant, so the loss
@@ -189,7 +190,8 @@ def training_check(state):
             loss = accelerator.backward(pmodel.loss, batch)
             popt.step()
             popt.zero_grad()
-            fw_losses.append(float(loss))
+            fw_losses.append(loss)  # device-side; read once after the loop
+    fw_losses = [float(l) for l in fw_losses]
 
     assert len(fw_losses) == len(baseline_losses)
     np.testing.assert_allclose(np.array(fw_losses), np.array(baseline_losses), rtol=1e-4, atol=1e-5)
@@ -232,8 +234,8 @@ def training_variants_check(state):
             loss, grads = jax.value_and_grad(loss_fn)(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            losses.append(float(loss))
-        return losses
+            losses.append(loss)  # device-side; read once after the loop
+        return [float(l) for l in losses]
 
     def framework(batch_size, **acc_kwargs):
         AcceleratorState._reset_state()

@@ -69,9 +69,12 @@ def training_function(args):
         loss = accelerator.backward(pp.loss, batch, model=pp)
         optimizer.step()
         optimizer.zero_grad()
-        losses.append(float(loss))
+        # Keep losses on device in the hot loop (a float() per step would sync
+        # the host every step — tpu-lint TPU111); read only at print points.
+        losses.append(loss)
         if step % 5 == 0:
-            accelerator.print(f"step {step}: lm loss {losses[-1]:.4f}")
+            accelerator.print(f"step {step}: lm loss {float(losses[-1]):.4f}")
+    losses = [float(l) for l in losses]
     accelerator.print(f"pretraining loss {losses[0]:.4f} -> {losses[-1]:.4f}")
     assert losses[-1] < losses[0], "next-token loss did not fall"
     return losses[-1]

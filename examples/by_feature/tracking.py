@@ -38,7 +38,9 @@ def training_function(args):
         for batch in train_dl:
             with accelerator.accumulate(model):
                 loss = accelerator.backward(model.loss, batch)
-                total_loss += float(loss)
+                # Accumulate ON DEVICE: float(loss) here would sync the host
+                # every step and serialize dispatch (tpu-lint TPU111).
+                total_loss += loss
                 optimizer.step()
                 optimizer.zero_grad()
             overall_step += 1
@@ -50,7 +52,7 @@ def training_function(args):
             correct += int((np.asarray(preds) == np.asarray(labels)).sum())
             total += len(np.asarray(labels))
         accelerator.log(
-            {"train_loss": total_loss / len(train_dl), "accuracy": correct / total, "epoch": epoch},
+            {"train_loss": float(total_loss) / len(train_dl), "accuracy": correct / total, "epoch": epoch},
             step=overall_step,
         )
         accelerator.print(f"epoch {epoch}: acc {correct / total:.3f}")

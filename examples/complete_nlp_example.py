@@ -116,7 +116,9 @@ def training_function(args):
                 optimizer.step()
                 scheduler.step()
                 optimizer.zero_grad()
-            total_loss += float(loss)
+            # Device-side accumulation: float(loss) here would block on the
+            # device every step (tpu-lint TPU111); read once per epoch below.
+            total_loss += loss
             n_batches += 1
             counter.overall_step += 1
             if isinstance(checkpointing_steps, int) and counter.overall_step % checkpointing_steps == 0:
@@ -132,7 +134,7 @@ def training_function(args):
             correct += int((np.asarray(preds) == np.asarray(labels)).sum())
             total += len(np.asarray(labels))
         accuracy = correct / total
-        train_loss = total_loss / max(n_batches, 1)
+        train_loss = float(total_loss) / max(n_batches, 1)
         accelerator.print(f"epoch {epoch}: loss {train_loss:.4f} accuracy {accuracy:.4f}")
         if args.with_tracking:
             accelerator.log(

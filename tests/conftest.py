@@ -38,6 +38,11 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
 
 
+# The analysis trace-guard fixture ships in test_utils (post-install parity);
+# re-exporting it here makes `trace_guard` available to every test in tests/.
+from accelerate_tpu.test_utils.analysis_fixtures import trace_guard  # noqa: E402, F401
+
+
 @pytest.fixture(autouse=True)
 def reset_singletons():
     yield

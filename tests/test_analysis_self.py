@@ -1,0 +1,43 @@
+"""Self-lint pin: the repo's own hot-path discipline is CI-enforced, not
+folklore. `accelerate analyze accelerate_tpu examples` must report zero
+error-severity findings — the exact gate `--fail-on error` applies — and any
+intentional exception must carry an explicit `# tpu-lint: disable=` comment."""
+
+from pathlib import Path
+
+import pytest
+
+from accelerate_tpu.analysis import analyze_paths, severity_at_least
+
+pytestmark = pytest.mark.analysis
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_repo_has_zero_error_findings():
+    findings, scanned = analyze_paths([str(REPO / "accelerate_tpu"), str(REPO / "examples")])
+    assert scanned > 80, f"suspiciously few files scanned ({scanned}) — wrong root?"
+    errors = [f for f in findings if severity_at_least(f.severity, "error")]
+    assert not errors, "error-severity TPU hazards in the repo:\n" + "\n".join(
+        f"  {f.file}:{f.line}: {f.rule_id} {f.message}" for f in errors
+    )
+
+
+def test_repo_warnings_stay_bounded():
+    """Warns don't gate CI, but silent growth means discipline drift: this pin
+    forces each new warn-level hazard to be either fixed or suppressed with an
+    explicit justification comment at the site."""
+    findings, _ = analyze_paths([str(REPO / "accelerate_tpu"), str(REPO / "examples")])
+    warns = [f for f in findings if f.severity == "warn"]
+    assert len(warns) == 0, "unsuppressed warn-level findings:\n" + "\n".join(
+        f"  {f.file}:{f.line}: {f.rule_id} {f.message}" for f in warns
+    )
+
+
+def test_benchmarks_and_bench_entry_are_error_free():
+    """The bench drivers run with the TraceGuard armed — they must hold the
+    same static discipline they enforce at runtime."""
+    findings, scanned = analyze_paths([str(REPO / "benchmarks"), str(REPO / "bench.py")])
+    assert scanned >= 3
+    errors = [f for f in findings if f.severity == "error"]
+    assert not errors, [(f.file, f.line, f.rule_id) for f in errors]

@@ -1,0 +1,10 @@
+"""Suppression syntax: both spellings silence the finding on their line."""
+import jax
+
+
+@jax.jit
+def step(x):
+    record = x.sum().item()  # tpu-lint: disable=TPU101
+    # tpu-lint: disable=host-scalar-cast
+    scale = float(x)
+    return x * record * scale

@@ -1,0 +1,12 @@
+"""TPU101 negative: .item() only at the host step boundary."""
+import jax
+
+
+@jax.jit
+def step(x):
+    return x.sum()
+
+
+def drive(x):
+    out = step(x)
+    return out.item()  # sanctioned: explicit read after the dispatch

@@ -1,0 +1,9 @@
+"""TPU101 host-sync-item: .item() inside jit-reachable code."""
+import jax
+
+
+@jax.jit
+def step(x):
+    total = x.sum()
+    record = total.item()  # hazard: device sync inside the program
+    return x * record

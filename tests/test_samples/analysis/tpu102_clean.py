@@ -1,0 +1,8 @@
+"""TPU102 negative: dtype work stays on device."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    return x * x.astype(jnp.float32)

@@ -1,0 +1,8 @@
+"""TPU102 host-scalar-cast: float() on a traced value."""
+import jax
+
+
+@jax.jit
+def step(x):
+    scale = float(x)  # hazard: host cast of a traced array
+    return x * scale

@@ -1,0 +1,12 @@
+"""TPU103 negative: numpy only outside the program."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    return x + 1
+
+
+def drive(x):
+    return np.asarray(step(x))  # sanctioned step-boundary drain

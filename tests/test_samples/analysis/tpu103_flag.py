@@ -1,0 +1,9 @@
+"""TPU103 host-transfer-numpy: np.asarray on a traced value."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    host = np.asarray(x)  # hazard: d2h copy inside the program
+    return x + host.shape[0]

@@ -1,0 +1,14 @@
+"""TPU104 negative: on-device select; host branches on static data."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    return jnp.where(x.any(), x + 1, x)
+
+
+def host_side(n: int):
+    if n > 4:  # static Python value: fine
+        return n
+    return 0

@@ -1,0 +1,9 @@
+"""TPU104 traced-bool-branch: Python `if` on a traced value."""
+import jax
+
+
+@jax.jit
+def step(x):
+    if x.any():  # hazard: implicit bool() on a tracer
+        return x + 1
+    return x

@@ -1,0 +1,10 @@
+"""TPU105 negative: the scalar rides as a traced operand."""
+import jax
+
+
+def make_step():
+    @jax.jit
+    def step(p, lr):
+        return p - lr * p
+
+    return step

@@ -1,0 +1,12 @@
+"""TPU105 closure-scalar-capture: enclosing Python scalar baked into a jit."""
+import jax
+
+
+def make_step():
+    lr = 0.01
+
+    @jax.jit
+    def step(p):
+        return p - lr * p  # hazard: lr is a trace-time constant now
+
+    return step

@@ -1,0 +1,9 @@
+"""TPU106 jit-in-loop: re-jitting per iteration."""
+import jax
+
+
+def drive(fns, xs):
+    outs = []
+    for fn, x in zip(fns, xs):
+        outs.append(jax.jit(fn)(x))  # hazard: fresh executable cache each pass
+    return outs

@@ -1,0 +1,10 @@
+"""TPU107 negative: static position holds genuinely constant config."""
+import jax
+
+
+def sweep(fn, xs, mode: int):
+    f = jax.jit(fn, static_argnums=(1,))
+    results = []
+    for x in xs:
+        results.append(f(x, mode))
+    return results

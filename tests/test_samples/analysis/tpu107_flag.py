@@ -1,0 +1,10 @@
+"""TPU107 static-argnums-varying: loop variable at a static position."""
+import jax
+
+
+def sweep(fn, xs):
+    f = jax.jit(fn, static_argnums=(1,))
+    results = []
+    for i, x in enumerate(xs):
+        results.append(f(x, i))  # hazard: recompiles every iteration
+    return results

@@ -1,0 +1,9 @@
+"""TPU108 donated-reuse: reading a buffer after donating it."""
+import jax
+
+
+def update(fn, params, grads):
+    f = jax.jit(fn, donate_argnums=(0,))
+    new_params = f(params, grads)
+    norm = (params ** 2).sum()  # hazard: params' buffer was invalidated
+    return new_params, norm

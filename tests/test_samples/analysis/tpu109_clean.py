@@ -1,0 +1,9 @@
+"""TPU109 negative: jitted callables built lazily."""
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=1)
+def get_double():
+    return jax.jit(lambda x: x * 2)

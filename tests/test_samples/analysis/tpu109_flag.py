@@ -1,0 +1,9 @@
+"""TPU109 module-level-jit: tracing at import time."""
+import jax
+
+
+def _double(x):
+    return x * 2
+
+
+double = jax.jit(_double)  # hazard: import compiles / touches the backend

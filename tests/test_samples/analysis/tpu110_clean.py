@@ -1,0 +1,7 @@
+"""TPU110 negative: explicit sharding annotations."""
+from jax.experimental.pjit import pjit
+from jax.sharding import PartitionSpec as P
+
+
+def build(fn):
+    return pjit(fn, in_shardings=(P("data"),), out_shardings=P("data"))

@@ -1,0 +1,9 @@
+"""TPU111 loop-host-sync: a per-step float() in the driving loop."""
+
+
+def train(step_fn, batches):
+    total = 0.0
+    for batch in batches:
+        loss = step_fn(batch)
+        total += float(loss)  # hazard: blocks on the device every step
+    return total

@@ -1,0 +1,220 @@
+"""TraceGuard runtime tests: steady-state serving and train steps hold the
+no-recompile / no-guarded-transfer discipline on CPU, a deliberately
+shape-unstable loop is caught WITH the executable's name, and the
+`Accelerator(analyze=True)` + test_utils fixture wiring works end to end."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.analysis import TraceGuard, TraceGuardViolation
+from accelerate_tpu.data_loader import BatchSampler
+from accelerate_tpu.test_utils.analysis_fixtures import assert_compiles
+
+from test_training import make_regression_data, make_regression_model
+
+pytestmark = pytest.mark.analysis
+
+
+def _tiny_llama():
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+
+    cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+    )
+    return create_llama_model(cfg, seq_len=32)
+
+
+# ------------------------------------------------------------------ serving
+def test_serving_steady_state_is_clean(trace_guard):
+    """3+ steady-state ContinuousBatcher.step() iterations: 0 recompiles, 0
+    guarded transfers (the acceptance criterion's serving half)."""
+    from accelerate_tpu.serving import ContinuousBatcher, Request
+
+    engine = ContinuousBatcher(_tiny_llama(), num_slots=2, max_length=64, chunk_size=4)
+    rng = np.random.default_rng(0)
+    # Warmup: compile the insert bucket + the one decode-chunk executable.
+    for i in range(3):
+        engine.submit(Request(i, rng.integers(1, 128, (5,)).astype(np.int32), max_new_tokens=12))
+    while engine.pending:
+        engine.step()
+    for i in range(3):
+        engine.release(i)
+
+    # Steady state: same prompt bucket, fresh requests, guard armed.
+    for i in range(10, 13):
+        engine.submit(Request(i, rng.integers(1, 128, (6,)).astype(np.int32), max_new_tokens=12))
+    guard = trace_guard(name="serving-steady")
+    engine.trace_guard = guard
+    steps = 0
+    with guard:
+        while engine.pending and steps < 25:
+            engine.step()
+            steps += 1
+    assert steps >= 3
+    assert_compiles(guard, exactly=0)
+    assert engine.trace_counts["decode_chunk"] == 1  # compiled once, ever
+    reasons = {r.finish_reason for r in engine.results.values()}
+    assert reasons <= {"eos", "length"}, reasons
+
+
+# ----------------------------------------------------------------- training
+def test_train_step_steady_state_is_clean(trace_guard):
+    """3 steady-state fused train-step iterations under the guard: 0/0."""
+    data = make_regression_data(n=32)
+    accelerator = Accelerator()
+    model = make_regression_model(seed=0)
+    dl = SimpleDataLoader(data, BatchSampler(range(len(data)), 8))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+    step_fn = accelerator.train_step()
+    batches = list(pdl)
+    step_fn(batches[0])  # warmup compile
+
+    guard = trace_guard(name="train-steady")
+    with guard:
+        for batch in batches[1:4]:
+            step_fn(batch)
+    assert guard.steps == 0  # fixture guards are armed manually, not per-call
+    assert_compiles(guard, exactly=0)
+
+
+def test_accelerator_analyze_wraps_train_step():
+    """Accelerator(analyze=True): steady-state steps pass, a shape-unstable
+    batch raises TraceGuardViolation naming the recompiled executable."""
+    data = make_regression_data(n=48)
+    accelerator = Accelerator(analyze=True)
+    assert accelerator.trace_guard is not None
+    model = make_regression_model(seed=0)
+    dl = SimpleDataLoader(data, BatchSampler(range(len(data)), 8))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+    step_fn = accelerator.train_step()
+    batches = list(pdl)
+    for batch in batches[:5]:  # warmup allowance (2) + 3 guarded steady steps
+        step_fn(batch)
+    assert accelerator.trace_guard.steps == 3
+    assert accelerator.trace_guard.total_recompiles == 0
+    assert accelerator.trace_guard.host_transfers == 0
+
+    # A shape-unstable batch (different batch dim) in steady state = caught.
+    small = {k: v[:5] for k, v in batches[0].items()}
+    with pytest.raises(TraceGuardViolation) as excinfo:
+        step_fn(small)
+    assert "fused" in str(excinfo.value)  # the executable is named
+    assert excinfo.value.report.total_recompiles >= 1
+
+
+# ------------------------------------------------------------ guard mechanics
+def test_unstable_loop_is_caught_and_named():
+    xs = [jnp.ones(n) for n in (4, 5, 6)]
+
+    def unstable_step(x):
+        return (x * 2).sum()
+
+    f = jax.jit(unstable_step)
+    f(xs[0])  # warmup one shape
+    with pytest.raises(TraceGuardViolation) as excinfo:
+        with TraceGuard(name="unstable"):
+            for x in xs[1:]:
+                f(x)
+    msg = str(excinfo.value)
+    assert "unstable_step" in msg and "recompiled" in msg
+    assert excinfo.value.report.compiles.get("unstable_step") == 2
+
+
+def test_record_mode_counts_without_raising():
+    xs = [jnp.ones(n) for n in (3, 7)]
+    f = jax.jit(lambda x: x + 1)
+    guard = TraceGuard(on_violation="record", name="record-mode")
+    with guard:
+        for x in xs:
+            f(x)
+    assert guard.total_recompiles == 2
+    assert guard.compiles  # per-executable ledger populated
+
+
+def test_wrap_warmup_allowance():
+    f = jax.jit(lambda x: (x * 3).sum())
+    xs = [jnp.ones(4), jnp.ones(9)]
+    guard = TraceGuard(name="wrapped")
+    wrapped = guard.wrap(f, warmup=1)
+    wrapped(xs[0])  # warmup: compile allowed
+    wrapped(xs[0])
+    wrapped(xs[0])
+    assert guard.steps == 2 and guard.total_recompiles == 0
+    with pytest.raises(TraceGuardViolation):
+        wrapped(xs[1])
+
+
+def test_transfer_guard_catches_implicit_transfer():
+    """Raw numpy leaking into a warm jitted call = implicit h2d = caught; the
+    sanctioned jnp.asarray push passes."""
+    f = jax.jit(lambda x: x * 2)
+    warm = jnp.ones(3)
+    f(warm)
+    guard = TraceGuard(name="transfers")
+    with guard:
+        f(jnp.asarray(np.ones(3, np.float32)))  # explicit: sanctioned
+    with pytest.raises(Exception) as excinfo:
+        with TraceGuard(name="transfers-2", on_violation="record"):
+            f(np.ones(3, np.float32))  # implicit: guarded at the call site
+    assert TraceGuard.is_transfer_violation(excinfo.value)
+
+
+def test_observe_classifies_and_records():
+    guard = TraceGuard(on_violation="record")
+    assert not guard.observe(ValueError("unrelated"))
+    fake = RuntimeError(
+        "INVALID_ARGUMENT: Disallowed host-to-device transfer: aval=ShapedArray(int32[])"
+    )
+    assert guard.observe(fake)
+    assert guard.host_transfers == 1
+
+
+def test_disarmed_guard_ignores_outside_compiles():
+    """Regression: guards must leave the monitoring fan-out on exit — compiles
+    OUTSIDE the armed window must never reach the ledger, and per-step
+    re-arming (wrap) must not accumulate stale registrations."""
+    from accelerate_tpu.analysis.trace_guard import _ARMED_GUARDS
+
+    guard = TraceGuard(on_violation="raise", name="disarmed")
+    f = jax.jit(lambda x: x - 1)
+    x = jnp.ones(4)
+    f(x)  # warmup
+    wrapped = guard.wrap(f, warmup=1)
+    for _ in range(5):
+        wrapped(x)
+    assert guard not in _ARMED_GUARDS
+    jax.jit(lambda x: x * 5)(x)  # unrelated compile, no guard armed
+    assert guard.total_recompiles == 0
+    wrapped(x)  # steady step after the unrelated compile: must NOT raise
+
+
+def test_wrapped_transfer_violation_counted_once():
+    """Regression: a guarded transfer inside a wrap()ped call is observe()d by
+    __exit__ exactly once, not double-counted by the wrapper."""
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.ones(3))
+    guard = TraceGuard(on_violation="record", name="once")
+    wrapped = guard.wrap(f, warmup=0)
+    with pytest.raises(Exception) as excinfo:
+        wrapped(np.ones(3, np.float32))  # implicit h2d: guarded at the site
+    assert TraceGuard.is_transfer_violation(excinfo.value)
+    assert guard.host_transfers == 1, guard.transfer_violations
+
+
+def test_guard_restores_logging_state():
+    before = bool(jax.config.jax_log_compiles)
+    with TraceGuard(on_violation="record"):
+        assert bool(jax.config.jax_log_compiles) is True
+    assert bool(jax.config.jax_log_compiles) is before
