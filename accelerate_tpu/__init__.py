@@ -35,6 +35,7 @@ from .hooks import (
     remove_hook_from_module,
 )
 from .tracking import GeneralTracker
+from .telemetry import MetricsRegistry, ProfilerManager, StepTimeline, TrackerBridge
 from .utils import (
     DataLoaderConfiguration,
     DeepSpeedPlugin,

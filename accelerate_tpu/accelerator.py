@@ -37,6 +37,7 @@ import functools
 import math
 import os
 import re
+import time
 from typing import Any, Callable, List, Optional, Union
 
 import numpy as np
@@ -55,6 +56,7 @@ from .modeling import Model, PreparedModel
 from .optimizer import AcceleratedOptimizer, GradScaler
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
+from .telemetry import MetricsRegistry, ProfilerManager, StepTimeline
 from .tracking import LOGGER_TYPE_TO_CLASS, GeneralTracker, filter_trackers
 from .utils import operations as ops
 from .utils.dataclasses import (
@@ -205,6 +207,26 @@ class Accelerator:
 
         self.step = 0
         self.flag_tensor = None
+
+        # Telemetry (the observability pillar, docs/observability.md): one
+        # registry for this Accelerator's instruments, a StepTimeline splitting
+        # per-step wall clock + keeping the goodput ledger, and a
+        # ProfilerManager armed from the launch env protocol
+        # (ACCELERATE_TPU_PROFILE_DIR, set by `launch --profile_dir`) for
+        # touch-file / SIGUSR2 on-demand capture. All construction is host-only
+        # and free when profiling wasn't requested.
+        self.telemetry = MetricsRegistry()
+        self.timeline = StepTimeline(self.telemetry, prefix="train")
+        self.profiler = ProfilerManager.from_env(registry=self.telemetry)
+        self._m_ckpt_saves = self.telemetry.counter(
+            "checkpoint_saves_total", help="save_state() completions"
+        )
+        self._m_ckpt_seconds = self.telemetry.histogram(
+            "checkpoint_save_seconds", help="wall-clock per save_state()"
+        )
+        self._m_ckpt_loads = self.telemetry.counter(
+            "checkpoint_loads_total", help="load_state() completions (restart recoveries)"
+        )
 
         if self.compilation_config.cache_dir:
             import jax
@@ -683,8 +705,29 @@ class Accelerator:
             # guarded host transfers. warmup=2 because the first scheduler step
             # installing an lr override legitimately rebuilds the with_lr
             # program once (train_step.py's _jitted cache).
-            return self.trace_guard.wrap(step, warmup=2)
-        return step
+            step = self.trace_guard.wrap(step, warmup=2)
+        return self._instrument_step(step)
+
+    def _instrument_step(self, step_fn: Callable) -> Callable:
+        """Telemetry shim around the fused step: each call is timed as the
+        timeline's "dispatch" phase (host enqueue — pure perf_counter
+        arithmetic, no device sync) and polls the ProfilerManager so touch-file
+        / SIGUSR2 capture requests are served at step boundaries. Exceptions
+        (including TraceGuardViolation from analyze mode) propagate untouched."""
+        timeline, profiler = self.timeline, self.profiler
+
+        def instrumented(*args, **kwargs):
+            with timeline.phase("dispatch"):
+                out = step_fn(*args, **kwargs)
+            timeline.step_done(out)
+            profiler.poll()
+            return out
+
+        instrumented.__wrapped__ = step_fn  # type: ignore[attr-defined]
+        guard = getattr(step_fn, "trace_guard", None)
+        if guard is not None:
+            instrumented.trace_guard = guard  # type: ignore[attr-defined]
+        return instrumented
 
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: int = 2, model=None):
         """Clip accumulated grads by global norm; no-op while accumulating
@@ -809,28 +852,30 @@ class Accelerator:
     # ------------------------------------------------------------------ profiling
     @contextlib.contextmanager
     def profile(self, log_dir: Optional[str] = None):
-        """Capture an XLA device trace for the wrapped block (SURVEY §5: the
-        first-class profiler the reference lacks — its perf observation is tracker
-        callbacks + psutil threads, benchmarks/measures_util.py). Output is an xplane
-        dump viewable in TensorBoard / xprof / Perfetto."""
-        import jax
-
-        if log_dir is None:
-            base = self.logging_dir or self.project_dir or "."
-            log_dir = os.path.join(str(base), "profile")
-        if self.is_main_process:
-            os.makedirs(log_dir, exist_ok=True)
-        with jax.profiler.trace(log_dir):
+        """Capture an XLA device trace for the wrapped block, via the
+        `telemetry.ProfilerManager` (which also serves on-demand touch-file /
+        SIGUSR2 captures between these scoped ones — docs/observability.md).
+        Output is an xplane dump viewable in TensorBoard / xprof / Perfetto."""
+        manager = self.profiler
+        if log_dir is not None or not manager.enabled:
+            if log_dir is None:
+                base = self.logging_dir or self.project_dir or "."
+                log_dir = os.path.join(str(base), "profile")
+            # Scoped capture outside the launch-configured dir: a transient
+            # manager sharing this Accelerator's registry (instruments are
+            # get-or-create, so capture counts keep accumulating in one place).
+            manager = ProfilerManager(log_dir=str(log_dir), registry=self.telemetry)
+        with manager.trace():
             yield
         self.wait_for_everyone()
 
     def save_memory_profile(self, path: str):
         """Dump a device-memory (HBM) profile in pprof format."""
-        import jax
-
         if self.is_main_process:
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-            jax.profiler.save_device_memory_profile(path)
+            manager = self.profiler if self.profiler.enabled else ProfilerManager(
+                log_dir=os.path.dirname(os.path.abspath(path)) or ".", registry=self.telemetry
+            )
+            manager.save_memory_snapshot(path)
 
     # ------------------------------------------------------------------ precision
     @contextlib.contextmanager
@@ -977,6 +1022,21 @@ class Accelerator:
         checkpoints visible. An explicit `output_dir` writes in place (each
         artifact individually atomic) and finishes with the digest manifest so
         `load_state` can verify it."""
+        t0 = time.perf_counter()
+        try:
+            result = self._save_state_inner(output_dir, **save_model_kwargs)
+        finally:
+            # Goodput ledger: checkpoint saves are wall clock the run paid that
+            # was not a training step (docs/observability.md) — charged even
+            # when the save fails (failed-save time is still lost time).
+            self.timeline.charge("checkpoint", time.perf_counter() - t0)
+        # Completion instruments bump only on SUCCESS: a raised save must not
+        # look like a usable checkpoint on a dashboard.
+        self._m_ckpt_saves.inc()
+        self._m_ckpt_seconds.observe(time.perf_counter() - t0)
+        return result
+
+    def _save_state_inner(self, output_dir: Optional[str] = None, **save_model_kwargs) -> str:
         if self.project_configuration.automatic_checkpoint_naming:
             manager = self.checkpoint_manager()
             logger.info(
@@ -1010,6 +1070,18 @@ class Accelerator:
         literal `"latest"` / `None` (with `automatic_checkpoint_naming`) — both
         resolve to the newest checkpoint that VERIFIES, falling back past a
         corrupted newest one to the last good save."""
+        t0 = time.perf_counter()
+        try:
+            result = self._load_state_inner(input_dir, **load_model_kwargs)
+        finally:
+            # Restart-recovery time (resume after a preemption/crash respawn)
+            # charges the goodput ledger's "restart" cause; the supervisor-side
+            # downtime is `fault_tolerance.Supervisor.downtime_s`.
+            self.timeline.charge("restart", time.perf_counter() - t0)
+        self._m_ckpt_loads.inc()  # completions only, like saves
+        return result
+
+    def _load_state_inner(self, input_dir: Optional[str] = None, **load_model_kwargs):
         if input_dir == "latest":
             input_dir = None
         if input_dir is None:

@@ -35,7 +35,13 @@ def add_launch_args(parser):
     parser.add_argument("--debug", action="store_true", help="Enable collective shape verification")
     parser.add_argument("--cpu", action="store_true", help="Force host-CPU platform (debug/testing)")
     parser.add_argument("--num_cpu_devices", type=int, default=None, help="Virtual CPU device count (testing)")
-    parser.add_argument("--profile_dir", default=None, help="Enable jax.profiler traces into this directory")
+    parser.add_argument(
+        "--profile_dir",
+        default=None,
+        help="Arm on-demand profiling in every worker (telemetry.ProfilerManager): "
+        "traces land in this directory; trigger a capture on a live run by "
+        "touching <dir>/CAPTURE or sending SIGUSR2 (docs/reference/cli.md)",
+    )
     for axis in ("data", "fsdp", "model", "seq", "expert", "stage"):
         parser.add_argument(f"--mesh_{axis}", type=int, default=None, help=f"Mesh axis size for `{axis}`")
     parser.add_argument("--max_restarts", type=int, default=0, help="Restart budget on child failure (elastic supervision)")

@@ -61,6 +61,9 @@ class Supervisor:
         # grace-period expiry can be noticed).
         self.monitor_interval = monitor_interval
         self.restart_count = 0
+        # Goodput accounting (telemetry.StepTimeline's "restart" cause): wall
+        # clock this supervisor spent between a child dying and its respawn.
+        self.downtime_s = 0.0
         self._child: Optional[subprocess.Popen] = None
         self._terminating = False
         self._kill_deadline: Optional[float] = None
@@ -127,7 +130,9 @@ class Supervisor:
                     self.restart_count,
                     self.max_restarts,
                 )
-                time.sleep(self._next_backoff())
+                backoff = self._next_backoff()
+                self.downtime_s += backoff
+                time.sleep(backoff)
         finally:
             signal.signal(signal.SIGTERM, prev_term)
             signal.signal(signal.SIGINT, prev_int)

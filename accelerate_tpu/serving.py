@@ -68,6 +68,7 @@ from .generation import (
     make_causal_programs,
 )
 from .logging import get_logger
+from .telemetry import MetricsRegistry
 from .utils.operations import tree_scatter_rows
 
 logger = get_logger(__name__)
@@ -151,6 +152,7 @@ class ContinuousBatcher:
         rng=None,
         max_queue: Optional[int] = None,
         trace_guard=None,
+        registry: Optional[MetricsRegistry] = None,
     ):
         if getattr(model, "module", None) is None or not hasattr(model.module, "config"):
             raise ValueError("ContinuousBatcher needs a Model bundle built from an in-tree flax module")
@@ -225,15 +227,56 @@ class ContinuousBatcher:
         # transfer violations are `observe()`d before being isolated — the
         # analysis ledger sees them even though serving keeps running.
         self.trace_guard = trace_guard
-        self.stats = {
-            "inserts": 0,
-            "chunks": 0,
-            "decode_steps": 0,
-            # Queue-depth high-water mark: how close the server ran to its
-            # backpressure limit (sized against `max_queue`).
-            "queue_peak": 0,
-            "finish_reasons": {reason: 0 for reason in FINISH_REASONS},
+        # Telemetry: every health counter lives in a MetricsRegistry (shareable
+        # with the Accelerator's, exportable via telemetry.export); the public
+        # `stats` dict is now a read-only VIEW over these instruments. All
+        # updates are host-scalar arithmetic — nothing here syncs the device.
+        self.metrics = registry if registry is not None else MetricsRegistry()
+        self._m_submitted = self.metrics.counter(
+            "serving_requests_submitted_total", help="requests accepted by submit()"
+        )
+        self._m_inserts = self.metrics.counter(
+            "serving_inserts_total", help="successful insert (prefill+admit) dispatches"
+        )
+        self._m_chunks = self.metrics.counter(
+            "serving_chunks_total", help="decode-chunk dispatches"
+        )
+        self._m_decode_steps = self.metrics.counter(
+            "serving_decode_steps_total", help="decode loop iterations (chunks * chunk_size)"
+        )
+        self._m_finish = {
+            reason: self.metrics.counter(
+                "serving_requests_finished_total",
+                help="finished requests by finish_reason",
+                labels={"reason": reason},
+            )
+            for reason in FINISH_REASONS
         }
+        self._m_queue_depth = self.metrics.gauge(
+            "serving_queue_depth", help="requests waiting for a slot"
+        )
+        self._m_queue_peak = self.metrics.gauge(
+            "serving_queue_peak",
+            help="queue-depth high-water mark (sized against max_queue)",
+        )
+        self._m_slots_in_use = self.metrics.gauge(
+            "serving_slots_in_use", help="slots occupied by in-flight requests"
+        )
+        self._m_slot_utilization = self.metrics.gauge(
+            "serving_slot_utilization", help="slots_in_use / num_slots"
+        )
+        self._m_ttft = self.metrics.histogram(
+            "serving_ttft_seconds", help="submit() -> first token (host wall clock)"
+        )
+        self._m_inter_token = self.metrics.histogram(
+            "serving_inter_token_seconds",
+            help="per-token gap between stream drains for an in-flight slot",
+        )
+        self._m_chunk_latency = self.metrics.histogram(
+            "serving_chunk_seconds", help="decode-chunk dispatch+drain wall clock"
+        )
+        self._submit_times: Dict[int, float] = {}  # request_id -> submit() perf_counter
+        self._slot_last_event = np.zeros(S, np.float64)  # last drain time per slot
 
     # ------------------------------------------------------------------ programs
 
@@ -357,6 +400,31 @@ class ContinuousBatcher:
     def closed(self) -> bool:
         return self._closed
 
+    @property
+    def stats(self) -> Dict[str, Any]:
+        """Back-compat health view, computed from the metrics registry (the
+        source of truth since the telemetry PR). Same keys and meanings as the
+        old ad-hoc dict; mutate nothing here — it is rebuilt per access."""
+        return {
+            "inserts": int(self._m_inserts.value),
+            "chunks": int(self._m_chunks.value),
+            "decode_steps": int(self._m_decode_steps.value),
+            "queue_peak": int(self._m_queue_peak.value),
+            "finish_reasons": {
+                reason: int(counter.value) for reason, counter in self._m_finish.items()
+            },
+        }
+
+    def _update_occupancy_gauges(self):
+        """Refresh the point-in-time gauges (queue depth, slot occupancy) —
+        called wherever the queue or the slot map changes."""
+        depth = len(self._queue)
+        self._m_queue_depth.set(depth)
+        self._m_queue_peak.set_max(depth)
+        in_use = sum(r is not None for r in self._slot_request)
+        self._m_slots_in_use.set(in_use)
+        self._m_slot_utilization.set(in_use / self.num_slots)
+
     def submit(self, request: Request) -> int:
         """Validate + enqueue. Raises `ValueError` for malformed requests (the
         caller's bug, reported synchronously), `QueueFull` for backpressure, and
@@ -387,8 +455,10 @@ class ContinuousBatcher:
         )
         if request.deadline_s is not None:
             self._deadlines[request.request_id] = time.perf_counter() + float(request.deadline_s)
+        self._submit_times[request.request_id] = time.perf_counter()
         self._queue.append(dataclasses.replace(request, input_ids=ids))
-        self.stats["queue_peak"] = max(self.stats["queue_peak"], len(self._queue))
+        self._m_submitted.inc()
+        self._update_occupancy_gauges()
         return request.request_id
 
     # ------------------------------------------------------------- fault isolation
@@ -408,11 +478,13 @@ class ContinuousBatcher:
         result.finish_reason = reason
         if error is not None:
             result.error = error
-        self.stats["finish_reasons"][reason] += 1
+        self._m_finish[reason].inc()
         self._deadlines.pop(result.request_id, None)
+        self._submit_times.pop(result.request_id, None)
         if slot is not None:
             self._slot_request[slot] = None
             self._active[slot] = False
+        self._update_occupancy_gauges()
 
     def _drop_queued(self, request_id: int) -> bool:
         before = len(self._queue)
@@ -486,7 +558,11 @@ class ContinuousBatcher:
                 self._finish(result, "error", error=repr(exc))
                 continue
             now = time.perf_counter()
-            self.stats["inserts"] += 1
+            self._m_inserts.inc()
+            submitted_at = self._submit_times.get(req.request_id)
+            if submitted_at is not None:
+                self._m_ttft.observe(now - submitted_at)
+            self._slot_last_event[slot] = now
             result.tokens.append(token)
             result.first_token_time = now
             events.append((req.request_id, [token]))
@@ -505,6 +581,7 @@ class ContinuousBatcher:
                 self._pen[slot] = req.repetition_penalty
             else:
                 self._finish(result, "eos" if token == eos else "length", now=now)
+        self._update_occupancy_gauges()
         return events
 
     def release(self, request_id: int) -> RequestResult:
@@ -528,6 +605,7 @@ class ContinuousBatcher:
         events = self._admit()
         if not self._active.any():
             return events
+        chunk_t0 = time.perf_counter()
         try:
             out = self._chunk_fn(
                 self.params,
@@ -564,19 +642,33 @@ class ContinuousBatcher:
         token, pos, active, rem = (np.array(x) for x in out[2:6])
         self._rng = out[6]
         packed, count = np.asarray(out[7]), int(out[8])
-        self.stats["chunks"] += 1
-        self.stats["decode_steps"] += self.chunk_size
+        self._m_chunks.inc()
+        self._m_decode_steps.inc(self.chunk_size)
 
         per_slot: Dict[int, List[int]] = {}
         for slot, tok in packed[:count]:
             per_slot.setdefault(int(slot), []).append(int(tok))
         now = time.perf_counter()
+        # The chunk's wall clock (dispatch + packed-stream drain) — measured
+        # AFTER the np.asarray readback above, so it covers real device work,
+        # not just the async enqueue.
+        self._m_chunk_latency.observe(max(now - chunk_t0, 0.0))
         for slot, toks in per_slot.items():
             result = self._slot_request[slot]
             if result is None:  # defensive: stream for a freed slot
                 continue
             result.tokens.extend(toks)
             events.append((result.request_id, toks))
+            # Inter-token latency: the host drains a slot's tokens once per
+            # chunk, so the per-token gap is the drain gap amortized over the
+            # tokens it delivered (one observation per token keeps histogram
+            # weights proportional to tokens served).
+            last = self._slot_last_event[slot]
+            if last > 0.0 and toks:
+                gap = max(now - last, 0.0) / len(toks)
+                for _ in toks:
+                    self._m_inter_token.observe(gap)
+            self._slot_last_event[slot] = now
 
         was_active = self._active
         self._token, self._pos, self._rem = token, pos, rem
@@ -629,4 +721,5 @@ class ContinuousBatcher:
                 self._finish(result, "cancelled", now=now)
         self._active[:] = False
         self._closed = True
+        self._update_occupancy_gauges()
         return self.results

@@ -1,0 +1,56 @@
+"""Runtime telemetry (L4 observability): metrics, step-timeline/goodput
+accounting, and on-demand profiler capture.
+
+Four modules, one discipline — observe the hot path without perturbing it
+(host scalars only, zero device syncs, bounded memory):
+
+  - `metrics` — process-local, thread-safe `MetricsRegistry` with
+    Counter/Gauge/Histogram instruments (fixed log-spaced latency buckets).
+  - `timeline` — `StepTimeline`: per-step data-wait / dispatch / sampled-block
+    phase split plus the goodput ledger (checkpoint saves, restarts,
+    compiles, TraceGuard recompiles).
+  - `profiler` — `ProfilerManager`: programmatic `jax.profiler` sessions with
+    touch-file / SIGUSR2 triggers and fixed-duration capture windows.
+  - `export` — JSONL snapshots, Prometheus text (file + stdlib HTTP
+    ``/metrics``), and the `tracking.py` bridge.
+
+Importing this package never touches jax: the profiler backend and the
+sampled `block_until_ready` import lazily, so lint-only and host-side tools
+can read metrics without an accelerator stack.
+"""
+
+from .export import (
+    MetricsHTTPServer,
+    TrackerBridge,
+    parse_prometheus_text,
+    to_prometheus_text,
+    write_jsonl_snapshot,
+    write_prometheus_textfile,
+)
+from .metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    log_spaced_buckets,
+)
+from .profiler import ProfilerManager
+from .timeline import StepTimeline
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_LATENCY_BUCKETS",
+    "log_spaced_buckets",
+    "StepTimeline",
+    "ProfilerManager",
+    "MetricsHTTPServer",
+    "TrackerBridge",
+    "to_prometheus_text",
+    "parse_prometheus_text",
+    "write_prometheus_textfile",
+    "write_jsonl_snapshot",
+]

@@ -56,7 +56,20 @@ FINAL_MARGIN_S = 30  # line emission + process teardown
 MIN_ATTEMPT_S = 180  # below this an accelerator attempt can't finish; go straight to CPU
 
 
-def _backend_preflight(timeout_s: int) -> bool:
+def _annotate_line(line: str, events) -> str:
+    """Fold the supervisor's structured event ledger into a worker's JSON line
+    (extra["supervisor_events"]) so BENCH_* artifacts explain preflight hangs,
+    retries and fallbacks after the fact — the r05 postmortem had only prose
+    stderr, which the driver doesn't keep. A clean run (no events) passes the
+    line through byte-identical."""
+    if not events:
+        return line
+    parsed = json.loads(line)
+    parsed.setdefault("extra", {})["supervisor_events"] = list(events)
+    return json.dumps(parsed)
+
+
+def _backend_preflight(timeout_s: int, note=None) -> bool:
     """Can the accelerator backend run ONE tiny op right now? A hung TPU tunnel
     makes backend init block forever; without this probe the supervisor would
     burn attempts x full timeouts (an hour-plus) before its CPU fallback. Cost on
@@ -79,9 +92,13 @@ def _backend_preflight(timeout_s: int) -> bool:
         )
         if r.returncode != 0:
             log(f"preflight probe crashed rc={r.returncode}; stderr tail: {(r.stderr or '')[-800:]!r}")
+            if note is not None:
+                note("preflight_probe_crashed", rc=r.returncode, timeout_s=round(timeout_s, 1))
         return r.returncode == 0
     except subprocess.TimeoutExpired:
         log(f"preflight probe hung >{timeout_s}s (backend init blocked)")
+        if note is not None:
+            note("preflight_probe_hung", timeout_s=round(timeout_s, 1))
         return False
 
 
@@ -99,13 +116,15 @@ def _env_int(name, default):
         return default
 
 
-def _run_worker(cmd, env, timeout_s, label):
+def _run_worker(cmd, env, timeout_s, label, note=None):
     """One worker attempt; returns the parsed-JSON stdout line or None."""
     t0 = time.time()
     try:
         proc = subprocess.run(cmd, env=env, timeout=timeout_s, capture_output=True, text=True)
     except subprocess.TimeoutExpired as e:
         log(f"{label}: worker hung >{timeout_s:.0f}s, killed")
+        if note is not None:
+            note("worker_hung", label=label, timeout_s=round(float(timeout_s), 1))
         for stream in (e.stderr, e.stdout):  # forward partial logs for diagnosis
             if stream:
                 text = stream.decode() if isinstance(stream, bytes) else stream
@@ -126,6 +145,9 @@ def _run_worker(cmd, env, timeout_s, label):
         f"{label} failed rc={proc.returncode} after {time.time() - t0:.0f}s; "
         f"stdout tail: {(proc.stdout or '')[-300:]!r}"
     )
+    if note is not None:
+        note("worker_failed", label=label, rc=proc.returncode,
+             elapsed_s=round(time.time() - t0, 1))
     return None
 
 
@@ -136,6 +158,15 @@ def supervise(argv, total_steps: int = 0):
     start = time.time()
     deadline_s = _env_int("BENCH_DEADLINE_S", DRIVER_WINDOW_S)
     hard_deadline = start + deadline_s
+    # Structured event ledger (satellite of the telemetry PR): every preflight
+    # failure, backoff wait and fallback decision lands as data in the emitted
+    # JSON's extra["supervisor_events"], not just as prose on stderr.
+    events = []
+
+    def note(event, **fields):
+        entry = {"event": event, "t_s": round(time.time() - start, 1)}
+        entry.update(fields)
+        events.append(entry)
 
     def remaining():
         return hard_deadline - time.time()
@@ -149,7 +180,8 @@ def supervise(argv, total_steps: int = 0):
     preflight_timeout = min(
         preflight_timeout, max(0, int(remaining() - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S))
     )
-    if preflight_timeout > 0 and not _backend_preflight(preflight_timeout):
+    cpu_fallback_cause = "attempts_exhausted"
+    if preflight_timeout > 0 and not _backend_preflight(preflight_timeout, note=note):
         # Backend is down/hung RIGHT NOW. A TPU tunnel outage is usually
         # transient, so retry the CHEAP probe on a backoff schedule — but only
         # up to a budget that still leaves room for one shortened accelerator
@@ -169,6 +201,7 @@ def supervise(argv, total_steps: int = 0):
                 f"preflight: backend down; retrying probe in {wait:.0f}s "
                 f"({backoff_deadline - time.time():.0f}s of budget left)"
             )
+            note("preflight_retry_wait", wait_s=round(wait, 1))
             time.sleep(wait)
             # Re-probes ESCALATE past the initial 120-s cap (up to 300 s, still
             # inside the ledger): a cold-but-healthy backend init can take
@@ -183,16 +216,19 @@ def supervise(argv, total_steps: int = 0):
             )
             if probe_t < 10:
                 break
-            if _backend_preflight(probe_t):
+            if _backend_preflight(probe_t, note=note):
                 recovered = True
                 log("preflight: backend recovered; proceeding with full attempts")
+                note("preflight_recovered")
                 break
             delay = min(delay * 2, 600)
         if not recovered:
             # Budget exhausted and still dead. Keep one real attempt (it may
             # recover mid-run); the ledger cap below already tightens it.
             log("preflight: budget exhausted, backend still unresponsive; shortening attempts")
+            note("preflight_budget_exhausted", budget_s=round(max(0, budget_s), 1))
             attempts = 1
+            cpu_fallback_cause = "backend_unresponsive"
     cmd = [sys.executable, os.path.abspath(__file__), "--_worker"] + argv
     for attempt in range(attempts):
         att_timeout = min(timeout_s, remaining() - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S)
@@ -201,10 +237,12 @@ def supervise(argv, total_steps: int = 0):
                 f"deadline: {remaining():.0f}s left; skipping remaining accelerator "
                 f"attempts to protect the CPU fallback"
             )
+            note("attempts_skipped_for_deadline", remaining_s=round(remaining(), 1))
+            cpu_fallback_cause = "deadline"
             break
-        line = _run_worker(cmd, dict(os.environ), att_timeout, f"attempt {attempt + 1}")
+        line = _run_worker(cmd, dict(os.environ), att_timeout, f"attempt {attempt + 1}", note=note)
         if line:
-            print(line, flush=True)
+            print(_annotate_line(line, events), flush=True)
             return 0
         if attempt + 1 < attempts:
             delay = min(30 * (attempt + 1), 120)
@@ -212,13 +250,15 @@ def supervise(argv, total_steps: int = 0):
             # the backoff just shaves the CPU fallback's reserve for nothing.
             if remaining() - delay - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S >= MIN_ATTEMPT_S:
                 log(f"retrying in {delay:.0f}s")
+                note("retry_wait", wait_s=round(delay, 1))
                 time.sleep(delay)
     # CPU fallback: gets whatever time is left (at least 60s even if the
     # deadline math went negative — a line late beats no line).
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     log("final attempt: falling back to JAX_PLATFORMS=cpu")
-    line = _run_worker(cmd, env, max(60, remaining() - FINAL_MARGIN_S), "cpu fallback")
+    note("cpu_fallback", cause=cpu_fallback_cause)
+    line = _run_worker(cmd, env, max(60, remaining() - FINAL_MARGIN_S), "cpu fallback", note=note)
     if line:
         # Never let a CPU smoke number masquerade as the chip benchmark
         # (round-2 verdict, weak #4): tag the metric and zero the ratio.
@@ -228,6 +268,8 @@ def supervise(argv, total_steps: int = 0):
         parsed["metric"] = "cpu-fallback " + parsed["metric"]
         parsed["vs_baseline"] = 0.0
         parsed.setdefault("extra", {})["cpu_fallback"] = True
+        parsed["extra"]["cpu_fallback_cause"] = cpu_fallback_cause
+        parsed["extra"]["supervisor_events"] = events
         print(json.dumps(parsed), flush=True)
         return 0
     # Even the CPU fallback failed: emit a diagnostic line so the driver parses *something*.
@@ -238,7 +280,7 @@ def supervise(argv, total_steps: int = 0):
                 "value": 0.0,
                 "unit": "samples/sec/chip",
                 "vs_baseline": 0.0,
-                "extra": {"error": "all attempts failed; see stderr"},
+                "extra": {"error": "all attempts failed; see stderr", "supervisor_events": events},
             }
         ),
         flush=True,
@@ -515,20 +557,35 @@ def train_bench(args):
 
     stream = batches()
 
+    # Telemetry (docs/observability.md): phase-split the bench loop through the
+    # Accelerator's own StepTimeline — data-wait vs dispatch vs explicit
+    # readback — and charge backend-compile durations to the goodput ledger so
+    # the emitted JSON says where the wall clock went (the r05 hang was
+    # invisible precisely because nothing recorded this).
+    timeline = accelerator.timeline
+    timeline.attach_compile_listener()
+
     if args.eager:
 
         def run_steps(n):
             last_loss = None
             for _ in range(n):
+                with timeline.phase("data_wait"):
+                    batch = next(stream)
                 with accelerator.accumulate(pmodel):
-                    last_loss = accelerator.backward(pmodel.loss, next(stream))
-                    popt.step()
-                    popt.zero_grad()
+                    with timeline.phase("dispatch"):
+                        last_loss = accelerator.backward(pmodel.loss, batch)
+                        popt.step()
+                        popt.zero_grad()
                 if args.per_step_readback:
-                    float(last_loss)
+                    with timeline.phase("block"):
+                        float(last_loss)
+                timeline.step_done()
             return last_loss
 
     else:
+        # train_step() is already timeline-instrumented (dispatch + step_done)
+        # by the Accelerator; only the data wait needs marking here.
         step_fn = accelerator.train_step(steps_per_call=spc)
 
         def run_steps(n):
@@ -536,9 +593,16 @@ def train_bench(args):
             # n is a step count, always a multiple of spc (steps are rounded up
             # at parse time, warmup is passed as warmup*spc).
             for _ in range(n // spc):
-                last_loss = step_fn(next(stream))
+                with timeline.phase("data_wait"):
+                    batch = next(stream)
+                last_loss = step_fn(batch)
                 if args.per_step_readback:
+                    # step_fn already closed the step (step_done inside the
+                    # Accelerator shim): record_phase attributes the readback
+                    # without reopening it.
+                    t_block = time.perf_counter()
                     float(last_loss)
+                    timeline.record_phase("block", time.perf_counter() - t_block)
             return last_loss
 
     # Warmup (compile)
@@ -594,12 +658,35 @@ def train_bench(args):
         metric = "cpu-smoke " + metric
         vs_baseline = 0.0
 
+    # Telemetry block: whole-run (warmup + all trials) phase accounting. The
+    # goodput ledger's "compile" entry is the warmup's trace+compile cost; a
+    # large unaccounted_s with small phase sums is the r05 signature (the host
+    # stalled OUTSIDE the instrumented loop, e.g. backend init).
+    def _phase_ms(name):
+        hist = accelerator.telemetry.get(f"train_{name}_seconds")
+        if hist is None or hist.count == 0:
+            return None
+        return {
+            "count": hist.count,
+            "p50_ms": round((hist.quantile(0.5) or 0.0) * 1000, 3),
+            "p99_ms": round((hist.quantile(0.99) or 0.0) * 1000, 3),
+        }
+
+    phase_stats = {
+        name: _phase_ms(name) for name in ("data_wait", "dispatch", "block", "step")
+    }
+    telemetry_block = {
+        "goodput": timeline.goodput(),
+        "phases": {name: stats for name, stats in phase_stats.items() if stats is not None},
+    }
+
     result = {
         "metric": metric,
         "value": round(samples_per_sec_per_chip, 3),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
         "extra": {
+            "telemetry": telemetry_block,
             "device_kind": device_kind,
             "n_chips": n_chips,
             "mfu": round(mfu, 4) if mfu is not None else None,

@@ -222,6 +222,32 @@ def main(argv=None):
 
     speedup = c_tps / max(s_tps, 1e-9)
     prefix = "" if on_accel else "cpu-smoke "
+
+    # Telemetry block: the engine's MetricsRegistry view of the SAME run —
+    # real-wall-clock TTFT / inter-token / chunk latency histograms (cumulative
+    # over warmup + timed passes; the virtual-clock numbers above stay the
+    # headline) plus occupancy gauges. docs/observability.md documents the
+    # instruments.
+    registry = engine.metrics
+
+    def _hist_ms(name):
+        hist = registry.get(name)
+        if hist is None or hist.count == 0:
+            return None
+        return {
+            "count": hist.count,
+            "p50_ms": round((hist.quantile(0.5) or 0.0) * 1000, 3),
+            "p99_ms": round((hist.quantile(0.99) or 0.0) * 1000, 3),
+        }
+
+    telemetry_block = {
+        "ttft": _hist_ms("serving_ttft_seconds"),
+        "inter_token": _hist_ms("serving_inter_token_seconds"),
+        "chunk": _hist_ms("serving_chunk_seconds"),
+        "queue_peak": registry.value("serving_queue_peak"),
+        "slot_utilization": registry.value("serving_slot_utilization"),
+        "requests_submitted": registry.value("serving_requests_submitted_total"),
+    }
     result = {
         "metric": f"{prefix}continuous-batching serving tokens/sec "
         f"({model_name}, slots {args.num_slots}, chunk {args.chunk_size}, "
@@ -248,6 +274,7 @@ def main(argv=None):
             # any timeout/error/cancelled here is a bench regression).
             "queue_peak": engine.stats["queue_peak"],
             "finish_reasons": dict(engine.stats["finish_reasons"]),
+            "telemetry": telemetry_block,
             # Steady-state discipline counters (TraceGuard armed over both
             # timed passes): any nonzero value is a no-recompile regression.
             "recompiles": guard.total_recompiles,

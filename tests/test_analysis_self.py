@@ -41,3 +41,17 @@ def test_benchmarks_and_bench_entry_are_error_free():
     assert scanned >= 3
     errors = [f for f in findings if f.severity == "error"]
     assert not errors, [(f.file, f.line, f.rule_id) for f in errors]
+
+
+def test_telemetry_subsystem_is_warn_clean():
+    """The observability layer rides the serving/train hot paths — it must be
+    completely clean at WARN level, not just error-free: a host-sync or
+    recompile hazard inside a metrics call would perturb the very loop it
+    measures. (The repo-wide pins above include this tree; the explicit root
+    keeps the gate loud if the walk roots ever change.)"""
+    findings, scanned = analyze_paths([str(REPO / "accelerate_tpu" / "telemetry")])
+    assert scanned >= 5, f"telemetry subsystem missing files? scanned {scanned}"
+    flagged = [f for f in findings if severity_at_least(f.severity, "warn")]
+    assert not flagged, "warn+ TPU hazards in telemetry:\n" + "\n".join(
+        f"  {f.file}:{f.line}: {f.rule_id} {f.message}" for f in flagged
+    )

@@ -160,6 +160,27 @@ def test_supervisor_dead_tunnel_emits_tagged_cpu_line_in_window(monkeypatch, cap
     assert row["extra"]["cpu_fallback"] is True
 
 
+def test_supervisor_emits_structured_event_ledger(monkeypatch, capsys):
+    """Telemetry satellite: preflight/fallback decisions land as DATA in the
+    emitted JSON (extra["supervisor_events"]), not just prose on stderr — so a
+    BENCH_* artifact explains an r05-style hang after the fact. The dead-tunnel
+    path must record the probe hangs, the backoff waits, the budget exhaustion
+    and the cpu_fallback cause."""
+    elapsed, row = _simulate_supervise(monkeypatch, capsys, cpu_fallback_hangs=False)
+    events = row["extra"]["supervisor_events"]
+    kinds = [e["event"] for e in events]
+    assert "preflight_probe_hung" in kinds
+    assert "preflight_retry_wait" in kinds
+    assert "preflight_budget_exhausted" in kinds
+    assert kinds.count("cpu_fallback") == 1
+    fallback = next(e for e in events if e["event"] == "cpu_fallback")
+    assert fallback["cause"] == "backend_unresponsive"
+    assert row["extra"]["cpu_fallback_cause"] == "backend_unresponsive"
+    # every entry is timestamped relative to supervise() start, monotonically
+    stamps = [e["t_s"] for e in events]
+    assert stamps == sorted(stamps) and all(s >= 0 for s in stamps)
+
+
 def test_supervisor_explicit_deadline_env(monkeypatch, capsys):
     """BENCH_DEADLINE_S is honored: a 600-s deadline bounds the whole worst
     case to 600 s (the driver can tighten the window without editing code)."""

@@ -1,0 +1,503 @@
+"""Runtime-telemetry tests: metrics-registry semantics (buckets, quantiles,
+thread safety — including under a concurrent ContinuousBatcher submit/drain
+load), step-timeline/goodput arithmetic on a fake clock, profiler-manager
+trigger/window mechanics against a stub backend, exporter round-trips
+(Prometheus text, JSONL, stdlib HTTP), and the tier-1 pin that the INSTRUMENTED
+serving path still holds the 0-recompile / 0-host-transfer discipline."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.telemetry import (
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsHTTPServer,
+    MetricsRegistry,
+    ProfilerManager,
+    StepTimeline,
+    TrackerBridge,
+    log_spaced_buckets,
+    parse_prometheus_text,
+    to_prometheus_text,
+    write_jsonl_snapshot,
+    write_prometheus_textfile,
+)
+
+pytestmark = pytest.mark.telemetry
+
+
+def _tiny_llama():
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+
+    cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+    )
+    return create_llama_model(cfg, seq_len=32)
+
+
+# ------------------------------------------------------------------ histogram
+def test_log_spaced_buckets_shape():
+    buckets = log_spaced_buckets(1e-4, 100.0, per_decade=4)
+    assert buckets == tuple(sorted(set(buckets)))
+    assert buckets[0] == pytest.approx(1e-4)
+    assert buckets[-1] >= 100.0
+    # 6 decades * 4/decade + the closing bound: bounded memory by construction.
+    assert len(buckets) == 25
+    assert DEFAULT_LATENCY_BUCKETS == buckets
+
+
+def test_histogram_bucket_property_every_observation_lands_once():
+    """Property over random workloads: bucket counts partition the
+    observations — sum(counts) == N for any inputs, including values outside
+    [lo, hi] (the overflow bucket absorbs the top, the first bucket the
+    bottom)."""
+    rng = np.random.default_rng(0)
+    for trial in range(5):
+        registry = MetricsRegistry()
+        hist = registry.histogram(f"h{trial}", buckets=log_spaced_buckets(1e-3, 10.0, 3))
+        values = np.exp(rng.normal(-2.0, 2.5, size=500))  # spills both ends
+        for v in values:
+            hist.observe(float(v))
+        counts = hist.bucket_counts()
+        assert sum(counts) == hist.count == 500
+        assert hist.sum == pytest.approx(float(values.sum()), rel=1e-9)
+        # cumulative monotonicity (what the Prometheus _bucket series encodes)
+        cumulative = np.cumsum(counts)
+        assert (np.diff(cumulative) >= 0).all()
+
+
+def test_histogram_quantile_within_bucket_resolution():
+    """The interpolated quantile can never be off by more than one bucket:
+    estimate and true percentile fall in the same (or adjacent) log bucket, so
+    their ratio is bounded by the bucket width 10^(1/per_decade)."""
+    rng = np.random.default_rng(1)
+    per_decade = 4
+    width = 10 ** (1 / per_decade)
+    registry = MetricsRegistry()
+    hist = registry.histogram("lat", buckets=log_spaced_buckets(1e-4, 100.0, per_decade))
+    values = np.exp(rng.normal(np.log(0.05), 1.0, size=2000))
+    for v in values:
+        hist.observe(float(v))
+    for q in (0.1, 0.5, 0.9, 0.99):
+        true = float(np.percentile(values, q * 100))
+        est = hist.quantile(q)
+        assert est is not None
+        assert est / true < width * 1.01 and true / est < width * 1.01, (q, est, true)
+
+
+def test_histogram_quantile_edge_cases():
+    registry = MetricsRegistry()
+    hist = registry.histogram("edge", buckets=(1.0, 10.0))
+    assert hist.quantile(0.5) is None  # empty
+    hist.observe(1e9)  # overflow-only
+    assert hist.quantile(0.99) == 10.0  # clamped to the top finite bound
+    with pytest.raises(ValueError):
+        hist.quantile(1.5)
+
+
+def test_instruments_reject_device_like_values():
+    """The zero-device-sync gate: anything that is not a host int/float is
+    refused (a jax array would hide a blocking readback inside a metrics
+    call)."""
+    registry = MetricsRegistry()
+    with pytest.raises(TypeError):
+        registry.counter("c").inc(np.ones(3))  # array-like
+    with pytest.raises(TypeError):
+        registry.histogram("h").observe("0.5")
+    with pytest.raises(TypeError):
+        registry.gauge("g").set(True)  # bool is not a measurement
+    registry.histogram("h").observe(np.float64(0.5))  # numpy scalar IS a float
+
+
+# ------------------------------------------------------------------- registry
+def test_registry_get_or_create_identity_and_kind_conflicts():
+    registry = MetricsRegistry()
+    a = registry.counter("requests_total", labels={"reason": "eos"})
+    b = registry.counter("requests_total", labels={"reason": "eos"})
+    c = registry.counter("requests_total", labels={"reason": "length"})
+    assert a is b and a is not c
+    with pytest.raises(ValueError):
+        registry.gauge("requests_total", labels={"reason": "eos"})
+    with pytest.raises(ValueError):
+        registry.counter("bad name!")
+    a.inc()
+    assert registry.value("requests_total", {"reason": "eos"}) == 1
+    assert registry.value("requests_total", {"reason": "length"}) == 0
+
+
+def test_registry_thread_safety_exact_counts():
+    """8 writers x 5000 increments + concurrent histogram observes: totals are
+    exact (no lost updates), which is the property the serving engine relies
+    on when submit() runs on request-handler threads."""
+    registry = MetricsRegistry()
+    counter = registry.counter("hits_total")
+    hist = registry.histogram("lat_seconds")
+
+    def hammer():
+        for i in range(5000):
+            counter.inc()
+            hist.observe(0.001 * (1 + i % 7))
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counter.value == 8 * 5000
+    assert hist.count == 8 * 5000
+    assert sum(hist.bucket_counts()) == 8 * 5000
+
+
+def test_registry_under_concurrent_serving_submit_drain():
+    """The satellite's integration load: a producer thread submits requests
+    while the main thread drains the engine — every registry count balances
+    afterwards (submitted == finished == TTFT observations; no torn or lost
+    updates between the two threads)."""
+    from accelerate_tpu.serving import ContinuousBatcher, Request
+
+    engine = ContinuousBatcher(_tiny_llama(), num_slots=2, max_length=64, chunk_size=4)
+    rng = np.random.default_rng(2)
+    n = 10
+    prompts = [rng.integers(1, 128, (int(rng.integers(3, 9)),)).astype(np.int32) for _ in range(n)]
+
+    def producer():
+        for i, p in enumerate(prompts):
+            engine.submit(Request(i, p, max_new_tokens=6))
+            time.sleep(0.002)
+
+    thread = threading.Thread(target=producer)
+    thread.start()
+    deadline = time.monotonic() + 60
+    while (thread.is_alive() or engine.pending) and time.monotonic() < deadline:
+        engine.step()
+    thread.join()
+    assert all(r.finished for r in engine.results.values())
+    registry = engine.metrics
+    assert registry.value("serving_requests_submitted_total") == n
+    finished = sum(engine.stats["finish_reasons"].values())
+    assert finished == n
+    assert registry.get("serving_ttft_seconds").count == n
+    assert engine.stats["finish_reasons"]["length"] == n  # EOS-free workload
+    assert registry.value("serving_slot_utilization") == 0.0  # all drained
+
+
+# ------------------------------------------------------------------- timeline
+def test_step_timeline_phases_and_goodput_arithmetic():
+    clock = {"t": 100.0}
+    registry = MetricsRegistry()
+    timeline = StepTimeline(registry, prefix="train", clock=lambda: clock["t"])
+
+    for _ in range(3):
+        with timeline.phase("data_wait"):
+            clock["t"] += 0.5
+        with timeline.phase("dispatch"):
+            clock["t"] += 1.5
+        timeline.step_done()
+    timeline.charge("checkpoint", 4.0)
+    clock["t"] += 2.0  # unaccounted host time
+
+    report = timeline.goodput()
+    assert report["steps"] == 3
+    assert report["total_s"] == pytest.approx(8.0)  # 3*(0.5+1.5) + 2.0
+    assert report["productive_s"] == pytest.approx(6.0)
+    assert report["lost_s"] == {"checkpoint": 4.0}
+    assert report["unaccounted_s"] == pytest.approx(0.0)  # lost overlaps clamped at 0
+    assert report["goodput"] == pytest.approx(6.0 / 8.0)
+    assert report["phase_s"]["data_wait"] == pytest.approx(1.5)
+    assert report["phase_s"]["dispatch"] == pytest.approx(4.5)
+    assert registry.value("train_steps_total") == 3
+    assert registry.get("train_step_seconds").count == 3
+    assert registry.value("train_lost_seconds_total", {"cause": "checkpoint"}) == pytest.approx(4.0)
+    assert registry.value("train_goodput_ratio") == pytest.approx(6.0 / 8.0)
+
+    timeline.reset()
+    assert timeline.goodput()["steps"] == 0
+    with pytest.raises(ValueError):
+        timeline.charge("checkpoint", -1.0)
+
+
+def test_step_timeline_folds_trace_guard_ledger():
+    from accelerate_tpu.analysis import TraceGuard
+
+    registry = MetricsRegistry()
+    timeline = StepTimeline(registry, prefix="train")
+    guard = TraceGuard(on_violation="record", name="t")
+    guard.compiles["fused_step"] = 2
+    guard.transfer_violations.append("Disallowed device-to-host transfer ...")
+    timeline.observe_trace_guard(guard)
+    timeline.observe_trace_guard(guard)  # idempotent folding, not double-count
+    assert registry.value("train_recompiles_total") == 2
+    assert registry.value("train_guarded_transfers_total") == 1
+
+
+# ------------------------------------------------------------------- profiler
+class _StubProfiler:
+    def __init__(self):
+        self.calls = []
+        self.tracing = False
+
+    def start_trace(self, log_dir):
+        assert not self.tracing
+        self.tracing = True
+        self.calls.append(("start", log_dir))
+
+    def stop_trace(self):
+        assert self.tracing
+        self.tracing = False
+        self.calls.append(("stop",))
+
+    def save_device_memory_profile(self, path):
+        with open(path, "w") as f:
+            f.write("pprof")
+        self.calls.append(("memory", path))
+
+
+def test_profiler_touch_file_trigger_and_fixed_window(tmp_path):
+    clock = {"t": 0.0}
+    stub = _StubProfiler()
+    manager = ProfilerManager(
+        log_dir=str(tmp_path),
+        capture_seconds=5.0,
+        poll_every=1,
+        backend=stub,
+        clock=lambda: clock["t"],
+    )
+    assert manager.enabled and not manager.poll()  # no trigger yet
+
+    (tmp_path / "CAPTURE").touch()
+    assert manager.poll() is True  # trigger consumed, window opened
+    assert not (tmp_path / "CAPTURE").exists()
+    assert stub.tracing
+    clock["t"] += 4.0
+    assert manager.poll() is True  # window still open
+    clock["t"] += 2.0
+    assert manager.poll() is False  # 6s > 5s window: auto-closed
+    assert not stub.tracing
+    assert manager.registry.value("profiler_captures_total") == 1
+    assert manager.registry.value("profiler_active") == 0
+
+
+def test_profiler_signal_latch_trace_scope_and_memory(tmp_path):
+    stub = _StubProfiler()
+    manager = ProfilerManager(log_dir=str(tmp_path), poll_every=1, backend=stub)
+    manager.request_capture()  # what the SIGUSR2 handler latches
+    assert manager.poll() is True
+    assert manager.stop() is True and manager.stop() is False  # idempotent
+
+    with manager.trace(subdir="scoped") as target:
+        assert target.endswith("scoped") and stub.tracing
+    assert not stub.tracing
+
+    path = manager.save_memory_snapshot()
+    assert path is not None and ("memory", path) in stub.calls
+
+    disabled = ProfilerManager(log_dir=None, backend=stub)
+    assert not disabled.enabled
+    assert disabled.start() is None and disabled.poll() is False
+    assert disabled.save_memory_snapshot() is None
+
+
+def test_profiler_from_env_reads_launch_protocol(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TPU_PROFILE_DIR", str(tmp_path / "prof"))
+    manager = ProfilerManager.from_env(install_signal=False, backend=_StubProfiler())
+    assert manager.enabled and manager.log_dir == str(tmp_path / "prof")
+    monkeypatch.delenv("ACCELERATE_TPU_PROFILE_DIR")
+    assert not ProfilerManager.from_env(backend=_StubProfiler()).enabled
+
+
+# ------------------------------------------------------------------- exporters
+def _populated_registry():
+    registry = MetricsRegistry()
+    registry.counter("reqs_total", help="requests", labels={"reason": "eos"}).inc(3)
+    registry.counter("reqs_total", labels={"reason": "length"}).inc(7)
+    registry.gauge("queue_depth", help="waiting").set(2)
+    hist = registry.histogram("ttft_seconds", help="ttft", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        hist.observe(v)
+    return registry
+
+
+def test_prometheus_text_round_trip():
+    registry = _populated_registry()
+    parsed = parse_prometheus_text(to_prometheus_text(registry))
+    assert parsed["reqs_total"]["type"] == "counter"
+    assert parsed["reqs_total"]["samples"][(("reason", "eos"),)] == 3
+    assert parsed["reqs_total"]["samples"][(("reason", "length"),)] == 7
+    assert parsed["queue_depth"]["samples"][()] == 2
+    buckets = parsed["ttft_seconds_bucket"]["samples"]
+    assert buckets[(("le", "0.01"),)] == 1
+    assert buckets[(("le", "0.1"),)] == 3
+    assert buckets[(("le", "1"),)] == 4
+    assert buckets[(("le", "+Inf"),)] == 5
+    assert parsed["ttft_seconds_count"]["samples"][()] == 5
+    assert parsed["ttft_seconds_sum"]["samples"][()] == pytest.approx(5.605)
+
+
+def test_prometheus_label_escapes_round_trip():
+    """Hostile label values (quotes, newlines, literal backslash-n, commas)
+    survive the wire: decoding must be one left-to-right pass — sequential
+    replace() corrupts a literal backslash followed by 'n'."""
+    registry = MetricsRegistry()
+    nasty = ['a"b', "line\nbreak", r"back\slash", r"literal\n", "comma,inside", "\\"]
+    for i, value in enumerate(nasty):
+        registry.counter("odd_total", labels={"v": value}).inc(i + 1)
+    parsed = parse_prometheus_text(to_prometheus_text(registry))
+    samples = parsed["odd_total"]["samples"]
+    for i, value in enumerate(nasty):
+        assert samples[(("v", value),)] == i + 1, value
+
+
+def test_log_spaced_buckets_cover_hi_on_fractional_decades():
+    buckets = log_spaced_buckets(1e-4, 90.0, per_decade=4)
+    assert buckets[-1] >= 90.0  # values in (last_bound, hi] must not overflow
+
+
+def test_timeline_record_phase_does_not_reopen_step():
+    clock = {"t": 0.0}
+    timeline = StepTimeline(MetricsRegistry(), prefix="t", clock=lambda: clock["t"])
+    with timeline.phase("dispatch"):
+        clock["t"] += 1.0
+    timeline.step_done()
+    timeline.record_phase("block", 0.5)  # post-step readback attribution
+    assert timeline._step_open_since is None
+    clock["t"] += 0.5
+    report = timeline.goodput()
+    assert report["phase_s"]["block"] == pytest.approx(0.5)
+    assert report["productive_s"] == pytest.approx(1.0)  # block did not inflate the next step
+
+
+def test_prometheus_textfile_and_jsonl(tmp_path):
+    registry = _populated_registry()
+    prom = tmp_path / "metrics.prom"
+    write_prometheus_textfile(registry, str(prom))
+    assert "reqs_total" in prom.read_text()
+
+    jsonl = tmp_path / "snapshots.jsonl"
+    write_jsonl_snapshot(registry, str(jsonl), step=1)
+    registry.gauge("queue_depth").set(9)
+    write_jsonl_snapshot(registry, str(jsonl), step=2, run="r06")
+    lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert len(lines) == 2 and lines[1]["step"] == 2 and lines[1]["run"] == "r06"
+    by_name = {m["name"]: m for m in lines[1]["metrics"] if m["name"] == "queue_depth"}
+    assert by_name["queue_depth"]["value"] == 9
+    hist_entries = [m for m in lines[0]["metrics"] if m["kind"] == "histogram"]
+    assert hist_entries and sum(hist_entries[0]["bucket_counts"]) == hist_entries[0]["count"]
+
+
+def test_metrics_http_server_serves_prometheus_text():
+    import urllib.request
+
+    registry = _populated_registry()
+    server = MetricsHTTPServer(registry, port=0)
+    try:
+        with urllib.request.urlopen(server.url, timeout=10) as resp:
+            body = resp.read().decode()
+        parsed = parse_prometheus_text(body)
+        assert parsed["reqs_total"]["samples"][(("reason", "eos"),)] == 3
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://{server.host}:{server.port}/nope", timeout=10)
+    finally:
+        server.close()
+
+
+def test_tracker_bridge_flattens_through_accelerator_log():
+    class FakeAccelerator:
+        telemetry = _populated_registry()
+
+        def __init__(self):
+            self.logged = []
+
+        def log(self, values, step=None, log_kwargs=None):
+            self.logged.append((values, step))
+
+    accelerator = FakeAccelerator()
+    bridge = TrackerBridge(accelerator)
+    values = bridge.publish(step=7)
+    assert accelerator.logged[0][1] == 7
+    assert values["telemetry/reqs_total.reason=eos"] == 3
+    assert values["telemetry/ttft_seconds.count"] == 5
+    assert "telemetry/ttft_seconds.p50" in values
+
+
+# ------------------------------------------ serving integration (acceptance)
+def test_instrumented_serving_steady_state_holds_0_0_and_exports(trace_guard):
+    """The acceptance pin: with full telemetry wired in, steady-state serving
+    still measures 0 recompiles / 0 guarded host transfers, and a
+    Prometheus-text snapshot of the TTFT/inter-token histograms and queue/slot
+    gauges round-trips through export.py with the exact counts the engine
+    recorded."""
+    from accelerate_tpu.serving import ContinuousBatcher, Request
+    from accelerate_tpu.test_utils.analysis_fixtures import assert_compiles
+
+    engine = ContinuousBatcher(_tiny_llama(), num_slots=2, max_length=64, chunk_size=4)
+    rng = np.random.default_rng(3)
+    for i in range(3):  # warmup: compile insert bucket + the one chunk program
+        engine.submit(Request(i, rng.integers(1, 128, (5,)).astype(np.int32), max_new_tokens=8))
+    while engine.pending:
+        engine.step()
+
+    guard = trace_guard(name="telemetry-serving")
+    engine.trace_guard = guard
+    for i in range(10, 14):
+        engine.submit(Request(i, rng.integers(1, 128, (6,)).astype(np.int32), max_new_tokens=8))
+    with guard:
+        while engine.pending:
+            engine.step()
+    assert_compiles(guard, exactly=0)
+    assert guard.host_transfers == 0
+    assert engine.trace_counts["decode_chunk"] == 1
+
+    registry = engine.metrics
+    ttft = registry.get("serving_ttft_seconds")
+    inter = registry.get("serving_inter_token_seconds")
+    assert ttft.count == 7 and inter.count > 0
+    parsed = parse_prometheus_text(to_prometheus_text(registry))
+    assert parsed["serving_ttft_seconds_count"]["samples"][()] == 7
+    assert parsed["serving_inter_token_seconds_count"]["samples"][()] == inter.count
+    assert parsed["serving_queue_depth"]["samples"][()] == 0
+    assert parsed["serving_slots_in_use"]["samples"][()] == 0
+    reasons = {
+        labels[0][1]: v
+        for labels, v in parsed["serving_requests_finished_total"]["samples"].items()
+    }
+    assert sum(reasons.values()) == 7
+    # stats stays the back-compat view over the same instruments
+    assert engine.stats["inserts"] == 7
+    assert engine.stats["finish_reasons"]["error"] == 0
+
+
+def test_accelerator_owns_telemetry_and_instruments_train_step():
+    """Accelerator construction wires registry + timeline + profiler; the
+    fused step bumps the step counter and times the dispatch phase without
+    changing results."""
+    import optax
+
+    from accelerate_tpu import Accelerator, SimpleDataLoader
+    from accelerate_tpu.data_loader import BatchSampler
+
+    from test_training import make_regression_data, make_regression_model
+
+    accelerator = Accelerator()
+    assert accelerator.telemetry is accelerator.timeline.registry
+    assert not accelerator.profiler.enabled  # env protocol not armed
+    data = make_regression_data(n=32)
+    model = make_regression_model(seed=0)
+    dl = SimpleDataLoader(data, BatchSampler(range(len(data)), 8))
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.05), dl)
+    step_fn = accelerator.train_step()
+    for batch in pdl:
+        step_fn(batch)
+    registry = accelerator.telemetry
+    assert registry.value("train_steps_total") == 4
+    assert registry.get("train_dispatch_seconds").count == 4
+    assert accelerator.timeline.goodput()["steps"] == 4
