@@ -1,0 +1,60 @@
+"""Deterministic fault injection + end-to-end recovery invariants (L5 robustness).
+
+The resilience layer (crash-safe checkpoints, restarting Supervisor,
+fault-isolated serving, goodput ledger) is only as trustworthy as the faults it
+has actually survived. This package makes those faults *scripted, seeded and
+replayable*:
+
+  - `plan` — JSON-serializable `FaultPlan`/`FaultEvent` schedules (triggers by
+    step index, call count, wall-clock offset, path pattern), propagated to
+    launched workers via ``ACCELERATE_TPU_FAULT_PLAN``.
+  - `injectors` — composable injectors at the seams the code already owns:
+    filesystem (torn writes, ENOSPC/EIO, slow fsync, rename-window crashes),
+    process (SIGKILL at step N, SIGTERM mid-save), backend/serving (stalled or
+    failing dispatches, queue-full bursts, forced retraces), plus a `FakeClock`
+    for backoff/deadline tests. Every firing counts in
+    ``chaos_injected_total{kind=...}``.
+  - `runner` — `ChaosRunner` executes train/serve workloads under a plan and
+    emits an `InvariantReport`: resume exactness, no-torn-checkpoint-resolved,
+    restart/downtime budgets, terminal finish reasons on drain, and
+    ledger/counter reconciliation.
+  - `workload` — the subprocess worker (`python -m accelerate_tpu.chaos.workload`)
+    the real-`Supervisor` path drives.
+
+CLI: ``accelerate-tpu chaos run|list-faults|report`` (docs/fault_tolerance.md).
+Importing this package never touches jax — workloads import it lazily.
+"""
+
+from .injectors import (
+    ChaosSession,
+    FakeClock,
+    FilesystemInjector,
+    HarnessInjector,
+    InjectedBackendError,
+    InjectedKill,
+    ServingInjector,
+    StepBoundaryInjector,
+    catalog,
+)
+from .plan import FAULT_KINDS, FAULT_PLAN_ENV, FaultEvent, FaultPlan, builtin_plans
+from .runner import ChaosRunner, InvariantCheck, InvariantReport
+
+__all__ = [
+    "FAULT_KINDS",
+    "FAULT_PLAN_ENV",
+    "FaultEvent",
+    "FaultPlan",
+    "builtin_plans",
+    "catalog",
+    "ChaosSession",
+    "FakeClock",
+    "FilesystemInjector",
+    "HarnessInjector",
+    "InjectedBackendError",
+    "InjectedKill",
+    "ServingInjector",
+    "StepBoundaryInjector",
+    "ChaosRunner",
+    "InvariantCheck",
+    "InvariantReport",
+]

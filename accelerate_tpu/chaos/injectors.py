@@ -1,0 +1,401 @@
+"""Composable fault injectors: the seams where scripted faults enter the stack.
+
+Each injector arms one seam the production code already owns:
+
+  - `FilesystemInjector` — the chaos hooks inside `checkpointing.atomic_write`
+    (write / fsync / rename-window) and `CheckpointManager._publish`
+    (directory rename + post-publish corruption), so torn writes, ENOSPC/EIO,
+    slow fsyncs and rename-window crashes land exactly where real storage
+    faults do.
+  - `StepBoundaryInjector` — polled at training step boundaries (the chaos
+    analogue of `ProfilerManager.poll()`): SIGKILL/SIGTERM delivery and forced
+    retraces.
+  - `ServingInjector` — wraps a `ContinuousBatcher`'s compiled-program
+    dispatches (decode chunk + per-bucket inserts) for stalls and failures;
+    queue bursts are driven by the runner.
+  - `HarnessInjector` — the seeded-regression fixture: neuters checkpoint
+    digest verification so the invariant checker (which verifies
+    independently) must go red.
+
+Every firing is counted in ``chaos_injected_total{kind=...}`` on the session's
+`MetricsRegistry` and journaled on `ChaosSession.injections`, so invariant
+reports can cross-check what was injected against what the goodput ledger and
+the serving counters recorded.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import fnmatch
+import os
+import signal as _signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..logging import get_logger
+from ..telemetry import MetricsRegistry
+from .plan import FAULT_KINDS, FaultEvent, FaultPlan
+
+logger = get_logger(__name__)
+
+_ERRNO_BY_NAME = {"ENOSPC": _errno.ENOSPC, "EIO": _errno.EIO}
+
+
+class InjectedKill(BaseException):
+    """The in-process SIGKILL analogue. Deliberately NOT an `Exception`: a hard
+    kill gives no handler a chance to clean up, so catch-all `except Exception`
+    blocks in the code under test must not swallow it either."""
+
+
+class InjectedBackendError(RuntimeError):
+    """A scripted backend/dispatch failure (the injected stand-in for a device
+    error during a compiled-program call)."""
+
+
+class FakeClock:
+    """Deterministic virtual clock for backoff/deadline tests: `sleep()`
+    advances the clock instead of blocking, so schedules spanning simulated
+    hours run in microseconds while every deadline comparison sees the full
+    wait."""
+
+    def __init__(self, start: float = 1_000_000.0):
+        self.t = float(start)
+        self.start = self.t
+        self.sleeps: List[float] = []
+
+    def time(self) -> float:
+        return self.t
+
+    def monotonic(self) -> float:
+        return self.t
+
+    def perf_counter(self) -> float:
+        return self.t
+
+    def sleep(self, seconds: float):
+        self.sleeps.append(float(seconds))
+        self.t += float(seconds)
+
+    def elapsed(self) -> float:
+        return self.t - self.start
+
+
+class _RealClock:
+    monotonic = staticmethod(time.monotonic)
+    perf_counter = staticmethod(time.perf_counter)
+    sleep = staticmethod(time.sleep)
+    time = staticmethod(time.time)
+
+
+class ChaosSession:
+    """Shared state for one chaos run: the plan, per-event trigger counters,
+    the injection journal, and the metrics registry the counters publish to.
+
+    `fire(kind, step=..., path=...)` is the single trigger evaluator every
+    injector calls at its seam: it returns the events that fire *now* (already
+    recorded/counted), so an injector's job reduces to "for each fired event,
+    do the damage"."""
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        registry: Optional[MetricsRegistry] = None,
+        clock=None,
+    ):
+        self.plan = plan
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.clock = clock if clock is not None else _RealClock()
+        self._lock = threading.Lock()
+        self._armed_at = self.clock.monotonic()
+        self._state = [{"calls": 0, "fired": 0} for _ in plan.events]
+        #: Journal of every injected fault: {"kind", "t_s", "step", "path"}.
+        self.injections: List[Dict[str, Any]] = []
+        #: Optional sink called with each injection record the moment it is
+        #: journaled — subprocess workloads persist records through this BEFORE
+        #: the fault lands (a SIGKILL firing right after must not erase the
+        #: evidence that it fired).
+        self.on_inject = None
+
+    def elapsed_s(self) -> float:
+        return self.clock.monotonic() - self._armed_at
+
+    def counts(self) -> Dict[str, int]:
+        """Injected-fault totals by kind (mirrors `chaos_injected_total`)."""
+        out: Dict[str, int] = {}
+        for entry in self.injections:
+            out[entry["kind"]] = out.get(entry["kind"], 0) + 1
+        return out
+
+    def event_fire_counts(self) -> List[int]:
+        """Per-event fired totals, aligned with `plan.events` (how invariant
+        checks attribute injected delays to the specific event that caused
+        them)."""
+        with self._lock:
+            return [state["fired"] for state in self._state]
+
+    def fire(
+        self,
+        kind: str,
+        step: Optional[int] = None,
+        path: Optional[str] = None,
+        require_pattern: bool = False,
+    ) -> List[FaultEvent]:
+        """Evaluate every event of `kind` against this call site's context.
+        A trigger field an event sets must match; a field it leaves unset never
+        constrains — EXCEPT that a path-triggered event only fires at path
+        sites, a step-triggered event only at step sites, and a site passing
+        `require_pattern` (the secondary seam of a multi-seam kind, e.g.
+        `proc.sigterm`'s artifact-write site) only fires events that opted in
+        with a `path_pattern`. Together the sites stay disjoint: one event is
+        only ever evaluated — and its call counter only ever advanced — at one
+        seam."""
+        fired: List[FaultEvent] = []
+        with self._lock:
+            for i, ev in enumerate(self.plan.events):
+                if ev.kind != kind:
+                    continue
+                if require_pattern and ev.path_pattern is None:
+                    continue
+                if ev.path_pattern is not None and (
+                    path is None or not _path_matches(path, ev.path_pattern)
+                ):
+                    continue
+                if ev.at_step is not None and step != ev.at_step:
+                    continue
+                if ev.after_s is not None and self.elapsed_s() < ev.after_s:
+                    continue
+                state = self._state[i]
+                state["calls"] += 1
+                if ev.at_call is not None and state["calls"] != ev.at_call:
+                    continue
+                if ev.times and state["fired"] >= ev.times:
+                    continue
+                state["fired"] += 1
+                self._record_locked(ev, step=step, path=path)
+                fired.append(ev)
+        if fired and self.on_inject is not None:
+            for entry in self.injections[-len(fired):]:
+                self.on_inject(dict(entry))
+        return fired
+
+    def _record_locked(self, event: FaultEvent, step: Optional[int], path: Optional[str]):
+        entry: Dict[str, Any] = {"kind": event.kind, "t_s": round(self.elapsed_s(), 6)}
+        if step is not None:
+            entry["step"] = step
+        if path is not None:
+            entry["path"] = os.path.basename(path)
+        self.injections.append(entry)
+        self.registry.counter(
+            "chaos_injected_total",
+            help="faults injected by the chaos subsystem, by kind",
+            labels={"kind": event.kind},
+        ).inc()
+        logger.info("chaos: injected %s (step=%s path=%s)", event.kind, step, entry.get("path"))
+
+
+def _path_matches(path: str, pattern: str) -> bool:
+    """Match the basename (the common case: 'model.npz*', 'MANIFEST.json') or,
+    for patterns with separators, the full path."""
+    if fnmatch.fnmatch(os.path.basename(path), pattern):
+        return True
+    return os.sep in pattern and fnmatch.fnmatch(path, pattern)
+
+
+# ------------------------------------------------------------------ filesystem
+class FilesystemInjector:
+    """Arms the chaos seam in `checkpointing` (`_chaos_hooks`): a context
+    manager so a crashed run can never leave faults armed for the next test."""
+
+    def __init__(self, session: ChaosSession):
+        self.session = session
+
+    def __enter__(self) -> "FilesystemInjector":
+        from .. import checkpointing
+
+        if checkpointing._chaos_hooks is not None:
+            raise RuntimeError("another FilesystemInjector is already armed")
+        checkpointing._chaos_hooks = self
+        return self
+
+    def __exit__(self, *exc):
+        from .. import checkpointing
+
+        checkpointing._chaos_hooks = None
+        return False
+
+    # ---- seam callbacks (called by checkpointing when armed) ----
+    def on_write(self, path: str):
+        """Entry of `atomic_write(path, ...)` — before any byte lands."""
+        # proc.sigterm's PRIMARY seam is the step boundary; only events that
+        # opted in with a path_pattern fire here (mid-commit delivery).
+        for ev in self.session.fire("proc.sigterm", path=path, require_pattern=True):
+            os.kill(os.getpid(), _signal.SIGTERM)
+        for ev in self.session.fire("fs.io_error", path=path):
+            code = _ERRNO_BY_NAME.get(str(ev.args.get("errno", "EIO")).upper(), _errno.EIO)
+            raise OSError(code, os.strerror(code), path)
+
+    def on_fsync(self, path: str):
+        """Just before the payload fsync."""
+        for ev in self.session.fire("fs.slow_fsync", path=path):
+            self.session.clock.sleep(float(ev.args.get("delay_s", 0.05)))
+
+    def on_rename(self, path: str):
+        """Inside the rename window: payload fsynced, `os.replace` not yet run."""
+        for ev in self.session.fire("fs.crash_in_rename", path=path):
+            raise InjectedKill(f"chaos: killed in rename window of {os.path.basename(path)}")
+
+    def on_publish_rename(self, staging: str, final: str):
+        """Before `CheckpointManager._publish`'s directory rename (transient
+        publish I/O errors land here)."""
+        for ev in self.session.fire("fs.io_error", path=final):
+            code = _ERRNO_BY_NAME.get(str(ev.args.get("errno", "EIO")).upper(), _errno.EIO)
+            raise OSError(code, os.strerror(code), final)
+
+    def on_published(self, final: str):
+        """After a checkpoint directory (and its latest pointer) committed:
+        post-commit corruption — the torn-persistence / bit-rot model."""
+        for root, dirs, names in os.walk(final):
+            for name in names:
+                full = os.path.join(root, name)
+                for ev in self.session.fire("fs.torn_write", path=full):
+                    _tear_file(full, ev.args)
+
+
+def _tear_file(path: str, args: Dict[str, Any]):
+    """Corrupt a committed file: truncate at a byte offset (or fraction of its
+    size), or flip one byte in place when args.flip is set."""
+    size = os.path.getsize(path)
+    if "offset_frac" in args:
+        offset = int(size * float(args["offset_frac"]))
+    else:
+        offset = int(args.get("offset", size // 2))
+    offset = max(0, min(offset, max(size - 1, 0)))
+    with open(path, "r+b") as f:
+        if args.get("flip"):
+            f.seek(offset)
+            byte = f.read(1)
+            f.seek(offset)
+            f.write(bytes([(byte[0] ^ 0xFF) if byte else 0xFF]))
+        else:
+            f.truncate(offset)
+
+
+# ------------------------------------------------------------------ process / backend
+class StepBoundaryInjector:
+    """Polled at step boundaries (`poll(step)`), like the profiler's capture
+    poll. `hard=True` delivers real signals (subprocess workloads); the
+    in-process default raises `InjectedKill` for SIGKILL so the supervised-loop
+    harness can observe the death without losing the interpreter."""
+
+    def __init__(self, session: ChaosSession, hard: bool = False):
+        self.session = session
+        self.hard = hard
+
+    def poll(self, step: int):
+        for _ev in self.session.fire("backend.recompile", step=step):
+            import jax
+
+            jax.clear_caches()
+        for _ev in self.session.fire("proc.sigterm", step=step):
+            os.kill(os.getpid(), _signal.SIGTERM)
+        for _ev in self.session.fire("proc.sigkill", step=step):
+            if self.hard:
+                os.kill(os.getpid(), _signal.SIGKILL)
+                time.sleep(5)  # unreachable — SIGKILL is unmaskable; belt for exotic platforms
+            raise InjectedKill(f"chaos: SIGKILL at step boundary {step}")
+
+
+# ------------------------------------------------------------------ serving
+class ServingInjector:
+    """Wraps a `ContinuousBatcher`'s compiled-program dispatches. Stalls and
+    failures fire by call count / wall clock (`at_call` counts decode-chunk
+    dispatches for `serve.dispatch_*` and insert dispatches for
+    `serve.insert_error`). Queue bursts are a workload-level fault the
+    `ChaosRunner` serve loop drives."""
+
+    def __init__(self, session: ChaosSession):
+        self.session = session
+
+    def arm(self, engine) -> "ServingInjector":
+        session = self.session
+        real_chunk = engine._chunk_fn
+
+        def chunk_with_chaos(*args, **kwargs):
+            for ev in session.fire("serve.dispatch_stall"):
+                session.clock.sleep(float(ev.args.get("delay_s", 0.05)))
+            for ev in session.fire("serve.dispatch_error"):
+                if ev.args.get("consume_donated"):
+                    _consume_donated_state(engine)
+                raise InjectedBackendError("chaos: decode-chunk dispatch failed")
+            return real_chunk(*args, **kwargs)
+
+        engine._chunk_fn = chunk_with_chaos
+        real_insert_fn = engine._insert_fn
+
+        def insert_fn_with_chaos(bucket):
+            fn = real_insert_fn(bucket)
+
+            def wrapped(*args, **kwargs):
+                for ev in session.fire("serve.insert_error"):
+                    if ev.args.get("consume_donated"):
+                        _consume_donated_state(engine)
+                    raise InjectedBackendError("chaos: insert dispatch failed")
+                return fn(*args, **kwargs)
+
+            return wrapped
+
+        engine._insert_fn = insert_fn_with_chaos
+        return self
+
+
+def _consume_donated_state(engine):
+    """Model the accelerator-only half of a dispatch failure: a program that
+    started executing CONSUMES its donated operands even when it fails, leaving
+    the engine's cache (and presence) referencing deleted buffers. CPU ignores
+    donation, so without this explicit `delete()` the poisoning the engine's
+    rebuild path guards against could never be exercised in tier-1 — the
+    regression pin would be vacuous."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(engine._cache):
+        if hasattr(leaf, "delete"):
+            leaf.delete()
+    if engine._presence is not None:
+        for leaf in jax.tree_util.tree_leaves(engine._presence):
+            if hasattr(leaf, "delete"):
+                leaf.delete()
+
+
+# ------------------------------------------------------------------ harness regression
+class HarnessInjector:
+    """`harness.disable_verification`: patch `checkpointing.verify_checkpoint_dir`
+    to vacuous truth — the scripted stand-in for a broken digest layer. The
+    invariant checker verifies checkpoints with its own independent hashing, so
+    a plan carrying this fault MUST produce a red report; a green one means the
+    harness itself can no longer detect regressions."""
+
+    def __init__(self, session: ChaosSession):
+        self.session = session
+        self._original = None
+
+    def __enter__(self) -> "HarnessInjector":
+        from .. import checkpointing
+
+        if self.session.fire("harness.disable_verification"):
+            self._original = checkpointing.verify_checkpoint_dir
+            checkpointing.verify_checkpoint_dir = lambda directory: True
+        return self
+
+    def __exit__(self, *exc):
+        if self._original is not None:
+            from .. import checkpointing
+
+            checkpointing.verify_checkpoint_dir = self._original
+            self._original = None
+        return False
+
+
+def catalog() -> Dict[str, str]:
+    """The fault-kind catalog (`accelerate-tpu chaos list-faults`)."""
+    return dict(FAULT_KINDS)

@@ -1,0 +1,226 @@
+"""Declarative, JSON-serializable fault plans (the chaos subsystem's contract).
+
+A `FaultPlan` is a seeded list of `FaultEvent`s: each names a fault *kind* from
+the injector catalog (`FAULT_KINDS`) plus the trigger that arms it — a step
+index (`at_step`), an N-th-matching-call count (`at_call`), a wall-clock offset
+from plan arm (`after_s`), and/or a filename glob (`path_pattern`) for
+filesystem faults. All specified trigger conditions AND together; `times`
+bounds how often an event fires (default once, `0` = every match). Everything
+is plain JSON, so a plan written once replays byte-identically — determinism is
+the point: a chaos failure must be a repro, not an anecdote.
+
+Plans reach launched worker processes through the ``ACCELERATE_TPU_FAULT_PLAN``
+environment variable (a path to a plan file, or inline JSON), the same
+two-sided protocol as the profiler's ``ACCELERATE_TPU_PROFILE_DIR``:
+`accelerate-tpu launch --fault_plan plan.json` exports it, and the worker-side
+workload re-arms via `FaultPlan.from_env()`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+#: Env var carrying the plan to launched workers (path to a JSON file, or
+#: inline JSON when the value starts with "{").
+FAULT_PLAN_ENV = "ACCELERATE_TPU_FAULT_PLAN"
+
+#: The injector catalog: every fault kind the subsystem can inject, with the
+#: seam it fires at. `accelerate-tpu chaos list-faults` prints this table.
+FAULT_KINDS: Dict[str, str] = {
+    "fs.torn_write": (
+        "post-commit corruption: truncate (or bit-flip with args.flip) a matching artifact of a "
+        "just-published checkpoint at args.offset bytes / args.offset_frac of its size"
+    ),
+    "fs.io_error": (
+        "raise OSError(args.errno: ENOSPC|EIO, default EIO) from a matching artifact write or "
+        "checkpoint-directory publish rename (transient-I/O / full-disk faults)"
+    ),
+    "fs.slow_fsync": "stall args.delay_s seconds (default 0.05) inside a matching artifact's fsync",
+    "fs.crash_in_rename": (
+        "die (InjectedKill) inside atomic_write's rename window — after the payload fsync, "
+        "before os.replace commits the matching artifact"
+    ),
+    "proc.sigkill": (
+        "hard kill at a matching step boundary: SIGKILL to self in subprocess workloads, "
+        "InjectedKill (a BaseException no handler may swallow) in-process"
+    ),
+    "proc.sigterm": (
+        "deliver SIGTERM to self at a matching step boundary or artifact write "
+        "(exercises the PreemptionHandler latch mid-commit)"
+    ),
+    "backend.recompile": "force a full retrace (jax.clear_caches()) at a matching step boundary",
+    "serve.dispatch_error": (
+        "a matching decode-chunk dispatch raises InjectedBackendError (the shared-executable "
+        "blast radius: every in-flight request errors, the engine must survive); "
+        "args.consume_donated additionally deletes the donated cache buffers, modeling an "
+        "accelerator dispatch that failed AFTER consuming its operands"
+    ),
+    "serve.dispatch_stall": "sleep args.delay_s (default 0.05) before a matching decode-chunk dispatch",
+    "serve.insert_error": (
+        "a matching insert (admission) dispatch raises (isolated to one request); "
+        "args.consume_donated deletes the donated cache buffers first (accelerator semantics)"
+    ),
+    "serve.queue_burst": (
+        "submit args.count (default 8) extra requests in one burst at a matching serve step "
+        "(drives the bounded queue into QueueFull backpressure)"
+    ),
+    "harness.disable_verification": (
+        "seeded-regression fixture: neuter checkpoint digest verification so torn checkpoints "
+        "resolve — the invariant report MUST go red (proves the harness detects regressions)"
+    ),
+}
+
+
+@dataclass
+class FaultEvent:
+    """One scripted fault. Trigger fields AND together; unset fields don't
+    constrain. `times` caps total firings (1 = once, 0 = every match)."""
+
+    kind: str
+    at_step: Optional[int] = None
+    at_call: Optional[int] = None
+    after_s: Optional[float] = None
+    path_pattern: Optional[str] = None
+    args: Dict[str, Any] = field(default_factory=dict)
+    times: int = 1
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; known kinds: {sorted(FAULT_KINDS)}"
+            )
+        if self.times < 0:
+            raise ValueError("times must be >= 0 (0 = unlimited)")
+
+    def to_dict(self) -> dict:
+        out = asdict(self)
+        # Compact serialization: drop unset trigger fields and empty args.
+        return {k: v for k, v in out.items() if v not in (None, {}) or k in ("kind", "times")}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "FaultEvent":
+        known = {"kind", "at_step", "at_call", "after_s", "path_pattern", "args", "times"}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(f"unknown FaultEvent field(s) {sorted(unknown)} in {data!r}")
+        return cls(**data)
+
+
+@dataclass
+class FaultPlan:
+    """A named, seeded fault schedule. The seed drives every random choice a
+    chaos workload makes (data, prompts), so one plan is one exact repro."""
+
+    name: str = "chaos"
+    seed: int = 0
+    events: List[FaultEvent] = field(default_factory=list)
+    notes: str = ""
+
+    def __post_init__(self):
+        self.events = [
+            ev if isinstance(ev, FaultEvent) else FaultEvent.from_dict(ev) for ev in self.events
+        ]
+
+    # ------------------------------------------------------------------ (de)serialization
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "seed": self.seed,
+            "events": [ev.to_dict() for ev in self.events],
+            **({"notes": self.notes} if self.notes else {}),
+        }
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "FaultPlan":
+        return cls(
+            name=data.get("name", "chaos"),
+            seed=int(data.get("seed", 0)),
+            events=[FaultEvent.from_dict(ev) for ev in data.get("events", [])],
+            notes=data.get("notes", ""),
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(text))
+
+    def save(self, path: str) -> str:
+        path = str(path)
+        with open(path, "w") as f:
+            f.write(self.to_json())
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "FaultPlan":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+    # ------------------------------------------------------------------ env protocol
+    @classmethod
+    def from_env(cls, environ: Optional[Dict[str, str]] = None) -> Optional["FaultPlan"]:
+        """Read the launch-propagated plan: ``ACCELERATE_TPU_FAULT_PLAN`` is a
+        path to a plan file, or inline JSON when it starts with ``{``. Returns
+        None when the env var is unset (no chaos armed)."""
+        value = (environ if environ is not None else os.environ).get(FAULT_PLAN_ENV)
+        if not value:
+            return None
+        value = value.strip()
+        if value.startswith("{"):
+            return cls.from_json(value)
+        return cls.load(value)
+
+
+# ------------------------------------------------------------------ builtin plans
+def builtin_plans() -> Dict[str, FaultPlan]:
+    """Named plans shipped with the CLI (`accelerate-tpu chaos run --plan NAME`).
+
+    `smoke-train` / `smoke-serve` are the clean fixtures (faults injected, every
+    invariant must hold, exit 0); `seeded-regression` deliberately neuters
+    digest verification so a torn manifest resolves — the run MUST exit
+    non-zero with a violated-invariant report, proving the harness can tell a
+    broken stack from a healthy one.
+    """
+    return {
+        "smoke-train": FaultPlan(
+            name="smoke-train",
+            seed=0,
+            notes="SIGKILL at a step boundary + SIGTERM inside a staged commit + a slow fsync: "
+            "the train recovery chain end to end",
+            events=[
+                FaultEvent(kind="fs.slow_fsync", path_pattern="model.npz*", at_call=1,
+                           args={"delay_s": 0.05}),
+                FaultEvent(kind="proc.sigkill", at_step=1),
+                FaultEvent(kind="proc.sigterm", path_pattern="model.npz*", at_call=4),
+            ],
+        ),
+        "smoke-serve": FaultPlan(
+            name="smoke-serve",
+            seed=0,
+            notes="dispatch stall + queue-full burst + one dispatch failure: every request must "
+            "still reach a terminal finish_reason",
+            events=[
+                FaultEvent(kind="serve.dispatch_stall", at_call=2, args={"delay_s": 0.02}),
+                FaultEvent(kind="serve.queue_burst", at_step=1, args={"count": 6}),
+                FaultEvent(kind="serve.dispatch_error", at_call=4),
+            ],
+        ),
+        "seeded-regression": FaultPlan(
+            name="seeded-regression",
+            seed=0,
+            notes="regression fixture: verification disabled + torn manifest -> the invariant "
+            "report must go red (non-zero exit)",
+            events=[
+                FaultEvent(kind="harness.disable_verification"),
+                FaultEvent(kind="fs.torn_write", path_pattern="MANIFEST.json", at_call=2,
+                           args={"offset": 0}),
+                # Kill IMMEDIATELY after the torn publish: the torn checkpoint
+                # is the newest, so the neutered resolver hands it to resume.
+                FaultEvent(kind="proc.sigkill", at_step=1),
+            ],
+        ),
+    }

@@ -1,0 +1,745 @@
+"""ChaosRunner: drive real train/serve workloads under a fault plan and emit a
+machine-readable invariant report.
+
+The runner owns the *invariants* the stack promises under faults, checked
+against evidence the workload journals as it runs:
+
+  - **resume_exactness** — every restart resumes from the last *committed*
+    checkpoint: the resolved manifest's step matches the newest
+    independently-verified checkpoint, and the restored parameter digest
+    matches what the journal recorded when that step was committed.
+  - **no_torn_resolved** — `resolve("latest")` never hands a resume a
+    checkpoint whose digests fail. Verification here is INDEPENDENT of
+    `checkpointing.verify_checkpoint_dir` (the runner re-hashes files
+    itself), so a regression — or the `harness.disable_verification`
+    seeded-regression fixture — turns the report red instead of being
+    vacuously green.
+  - **restart_budget** — restarts and injected downtime stay inside budget,
+    and the run actually completes.
+  - **terminal_finish_reasons** — under serving faults, every accepted request
+    drains to a terminal `finish_reason`; the engine recovers after a
+    dispatch failure; the bounded queue never exceeds its cap.
+  - **ledger_reconciles** — `chaos_injected_total{kind=...}` counters match
+    the injection journal, and injected downtime shows up in the goodput
+    ledger (slow fsyncs inside `save_state` land in the "checkpoint" cause,
+    resumes in "restart").
+
+Workloads are deliberately tiny (the regression model / a tiny llama) so full
+sweeps — SIGKILL at every boundary, torn bytes at every offset — run on CPU in
+tier-1 time. `run_supervised_train` additionally drives the real
+`fault_tolerance.Supervisor` over a subprocess workload with the plan
+propagated via ``ACCELERATE_TPU_FAULT_PLAN`` (`chaos.workload`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..logging import get_logger
+from ..telemetry import MetricsRegistry
+from .injectors import (
+    ChaosSession,
+    FilesystemInjector,
+    HarnessInjector,
+    InjectedKill,
+    ServingInjector,
+    StepBoundaryInjector,
+)
+from .plan import FAULT_PLAN_ENV, FaultPlan
+
+logger = get_logger(__name__)
+
+
+class _GracefulPreemption(Exception):
+    """In-process stand-in for the SIGTERM -> checkpoint -> exit-143 handoff."""
+
+
+# ------------------------------------------------------------------ independent evidence
+def independent_verify(directory: str) -> bool:
+    """Re-hash every file a checkpoint's MANIFEST.json names, with our own
+    hashlib walk — NOT `checkpointing.verify_checkpoint_dir`, which a chaos
+    plan (or a real regression) may have neutered. The auditor must never
+    share machinery with the system it audits."""
+    manifest_path = os.path.join(str(directory), "MANIFEST.json")
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):  # ValueError covers JSON errors AND flipped-byte utf-8 tears
+        return False
+    for rel, digest in manifest.get("files", {}).items():
+        h = hashlib.sha256()
+        try:
+            with open(os.path.join(str(directory), rel), "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+        except OSError:
+            return False
+        if h.hexdigest() != digest:
+            return False
+    return True
+
+
+def manifest_step(directory: str) -> Optional[int]:
+    try:
+        with open(os.path.join(str(directory), "MANIFEST.json")) as f:
+            return json.load(f).get("step")
+    except (OSError, ValueError):  # ValueError covers JSON errors AND flipped-byte utf-8 tears
+        return None
+
+
+def independent_latest_step(checkpoint_base: str) -> Optional[int]:
+    """Newest step among checkpoints that INDEPENDENTLY verify — what a correct
+    `resolve("latest")` must land on."""
+    best = None
+    if not os.path.isdir(checkpoint_base):
+        return None
+    for name in os.listdir(checkpoint_base):
+        path = os.path.join(checkpoint_base, name)
+        suffix = name[len("checkpoint_"):] if name.startswith("checkpoint_") else ""
+        if not suffix.isdigit() or not os.path.isdir(path):
+            continue
+        if independent_verify(path):
+            step = int(suffix)
+            best = step if best is None else max(best, step)
+    return best
+
+
+def params_digest(model) -> str:
+    """Content hash of a prepared model's parameters (path-keyed, host-side):
+    the resume-exactness fingerprint."""
+    from ..checkpointing import _flatten_with_paths
+
+    flat, _ = _flatten_with_paths(model.params)
+    h = hashlib.sha256()
+    for path, leaf in flat:
+        h.update(path.encode())
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def build_train_workload(base_dir: str, keep_last_n: int, seed: int):
+    """The canonical tiny train workload — shared by the in-process runner and
+    the subprocess `chaos.workload`, so both sides of the supervised story
+    exercise (and journal) the same thing. Returns (accelerator, model, opt,
+    prepared_dataloader)."""
+    import optax
+
+    from .. import Accelerator, SimpleDataLoader
+    from ..data_loader import BatchSampler
+    from ..test_utils.training import RegressionDataset, RegressionModel
+    from ..utils import ProjectConfiguration
+
+    accelerator = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(base_dir),
+            automatic_checkpoint_naming=True,
+            total_limit=keep_last_n,
+        )
+    )
+    n = 16
+    data = [RegressionDataset(length=n, seed=seed)[i] for i in range(n)]
+    dl = SimpleDataLoader(data, BatchSampler(range(n), 8))
+    model, opt, pdl = accelerator.prepare(RegressionModel(), optax.sgd(0.05), dl)
+    return accelerator, model, opt, pdl
+
+
+def resume_evidence(resolved: str, model, checkpoint_base: str) -> Dict[str, Any]:
+    """The journal record both train workloads write after a resume — one
+    schema, one producer, so the invariant checks can never diverge between
+    the in-process and subprocess paths."""
+    return {
+        "path": resolved,
+        "step": manifest_step(resolved),
+        "digest": params_digest(model),
+        "independently_verified": independent_verify(resolved),
+        "expected_step": independent_latest_step(checkpoint_base),
+    }
+
+
+# ------------------------------------------------------------------ report
+@dataclass
+class InvariantCheck:
+    name: str
+    passed: bool
+    details: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "passed": self.passed, "details": self.details}
+
+
+@dataclass
+class InvariantReport:
+    """The machine-readable outcome of one chaos run: plan, per-invariant
+    verdicts, the injection journal, and a registry snapshot (chaos counters +
+    whatever the workload instrumented)."""
+
+    plan: dict
+    workload: str
+    checks: List[InvariantCheck] = field(default_factory=list)
+    injections: List[dict] = field(default_factory=list)
+    metrics: List[dict] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(c.passed for c in self.checks)
+
+    @property
+    def violated(self) -> List[InvariantCheck]:
+        return [c for c in self.checks if not c.passed]
+
+    def to_dict(self) -> dict:
+        return {
+            "format": 1,
+            "plan": self.plan,
+            "workload": self.workload,
+            "ok": self.ok,
+            "checks": [c.to_dict() for c in self.checks],
+            "injections": self.injections,
+            "metrics": self.metrics,
+        }
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    def save(self, path: str) -> str:
+        with open(str(path), "w") as f:
+            f.write(self.to_json())
+        return str(path)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "InvariantReport":
+        return cls(
+            plan=data.get("plan", {}),
+            workload=data.get("workload", "?"),
+            checks=[
+                InvariantCheck(c["name"], bool(c["passed"]), c.get("details", {}))
+                for c in data.get("checks", [])
+            ],
+            injections=data.get("injections", []),
+            metrics=data.get("metrics", []),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "InvariantReport":
+        with open(str(path)) as f:
+            return cls.from_dict(json.load(f))
+
+    def render_text(self) -> str:
+        lines = [
+            f"chaos run: plan={self.plan.get('name', '?')} workload={self.workload} "
+            f"injections={len(self.injections)} -> {'OK' if self.ok else 'INVARIANTS VIOLATED'}"
+        ]
+        for check in self.checks:
+            mark = "ok " if check.passed else "FAIL"
+            lines.append(f"  [{mark}] {check.name}")
+            if not check.passed:
+                for key, value in sorted(check.details.items()):
+                    lines.append(f"         {key}: {value}")
+        counts: Dict[str, int] = {}
+        for entry in self.injections:
+            counts[entry["kind"]] = counts.get(entry["kind"], 0) + 1
+        for kind in sorted(counts):
+            lines.append(f"  injected {kind} x{counts[kind]}")
+        return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ runner
+class ChaosRunner:
+    """Execute a workload under a `FaultPlan` and check the recovery invariants."""
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        registry: Optional[MetricsRegistry] = None,
+        clock=None,
+    ):
+        self.plan = plan
+        self.session = ChaosSession(plan, registry=registry, clock=clock)
+
+    # ---------------------------------------------------------------- train
+    def run_train(
+        self,
+        base_dir: str,
+        steps: int = 8,
+        max_restarts: int = 16,
+        keep_last_n: int = 3,
+        downtime_budget_s: float = 5.0,
+    ) -> InvariantReport:
+        """In-process supervised train loop: RegressionModel, one checkpoint per
+        step, chaos polled at every boundary. An `InjectedKill` ends an attempt
+        exactly like a SIGKILL ends a process (no cleanup runs in the workload);
+        the runner then 'respawns' — fresh Accelerator, resume from latest —
+        until the run completes or the restart budget is spent."""
+        journal: Dict[str, Any] = {
+            "attempts": 0, "graceful_exits": 0, "saves": [], "intents": [], "resumes": [],
+        }
+        ledger: Dict[str, float] = {}
+        restarts = 0
+        downtime_s = 0.0
+        completed = False
+        boundary = StepBoundaryInjector(self.session, hard=False)
+        with FilesystemInjector(self.session), HarnessInjector(self.session):
+            while True:
+                journal["attempts"] += 1
+                try:
+                    self._train_attempt(base_dir, steps, keep_last_n, boundary, journal, ledger)
+                    completed = True
+                    break
+                except InjectedKill:
+                    pass  # hard kill: nothing in the attempt got to clean up
+                except _GracefulPreemption:
+                    journal["graceful_exits"] += 1
+                restarts += 1
+                if restarts > max_restarts:
+                    break
+                backoff = min(0.01 * restarts, 0.05)
+                self.session.clock.sleep(backoff)
+                downtime_s += backoff
+        checkpoint_base = os.path.join(str(base_dir), "checkpoints")
+        checks = [
+            self._check_resume_exactness(journal),
+            self._check_no_torn_resolved(journal, checkpoint_base),
+            self._check_restart_budget(completed, restarts, max_restarts, downtime_s,
+                                       downtime_budget_s),
+            self._check_ledger_reconciles(ledger, journal),
+        ]
+        return self._report("train", checks)
+
+    def _train_attempt(
+        self,
+        base_dir: str,
+        steps: int,
+        keep_last_n: int,
+        boundary: StepBoundaryInjector,
+        journal: Dict[str, Any],
+        ledger: Dict[str, float],
+    ):
+        accelerator, model, opt, pdl = build_train_workload(base_dir, keep_last_n, self.plan.seed)
+        handler = accelerator.register_preemption_checkpoint(exit_on_save=False)
+        stream = None
+        try:
+            manager = accelerator.checkpoint_manager()
+            start_step = 0
+            try:
+                resolved = manager.resolve("latest")
+            except FileNotFoundError:
+                resolved = None
+            if resolved is not None:
+                accelerator.load_state("latest")
+                evidence = resume_evidence(resolved, model, manager.base_dir)
+                journal["resumes"].append({"attempt": journal["attempts"], **evidence})
+                resumed_step = evidence["step"]
+                start_step = (resumed_step if resumed_step is not None else -1) + 1
+
+            def batches():
+                while True:
+                    for b in pdl:
+                        yield b
+
+            stream = batches()
+            for step in range(start_step, steps):
+                batch = next(stream)
+                accelerator.backward(model.loss, batch)
+                opt.step()
+                opt.zero_grad()
+                digest = params_digest(model)
+                # Intent BEFORE the save: a kill after the directory rename but
+                # before save_state returns leaves a committed checkpoint the
+                # journal would otherwise not know the digest of.
+                journal["intents"].append({"step": accelerator.save_iteration, "digest": digest})
+                path = accelerator.save_state()
+                journal["saves"].append({
+                    "attempt": journal["attempts"],
+                    "step": manifest_step(path),
+                    "digest": digest,
+                    "path": path,
+                })
+                boundary.poll(step)
+                if handler.preemption_requested:
+                    raise _GracefulPreemption()
+        finally:
+            if stream is not None:
+                # A kill mid-iteration leaves the loader generator suspended;
+                # close it here instead of letting GC tear it down mid-suite.
+                stream.close()
+            for cause, seconds in accelerator.timeline.goodput()["lost_s"].items():
+                ledger[cause] = ledger.get(cause, 0.0) + seconds
+            handler.uninstall()
+
+    # ---------------------------------------------------------------- supervised train
+    def run_supervised_train(
+        self,
+        base_dir: str,
+        steps: int = 5,
+        max_restarts: int = 4,
+        downtime_budget_s: float = 30.0,
+    ) -> InvariantReport:
+        """The end-to-end path: the real `Supervisor` restarting a real
+        subprocess workload (`python -m accelerate_tpu.chaos.workload`), the
+        plan propagated through ``ACCELERATE_TPU_FAULT_PLAN`` exactly as
+        `accelerate-tpu launch --fault_plan` would."""
+        from ..fault_tolerance import PREEMPTED_EXIT_CODE, Supervisor
+
+        base_dir = str(base_dir)
+        os.makedirs(base_dir, exist_ok=True)
+        plan_path = self.plan.save(os.path.join(base_dir, "fault_plan.json"))
+        env = dict(os.environ)
+        env[FAULT_PLAN_ENV] = plan_path
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        cmd = [
+            sys.executable, "-m", "accelerate_tpu.chaos.workload",
+            "--base-dir", base_dir, "--steps", str(steps),
+        ]
+        # A clean preemption handoff (exit 143) ENDS supervision by design —
+        # in production the scheduler respawns the whole job. The runner plays
+        # the scheduler: re-run the supervisor after each handoff (counted
+        # against the same budget) until the workload completes or fails.
+        restarts = 0
+        preemption_handoffs = 0
+        downtime_s = 0.0
+        crash_loop = False
+        while True:
+            supervisor = Supervisor(
+                cmd,
+                env=env,
+                max_restarts=max_restarts - restarts,
+                grace_period=30.0,
+                backoff_seconds=0.05,
+                max_backoff_seconds=0.2,
+                monitor_interval=0.05,
+                crash_loop_min_uptime=0.0,  # every attempt imports jax; uptime is not a crash signal here
+            )
+            code = supervisor.run()
+            restarts += supervisor.restart_count
+            downtime_s += supervisor.downtime_s
+            crash_loop = crash_loop or supervisor.crash_loop_detected
+            if code == PREEMPTED_EXIT_CODE and preemption_handoffs + restarts < max_restarts:
+                preemption_handoffs += 1
+                continue
+            break
+        journal = self._read_workload_journal(base_dir)
+        checkpoint_base = os.path.join(base_dir, "checkpoints")
+        checks = [
+            self._check_resume_exactness(journal),
+            self._check_no_torn_resolved(journal, checkpoint_base),
+            InvariantCheck(
+                "supervisor",
+                passed=code == 0 and restarts + preemption_handoffs <= max_restarts
+                and downtime_s <= downtime_budget_s,
+                details={
+                    "exit_code": code,
+                    "restarts": restarts,
+                    "preemption_handoffs": preemption_handoffs,
+                    "max_restarts": max_restarts,
+                    "downtime_s": round(downtime_s, 6),
+                    "downtime_budget_s": downtime_budget_s,
+                    "crash_loop_detected": crash_loop,
+                },
+            ),
+        ]
+        # The workload's own injections happened in child processes; fold its
+        # journal into ours so the report still carries them.
+        for entry in journal.get("injections", []):
+            self.session.injections.append(entry)
+            self.session.registry.counter(
+                "chaos_injected_total",
+                help="faults injected by the chaos subsystem, by kind",
+                labels={"kind": entry["kind"]},
+            ).inc()
+        return self._report("supervised-train", checks)
+
+    @staticmethod
+    def _read_workload_journal(base_dir: str) -> Dict[str, Any]:
+        journal: Dict[str, Any] = {
+            "attempts": 0, "graceful_exits": 0, "saves": [], "intents": [],
+            "resumes": [], "injections": [],
+        }
+        path = os.path.join(str(base_dir), "chaos_journal.jsonl")
+        if not os.path.isfile(path):
+            return journal
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # a torn final line from a killed writer
+                rtype = record.pop("type", None)
+                if rtype == "attempt":
+                    journal["attempts"] += 1
+                elif rtype == "graceful_exit":
+                    journal["graceful_exits"] += 1
+                elif rtype in ("save", "intent", "resume", "injection"):
+                    journal[rtype + "s"].append(record)
+        return journal
+
+    # ---------------------------------------------------------------- serve
+    def run_serve(
+        self,
+        num_requests: int = 8,
+        num_slots: int = 2,
+        chunk_size: int = 4,
+        max_queue: int = 4,
+        max_new_tokens: int = 4,
+        max_cycles: int = 200,
+    ) -> InvariantReport:
+        """Serving workload: a tiny llama `ContinuousBatcher` fed one request
+        per cycle (plus scripted queue bursts), driven to drain under injected
+        dispatch stalls/failures. Chaos shares the engine's metrics registry so
+        the report's snapshot carries both."""
+        from ..models.llama import LlamaConfig, create_llama_model
+        from ..serving import FINISH_REASONS, ContinuousBatcher, QueueFull, Request
+
+        cfg = LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+            rope_theta=10000.0,
+        )
+        model = create_llama_model(cfg, seq_len=32)
+        engine = ContinuousBatcher(
+            model, num_slots=num_slots, max_length=64, chunk_size=chunk_size,
+            max_queue=max_queue, registry=self.session.registry,
+        )
+        ServingInjector(self.session).arm(engine)
+        rng = np.random.default_rng(self.plan.seed)
+
+        next_id = 0
+        rejected = 0
+        accepted: List[int] = []
+        first_id_after_error: Optional[int] = None
+
+        def make_request() -> Request:
+            nonlocal next_id
+            prompt = rng.integers(1, cfg.vocab_size, (int(rng.integers(2, 9)),)).astype(np.int32)
+            request = Request(next_id, prompt, max_new_tokens=max_new_tokens)
+            next_id += 1
+            return request
+
+        def submit_one() -> bool:
+            nonlocal rejected
+            request = make_request()
+            try:
+                engine.submit(request)
+            except QueueFull:
+                rejected += 1
+                return False
+            accepted.append(request.request_id)
+            return True
+
+        # After a dispatch failure's blast radius, the recovery invariant needs
+        # live evidence: keep the workload submitting a couple of fresh probe
+        # requests past the failure so "the engine still serves" is observed,
+        # not assumed.
+        error_kinds = ("serve.dispatch_error", "serve.insert_error")
+        recovery_probes = 2 if any(ev.kind in error_kinds for ev in self.plan.events) else 0
+        probes_sent = 0
+        errors_before = 0
+        cycles = 0
+        stalled = False
+        while (
+            len(accepted) < num_requests
+            or engine.pending
+            or (first_id_after_error is not None and probes_sent < recovery_probes)
+        ):
+            if cycles >= max_cycles:
+                stalled = True
+                break
+            if len(accepted) < num_requests:
+                submit_one()
+            elif first_id_after_error is not None and probes_sent < recovery_probes:
+                if submit_one():
+                    probes_sent += 1
+            for ev in self.session.fire("serve.queue_burst", step=cycles):
+                for _ in range(int(ev.args.get("count", 8))):
+                    submit_one()
+            engine.step()
+            error_count = sum(
+                1 for e in self.session.injections if e["kind"] in error_kinds
+            )
+            if error_count > errors_before and first_id_after_error is None:
+                first_id_after_error = next_id
+            errors_before = error_count
+            cycles += 1
+        results = dict(engine.drain())
+        engine.close()
+
+        finish_reasons = {
+            rid: results[rid].finish_reason if rid in results else None for rid in accepted
+        }
+        non_terminal = {
+            rid: reason for rid, reason in finish_reasons.items()
+            if reason not in FINISH_REASONS
+        }
+        checks = [
+            InvariantCheck(
+                "terminal_finish_reasons",
+                passed=not non_terminal and not stalled,
+                details={
+                    "accepted": len(accepted), "rejected_queue_full": rejected,
+                    "non_terminal": non_terminal, "stalled": stalled, "cycles": cycles,
+                },
+            ),
+            InvariantCheck(
+                "queue_bounded",
+                passed=int(engine.stats["queue_peak"]) <= max_queue,
+                details={"queue_peak": int(engine.stats["queue_peak"]), "max_queue": max_queue},
+            ),
+            self._check_engine_recovered(finish_reasons, first_id_after_error),
+            self._check_serve_ledger(engine, accepted),
+        ]
+        return self._report("serve", checks)
+
+    def _check_engine_recovered(
+        self, finish_reasons: Dict[int, Optional[str]], first_id_after_error: Optional[int]
+    ) -> InvariantCheck:
+        """After a dispatch failure's blast radius, requests submitted LATER
+        must still complete normally — the engine degrades per-step, never
+        permanently."""
+        if first_id_after_error is None:
+            return InvariantCheck(
+                "engine_recovered", True, {"note": "no dispatch_error fault in plan"}
+            )
+        later = {r: fr for r, fr in finish_reasons.items() if r >= first_id_after_error}
+        bad = {r: fr for r, fr in later.items() if fr == "error"}
+        return InvariantCheck(
+            "engine_recovered",
+            passed=bool(later) and not bad,
+            details={
+                "requests_after_error": len(later),
+                "errored_after_recovery": bad,
+                "first_id_after_error": first_id_after_error,
+            },
+        )
+
+    def _check_serve_ledger(self, engine, accepted: List[int]) -> InvariantCheck:
+        counts = self.session.counts()
+        registry_ok = all(
+            self.session.registry.value("chaos_injected_total", {"kind": kind}) == count
+            for kind, count in counts.items()
+        )
+        finished_total = sum(engine.stats["finish_reasons"].values())
+        return InvariantCheck(
+            "ledger_reconciles",
+            passed=registry_ok and finished_total == len(accepted),
+            details={
+                "injected_counts": counts,
+                "registry_matches_journal": registry_ok,
+                "finished_total": finished_total,
+                "accepted": len(accepted),
+            },
+        )
+
+    # ---------------------------------------------------------------- shared checks
+    @staticmethod
+    def _check_resume_exactness(journal: Dict[str, Any]) -> InvariantCheck:
+        failures = []
+        known = {}
+        for entry in journal["intents"]:
+            known.setdefault(entry["step"], set()).add(entry["digest"])
+        for entry in journal["saves"]:
+            known.setdefault(entry["step"], set()).add(entry["digest"])
+        for resume in journal["resumes"]:
+            step, digest = resume.get("step"), resume.get("digest")
+            if step is None:
+                failures.append({"resume": resume, "why": "resolved checkpoint has no step"})
+            elif step not in known:
+                failures.append({"resume": resume, "why": f"no committed save for step {step}"})
+            elif digest not in known[step]:
+                failures.append({"resume": resume, "why": "restored params != committed digest"})
+        return InvariantCheck(
+            "resume_exactness",
+            passed=not failures,
+            details={"resumes": len(journal["resumes"]), "failures": failures},
+        )
+
+    @staticmethod
+    def _check_no_torn_resolved(journal: Dict[str, Any], checkpoint_base: str) -> InvariantCheck:
+        failures = []
+        for resume in journal["resumes"]:
+            if not resume.get("independently_verified"):
+                failures.append({"resume": resume, "why": "resolved checkpoint fails digests"})
+            elif resume.get("expected_step") is not None and resume.get("step") != resume.get(
+                "expected_step"
+            ):
+                failures.append({
+                    "resume": resume,
+                    "why": "resolve() skipped or overshot the newest verified checkpoint",
+                })
+        # Terminal state: whatever 'latest' would resolve to now must verify.
+        final_latest = independent_latest_step(checkpoint_base)
+        return InvariantCheck(
+            "no_torn_resolved",
+            passed=not failures,
+            details={
+                "resumes": len(journal["resumes"]),
+                "failures": failures,
+                "final_verified_latest_step": final_latest,
+            },
+        )
+
+    @staticmethod
+    def _check_restart_budget(
+        completed: bool, restarts: int, max_restarts: int, downtime_s: float,
+        downtime_budget_s: float,
+    ) -> InvariantCheck:
+        return InvariantCheck(
+            "restart_budget",
+            passed=completed and restarts <= max_restarts and downtime_s <= downtime_budget_s,
+            details={
+                "completed": completed,
+                "restarts": restarts,
+                "max_restarts": max_restarts,
+                "downtime_s": round(downtime_s, 6),
+                "downtime_budget_s": downtime_budget_s,
+            },
+        )
+
+    def _check_ledger_reconciles(
+        self, ledger: Dict[str, float], journal: Dict[str, Any]
+    ) -> InvariantCheck:
+        counts = self.session.counts()
+        registry_ok = all(
+            self.session.registry.value("chaos_injected_total", {"kind": kind}) == count
+            for kind, count in counts.items()
+        )
+        fired = self.session.event_fire_counts()
+        injected_fsync_s = sum(
+            float(ev.args.get("delay_s", 0.05)) * fired[i]
+            for i, ev in enumerate(self.plan.events)
+            if ev.kind == "fs.slow_fsync"
+        )
+        # Injected fsync stalls happen inside save_state, so the goodput
+        # ledger's "checkpoint" cause must carry at least that much (10%
+        # scheduling tolerance); every resume charges "restart".
+        checkpoint_ok = ledger.get("checkpoint", 0.0) >= 0.9 * injected_fsync_s
+        restart_ok = (not journal["resumes"]) or ledger.get("restart", 0.0) > 0.0
+        return InvariantCheck(
+            "ledger_reconciles",
+            passed=registry_ok and checkpoint_ok and restart_ok,
+            details={
+                "injected_counts": counts,
+                "registry_matches_journal": registry_ok,
+                "goodput_ledger_s": {k: round(v, 6) for k, v in sorted(ledger.items())},
+                "injected_fsync_s": round(injected_fsync_s, 6),
+            },
+        )
+
+    # ---------------------------------------------------------------- report assembly
+    def _report(self, workload: str, checks: List[InvariantCheck]) -> InvariantReport:
+        return InvariantReport(
+            plan=self.plan.to_dict(),
+            workload=workload,
+            checks=checks,
+            injections=list(self.session.injections),
+            metrics=self.session.registry.snapshot(),
+        )

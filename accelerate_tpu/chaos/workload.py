@@ -1,0 +1,95 @@
+"""Subprocess chaos workload: the worker side of the ``ACCELERATE_TPU_FAULT_PLAN``
+env protocol.
+
+``python -m accelerate_tpu.chaos.workload --base-dir DIR --steps N`` runs the
+tiny supervised training loop under whatever plan the environment carries —
+real signals this time (`proc.sigkill` is an actual SIGKILL, `proc.sigterm`
+exercises the real `PreemptionHandler` -> `check_preemption()` -> exit-143
+handoff) — and journals its evidence to ``DIR/chaos_journal.jsonl`` for the
+`ChaosRunner.run_supervised_train` invariant checks. Each journal line is one
+JSON record flushed before the next step, so a SIGKILL tears at most the line
+in flight (the reader skips it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .injectors import ChaosSession, FilesystemInjector, HarnessInjector, StepBoundaryInjector
+from .plan import FaultPlan
+from .runner import build_train_workload, manifest_step, params_digest, resume_evidence
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("accelerate-tpu chaos workload")
+    parser.add_argument("--base-dir", required=True, help="project dir (checkpoints + journal)")
+    parser.add_argument("--steps", type=int, default=5)
+    parser.add_argument("--keep-last-n", type=int, default=3)
+    args = parser.parse_args(argv)
+
+    plan = FaultPlan.from_env() or FaultPlan(name="empty")
+    session = ChaosSession(plan)
+    journal_path = os.path.join(args.base_dir, "chaos_journal.jsonl")
+    os.makedirs(args.base_dir, exist_ok=True)
+    journal_file = open(journal_path, "a")
+
+    def journal(record: dict):
+        journal_file.write(json.dumps(record) + "\n")
+        journal_file.flush()
+        os.fsync(journal_file.fileno())
+
+    # Persist each injection record BEFORE its fault lands: a SIGKILL firing at
+    # a step boundary must not erase the evidence that it fired.
+    session.on_inject = lambda entry: journal({"type": "injection", **entry})
+    journal({"type": "attempt", "pid": os.getpid()})
+
+    accelerator, model, opt, pdl = build_train_workload(args.base_dir, args.keep_last_n, plan.seed)
+    accelerator.register_preemption_checkpoint()  # real SIGTERM latch + exit 143
+
+    boundary = StepBoundaryInjector(session, hard=True)
+    with FilesystemInjector(session), HarnessInjector(session):
+        manager = accelerator.checkpoint_manager()
+        start_step = 0
+        try:
+            resolved = manager.resolve("latest")
+        except FileNotFoundError:
+            resolved = None
+        if resolved is not None:
+            accelerator.load_state("latest")
+            evidence = resume_evidence(resolved, model, manager.base_dir)
+            journal({"type": "resume", **evidence})
+            resumed_step = evidence["step"]
+            start_step = (resumed_step if resumed_step is not None else -1) + 1
+
+        def batches():
+            while True:
+                for b in pdl:
+                    yield b
+
+        stream = batches()
+        for step in range(start_step, args.steps):
+            batch = next(stream)
+            accelerator.backward(model.loss, batch)
+            opt.step()
+            opt.zero_grad()
+            digest = params_digest(model)
+            journal({"type": "intent", "step": accelerator.save_iteration, "digest": digest})
+            path = accelerator.save_state()
+            journal({"type": "save", "step": manifest_step(path), "digest": digest, "path": path})
+            boundary.poll(step)
+            if accelerator.preemption_requested:
+                # Journal the preemption checkpoint's intent first: params are
+                # unchanged since this step's save, so the digest carries over.
+                journal({
+                    "type": "intent", "step": accelerator.save_iteration, "digest": digest,
+                })
+                journal({"type": "graceful_exit", "step": step})
+                accelerator.check_preemption()  # saves + SystemExit(143)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,12 @@ CHECKPOINT_MANIFEST_NAME = "MANIFEST.json"
 LATEST_POINTER_NAME = "latest"
 _STAGING_PREFIX = ".tmp-"
 
+# Chaos seam (`accelerate_tpu.chaos.injectors.FilesystemInjector`): when armed,
+# consulted at the fault-relevant points of the commit sequence — artifact
+# write entry, the payload fsync, the rename window, the directory publish.
+# None in production; every call site is a single attribute test.
+_chaos_hooks = None
+
 
 class CheckpointCorruptError(RuntimeError):
     """An artifact failed digest verification (torn write, bit rot, truncation)."""
@@ -91,13 +97,20 @@ def atomic_write(path: str, writer: Callable, mode: str = "wb"):
     observe a torn file. The temp name is randomized (mkstemp) so concurrent
     writers in one directory can't collide."""
     path = str(path)
+    hooks = _chaos_hooks
+    if hooks is not None:
+        hooks.on_write(path)
     directory = os.path.dirname(path) or "."
     fd, tmp = tempfile.mkstemp(dir=directory, prefix=os.path.basename(path) + ".tmp-")
     try:
         with os.fdopen(fd, mode) as f:
             writer(f)
             f.flush()
+            if hooks is not None:
+                hooks.on_fsync(path)
             os.fsync(f.fileno())
+        if hooks is not None:
+            hooks.on_rename(path)
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -572,6 +585,18 @@ def save_custom_state(obj, path: str, index: int = 0):
 
 
 # ------------------------------------------------------------------ crash-safe manager
+def _rmtree_missing_ok(path: str):
+    """`shutil.rmtree` that treats an already-gone tree as success — required
+    under `_retry` (chaos-surfaced bug): a first attempt that raised a
+    transient error AFTER deleting most of the tree must not make the retry
+    fail on the now-missing path and abort a save whose rotation had in fact
+    completed."""
+    try:
+        shutil.rmtree(path)
+    except FileNotFoundError:
+        pass
+
+
 def write_checkpoint_manifest(directory: str, step: Optional[int] = None) -> str:
     """Commit record for a checkpoint DIRECTORY: scan every artifact, digest it,
     and atomically write `MANIFEST.json`. Written LAST — its presence asserts
@@ -598,7 +623,7 @@ def write_checkpoint_manifest(directory: str, step: Optional[int] = None) -> str
         try:
             with open(os.path.join(directory, rel)) as f:
                 digest = json.load(f).get("npz_sha256")
-        except (OSError, json.JSONDecodeError):
+        except (OSError, ValueError):  # ValueError: JSON errors AND flipped-byte utf-8 tears
             continue
         if digest:
             known[rel[: -len(".manifest.json")] + ".npz"] = digest
@@ -618,7 +643,11 @@ def verify_checkpoint_dir(directory: str) -> bool:
     try:
         with open(manifest_path) as f:
             manifest = json.load(f)
-    except (OSError, json.JSONDecodeError):
+    except (OSError, ValueError):
+        # ValueError, not just JSONDecodeError (chaos-surfaced bug): a single
+        # flipped byte can make the manifest invalid UTF-8, and the resulting
+        # UnicodeDecodeError used to CRASH resolution instead of reading as
+        # "this checkpoint does not verify — fall back".
         return False
     for rel, digest in manifest.get("files", {}).items():
         full = os.path.join(str(directory), rel)
@@ -818,20 +847,37 @@ class CheckpointManager:
                 # Retire the torn dir just before publishing: the new checkpoint
                 # (manifest included) is already fully on disk in staging, so a
                 # kill in this window loses nothing that could have been loaded.
-                self._retry(lambda: shutil.rmtree(final), f"reap of torn {final}")
+                self._retry(lambda: _rmtree_missing_ok(final), f"reap of torn {final}")
             self._retry(lambda: self._publish(staging, final), "checkpoint publish")
             self._rotate(keep=final)
         barrier()
         return final
 
     def _publish(self, staging: str, final: str):
-        os.replace(staging, final)  # THE commit point (atomic dir rename)
+        # Idempotent under `_retry` (chaos-surfaced bug): a transient failure
+        # AFTER the rename — the directory fsync or the pointer write — used to
+        # make the retry re-run `os.replace` on a staging dir that no longer
+        # exists, so a fully-committed checkpoint still raised out of save()
+        # and the caller burned a restart on a save that had in fact succeeded.
+        # The rename is THE commit point; once `final` exists, a retry only
+        # needs to finish the pointer swap.
+        hooks = _chaos_hooks
+        if os.path.isdir(staging):
+            if hooks is not None:
+                hooks.on_publish_rename(staging, final)
+            os.replace(staging, final)  # THE commit point (atomic dir rename)
+        elif not os.path.isdir(final):
+            raise FileNotFoundError(
+                f"checkpoint publish lost both staging ({staging}) and committed ({final}) dirs"
+            )
         _fsync_directory(self.base_dir)
         atomic_write(
             os.path.join(self.base_dir, LATEST_POINTER_NAME),
             lambda f: f.write(os.path.basename(final)),
             mode="w",
         )
+        if hooks is not None:
+            hooks.on_published(final)
 
     def _rotate(self, keep: str):
         if self.keep_last_n is None:
@@ -852,7 +898,7 @@ class CheckpointManager:
             if os.path.abspath(path) == os.path.abspath(keep):
                 continue  # never reap the checkpoint just committed
             logger.info("rotating out checkpoint %s (keep_last_n=%d)", path, self.keep_last_n)
-            self._retry(lambda p=path: shutil.rmtree(p), f"rotation of {path}")
+            self._retry(lambda p=path: _rmtree_missing_ok(p), f"rotation of {path}")
             excess -= 1
 
 

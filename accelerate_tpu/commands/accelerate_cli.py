@@ -16,9 +16,10 @@ def get_command_parser():
 
     # Subcommand modules are imported lazily so `--help` stays fast and optional deps
     # (yaml, rich) are only touched by the commands that need them.
-    from . import analysis, config, convert, env, estimate, launch, test, tpu
+    from . import analysis, chaos, config, convert, env, estimate, launch, test, tpu
 
     analysis.register_subcommand(subparsers)
+    chaos.register_subcommand(subparsers)
     config.register_subcommand(subparsers)
     env.register_subcommand(subparsers)
     estimate.register_subcommand(subparsers)

@@ -1,0 +1,136 @@
+"""`accelerate-tpu chaos` — deterministic fault-injection runs with invariant
+reports.
+
+Subcommands (exit codes mirror `analyze`'s CI contract):
+
+  - ``chaos run`` — execute a train or serve workload under a fault plan and
+    check the end-to-end recovery invariants. Exit 0 when every invariant
+    holds, 1 when any is violated (the report says which), 2 on usage errors.
+  - ``chaos list-faults`` — print the injector catalog (fault kind + effect).
+  - ``chaos report FILE`` — re-render a saved invariant report; exits with the
+    report's verdict, so a stored artifact gates CI the same way a live run
+    does.
+
+``--plan`` takes a JSON plan file or a builtin name (``smoke-train``,
+``smoke-serve``, ``seeded-regression``). The seeded-regression fixture MUST
+exit non-zero: it scripts a broken digest layer, and a green report there means
+the harness can no longer detect regressions.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "chaos",
+        help="Run train/serve workloads under deterministic fault injection and check recovery invariants",
+        description=__doc__,
+    )
+    sub = parser.add_subparsers(dest="chaos_command")
+
+    run = sub.add_parser("run", help="Execute a workload under a fault plan")
+    run.add_argument(
+        "--plan",
+        default="smoke-train",
+        help="Fault plan: a JSON file path or a builtin name (smoke-train, smoke-serve, "
+        "seeded-regression). Default: smoke-train",
+    )
+    run.add_argument(
+        "--workload",
+        default=None,
+        choices=(None, "train", "serve", "supervised-train"),
+        help="Workload to drive (default: inferred from the plan's fault kinds)",
+    )
+    run.add_argument("--base-dir", default=None, help="Checkpoint/journal dir (default: a temp dir)")
+    run.add_argument("--steps", type=int, default=6, help="Train steps (train workloads)")
+    run.add_argument("--requests", type=int, default=8, help="Requests (serve workloads)")
+    run.add_argument("--json", action="store_true", dest="as_json", help="Emit the report as JSON")
+    run.add_argument("--report-out", default=None, help="Also save the report JSON to this path")
+    run.set_defaults(func=chaos_run_command)
+
+    list_faults = sub.add_parser("list-faults", help="Print the fault-kind catalog")
+    list_faults.set_defaults(func=chaos_list_faults_command)
+
+    report = sub.add_parser("report", help="Re-render a saved invariant report")
+    report.add_argument("report_file", help="Path to a report JSON written by `chaos run --report-out`")
+    report.add_argument("--json", action="store_true", dest="as_json")
+    report.set_defaults(func=chaos_report_command)
+
+    parser.set_defaults(func=lambda args: parser.print_help() or sys.exit(2))
+    return parser
+
+
+def _load_plan(spec: str):
+    from ..chaos import FaultPlan, builtin_plans
+
+    plans = builtin_plans()
+    if spec in plans:
+        return plans[spec]
+    if not os.path.isfile(spec):
+        print(
+            f"accelerate-tpu chaos: plan {spec!r} is neither a file nor a builtin "
+            f"({', '.join(sorted(plans))})",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    try:
+        return FaultPlan.load(spec)
+    except (ValueError, KeyError, OSError) as exc:
+        print(f"accelerate-tpu chaos: bad plan file {spec}: {exc}", file=sys.stderr)
+        raise SystemExit(2)
+
+
+def _infer_workload(plan) -> str:
+    return "serve" if any(ev.kind.startswith("serve.") for ev in plan.events) else "train"
+
+
+def chaos_run_command(args):
+    import contextlib
+
+    from ..chaos import ChaosRunner
+
+    plan = _load_plan(args.plan)
+    workload = args.workload or _infer_workload(plan)
+    runner = ChaosRunner(plan)
+    if workload == "serve":
+        report = runner.run_serve(num_requests=args.requests)
+    else:
+        # Default scratch dirs are cleaned up after the report is assembled
+        # (checkpoint trees add up across CI runs); an explicit --base-dir is
+        # the user's to keep for post-mortems.
+        with contextlib.ExitStack() as stack:
+            base_dir = args.base_dir or stack.enter_context(
+                tempfile.TemporaryDirectory(prefix="accelerate_tpu_chaos_")
+            )
+            if workload == "supervised-train":
+                report = runner.run_supervised_train(base_dir, steps=args.steps)
+            else:
+                report = runner.run_train(base_dir, steps=args.steps)
+    if args.report_out:
+        report.save(args.report_out)
+    print(report.to_json() if args.as_json else report.render_text())
+    raise SystemExit(0 if report.ok else 1)
+
+
+def chaos_list_faults_command(args):
+    from ..chaos import catalog
+
+    for kind, description in sorted(catalog().items()):
+        print(f"{kind:<28} {description}")
+    raise SystemExit(0)
+
+
+def chaos_report_command(args):
+    from ..chaos import InvariantReport
+
+    try:
+        report = InvariantReport.load(args.report_file)
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"accelerate-tpu chaos report: {exc}", file=sys.stderr)
+        raise SystemExit(2)
+    print(report.to_json() if args.as_json else report.render_text())
+    raise SystemExit(0 if report.ok else 1)

@@ -63,6 +63,19 @@ def add_launch_args(parser):
         default=None,
         help="Backoff ceiling in seconds so a crash loop with a large budget never sleeps unboundedly (default 30)",
     )
+    parser.add_argument(
+        "--crash_loop_threshold",
+        type=int,
+        default=None,
+        help="Abort supervision after N consecutive identical-exit-code crashes where the child "
+        "lived under the uptime floor (default 3; 0 disables crash-loop detection)",
+    )
+    parser.add_argument(
+        "--fault_plan",
+        default=None,
+        help="Chaos fault plan (JSON file) exported to every worker as ACCELERATE_TPU_FAULT_PLAN "
+        "(accelerate-tpu chaos; docs/fault_tolerance.md) — fault-injection runs only",
+    )
     parser.add_argument("--tpu_use_cluster", action="store_true", help="Launch on every worker of a TPU pod")
     parser.add_argument("--tpu_name", default=None)
     parser.add_argument("--tpu_zone", default=None)
@@ -102,6 +115,9 @@ def build_launch_env(args, config: dict) -> dict:
     profile_dir = pick(args.profile_dir, "profile_dir")
     if profile_dir:
         env["ACCELERATE_TPU_PROFILE_DIR"] = str(profile_dir)
+    fault_plan = pick(getattr(args, "fault_plan", None), "fault_plan")
+    if fault_plan:
+        env["ACCELERATE_TPU_FAULT_PLAN"] = str(fault_plan)
 
     # Plugin blocks from the questionnaire YAML -> the env protocol the worker-side
     # dataclasses' __post_init__ reads (reference utils/launch.py:226-267 FSDP_* block).
@@ -187,6 +203,11 @@ def launch_command(args):
         grace = args.grace_period if args.grace_period is not None else float(config.get("grace_period", 30.0))
         backoff = args.restart_backoff if args.restart_backoff is not None else float(config.get("restart_backoff", 1.0))
         max_backoff = args.max_backoff if args.max_backoff is not None else float(config.get("max_backoff", 30.0))
+        crash_loop = (
+            args.crash_loop_threshold
+            if args.crash_loop_threshold is not None
+            else int(config.get("crash_loop_threshold", 3))
+        )
         code = Supervisor(
             cmd,
             env=env,
@@ -194,6 +215,7 @@ def launch_command(args):
             grace_period=grace,
             backoff_seconds=backoff,
             max_backoff_seconds=max_backoff,
+            crash_loop_threshold=crash_loop,
         ).run()
         if code != 0:
             raise SystemExit(code)

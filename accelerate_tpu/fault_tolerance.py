@@ -50,6 +50,8 @@ class Supervisor:
         backoff_seconds: float = 1.0,
         max_backoff_seconds: float = 30.0,
         monitor_interval: float = 0.5,
+        crash_loop_threshold: int = 3,
+        crash_loop_min_uptime: float = 3.0,
     ):
         self.cmd = cmd
         self.env = env
@@ -60,6 +62,18 @@ class Supervisor:
         # Cadence of the monitor's timed child.wait() cycles (bounds how late a
         # grace-period expiry can be noticed).
         self.monitor_interval = monitor_interval
+        # Crash-loop detection: after `crash_loop_threshold` consecutive
+        # crashes with the SAME exit code where the child lived less than
+        # `crash_loop_min_uptime` seconds, supervision aborts with a tagged
+        # diagnostic instead of grinding through the full backoff schedule —
+        # a child that dies instantly with an identical code every time (an
+        # import error, a missing checkpoint, a bad flag) will not be healed
+        # by restart N+1. 0 disables the detector.
+        self.crash_loop_threshold = crash_loop_threshold
+        self.crash_loop_min_uptime = crash_loop_min_uptime
+        self.crash_loop_detected = False
+        self._consecutive_fast_identical = 0
+        self._last_exit_code: Optional[int] = None
         self.restart_count = 0
         # Goodput accounting (telemetry.StepTimeline's "restart" cause): wall
         # clock this supervisor spent between a child dying and its respawn.
@@ -112,9 +126,35 @@ class Supervisor:
         prev_int = signal.signal(signal.SIGINT, self._forward_signal)
         try:
             while True:
+                spawned_at = time.monotonic()
                 self._child = subprocess.Popen(self.cmd, env=self.env)
                 code = self._monitor(self._child)
                 if code == 0 or code == PREEMPTED_EXIT_CODE or self._terminating:
+                    return code
+                uptime = time.monotonic() - spawned_at
+                fast = uptime < self.crash_loop_min_uptime
+                if fast and code == self._last_exit_code:
+                    self._consecutive_fast_identical += 1
+                else:
+                    self._consecutive_fast_identical = 1 if fast else 0
+                self._last_exit_code = code
+                if (
+                    self.crash_loop_threshold > 0
+                    and self._consecutive_fast_identical >= self.crash_loop_threshold
+                ):
+                    # Downtime already charged for every backoff this loop DID
+                    # sleep; aborting here just refuses to burn the rest of the
+                    # budget on a deterministic failure.
+                    self.crash_loop_detected = True
+                    logger.error(
+                        "supervisor: CRASH LOOP — %d consecutive crashes with identical "
+                        "exit code %d, each alive < %.1fs; refusing further restarts "
+                        "(%d restart(s) left unused). diagnostic=crash_loop",
+                        self._consecutive_fast_identical,
+                        code,
+                        self.crash_loop_min_uptime,
+                        max(self.max_restarts - self.restart_count, 0),
+                    )
                     return code
                 if self.restart_count >= self.max_restarts:
                     logger.warning(

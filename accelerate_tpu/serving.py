@@ -42,7 +42,10 @@ explicit backpressure instead of unbounded host memory growth; and
 `drain()`/`close()` give the server a clean shutdown lifecycle. The one shared
 decode executable is the blast-radius exception: if a chunk dispatch itself
 dies, every in-flight request errors (the cache state is gone) but the engine
-stays up and keeps admitting.
+stays up and keeps admitting — the slot cache is rebuilt from zeros, since the
+failed dispatch may already have consumed the donated buffers. An insert
+failure that consumed ITS donated operands (accelerators only) widens to the
+same blast-radius recovery; otherwise admission failures stay per-request.
 """
 
 from __future__ import annotations
@@ -462,6 +465,33 @@ class ContinuousBatcher:
         return request.request_id
 
     # ------------------------------------------------------------- fault isolation
+    def _cache_consumed(self) -> bool:
+        """True when a failed dispatch actually CONSUMED the donated slot cache
+        (its buffers are deleted) — accelerators only; CPU ignores donation.
+        Donation is all-or-nothing per dispatch, so the first leaf decides."""
+        for leaf in jax.tree_util.tree_leaves(self._cache):
+            is_deleted = getattr(leaf, "is_deleted", None)
+            return bool(is_deleted()) if callable(is_deleted) else False
+        return False
+
+    def _abort_in_flight(self, exc: Exception, now: Optional[float] = None):
+        """The shared-state blast radius: a dispatch failure that took the slot
+        cache with it (the decode chunk always; an insert only when its donated
+        operands were consumed). Every in-flight request errors (partial tokens
+        kept) and the cache is rebuilt from zeros — the donated buffers may
+        already be invalidated, and keeping the references would poison every
+        later insert with a deleted-buffer error, leaving the engine up but
+        failing every future request. New admissions overwrite their own rows
+        before they are ever attended, exactly as at engine construction."""
+        now = time.perf_counter() if now is None else now
+        for slot, result in enumerate(self._slot_request):
+            if result is not None:
+                self._finish(result, "error", now=now, slot=slot, error=repr(exc))
+        self._active[:] = False
+        self._cache = self._init_cache()
+        if self._presence is not None:
+            self._presence = jnp.zeros((self.num_slots, self.base_config.vocab_size), bool)
+
     def _slot_of(self, request_id: int) -> Optional[int]:
         for slot, result in enumerate(self._slot_request):
             if result is not None and result.request_id == request_id:
@@ -556,6 +586,18 @@ class ContinuousBatcher:
                     "insert failed for request %s (isolated): %r", req.request_id, exc
                 )
                 self._finish(result, "error", error=repr(exc))
+                # Per-request isolation holds only while the shared cache is
+                # intact. The insert fn donates (cache, presence) too: if this
+                # failed dispatch consumed them (chaos-surfaced hazard — the
+                # same poisoning the chunk path guards against), the state is
+                # gone for EVERY slot — widen to the blast-radius recovery.
+                if self._cache_consumed():
+                    logger.warning(
+                        "failed insert consumed the donated slot cache; erroring "
+                        "%d in-flight request(s) and rebuilding",
+                        sum(r is not None for r in self._slot_request),
+                    )
+                    self._abort_in_flight(exc)
                 continue
             now = time.perf_counter()
             self._m_inserts.inc()
@@ -630,11 +672,7 @@ class ContinuousBatcher:
             # their own cache rows from scratch.
             logger.warning("decode chunk dispatch failed; erroring %d in-flight request(s): %r",
                            sum(r is not None for r in self._slot_request), exc)
-            now = time.perf_counter()
-            for slot, result in enumerate(self._slot_request):
-                if result is not None:
-                    self._finish(result, "error", now=now, slot=slot, error=repr(exc))
-            self._active[:] = False
+            self._abort_in_flight(exc)
             return events
         self._cache, self._presence = out[0], out[1]
         # np.array (copy): np.asarray of a jax buffer is a READ-ONLY view, and

@@ -55,6 +55,70 @@ CPU_FALLBACK_RESERVE_S = 360  # measured CPU worker (bert-base, 8 steps, 1 vCPU)
 FINAL_MARGIN_S = 30  # line emission + process teardown
 MIN_ATTEMPT_S = 180  # below this an accelerator attempt can't finish; go straight to CPU
 
+# Tunnel-state memo (round-5 verdict): when a recent probe — this process's or
+# the watcher's — already established the tunnel is dead, don't burn the
+# backoff budget re-learning it; fast-fail the probe phase and spend the
+# window on the CPU fallback instead. The memo lives in a small JSON file
+# (BENCH_TUNNEL_STATE_FILE) and expires after BENCH_TUNNEL_MEMO_TTL seconds,
+# so a recovered tunnel is re-probed within one TTL.
+TUNNEL_MEMO_TTL_S = 900
+_DEFAULT_TUNNEL_STATE = os.path.join(
+    os.environ.get("TMPDIR", "/tmp"), "accelerate_tpu_tunnel_state.json"
+)
+
+# Last-known-good hardware rows embedded in fallback artifacts
+# (extra.cached_hardware_evidence): when the tunnel is down for the whole
+# round, the driver artifact still carries real TPU numbers with provenance.
+CACHED_EVIDENCE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_suite_r04.jsonl")
+
+
+def _tunnel_state_path():
+    return os.environ.get("BENCH_TUNNEL_STATE_FILE", _DEFAULT_TUNNEL_STATE)
+
+
+def _read_tunnel_state():
+    try:
+        with open(_tunnel_state_path()) as f:
+            state = json.load(f)
+        return state if isinstance(state, dict) else None
+    except (OSError, ValueError):  # ValueError: JSON errors AND torn-byte utf-8 tears
+        return None
+
+
+def _write_tunnel_state(alive, source="preflight"):
+    """Best effort — a memo write must never cost the run its JSON line."""
+    path = _tunnel_state_path()
+    try:
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"alive": bool(alive), "checked_at": time.time(), "source": source}, f)
+        os.replace(tmp, path)
+    except OSError as exc:
+        log(f"could not persist tunnel state to {path}: {exc}")
+
+
+def _cached_hardware_evidence():
+    """Parse the last-known-good hardware rows (jsonl), tagged with provenance.
+    Returns [] when the evidence file is missing/unreadable."""
+    path = os.environ.get("BENCH_CACHED_EVIDENCE", CACHED_EVIDENCE_FILE)
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict) and "metric" in row:
+                    row["source"] = os.path.basename(path)
+                    rows.append(row)
+    except OSError:
+        return []
+    return rows
+
 
 def _annotate_line(line: str, events) -> str:
     """Fold the supervisor's structured event ledger into a worker's JSON line
@@ -181,7 +245,31 @@ def supervise(argv, total_steps: int = 0):
         preflight_timeout, max(0, int(remaining() - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S))
     )
     cpu_fallback_cause = "attempts_exhausted"
-    if preflight_timeout > 0 and not _backend_preflight(preflight_timeout, note=note):
+    memo = _read_tunnel_state() if preflight_timeout > 0 else None
+    memo_ttl = _env_int("BENCH_TUNNEL_MEMO_TTL", TUNNEL_MEMO_TTL_S)
+    memo_age = None if memo is None else time.time() - float(memo.get("checked_at", 0) or 0)
+    memo_dead = (
+        memo is not None
+        and memo.get("alive") is False
+        and memo_age is not None
+        and 0 <= memo_age < memo_ttl
+    )
+    if memo_dead:
+        # The watcher/a previous preflight ALREADY established the tunnel is
+        # dead within the memo TTL: fast-fail the probe phase instead of
+        # burning the backoff budget re-learning it — the window goes to one
+        # shortened accelerator attempt (it may have recovered) + the CPU
+        # fallback.
+        log(
+            f"preflight: memoized tunnel-dead state ({memo_age:.0f}s old, "
+            f"source={memo.get('source', '?')}); fast-failing probe phase"
+        )
+        note("preflight_memoized_dead", age_s=round(memo_age, 1),
+             source=str(memo.get("source", "?")))
+        attempts = 1
+        cpu_fallback_cause = "backend_unresponsive"
+    elif preflight_timeout > 0 and not _backend_preflight(preflight_timeout, note=note):
+        _write_tunnel_state(False)
         # Backend is down/hung RIGHT NOW. A TPU tunnel outage is usually
         # transient, so retry the CHEAP probe on a backoff schedule — but only
         # up to a budget that still leaves room for one shortened accelerator
@@ -218,6 +306,7 @@ def supervise(argv, total_steps: int = 0):
                 break
             if _backend_preflight(probe_t, note=note):
                 recovered = True
+                _write_tunnel_state(True)
                 log("preflight: backend recovered; proceeding with full attempts")
                 note("preflight_recovered")
                 break
@@ -225,10 +314,13 @@ def supervise(argv, total_steps: int = 0):
         if not recovered:
             # Budget exhausted and still dead. Keep one real attempt (it may
             # recover mid-run); the ledger cap below already tightens it.
+            _write_tunnel_state(False)
             log("preflight: budget exhausted, backend still unresponsive; shortening attempts")
             note("preflight_budget_exhausted", budget_s=round(max(0, budget_s), 1))
             attempts = 1
             cpu_fallback_cause = "backend_unresponsive"
+    elif preflight_timeout > 0:
+        _write_tunnel_state(True)
     cmd = [sys.executable, os.path.abspath(__file__), "--_worker"] + argv
     for attempt in range(attempts):
         att_timeout = min(timeout_s, remaining() - CPU_FALLBACK_RESERVE_S - FINAL_MARGIN_S)
@@ -270,9 +362,19 @@ def supervise(argv, total_steps: int = 0):
         parsed.setdefault("extra", {})["cpu_fallback"] = True
         parsed["extra"]["cpu_fallback_cause"] = cpu_fallback_cause
         parsed["extra"]["supervisor_events"] = events
+        cached = _cached_hardware_evidence()
+        if cached:
+            # Round-5 verdict: a dead-tunnel round must not produce an
+            # evidence-free artifact — carry the last-known-good hardware rows
+            # (with provenance) alongside the tagged CPU number.
+            parsed["extra"]["cached_hardware_evidence"] = cached
         print(json.dumps(parsed), flush=True)
         return 0
     # Even the CPU fallback failed: emit a diagnostic line so the driver parses *something*.
+    extra = {"error": "all attempts failed; see stderr", "supervisor_events": events}
+    cached = _cached_hardware_evidence()
+    if cached:
+        extra["cached_hardware_evidence"] = cached
     print(
         json.dumps(
             {
@@ -280,7 +382,7 @@ def supervise(argv, total_steps: int = 0):
                 "value": 0.0,
                 "unit": "samples/sec/chip",
                 "vs_baseline": 0.0,
-                "extra": {"error": "all attempts failed; see stderr", "supervisor_events": events},
+                "extra": extra,
             }
         ),
         flush=True,

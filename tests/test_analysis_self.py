@@ -43,6 +43,19 @@ def test_benchmarks_and_bench_entry_are_error_free():
     assert not errors, [(f.file, f.line, f.rule_id) for f in errors]
 
 
+def test_chaos_subsystem_is_warn_clean():
+    """The chaos injectors wrap the checkpoint commit path and the serving
+    dispatch seam — a host-sync or recompile hazard inside an injector would
+    perturb exactly the recovery behavior it exists to test. Warn-clean, like
+    telemetry."""
+    findings, scanned = analyze_paths([str(REPO / "accelerate_tpu" / "chaos")])
+    assert scanned >= 5, f"chaos subsystem missing files? scanned {scanned}"
+    flagged = [f for f in findings if severity_at_least(f.severity, "warn")]
+    assert not flagged, "warn+ TPU hazards in chaos:\n" + "\n".join(
+        f"  {f.file}:{f.line}: {f.rule_id} {f.message}" for f in flagged
+    )
+
+
 def test_telemetry_subsystem_is_warn_clean():
     """The observability layer rides the serving/train hot paths — it must be
     completely clean at WARN level, not just error-free: a host-sync or

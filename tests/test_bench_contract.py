@@ -80,7 +80,8 @@ def test_inference_bench_contract():
     assert row["extra"]["ttft_p50_ms"] > 0
 
 
-def _simulate_supervise(monkeypatch, capsys, env=None, cpu_fallback_hangs=True, cpu_wall_s=300.0):
+def _simulate_supervise(monkeypatch, capsys, tmp_path, env=None, cpu_fallback_hangs=True,
+                        cpu_wall_s=300.0):
     """Drive bench.supervise() through its WORST case on a fake clock: the
     preflight probe hangs to its timeout every retry, every accelerator attempt
     hangs to its cap, and (optionally) even the CPU fallback hangs. Returns
@@ -89,9 +90,12 @@ def _simulate_supervise(monkeypatch, capsys, env=None, cpu_fallback_hangs=True, 
     clock = _FakeClock()
     monkeypatch.setattr(bench, "time", clock)
     for key in ("BENCH_DEADLINE_S", "BENCH_MAX_ATTEMPTS", "BENCH_ATTEMPT_TIMEOUT",
-                "BENCH_PREFLIGHT_TIMEOUT", "BENCH_PREFLIGHT_BUDGET",
+                "BENCH_PREFLIGHT_TIMEOUT", "BENCH_PREFLIGHT_BUDGET", "BENCH_TUNNEL_MEMO_TTL",
                 "JAX_PLATFORMS"):  # the conftest's cpu pin would make every fake attempt look like the fallback
         monkeypatch.delenv(key, raising=False)
+    # Isolate the tunnel-state memo: a stale memo from another run on this
+    # machine must not skip the probe phases these simulations exercise.
+    monkeypatch.setenv("BENCH_TUNNEL_STATE_FILE", str(tmp_path / "tunnel_state.json"))
     for key, value in (env or {}).items():
         monkeypatch.setenv(key, value)
 
@@ -123,7 +127,7 @@ def _simulate_supervise(monkeypatch, capsys, env=None, cpu_fallback_hangs=True, 
     return clock.elapsed(), json.loads(out_lines[0])
 
 
-def test_supervisor_worst_case_bounded_by_default_deadline(monkeypatch, capsys):
+def test_supervisor_worst_case_bounded_by_default_deadline(monkeypatch, capsys, tmp_path):
     """Round-4 postmortem: the driver killed bench.py mid-preflight-backoff at
     ~30 min and BENCH_r04.json had no JSON line at all. The ledger invariant:
     even when EVERYTHING hangs (probe, every attempt, the CPU fallback), the
@@ -131,42 +135,42 @@ def test_supervisor_worst_case_bounded_by_default_deadline(monkeypatch, capsys):
     driver's observed ~30-min window."""
     bench = _load_bench_module()
     assert bench.DRIVER_WINDOW_S <= 1680, "default deadline must stay under the ~30-min driver window"
-    elapsed, row = _simulate_supervise(monkeypatch, capsys)
+    elapsed, row = _simulate_supervise(monkeypatch, capsys, tmp_path)
     assert elapsed <= bench.DRIVER_WINDOW_S, f"worst-case time-to-JSON {elapsed:.0f}s exceeds the deadline"
     assert row["metric"] == "bench-failed"  # everything hung: diagnostic line
     assert row["vs_baseline"] == 0.0
 
 
-def test_supervisor_deadline_survives_hostile_env(monkeypatch, capsys):
+def test_supervisor_deadline_survives_hostile_env(monkeypatch, capsys, tmp_path):
     """User-set knobs (huge attempt timeout / preflight budget — round 4's
     actual mistake was BENCH_PREFLIGHT_BUDGET=4800) must not push the line past
     the deadline: the ledger caps every phase by remaining()."""
     elapsed, row = _simulate_supervise(
-        monkeypatch, capsys,
+        monkeypatch, capsys, tmp_path,
         env={"BENCH_PREFLIGHT_BUDGET": "4800", "BENCH_ATTEMPT_TIMEOUT": "7200",
              "BENCH_MAX_ATTEMPTS": "5"},
     )
     assert elapsed <= 1500, f"hostile env pushed time-to-JSON to {elapsed:.0f}s"
 
 
-def test_supervisor_dead_tunnel_emits_tagged_cpu_line_in_window(monkeypatch, capsys):
+def test_supervisor_dead_tunnel_emits_tagged_cpu_line_in_window(monkeypatch, capsys, tmp_path):
     """The realistic dead-tunnel path: probe never answers, the shortened
     accelerator attempt hangs, the CPU fallback SUCCEEDS — the driver gets a
     tagged cpu-fallback row well inside its window."""
-    elapsed, row = _simulate_supervise(monkeypatch, capsys, cpu_fallback_hangs=False)
+    elapsed, row = _simulate_supervise(monkeypatch, capsys, tmp_path, cpu_fallback_hangs=False)
     assert elapsed <= 1500
     assert row["metric"].startswith("cpu-fallback")
     assert row["vs_baseline"] == 0.0
     assert row["extra"]["cpu_fallback"] is True
 
 
-def test_supervisor_emits_structured_event_ledger(monkeypatch, capsys):
+def test_supervisor_emits_structured_event_ledger(monkeypatch, capsys, tmp_path):
     """Telemetry satellite: preflight/fallback decisions land as DATA in the
     emitted JSON (extra["supervisor_events"]), not just prose on stderr — so a
     BENCH_* artifact explains an r05-style hang after the fact. The dead-tunnel
     path must record the probe hangs, the backoff waits, the budget exhaustion
     and the cpu_fallback cause."""
-    elapsed, row = _simulate_supervise(monkeypatch, capsys, cpu_fallback_hangs=False)
+    elapsed, row = _simulate_supervise(monkeypatch, capsys, tmp_path, cpu_fallback_hangs=False)
     events = row["extra"]["supervisor_events"]
     kinds = [e["event"] for e in events]
     assert "preflight_probe_hung" in kinds
@@ -181,10 +185,56 @@ def test_supervisor_emits_structured_event_ledger(monkeypatch, capsys):
     assert stamps == sorted(stamps) and all(s >= 0 for s in stamps)
 
 
-def test_supervisor_explicit_deadline_env(monkeypatch, capsys):
+def test_supervisor_memoized_dead_tunnel_fast_fails(monkeypatch, capsys, tmp_path):
+    """Round-5 satellite: when the watcher/a previous preflight already knows
+    the tunnel is dead (a fresh tunnel-state memo), the probe phase fast-fails
+    instead of burning the backoff budget — no probe retries, no backoff waits,
+    straight to the shortened attempt + CPU fallback — and the cpu-fallback
+    artifact carries the last-known-good hardware rows."""
+    state = tmp_path / "tunnel_state.json"
+    state.write_text(json.dumps({"alive": False, "checked_at": 1_000_000.0, "source": "watcher"}))
+    elapsed, row = _simulate_supervise(monkeypatch, capsys, tmp_path, cpu_fallback_hangs=False)
+    events = row["extra"]["supervisor_events"]
+    kinds = [e["event"] for e in events]
+    assert "preflight_memoized_dead" in kinds
+    assert "preflight_retry_wait" not in kinds, "memoized-dead run still burned backoff budget"
+    assert "preflight_probe_hung" not in kinds, "memoized-dead run still ran the probe"
+    assert row["metric"].startswith("cpu-fallback")
+    assert row["extra"]["cpu_fallback_cause"] == "backend_unresponsive"
+    # cached hardware evidence rides along, with provenance
+    evidence = row["extra"]["cached_hardware_evidence"]
+    assert evidence, "cpu-fallback artifact carries no cached hardware rows"
+    for cached_row in evidence:
+        assert "metric" in cached_row and "value" in cached_row
+        assert cached_row["source"] == "bench_suite_r04.jsonl"
+    assert any("TPU" in str(r.get("extra", {}).get("device_kind", "")) for r in evidence)
+
+
+def test_supervisor_stale_memo_probes_again(monkeypatch, capsys, tmp_path):
+    """A memo older than BENCH_TUNNEL_MEMO_TTL must NOT short-circuit the
+    probe: the tunnel may have recovered since."""
+    state = tmp_path / "tunnel_state.json"
+    state.write_text(json.dumps({"alive": False, "checked_at": 1_000_000.0 - 3600, "source": "watcher"}))
+    _elapsed, row = _simulate_supervise(monkeypatch, capsys, tmp_path, cpu_fallback_hangs=False)
+    kinds = [e["event"] for e in row["extra"]["supervisor_events"]]
+    assert "preflight_memoized_dead" not in kinds
+    assert "preflight_probe_hung" in kinds
+
+
+def test_supervisor_writes_tunnel_state_after_probe_failure(monkeypatch, capsys, tmp_path):
+    """A failed probe phase persists alive=False so the NEXT bench invocation
+    (or the watcher) can fast-fail within the TTL."""
+    _simulate_supervise(monkeypatch, capsys, tmp_path, cpu_fallback_hangs=False)
+    state = json.loads((tmp_path / "tunnel_state.json").read_text())
+    assert state["alive"] is False
+    assert state["checked_at"] >= 1_000_000.0
+    assert state["source"] == "preflight"
+
+
+def test_supervisor_explicit_deadline_env(monkeypatch, capsys, tmp_path):
     """BENCH_DEADLINE_S is honored: a 600-s deadline bounds the whole worst
     case to 600 s (the driver can tighten the window without editing code)."""
-    elapsed, _ = _simulate_supervise(monkeypatch, capsys, env={"BENCH_DEADLINE_S": "600"})
+    elapsed, _ = _simulate_supervise(monkeypatch, capsys, tmp_path, env={"BENCH_DEADLINE_S": "600"})
     assert elapsed <= 600, f"explicit BENCH_DEADLINE_S ignored: {elapsed:.0f}s"
 
 

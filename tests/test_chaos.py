@@ -1,0 +1,437 @@
+"""Chaos-subsystem tests: the acceptance sweeps of the fault-injection tentpole.
+
+Pins, on CPU inside tier-1 time:
+
+  1. plan semantics — JSON round trip, the ``ACCELERATE_TPU_FAULT_PLAN`` env
+     protocol, trigger evaluation (step / call-count / path / times);
+  2. the SIGKILL sweep — a kill at EVERY step boundary of an 8-step supervised
+     run resumes exactly from the last committed checkpoint;
+  3. the torn-write sweep — post-commit corruption at a range of byte offsets
+     of a checkpoint MANIFEST (and the npz payload) never gets a torn
+     checkpoint resolved by `resolve("latest")`;
+  4. commit-window faults — SIGTERM landing inside the staged-dir commit,
+     crashes inside the rename window, transient EIO during publish (the
+     retry-idempotency bug this PR fixed);
+  5. serving chaos — an injected dispatch stall + queue-full burst drains with
+     every request carrying a terminal finish_reason, and the engine keeps
+     serving after a dispatch failure;
+  6. the CLI contract — `accelerate-tpu chaos run` exits 0 on a clean plan and
+     non-zero on the seeded-regression fixture (the harness can tell a broken
+     stack from a healthy one);
+  7. telemetry reconciliation — `chaos_injected_total{kind=...}` matches the
+     injection journal and injected downtime lands in the goodput ledger.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.chaos import (
+    FAULT_PLAN_ENV,
+    ChaosRunner,
+    ChaosSession,
+    FakeClock,
+    FaultEvent,
+    FaultPlan,
+    InvariantReport,
+    builtin_plans,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# ------------------------------------------------------------------ plan + triggers
+def test_plan_json_round_trip():
+    plan = FaultPlan(
+        name="rt", seed=7,
+        events=[
+            FaultEvent(kind="proc.sigkill", at_step=3),
+            FaultEvent(kind="fs.torn_write", path_pattern="MANIFEST.json", at_call=2,
+                       args={"offset": 17}, times=2),
+        ],
+        notes="round trip",
+    )
+    restored = FaultPlan.from_json(plan.to_json())
+    assert restored == plan
+    assert restored.events[1].args == {"offset": 17}
+
+
+def test_plan_rejects_unknown_kind_and_fields():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultEvent(kind="fs.does_not_exist")
+    with pytest.raises(ValueError, match="unknown FaultEvent field"):
+        FaultEvent.from_dict({"kind": "proc.sigkill", "at_stepp": 3})
+
+
+def test_plan_env_protocol_inline_and_file(tmp_path):
+    plan = FaultPlan(name="envp", events=[FaultEvent(kind="proc.sigterm", at_step=1)])
+    # inline JSON
+    restored = FaultPlan.from_env({FAULT_PLAN_ENV: plan.to_json(indent=None)})
+    assert restored == plan
+    # file path
+    path = plan.save(str(tmp_path / "plan.json"))
+    assert FaultPlan.from_env({FAULT_PLAN_ENV: path}) == plan
+    # unset -> no chaos armed
+    assert FaultPlan.from_env({}) is None
+
+
+def test_trigger_semantics_call_step_path_times():
+    plan = FaultPlan(events=[
+        FaultEvent(kind="fs.io_error", path_pattern="model.npz*", at_call=2),
+        FaultEvent(kind="proc.sigkill", at_step=5),
+        FaultEvent(kind="fs.slow_fsync", path_pattern="*.bin", times=2),
+    ])
+    session = ChaosSession(plan, clock=FakeClock())
+    # path-triggered events never fire at step sites and vice versa
+    assert session.fire("fs.io_error", step=1) == []
+    assert session.fire("proc.sigkill", path="/x/model.npz") == []
+    # at_call counts MATCHING calls only
+    assert session.fire("fs.io_error", path="/ck/model.npz") == []       # matching call 1: no fire
+    assert session.fire("fs.io_error", path="/ck/optimizer.npz") == []   # non-matching: not counted
+    assert len(session.fire("fs.io_error", path="/ck/model.npz")) == 1   # matching call 2: fires
+    assert session.counts().get("fs.io_error", 0) == 1
+    # step trigger
+    assert session.fire("proc.sigkill", step=4) == []
+    assert len(session.fire("proc.sigkill", step=5)) == 1
+    assert session.fire("proc.sigkill", step=5) == []  # times=1 exhausted
+    # times=2 fires twice, then disarms
+    assert len(session.fire("fs.slow_fsync", path="a.bin")) == 1
+    assert len(session.fire("fs.slow_fsync", path="b.bin")) == 1
+    assert session.fire("fs.slow_fsync", path="c.bin") == []
+    # every firing counted in the registry
+    assert session.registry.value("chaos_injected_total", {"kind": "fs.slow_fsync"}) == 2
+
+
+def test_multi_seam_kinds_stay_disjoint():
+    """`proc.sigterm` has two seams (step boundary, artifact write). An event
+    without a `path_pattern` belongs to the step seam only — the write seam
+    (which passes require_pattern) must neither fire it nor advance its call
+    counter, so `at_call` counts one seam's calls, never an interleaving."""
+    plan = FaultPlan(events=[FaultEvent(kind="proc.sigterm", at_call=2)])
+    session = ChaosSession(plan, clock=FakeClock())
+    # artifact-write seam: not evaluated at all for a pattern-less event
+    assert session.fire("proc.sigterm", path="/ck/model.npz", require_pattern=True) == []
+    assert session.fire("proc.sigterm", path="/ck/model.npz", require_pattern=True) == []
+    # step seam: the 2nd STEP call fires — write-seam calls did not count
+    assert session.fire("proc.sigterm", step=0) == []
+    assert len(session.fire("proc.sigterm", step=1)) == 1
+
+
+def test_after_s_trigger_with_fake_clock():
+    clock = FakeClock()
+    plan = FaultPlan(events=[FaultEvent(kind="serve.dispatch_stall", after_s=10.0)])
+    session = ChaosSession(plan, clock=clock)
+    assert session.fire("serve.dispatch_stall") == []
+    clock.sleep(11.0)
+    assert len(session.fire("serve.dispatch_stall")) == 1
+
+
+# ------------------------------------------------------------------ train sweeps
+def test_sigkill_at_every_boundary_of_8_step_run_resumes_exactly(tmp_path):
+    """THE acceptance sweep: one run, a SIGKILL scripted at every one of the 8
+    step boundaries — nine attempts, eight resumes, each landing exactly on the
+    last committed checkpoint (step + parameter digest)."""
+    plan = FaultPlan(
+        name="kill-every-boundary",
+        events=[FaultEvent(kind="proc.sigkill", at_step=k) for k in range(8)],
+    )
+    runner = ChaosRunner(plan)
+    report = runner.run_train(str(tmp_path), steps=8, max_restarts=16)
+    assert report.ok, report.render_text()
+    assert len(report.injections) == 8
+    by_name = {c.name: c for c in report.checks}
+    assert by_name["resume_exactness"].details["resumes"] == 8
+    assert by_name["restart_budget"].details["restarts"] == 8
+    assert by_name["restart_budget"].details["completed"] is True
+
+
+@pytest.mark.parametrize(
+    "target,args",
+    [
+        ("MANIFEST.json", {"offset": 0}),
+        ("MANIFEST.json", {"offset_frac": 0.5}),
+        ("MANIFEST.json", {"offset_frac": 0.9, "flip": True}),
+        ("model.npz", {"offset": 1}),
+        ("model.npz", {"offset_frac": 0.5, "flip": True}),
+    ],
+)
+def test_torn_write_sweep_never_resolves_torn_checkpoint(tmp_path, target, args):
+    """Post-commit corruption at a range of byte offsets — truncation and bit
+    flips, on the checkpoint MANIFEST and the model payload. Resume after the
+    kill must fall back past the torn newest checkpoint, and the re-save must
+    replace it with one that verifies."""
+    plan = FaultPlan(
+        name="torn-sweep",
+        events=[
+            FaultEvent(kind="fs.torn_write", path_pattern=target, at_call=2, args=args),
+            FaultEvent(kind="proc.sigkill", at_step=1),
+        ],
+    )
+    runner = ChaosRunner(plan)
+    report = runner.run_train(str(tmp_path), steps=4)
+    assert report.ok, report.render_text()
+    by_name = {c.name: c for c in report.checks}
+    assert by_name["no_torn_resolved"].details["resumes"] == 1
+    # the terminal state re-verified independently: latest committed step is the last one
+    assert by_name["no_torn_resolved"].details["final_verified_latest_step"] == 3
+
+
+def test_sigterm_inside_staged_commit_preempts_gracefully(tmp_path):
+    """SIGTERM delivered while an artifact is mid-commit inside the staging dir
+    (the expected-bug window): the latch must not tear the commit — the save
+    completes, the run preempts gracefully at the boundary, and the resume is
+    exact."""
+    plan = FaultPlan(
+        name="sigterm-mid-commit",
+        events=[FaultEvent(kind="proc.sigterm", path_pattern="model.npz*", at_call=3)],
+    )
+    runner = ChaosRunner(plan)
+    report = runner.run_train(str(tmp_path), steps=4)
+    assert report.ok, report.render_text()
+    assert [e["kind"] for e in report.injections] == ["proc.sigterm"]
+
+
+def test_crash_in_rename_window_of_staged_manifest(tmp_path):
+    """A kill between the payload fsync and the rename of the staged MANIFEST:
+    the checkpoint never becomes visible, the retry (next attempt) lands the
+    same step cleanly."""
+    plan = FaultPlan(
+        name="rename-crash",
+        events=[FaultEvent(kind="fs.crash_in_rename", path_pattern="MANIFEST.json", at_call=2)],
+    )
+    report = ChaosRunner(plan).run_train(str(tmp_path), steps=4)
+    assert report.ok, report.render_text()
+
+
+def test_transient_eio_on_latest_pointer_does_not_lose_commit(tmp_path):
+    """Regression pin for the publish-retry idempotency fix: a transient EIO on
+    the `latest` pointer write lands AFTER the directory rename; the retry used
+    to re-run `os.replace` on the vanished staging dir and fail a save whose
+    checkpoint was already committed."""
+    plan = FaultPlan(
+        name="pointer-eio",
+        events=[FaultEvent(kind="fs.io_error", path_pattern="latest", at_call=2,
+                           args={"errno": "EIO"})],
+    )
+    report = ChaosRunner(plan).run_train(str(tmp_path), steps=3)
+    assert report.ok, report.render_text()
+    assert report.injections and report.injections[0]["kind"] == "fs.io_error"
+
+
+def test_enospc_on_staged_manifest_write_retries(tmp_path):
+    plan = FaultPlan(
+        name="manifest-enospc",
+        events=[FaultEvent(kind="fs.io_error", path_pattern="MANIFEST.json", at_call=1,
+                           args={"errno": "ENOSPC"})],
+    )
+    report = ChaosRunner(plan).run_train(str(tmp_path), steps=3)
+    assert report.ok, report.render_text()
+
+
+def test_chaos_counters_reconcile_with_goodput_ledger(tmp_path):
+    """Satellite pin: a chaos run's injected-fault counters reconcile with the
+    goodput-ledger entries it produces — the slow-fsync delay shows up in the
+    'checkpoint' cause, resumes charge 'restart', and every injection journal
+    entry has a matching `chaos_injected_total` count."""
+    plan = FaultPlan(
+        name="ledger",
+        events=[
+            FaultEvent(kind="fs.slow_fsync", path_pattern="model.npz*", at_call=1,
+                       args={"delay_s": 0.05}),
+            FaultEvent(kind="proc.sigkill", at_step=1),
+        ],
+    )
+    runner = ChaosRunner(plan)
+    report = runner.run_train(str(tmp_path), steps=3)
+    assert report.ok, report.render_text()
+    ledger_check = next(c for c in report.checks if c.name == "ledger_reconciles")
+    details = ledger_check.details
+    assert details["registry_matches_journal"] is True
+    assert details["injected_counts"] == {"fs.slow_fsync": 1, "proc.sigkill": 1}
+    assert details["goodput_ledger_s"]["checkpoint"] >= 0.045  # the injected stall, -10% tolerance
+    assert details["goodput_ledger_s"].get("restart", 0.0) > 0.0  # the resume charged
+    # the counters are real registry instruments, visible in the snapshot
+    counter_rows = [m for m in report.metrics if m["name"] == "chaos_injected_total"]
+    assert {row["labels"]["kind"]: row["value"] for row in counter_rows} == {
+        "fs.slow_fsync": 1.0, "proc.sigkill": 1.0,
+    }
+
+
+def test_seeded_regression_fixture_goes_red(tmp_path):
+    """The harness must detect a broken stack: with digest verification
+    neutered and a torn newest manifest, resolve() hands resume a torn
+    checkpoint — the independent invariant checker flags it and the report
+    comes back violated."""
+    report = ChaosRunner(builtin_plans()["seeded-regression"]).run_train(str(tmp_path), steps=4)
+    assert not report.ok
+    failed = {c.name for c in report.violated}
+    assert "no_torn_resolved" in failed
+
+
+# ------------------------------------------------------------------ supervised subprocess
+def test_supervised_run_with_real_signals_resumes_via_env_protocol(tmp_path):
+    """End-to-end: the real `Supervisor` over the real subprocess workload, the
+    plan propagated via ACCELERATE_TPU_FAULT_PLAN. A REAL SIGTERM at step 1
+    exercises the PreemptionHandler → preemption checkpoint → exit 143 → respawn
+    handoff; a REAL SIGKILL at step 3 exercises the crash-restart path. Both
+    resumes are exact and the run completes inside the budget."""
+    plan = FaultPlan(name="supervised-signals", events=[
+        FaultEvent(kind="proc.sigterm", at_step=1),
+        FaultEvent(kind="proc.sigkill", at_step=3),
+    ])
+    runner = ChaosRunner(plan)
+    report = runner.run_supervised_train(str(tmp_path), steps=5, max_restarts=3)
+    assert report.ok, report.render_text()
+    supervisor_check = next(c for c in report.checks if c.name == "supervisor")
+    assert supervisor_check.details["restarts"] == 1
+    assert supervisor_check.details["preemption_handoffs"] == 1
+    # the workload journaled both injections before the faults landed
+    assert sorted(e["kind"] for e in report.injections) == ["proc.sigkill", "proc.sigterm"]
+    resumes = next(c for c in report.checks if c.name == "resume_exactness").details["resumes"]
+    assert resumes == 2
+
+
+# ------------------------------------------------------------------ serving chaos
+def test_dispatch_stall_and_queue_burst_drain_with_terminal_reasons(tmp_path):
+    """The serving acceptance sweep: an injected dispatch stall + a queue-full
+    burst against a bounded queue + one dispatch failure — the drain finishes
+    with EVERY accepted request carrying a terminal finish_reason, the queue
+    never exceeds its cap, and requests submitted after the failure complete
+    normally."""
+    plan = FaultPlan(
+        name="serve-sweep",
+        events=[
+            FaultEvent(kind="serve.dispatch_stall", at_call=2, args={"delay_s": 0.02}),
+            FaultEvent(kind="serve.queue_burst", at_step=1, args={"count": 6}),
+            FaultEvent(kind="serve.dispatch_error", at_call=4),
+        ],
+    )
+    runner = ChaosRunner(plan)
+    report = runner.run_serve(num_requests=6, max_queue=3)
+    assert report.ok, report.render_text()
+    by_name = {c.name: c for c in report.checks}
+    terminal = by_name["terminal_finish_reasons"].details
+    assert terminal["rejected_queue_full"] > 0, "burst never hit the queue bound"
+    assert terminal["accepted"] >= 6
+    assert by_name["queue_bounded"].details["queue_peak"] <= 3
+    assert by_name["engine_recovered"].details.get("requests_after_error", 0) >= 2
+
+
+def test_consumed_donation_on_chunk_dispatch_recovers():
+    """Regression pin WITH TEETH for the donated-cache rebuild: the injected
+    chunk failure also deletes the donated cache buffers (what a real
+    accelerator dispatch failure does — CPU alone can't model it, donation is
+    ignored there). Without the engine's rebuild-on-abort fix, every admission
+    after the failure dies on deleted buffers and recovery probes error."""
+    plan = FaultPlan(
+        name="chunk-consumes-donation",
+        events=[FaultEvent(kind="serve.dispatch_error", at_call=2,
+                           args={"consume_donated": True})],
+    )
+    report = ChaosRunner(plan).run_serve(num_requests=4, max_queue=4)
+    assert report.ok, report.render_text()
+    recovered = next(c for c in report.checks if c.name == "engine_recovered")
+    assert recovered.details["requests_after_error"] >= 2
+
+
+def test_consumed_donation_on_insert_recovers():
+    """The insert fn donates (cache, presence) too: an admission dispatch that
+    failed AFTER consuming them poisons every slot, so the engine must widen to
+    the blast-radius recovery (error in-flight + rebuild) instead of pretending
+    the failure was isolated — then keep serving."""
+    plan = FaultPlan(
+        name="insert-consumes-donation",
+        events=[FaultEvent(kind="serve.insert_error", at_call=2,
+                           args={"consume_donated": True})],
+    )
+    report = ChaosRunner(plan).run_serve(num_requests=4, max_queue=4)
+    assert report.ok, report.render_text()
+    recovered = next(c for c in report.checks if c.name == "engine_recovered")
+    assert recovered.details["requests_after_error"] >= 2
+
+
+def test_insert_error_is_isolated_to_one_request():
+    plan = FaultPlan(
+        name="insert-error",
+        events=[FaultEvent(kind="serve.insert_error", at_call=2)],
+    )
+    report = ChaosRunner(plan).run_serve(num_requests=4, max_queue=4)
+    assert report.ok, report.render_text()
+    # exactly one admission errored; everything else completed normally
+    finished = next(
+        m for m in report.metrics
+        if m["name"] == "serving_requests_finished_total" and m["labels"].get("reason") == "error"
+    )
+    assert finished["value"] == 1.0
+
+
+# ------------------------------------------------------------------ CLI contract
+def _run_cli(capsys, *argv):
+    from accelerate_tpu.commands.accelerate_cli import get_command_parser
+
+    parser = get_command_parser()
+    args = parser.parse_args(list(argv))
+    with pytest.raises(SystemExit) as excinfo:
+        args.func(args)
+    out = capsys.readouterr().out
+    return excinfo.value.code, out
+
+
+def test_cli_list_faults(capsys):
+    code, out = _run_cli(capsys, "chaos", "list-faults")
+    assert code == 0
+    for kind in ("fs.torn_write", "proc.sigkill", "serve.queue_burst"):
+        assert kind in out
+
+
+def test_cli_run_clean_plan_exits_0_and_report_round_trips(capsys, tmp_path):
+    report_path = str(tmp_path / "report.json")
+    code, out = _run_cli(
+        capsys, "chaos", "run", "--plan", "smoke-train", "--steps", "4",
+        "--base-dir", str(tmp_path / "run"), "--json", "--report-out", report_path,
+    )
+    assert code == 0, out
+    emitted = json.loads(out)
+    assert emitted["ok"] is True and emitted["workload"] == "train"
+    # a stored report re-renders with the same verdict/exit code
+    loaded = InvariantReport.load(report_path)
+    assert loaded.ok and loaded.to_dict()["checks"] == emitted["checks"]
+    code2, _ = _run_cli(capsys, "chaos", "report", report_path)
+    assert code2 == 0
+
+
+def test_cli_run_seeded_regression_exits_nonzero(capsys, tmp_path):
+    code, out = _run_cli(
+        capsys, "chaos", "run", "--plan", "seeded-regression", "--steps", "4",
+        "--base-dir", str(tmp_path / "run"),
+    )
+    assert code == 1
+    assert "INVARIANTS VIOLATED" in out
+    assert "no_torn_resolved" in out
+
+
+def test_cli_bad_plan_exits_2(capsys, tmp_path):
+    code, _ = _run_cli(capsys, "chaos", "run", "--plan", str(tmp_path / "missing.json"))
+    assert code == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"events": [{"kind": "nope"}]}))
+    code, _ = _run_cli(capsys, "chaos", "run", "--plan", str(bad))
+    assert code == 2
+
+
+def test_launch_exports_fault_plan_env(tmp_path):
+    """`accelerate-tpu launch --fault_plan` joins the env protocol exactly like
+    --profile_dir does."""
+    import argparse
+
+    from accelerate_tpu.commands.launch import add_launch_args, build_launch_env
+
+    parser = argparse.ArgumentParser()
+    add_launch_args(parser)
+    plan_file = str(tmp_path / "plan.json")
+    args = parser.parse_args(["--fault_plan", plan_file, "script.py"])
+    env = build_launch_env(args, {})
+    assert env[FAULT_PLAN_ENV] == plan_file

@@ -11,8 +11,11 @@ Pins the resilience contract of `checkpointing.py` + `CheckpointManager` +
      last verified one, and the next save replaces the torn directory and
      rotates correctly.
 
-All tests are CPU-only, subprocess-free and fast (tier-1, `-m faults` selects
-just the fault-injection suite).
+Scripted faults ride the chaos injectors (`accelerate_tpu.chaos`) — declarative
+`FaultPlan`s at the seams the code owns — instead of ad-hoc monkeypatching;
+only byte-level corruption of files already on disk stays manual. All tests are
+CPU-only, subprocess-free and fast (tier-1; `-m faults` or `-m chaos` selects
+them).
 """
 
 import json
@@ -24,12 +27,20 @@ import pytest
 import optax
 
 from accelerate_tpu import Accelerator, SimpleDataLoader
+from accelerate_tpu.chaos import (
+    ChaosSession,
+    FaultEvent,
+    FaultPlan,
+    FilesystemInjector,
+    InjectedKill,
+)
 from accelerate_tpu.checkpointing import (
     CHECKPOINT_MANIFEST_NAME,
     LATEST_POINTER_NAME,
     CheckpointCorruptError,
     CheckpointManager,
     atomic_write,
+    atomic_write_bytes,
     load_pytree,
     save_pytree,
     verify_checkpoint_dir,
@@ -39,7 +50,7 @@ from accelerate_tpu.data_loader import BatchSampler
 from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
 from accelerate_tpu.utils import ProjectConfiguration
 
-pytestmark = pytest.mark.faults
+pytestmark = [pytest.mark.faults, pytest.mark.chaos]
 
 
 # ------------------------------------------------------------------ file-level atomicity
@@ -122,21 +133,26 @@ def test_manager_rotation_keeps_last_n(tmp_path):
 
 @pytest.mark.parametrize("artifacts_before_kill", [0, 1, 2])
 def test_kill_between_any_two_artifact_writes_never_publishes(tmp_path, artifacts_before_kill):
-    """The acceptance-criterion sweep: interrupt the save after each artifact in
-    turn. Whatever the offset, the in-flight checkpoint must never become
-    visible and `latest` must keep resolving to the previous verified save."""
+    """The acceptance-criterion sweep, on the chaos injectors: a scripted
+    rename-window kill interrupts the save at each artifact in turn. Whatever
+    the offset, the in-flight checkpoint must never become visible and `latest`
+    must keep resolving to the previous verified save. (`InjectedKill` is a
+    BaseException: even a SIGKILL-like non-Exception path must not commit.)"""
     manager = CheckpointManager(str(tmp_path))
     good = manager.save(0, _write_artifacts(["model.npz", "optimizer.npz"]))
 
-    class Kill(BaseException):
-        """BaseException: even a SIGKILL-like non-Exception path must not commit."""
+    plan = FaultPlan(events=[
+        FaultEvent(kind="fs.crash_in_rename", path_pattern="part*.bin",
+                   at_call=artifacts_before_kill + 1),
+    ])
 
-    def dying_write_fn(staging):
-        _write_artifacts([f"part{i}.bin" for i in range(artifacts_before_kill)])(staging)
-        raise Kill
+    def atomic_write_fn(staging):
+        for i in range(3):
+            atomic_write_bytes(os.path.join(staging, f"part{i}.bin"), b"payload")
 
-    with pytest.raises(Kill):
-        manager.save(1, dying_write_fn)
+    with FilesystemInjector(ChaosSession(plan)):
+        with pytest.raises(InjectedKill):
+            manager.save(1, atomic_write_fn)
     # the torn save is invisible: no checkpoint_1, latest still the good one
     assert [s for s, _ in manager.checkpoints()] == [0]
     assert manager.resolve("latest") == good
@@ -206,22 +222,79 @@ def test_legacy_pre_manifest_checkpoints_survive_an_upgrade(tmp_path):
     assert [s for s, _ in manager.checkpoints()] == [1, 2]
 
 
-def test_transient_io_errors_retry_with_backoff(tmp_path, monkeypatch):
+def test_transient_io_errors_retry_with_backoff(tmp_path):
     """The publish sequence retries OSErrors (full-disk blips, NFS hiccups)
-    instead of dying on the first one."""
+    instead of dying on the first one — scripted as two transient EIOs on the
+    checkpoint-directory publish rename."""
     manager = CheckpointManager(str(tmp_path), retries=3, backoff_seconds=0.0)
-    failures = {"n": 2}
-    real_replace = os.replace
+    plan = FaultPlan(events=[
+        FaultEvent(kind="fs.io_error", path_pattern="checkpoint_0", times=2,
+                   args={"errno": "EIO"}),
+    ])
+    session = ChaosSession(plan)
+    with FilesystemInjector(session):
+        path = manager.save(0, _write_artifacts(["model.npz"]))
+    assert session.counts() == {"fs.io_error": 2}
+    assert verify_checkpoint_dir(path)
+    assert manager.resolve("latest") == path
 
-    def flaky_replace(src, dst):
-        if failures["n"] > 0 and os.path.basename(dst) == "checkpoint_0":
-            failures["n"] -= 1
-            raise OSError("transient")
-        return real_replace(src, dst)
 
-    monkeypatch.setattr(os, "replace", flaky_replace)
-    path = manager.save(0, _write_artifacts(["model.npz"]))
-    assert failures["n"] == 0 and verify_checkpoint_dir(path)
+def test_publish_retry_after_pointer_write_failure_is_idempotent(tmp_path):
+    """Chaos-surfaced bug, fixed this PR: a transient failure on the `latest`
+    pointer write lands AFTER the directory rename. The retry used to re-run
+    `os.replace` on the vanished staging dir and raise FileNotFoundError out of
+    a save whose checkpoint was already fully committed."""
+    manager = CheckpointManager(str(tmp_path), retries=3, backoff_seconds=0.0)
+    plan = FaultPlan(events=[
+        FaultEvent(kind="fs.io_error", path_pattern=LATEST_POINTER_NAME, at_call=1),
+    ])
+    with FilesystemInjector(ChaosSession(plan)):
+        path = manager.save(0, _write_artifacts(["model.npz"]))
+    assert verify_checkpoint_dir(path)
+    assert manager.resolve("latest") == path
+    with open(os.path.join(str(tmp_path), LATEST_POINTER_NAME)) as f:
+        assert f.read() == "checkpoint_0"
+
+
+def test_rotation_survives_rmtree_raising_after_partial_delete(tmp_path, monkeypatch):
+    """Chaos-surfaced bug, fixed this PR: rotation's retry used to re-run
+    `shutil.rmtree` on a directory the failed first attempt had already
+    removed, so the FileNotFoundError retried until exhaustion and failed a
+    save whose rotation had effectively succeeded."""
+    import shutil as _shutil
+
+    manager = CheckpointManager(str(tmp_path), keep_last_n=1, retries=3, backoff_seconds=0.0)
+    manager.save(0, _write_artifacts(["a.bin"]))
+    real_rmtree = _shutil.rmtree
+    state = {"armed": True}
+
+    def delete_then_raise(path, **kwargs):
+        if state["armed"] and os.path.basename(path) == "checkpoint_0":
+            state["armed"] = False
+            real_rmtree(path)  # the deletion itself succeeded...
+            raise OSError("transient error reported after the delete")
+        return real_rmtree(path, **kwargs)
+
+    monkeypatch.setattr(_shutil, "rmtree", delete_then_raise)
+    path = manager.save(1, _write_artifacts(["a.bin"]))
+    assert [s for s, _ in manager.checkpoints()] == [1]
+    assert verify_checkpoint_dir(path)
+
+
+def test_verify_checkpoint_dir_survives_bitflipped_manifest(tmp_path):
+    """Chaos-surfaced bug, fixed this PR: one flipped byte can make
+    MANIFEST.json invalid UTF-8 — verification must read that as 'does not
+    verify' and resolution must fall back, not crash with UnicodeDecodeError."""
+    manager = CheckpointManager(str(tmp_path))
+    good = manager.save(0, _write_artifacts(["model.npz"]))
+    flipped = manager.save(1, _write_artifacts(["model.npz"]))
+    manifest = os.path.join(flipped, CHECKPOINT_MANIFEST_NAME)
+    data = bytearray(open(manifest, "rb").read())
+    data[len(data) // 2] = 0xFF  # invalid UTF-8 continuation byte
+    with open(manifest, "wb") as f:
+        f.write(bytes(data))
+    assert verify_checkpoint_dir(flipped) is False
+    assert manager.resolve("latest") == good
 
 
 def test_write_checkpoint_manifest_skips_staging_and_temp_litter(tmp_path):

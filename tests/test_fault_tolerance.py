@@ -98,6 +98,89 @@ def test_preemption_handler_off_main_thread_degrades_to_noop():
 
 
 @pytest.mark.faults
+def test_supervisor_crash_loop_detection_stops_early():
+    """A child that dies instantly with the SAME exit code every time (import
+    error, bad flag, missing checkpoint) is a deterministic failure: after
+    `crash_loop_threshold` identical fast crashes the supervisor must abort
+    with a tagged diagnostic instead of grinding through a 50-restart backoff
+    schedule."""
+    sup = Supervisor(
+        [sys.executable, "-c", "raise SystemExit(7)"],
+        max_restarts=50,
+        backoff_seconds=0.01,
+        max_backoff_seconds=0.05,
+        monitor_interval=0.05,
+        crash_loop_threshold=3,
+        crash_loop_min_uptime=30.0,  # python startup counts as "immediate" here
+    )
+    code = sup.run()
+    assert code == 7
+    assert sup.crash_loop_detected is True
+    assert sup.restart_count == 2, "threshold=3 means: initial crash + 2 restarts, then abort"
+
+
+@pytest.mark.faults
+def test_supervisor_crash_loop_requires_identical_exit_codes():
+    """Alternating exit codes are NOT a crash loop (a flaky-but-varied failure
+    may still be healed by a restart): detection must reset on a code change
+    and the budget path decides instead."""
+    with tempfile.TemporaryDirectory() as d:
+        marker = os.path.join(d, "n")
+        body = (
+            "import os, sys\n"
+            "n = int(open(sys.argv[1]).read()) if os.path.exists(sys.argv[1]) else 0\n"
+            "open(sys.argv[1], 'w').write(str(n + 1))\n"
+            "sys.exit(7 if n % 2 == 0 else 8)\n"
+        )
+        script = _script(d, "alternating.py", body)
+        sup = Supervisor(
+            [sys.executable, script, marker],
+            max_restarts=5,
+            backoff_seconds=0.01,
+            monitor_interval=0.05,
+            crash_loop_threshold=3,
+            crash_loop_min_uptime=30.0,
+        )
+        code = sup.run()
+        assert sup.crash_loop_detected is False
+        assert sup.restart_count == 5, "budget, not the crash-loop detector, must end this run"
+        assert code in (7, 8)
+
+
+@pytest.mark.faults
+def test_supervisor_slow_failures_are_not_a_crash_loop():
+    """Identical exit codes from a child that lived past the uptime floor are a
+    workload problem, not a crash loop — restarts may genuinely help."""
+    sup = Supervisor(
+        [sys.executable, "-c", "raise SystemExit(7)"],
+        max_restarts=4,
+        backoff_seconds=0.01,
+        monitor_interval=0.05,
+        crash_loop_threshold=3,
+        crash_loop_min_uptime=0.0,  # nothing is "immediate": detector never arms
+    )
+    code = sup.run()
+    assert code == 7
+    assert sup.crash_loop_detected is False
+    assert sup.restart_count == 4
+
+
+@pytest.mark.faults
+def test_supervisor_crash_loop_detection_can_be_disabled():
+    sup = Supervisor(
+        [sys.executable, "-c", "raise SystemExit(7)"],
+        max_restarts=6,
+        backoff_seconds=0.01,
+        monitor_interval=0.05,
+        crash_loop_threshold=0,
+        crash_loop_min_uptime=30.0,
+    )
+    assert sup.run() == 7
+    assert sup.crash_loop_detected is False
+    assert sup.restart_count == 6
+
+
+@pytest.mark.faults
 def test_supervisor_backoff_is_capped():
     """A tight crash loop with a big restart budget must never sleep unboundedly:
     linear backoff saturates at `max_backoff_seconds`."""
