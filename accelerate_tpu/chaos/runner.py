@@ -492,6 +492,7 @@ class ChaosRunner:
         max_queue: int = 4,
         max_new_tokens: int = 4,
         max_cycles: int = 200,
+        paged: bool = True,
     ) -> InvariantReport:
         """Serving workload: a tiny llama `ContinuousBatcher` fed one request
         per cycle (plus scripted queue bursts), driven to drain under injected
@@ -506,12 +507,19 @@ class ChaosRunner:
             rope_theta=10000.0,
         )
         model = create_llama_model(cfg, seq_len=32)
+        # Paged (default): page_size=4 with a shared 8-token system prompt on
+        # half the traffic, so the dispatch-failure sweeps exercise page
+        # refcounts AND live prefix registrations — the page-ledger invariant
+        # below is non-vacuous. paged=False drives the same sweeps through the
+        # contiguous fallback layout (its blast-radius recovery stays covered).
         engine = ContinuousBatcher(
             model, num_slots=num_slots, max_length=64, chunk_size=chunk_size,
             max_queue=max_queue, registry=self.session.registry,
+            paged=paged, page_size=4,
         )
         ServingInjector(self.session).arm(engine)
         rng = np.random.default_rng(self.plan.seed)
+        shared_prefix = rng.integers(1, cfg.vocab_size, (8,)).astype(np.int32)
 
         next_id = 0
         rejected = 0
@@ -521,6 +529,8 @@ class ChaosRunner:
         def make_request() -> Request:
             nonlocal next_id
             prompt = rng.integers(1, cfg.vocab_size, (int(rng.integers(2, 9)),)).astype(np.int32)
+            if rng.integers(2):
+                prompt = np.concatenate([shared_prefix, prompt])
             request = Request(next_id, prompt, max_new_tokens=max_new_tokens)
             next_id += 1
             return request
@@ -596,8 +606,31 @@ class ChaosRunner:
             ),
             self._check_engine_recovered(finish_reasons, first_id_after_error),
             self._check_serve_ledger(engine, accepted),
+            self._check_page_ledger(engine),
         ]
         return self._report("serve", checks)
+
+    @staticmethod
+    def _check_page_ledger(engine) -> InvariantCheck:
+        """Paged engines must end a drained run with ZERO pages in use — every
+        refcount returned through finish/cancel/error/abort, none leaked by the
+        blast-radius rebuild — and a structurally consistent pool: no page both
+        free and cached, no prefix registration pointing at a freed page (the
+        'resurrected prefix' failure a post-recovery stale hash map would
+        cause). Contiguous engines pass vacuously."""
+        pool = getattr(engine, "pool", None)
+        if pool is None:
+            return InvariantCheck("page_ledger", True, {"note": "contiguous engine (no pool)"})
+        problems = pool.check_consistency()
+        return InvariantCheck(
+            "page_ledger",
+            passed=pool.pages_in_use == 0 and not problems,
+            details={
+                "pages_in_use_after_drain": pool.pages_in_use,
+                "consistency_problems": problems,
+                **pool.stats(),
+            },
+        )
 
     def _check_engine_recovered(
         self, finish_reasons: Dict[int, Optional[str]], first_id_after_error: Optional[int]

@@ -133,7 +133,9 @@ def _params_resolver(model):
     return lambda p: dequantize_params(p, compute_dtype)
 
 
-def make_causal_programs(module, resolve, full_prefill_logits: bool = False):
+def make_causal_programs(
+    module, resolve, full_prefill_logits: bool = False, step_mask_operand: bool = False
+):
     """(prefill, step) raw callables for a decode-cache causal-LM module — the
     factored seam that `Generator` jits directly and `serving.ContinuousBatcher`
     composes into its slot-insert / chunked-decode programs.
@@ -143,7 +145,13 @@ def make_causal_programs(module, resolve, full_prefill_logits: bool = False):
     `[B, S, V]` logits with `full_prefill_logits=True` (serving's bucketed insert
     reads the logits at each prompt's REAL length, not the padded end);
     `step(params, cache, token, position)` advances one token. Both are un-jitted
-    so callers can trace them inside larger fused programs."""
+    so callers can trace them inside larger fused programs.
+
+    `step_mask_operand=True` gives `step` a fifth argument threaded through as
+    the module's `attention_mask`: the PAGED slot cache reads it as the
+    [B, pages_per_slot] int32 page table (a traced operand — the one decode
+    executable survives every admission), since slot decode never carries a
+    boolean mask of its own."""
 
     def prefill(params, input_ids, positions, attention_mask=None):
         # attention_mask (left-padded batch prompts): rides into the cached
@@ -165,7 +173,40 @@ def make_causal_programs(module, resolve, full_prefill_logits: bool = False):
         )
         return logits[:, -1, :], mutated["cache"]
 
-    return prefill, step
+    def step_with_mask(params, cache, token, position, mask):
+        logits, mutated = module.apply(
+            {**resolve(params), "cache": cache},
+            token[:, None],
+            mask,
+            position[:, None],
+            mutable=["cache"],
+        )
+        return logits[:, -1, :], mutated["cache"]
+
+    return prefill, (step_with_mask if step_mask_operand else step)
+
+
+def make_cached_prefill_program(module, resolve):
+    """`prefill_with_cache(params, cache, input_ids, positions)` — prefill a
+    token block INTO AN EXISTING dense decode cache, continuing at the cache's
+    own `cache_index` instead of position 0, and return the full `[B, S, V]`
+    logits plus the mutated cache. The paged serving engine's shared-prefix
+    insert drives this: the prefix pages are gathered into a batch-1 dense cache
+    (`cache_index` = matched length), only the unmatched SUFFIX runs through the
+    model here — the prefill FLOPs a shared system prompt would have cost are
+    simply never issued — and the result is scattered back into pool pages."""
+
+    def prefill_with_cache(params, cache, input_ids, positions):
+        logits, mutated = module.apply(
+            {**resolve(params), "cache": cache},
+            input_ids,
+            None,
+            positions,
+            mutable=["cache"],
+        )
+        return logits, mutated["cache"]
+
+    return prefill_with_cache
 
 
 class Generator:

@@ -49,6 +49,10 @@ class GPTNeoXConfig:
     decode_cache_length: int = 0
     # Per-row slot-cache decode for continuous batching (see LlamaConfig).
     decode_slot_cache: bool = False
+    # Paged slot cache: pool geometry + page tables via the mask seam (see
+    # LlamaConfig for the full semantics).
+    decode_page_size: int = 0
+    decode_num_pages: int = 0
     param_dtype: str = "float32"
 
     @property
@@ -95,8 +99,14 @@ class GPTNeoXAttention(nn.Module):
             L = cfg.decode_cache_length
             if cfg.decode_slot_cache:
                 # Continuous-batching decode: per-row scatter writes at each
-                # slot's own position (serving.ContinuousBatcher).
-                k_all, v_all, decode_mask = update_slot_cache(self, k, v, L, positions)
+                # slot's own position (serving.ContinuousBatcher). Paged mode
+                # reads `mask` as the [B, pages_per_slot] int32 page table.
+                k_all, v_all, decode_mask = update_slot_cache(
+                    self, k, v, L, positions,
+                    page_table=mask if cfg.decode_page_size else None,
+                    page_size=cfg.decode_page_size,
+                    num_pages=cfg.decode_num_pages,
+                )
             else:
                 k_all, v_all, decode_mask = update_decode_cache(self, k, v, L, pad_mask=mask)
             out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)

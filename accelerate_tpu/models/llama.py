@@ -54,6 +54,13 @@ class LlamaConfig:
     # independent request slot whose decode position comes from the `positions`
     # argument (per-row scatter writes) instead of the shared `cache_index`.
     decode_slot_cache: bool = False
+    # Paged slot cache: K/V live in one pool of decode_num_pages fixed-size
+    # pages ([num_pages, page_size, h, d]) instead of a dense row per slot, and
+    # the per-slot page tables ride in through the `attention_mask` argument as
+    # [B, pages_per_slot] int32 traced operands (slot decode never carries a
+    # boolean mask, so the seam is free). 0 = contiguous per-slot rows.
+    decode_page_size: int = 0
+    decode_num_pages: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -100,9 +107,14 @@ class LlamaAttention(nn.Module):
         if cfg.decode_cache_length:
             if cfg.decode_slot_cache:
                 # Continuous-batching decode: each slot row writes at its OWN
-                # position (per-row scatter) and attends its written prefix only.
+                # position (per-row scatter) and attends its written prefix
+                # only. Paged mode reads `mask` as the slot page table ([B,
+                # pages_per_slot] int32) mapping positions onto pool pages.
                 k_all, v_all, decode_mask = update_slot_cache(
-                    self, k, v, cfg.decode_cache_length, positions
+                    self, k, v, cfg.decode_cache_length, positions,
+                    page_table=mask if cfg.decode_page_size else None,
+                    page_size=cfg.decode_page_size,
+                    num_pages=cfg.decode_num_pages,
                 )
             else:
                 # Incremental decoding through the shared flax-cache write path

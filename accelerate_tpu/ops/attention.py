@@ -90,7 +90,10 @@ def update_decode_cache(module, k, v, cache_length: int, pad_mask=None):
     return cached_k.value, cached_v.value, decode_mask
 
 
-def update_slot_cache(module, k, v, cache_length: int, positions):
+def update_slot_cache(
+    module, k, v, cache_length: int, positions, page_table=None, page_size: int = 0,
+    num_pages: int = 0,
+):
     """Per-ROW cache writes for slot-based continuous batching (serving.py):
     every batch row is an independent request slot with its OWN running position,
     so the single new K/V of row i lands at `positions[i]` instead of a shared
@@ -102,11 +105,26 @@ def update_slot_cache(module, k, v, cache_length: int, positions):
 
     Decode-only (s == 1): slot PREFILL goes through the ordinary
     `update_decode_cache` path on a batch-1 cache that the serving engine
-    scatters into the slot row (utils/operations.tree_scatter_rows), so one
-    attention code path covers both programs.
+    scatters into the slot row (utils/operations.tree_scatter_rows) — or, paged,
+    into the slot's pool pages (tree_scatter_pages) — so one attention code path
+    covers both programs.
+
+    PAGED mode (`page_size > 0`): the cache collection holds one POOL of
+    `num_pages` fixed-size pages ([num_pages, page_size, h, d]) instead of one
+    `cache_length` row per slot, and `page_table` ([B, pages_per_slot] int32, a
+    traced operand — admissions never recompile) maps each slot's logical
+    positions onto pool pages. Row i's new K/V lands at
+    `pool[page_table[i, pos_i // page_size], pos_i % page_size]`; the read
+    gathers the row's pages back into logical order and applies the same
+    `cols <= pos` mask, so decode is token-identical to the contiguous layout.
+    Page 0 is the engine's reserved scratch page: the host points inactive
+    slots' table rows at it, so their (discarded) writes can never land in a
+    page owned by a live request or a shared read-only prefix page.
 
     Args:
         positions: [B, 1] int32 — each slot's absolute write/attend position.
+        page_table: [B, pages_per_slot] int32 pool-page ids per slot (paged only).
+        page_size / num_pages: static pool geometry (paged only).
 
     Returns `(k_full, v_full, decode_mask)` like `update_decode_cache`.
     """
@@ -119,6 +137,31 @@ def update_slot_cache(module, k, v, cache_length: int, positions):
             "prefill a slot through update_decode_cache on a batch-1 cache and "
             "scatter it into the slot row (tree_scatter_rows)"
         )
+    if page_size:
+        if page_table is None:
+            raise ValueError("paged slot cache needs a [B, pages_per_slot] page_table operand")
+        pages_per_slot = page_table.shape[-1]
+        L = pages_per_slot * page_size
+        pool_k = module.variable(
+            "cache", "cached_key", jnp.zeros, (num_pages, page_size, h, d), k.dtype
+        )
+        pool_v = module.variable(
+            "cache", "cached_value", jnp.zeros, (num_pages, page_size, h, d), v.dtype
+        )
+        pos = jnp.clip(positions[:, 0], 0, L - 1).astype(jnp.int32)
+        table = jnp.asarray(page_table, jnp.int32)
+        page_slot = jnp.clip(pos // page_size, 0, pages_per_slot - 1)
+        pid = jnp.take_along_axis(table, page_slot[:, None], axis=1)[:, 0]  # [B]
+        off = pos % page_size
+        pool_k.value = pool_k.value.at[pid, off].set(k[:, 0])
+        pool_v.value = pool_v.value.at[pid, off].set(v[:, 0])
+        # Logical-order read: [B, P, ps, h, d] -> [B, P*ps, h, d]. Same masked
+        # attention as the contiguous layout — pool order never leaks.
+        k_full = jnp.take(pool_k.value, table, axis=0).reshape(b, L, h, d)
+        v_full = jnp.take(pool_v.value, table, axis=0).reshape(b, L, h, d)
+        cols = jnp.arange(L)[None, :]
+        decode_mask = (cols <= pos[:, None])[:, None, None, :]  # [B, 1, 1, L]
+        return k_full, v_full, decode_mask
     L = cache_length
     cached_k = module.variable("cache", "cached_key", jnp.zeros, (b, L, h, d), k.dtype)
     cached_v = module.variable("cache", "cached_value", jnp.zeros, (b, L, h, d), v.dtype)

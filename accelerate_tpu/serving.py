@@ -7,9 +7,18 @@ work, and nothing new can join until the whole batch drains. `ContinuousBatcher`
 keeps the GSPMD single-compiled-program discipline (one decode executable, ever)
 but makes the BATCH dynamic at the host level:
 
-  - A fixed-capacity **slot batch**: `num_slots` rows sharing one static KV cache
-    of capacity `max_length`. A slot is a physical cache row; requests come and
-    go, the compiled program never changes shape.
+  - A fixed-capacity **slot batch**: `num_slots` rows over one static KV cache.
+    A slot is a logical cache row; requests come and go, the compiled program
+    never changes shape. By default (`paged=True`) the cache is a POOL of
+    fixed-size KV pages plus per-slot page tables riding as traced int32
+    operands (`ops/attention.update_slot_cache` paged mode): admission reserves
+    `ceil((prompt + max_new) / page_size)` pages — memory proportional to each
+    request's ACTUAL footprint, not the engine-wide `max_length` worst case —
+    and a page-granular prefix cache (`paging.PagePool`) maps shared prompt
+    prefixes (system prompts) to shared read-only pages with refcounts, so a
+    repeated prefix costs zero prefill FLOPs and zero duplicate HBM after its
+    first request. `paged=False` keeps the dense one-row-per-slot layout;
+    greedy decode is token-identical between the two.
   - **insert** (one executable per power-of-two prompt bucket): prefill a new
     request's prompt through the ordinary decode-cache path on a batch-1 cache,
     then `tree_scatter_rows` it into the free slot's cache rows, read the logits
@@ -68,11 +77,13 @@ from .generation import (
     _operand,
     _params_resolver,
     _sample,
+    make_cached_prefill_program,
     make_causal_programs,
 )
 from .logging import get_logger
+from .paging import SCRATCH_PAGE, PagePool, chain_hashes
 from .telemetry import MetricsRegistry
-from .utils.operations import tree_scatter_rows
+from .utils.operations import tree_gather_pages, tree_scatter_pages, tree_scatter_rows
 
 logger = get_logger(__name__)
 
@@ -156,6 +167,10 @@ class ContinuousBatcher:
         max_queue: Optional[int] = None,
         trace_guard=None,
         registry: Optional[MetricsRegistry] = None,
+        paged: bool = True,
+        page_size: int = 16,
+        num_pages: Optional[int] = None,
+        prefix_cache: bool = True,
     ):
         if getattr(model, "module", None) is None or not hasattr(model.module, "config"):
             raise ValueError("ContinuousBatcher needs a Model bundle built from an in-tree flax module")
@@ -164,6 +179,12 @@ class ContinuousBatcher:
             raise ValueError(
                 f"{type(model.module).__name__}'s config has no `decode_slot_cache` "
                 "field — this model family doesn't support slot-batched serving yet"
+            )
+        if paged and not hasattr(base, "decode_page_size"):
+            raise ValueError(
+                f"{type(model.module).__name__}'s config has no `decode_page_size` "
+                "field — this model family doesn't support the paged KV cache; "
+                "pass paged=False for the contiguous per-slot layout"
             )
         self.base_config = base
         self.params = model.params if "params" in model.params else {"params": model.params}
@@ -176,21 +197,70 @@ class ContinuousBatcher:
         self.use_repetition_penalty = use_repetition_penalty
         if self.num_slots < 1 or self.chunk_size < 1:
             raise ValueError("num_slots and chunk_size must be >= 1")
+        self.paged = bool(paged)
+        self.page_size = int(page_size)
+        if self.paged:
+            if self.page_size < 1:
+                raise ValueError("page_size must be >= 1")
+            self.pages_per_slot = -(-self.max_length // self.page_size)
+            # Per-slot logical capacity rounded up to whole pages; columns past
+            # max_length stay masked (exact zeros under the f32 softmax), so
+            # decode is token-identical to the contiguous layout.
+            self._padded_length = self.pages_per_slot * self.page_size
+            # Default pool: the contiguous layout's worst case (every slot at
+            # max_length) plus the scratch page — same capacity, so admission
+            # only ever gets LOOSER. Size it DOWN for real HBM savings: any
+            # request mix whose actual token footprint fits still completes.
+            self.num_pages = (
+                int(num_pages) if num_pages is not None
+                else self.num_slots * self.pages_per_slot + 1
+            )
+        else:
+            self.pages_per_slot = 0
+            self._padded_length = self.max_length
+            self.num_pages = 0
+        # Prefix sharing needs the suffix-only insert to seed presence from the
+        # WHOLE prompt, which the suffix program never sees — repetition-penalty
+        # engines therefore run the paged cache without prefix reuse.
+        self.use_prefix_cache = bool(prefix_cache) and self.paged and not use_repetition_penalty
+        if prefix_cache and self.paged and use_repetition_penalty:
+            logger.info(
+                "prefix cache disabled: use_repetition_penalty needs whole-prompt "
+                "presence seeding, which shared-prefix inserts cannot provide"
+            )
 
         resolve = _params_resolver(model)
         # Prefill rides the ORDINARY decode-cache path on a batch-1 cache (shared
-        # scalar cache_index, write at 0); decode steps ride the per-row slot
-        # cache. Same cache capacity so slot rows line up for the scatter.
-        prefill_cfg = dataclasses.replace(base, decode_cache_length=self.max_length)
-        step_cfg = dataclasses.replace(
-            base, decode_cache_length=self.max_length, decode_slot_cache=True
-        )
+        # scalar cache_index); decode steps ride the per-row slot cache. Same
+        # logical cache capacity so the prefilled rows line up for the scatter —
+        # into slot rows (contiguous) or pool pages (paged).
+        cache_len = self._padded_length
+        prefill_cfg = dataclasses.replace(base, decode_cache_length=cache_len)
+        if self.paged:
+            step_cfg = dataclasses.replace(
+                base, decode_cache_length=cache_len, decode_slot_cache=True,
+                decode_page_size=self.page_size, decode_num_pages=self.num_pages,
+            )
+        else:
+            step_cfg = dataclasses.replace(
+                base, decode_cache_length=cache_len, decode_slot_cache=True
+            )
         prefill_module = type(model.module)(prefill_cfg)
         step_module = type(model.module)(step_cfg)
         self._prefill_raw, _ = make_causal_programs(prefill_module, resolve, full_prefill_logits=True)
-        _, self._step_raw = make_causal_programs(step_module, resolve)
+        _, self._step_raw = make_causal_programs(step_module, resolve, step_mask_operand=self.paged)
         self._step_module = step_module
         self._resolve = resolve
+        if self.paged:
+            self._cached_prefill_raw = make_cached_prefill_program(prefill_module, resolve)
+            # The dense batch-1 cache STRUCTURE the paged insert materializes by
+            # gathering pool pages (zero compute/compile: eval_shape only).
+            dummy = jnp.zeros((1, 1), jnp.int32)
+            dpos = jnp.zeros((1, 1), jnp.int32)
+            self._dense_cache_struct = jax.eval_shape(
+                lambda p: prefill_module.apply(resolve(p), dummy, None, dpos, mutable=["cache"])[1]["cache"],
+                self.params,
+            )
 
         self._sample_config = GenerationConfig(do_sample=do_sample, top_k=top_k, top_p=top_p)
         # Python-side effects run at TRACE time: these count compiles, and the
@@ -215,6 +285,12 @@ class ContinuousBatcher:
         self._eos = np.full(S, -1, np.int32)
         self._temp = np.ones(S, np.float32)
         self._pen = np.ones(S, np.float32)
+        # Per-slot page tables (paged): all-zeros rows point at the scratch
+        # page, so a freed/inactive slot's discarded decode writes can never
+        # land in a live request's pages. Contiguous engines keep a [S, 1]
+        # dummy so the chunk signature stays uniform (the operand is unused).
+        self._page_table = np.zeros((S, self.pages_per_slot if self.paged else 1), np.int32)
+        self._slot_pages: List[List[int]] = [[] for _ in range(S)]
 
         self._slot_request: List[Optional[RequestResult]] = [None] * S
         self._queue: deque = deque()
@@ -281,28 +357,69 @@ class ContinuousBatcher:
         self._submit_times: Dict[int, float] = {}  # request_id -> submit() perf_counter
         self._slot_last_event = np.zeros(S, np.float64)  # last drain time per slot
 
+        # Page-pool + prefix-cache telemetry and the host allocator itself
+        # (paged engines only; all updates are host-scalar arithmetic).
+        self.pool: Optional[PagePool] = None
+        if self.paged:
+            self._m_pages_total = self.metrics.gauge(
+                "serving_pages_total", help="usable KV pool pages (excludes the scratch page)"
+            )
+            self._m_pages_in_use = self.metrics.gauge(
+                "serving_pages_in_use", help="pool pages referenced by in-flight requests"
+            )
+            self._m_prefix_hits = self.metrics.counter(
+                "serving_prefix_cache_hits_total",
+                help="prompt pages served from the shared-prefix cache",
+            )
+            self._m_prefix_misses = self.metrics.counter(
+                "serving_prefix_cache_misses_total",
+                help="full prompt pages that had to be prefilled (no cached prefix)",
+            )
+            self._m_prefix_evictions = self.metrics.counter(
+                "serving_prefix_cache_evictions_total",
+                help="unreferenced cached prefix pages reclaimed by the allocator",
+            )
+            self._m_prefill_saved = self.metrics.counter(
+                "prefill_tokens_saved_total",
+                help="prompt tokens whose prefill FLOPs the prefix cache skipped",
+            )
+            self.pool = PagePool(
+                self.num_pages, self.page_size,
+                on_evict=self._m_prefix_evictions.inc,
+            )
+            self._m_pages_total.set(self.pool.pages_total)
+
     # ------------------------------------------------------------------ programs
 
     def _init_cache(self):
-        """Create the [num_slots, max_length] slot cache: `eval_shape` the
-        slot-mode module's cache variables (zero compute, zero compile — no
-        throwaway executable at engine construction) and materialize them as
-        zeros. Correct because every slot's rows are overwritten by insert
+        """Create the slot cache — dense [num_slots, max_length] rows, or the
+        [num_pages, page_size] pool when paged: `eval_shape` the slot-mode
+        module's cache variables (zero compute, zero compile — no throwaway
+        executable at engine construction) and materialize them as zeros.
+        Correct because every slot's rows/pages are overwritten by insert
         before they're ever attended."""
         S = self.num_slots
         module, resolve = self._step_module, self._resolve
         dummy = jnp.zeros((S, 1), jnp.int32)
         pos = jnp.zeros((S, 1), jnp.int32)
+        mask = jnp.zeros((S, self.pages_per_slot), jnp.int32) if self.paged else None
         shapes = jax.eval_shape(
-            lambda p: module.apply(resolve(p), dummy, None, pos, mutable=["cache"])[1]["cache"],
+            lambda p: module.apply(resolve(p), dummy, mask, pos, mutable=["cache"])[1]["cache"],
             self.params,
         )
         return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
     def _insert_fn(self, bucket: int):
-        """One compiled insert per power-of-two prompt bucket. The prompt's real
-        length, the slot index, temperature/penalty and the rng all ride as
-        traced operands — re-admission never recompiles anything."""
+        """One compiled insert per power-of-two prompt bucket (paged: per
+        SUFFIX bucket — the unmatched tail after prefix-cache hits). The real
+        length, the slot index, page table row, matched prefix length,
+        temperature/penalty and the rng all ride as traced operands —
+        re-admission never recompiles anything."""
+        if self.paged:
+            return self._paged_insert_fn(bucket)
+        return self._contiguous_insert_fn(bucket)
+
+    def _contiguous_insert_fn(self, bucket: int):
         fn = self._insert_fns.get(bucket)
         if fn is not None:
             return fn
@@ -337,20 +454,82 @@ class ContinuousBatcher:
         self._insert_fns[bucket] = fn
         return fn
 
+    def _paged_insert_fn(self, bucket: int):
+        """Paged admission: gather the slot's (possibly shared-prefix) pages
+        into a batch-1 dense cache positioned at `matched_len`, prefill ONLY the
+        unmatched suffix through it, scatter the result back into pool pages —
+        with every already-matched table entry redirected to the scratch page,
+        so a shared read-only prefix page is never rewritten — and sample the
+        first token from the suffix's real last logits. A full prefix hit still
+        recomputes the prompt's final token (matching is capped below the whole
+        prompt), so first-token logits always exist."""
+        fn = self._insert_fns.get(bucket)
+        if fn is not None:
+            return fn
+        cached_prefill = self._cached_prefill_raw
+        dense_struct = self._dense_cache_struct
+        use_pen = self.use_repetition_penalty
+        config = self._sample_config
+        V = self.base_config.vocab_size
+        P = self.pages_per_slot
+
+        def insert(
+            params, pool_cache, presence, suffix_ids, real_len, matched_len,
+            matched_pages, page_row, slot, temperature, penalty, rng,
+        ):
+            self.trace_counts["insert"] += 1
+            dense = tree_gather_pages(pool_cache, dense_struct, page_row, matched_len)
+            positions = matched_len + jnp.broadcast_to(jnp.arange(bucket)[None, :], (1, bucket))
+            logits, dense = cached_prefill(params, dense, suffix_ids, positions)
+            write_row = jnp.where(
+                jnp.arange(P) < matched_pages, jnp.int32(SCRATCH_PAGE), page_row
+            )
+            pool_cache = tree_scatter_pages(pool_cache, dense, write_row)
+            # Logits at the REAL last suffix token (bucket pads sit above it
+            # and, being causal, never influenced it).
+            last = jax.lax.dynamic_slice_in_dim(logits, real_len - 1, 1, axis=1)[:, 0, :]
+            row = None
+            if use_pen:
+                # Penalty engines run with the prefix cache OFF (matched_len is
+                # always 0), so the "suffix" here is the whole prompt and the
+                # presence row seeds exactly as on the contiguous path.
+                valid = jnp.arange(bucket) < real_len
+                row = jnp.zeros((V,), bool).at[suffix_ids[0]].max(valid)
+                last = _apply_repetition_penalty(last, row[None, :], penalty)
+            token, rng = _sample(last, config, rng, temperature)
+            if use_pen:
+                row = row.at[token[0]].set(True)
+                presence = jax.lax.dynamic_update_slice(
+                    presence, row[None, :], (jnp.asarray(slot, jnp.int32), jnp.int32(0))
+                )
+            return token[0], pool_cache, presence, rng
+
+        donate = (1, 2) if use_pen else (1,)
+        fn = jax.jit(insert, donate_argnums=donate)
+        self._insert_fns[bucket] = fn
+        return fn
+
     def _build_chunk(self):
         """THE decode executable: `chunk_size` scan steps over all slots, per-slot
         operands, packed (slot, token) stream output. Compiled exactly once."""
         S, L, chunk = self.num_slots, self.max_length, self.chunk_size
         step_inner = self._step_raw
         use_pen = self.use_repetition_penalty
+        paged = self.paged
         config = self._sample_config
 
-        def decode_chunk(params, cache, presence, token, pos, active, rem, eos_ids, temperature, penalty, rng):
+        def decode_chunk(params, cache, presence, token, pos, active, rem, eos_ids, temperature, penalty, page_table, rng):
             self.trace_counts["decode_chunk"] += 1
 
             def body(carry, _):
                 cache, presence, token, pos, active, rem, rng = carry
-                logits, cache = step_inner(params, cache, token, pos)
+                # The page table is loop-invariant: admission reserves a
+                # request's whole worst-case footprint up front, so no page
+                # boundary crossed mid-chunk ever needs a fresh page.
+                if paged:
+                    logits, cache = step_inner(params, cache, token, pos, page_table)
+                else:
+                    logits, cache = step_inner(params, cache, token, pos)
                 if use_pen:
                     logits = _apply_repetition_penalty(logits, presence, penalty[:, None])
                 nxt, rng = _sample(logits, config, rng, temperature[:, None])
@@ -408,7 +587,7 @@ class ContinuousBatcher:
         """Back-compat health view, computed from the metrics registry (the
         source of truth since the telemetry PR). Same keys and meanings as the
         old ad-hoc dict; mutate nothing here — it is rebuilt per access."""
-        return {
+        view: Dict[str, Any] = {
             "inserts": int(self._m_inserts.value),
             "chunks": int(self._m_chunks.value),
             "decode_steps": int(self._m_decode_steps.value),
@@ -417,6 +596,19 @@ class ContinuousBatcher:
                 reason: int(counter.value) for reason, counter in self._m_finish.items()
             },
         }
+        if self.paged:
+            view["pages_total"] = self.pool.pages_total
+            view["pages_in_use"] = self.pool.pages_in_use
+            view["prefix_cache"] = {
+                "enabled": self.use_prefix_cache,
+                "hits": int(self._m_prefix_hits.value),
+                "misses": int(self._m_prefix_misses.value),
+                "evictions": int(self._m_prefix_evictions.value),
+                "prefill_tokens_saved": int(self._m_prefill_saved.value),
+                "entries": self.pool.prefix_entries,
+                "cached_pages": self.pool.pages_cached,
+            }
+        return view
 
     def _update_occupancy_gauges(self):
         """Refresh the point-in-time gauges (queue depth, slot occupancy) —
@@ -427,6 +619,8 @@ class ContinuousBatcher:
         in_use = sum(r is not None for r in self._slot_request)
         self._m_slots_in_use.set(in_use)
         self._m_slot_utilization.set(in_use / self.num_slots)
+        if self.paged:
+            self._m_pages_in_use.set(self.pool.pages_in_use)
 
     def submit(self, request: Request) -> int:
         """Validate + enqueue. Raises `ValueError` for malformed requests (the
@@ -447,6 +641,14 @@ class ContinuousBatcher:
                 f"prompt ({ids.size}) + max_new_tokens ({request.max_new_tokens}) "
                 f"exceeds the {self.max_length}-token slot capacity"
             )
+        if self.paged:
+            need = -(-(int(ids.size) + request.max_new_tokens) // self.page_size)
+            if need > self.pool.pages_total:
+                raise ValueError(
+                    f"request needs {need} KV pages ({ids.size} prompt + "
+                    f"{request.max_new_tokens} new tokens at page_size "
+                    f"{self.page_size}) but the pool holds {self.pool.pages_total}"
+                )
         if request.request_id in self.results:
             raise ValueError(f"duplicate request_id {request.request_id}")
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
@@ -491,6 +693,15 @@ class ContinuousBatcher:
         self._cache = self._init_cache()
         if self._presence is not None:
             self._presence = jnp.zeros((self.num_slots, self.base_config.vocab_size), bool)
+        if self.paged:
+            # The pool CONTENT died with the donated buffers: every refcount,
+            # page-table row and — critically — prefix registration goes with
+            # it (a stale hash->page mapping would serve zeroed KV as a
+            # "cached" prefix to the next shared-prompt request).
+            self.pool.reset()
+            self._page_table[:] = SCRATCH_PAGE
+            self._slot_pages = [[] for _ in range(self.num_slots)]
+            self._m_pages_in_use.set(0)
 
     def _slot_of(self, request_id: int) -> Optional[int]:
         for slot, result in enumerate(self._slot_request):
@@ -514,6 +725,15 @@ class ContinuousBatcher:
         if slot is not None:
             self._slot_request[slot] = None
             self._active[slot] = False
+            if self.paged:
+                # Release the slot's page references (a shared prefix page
+                # drops to CACHED at refcount 0, private pages go free) and
+                # point the table row at the scratch page so any residual
+                # write for this row is discarded.
+                if self._slot_pages[slot]:
+                    self.pool.release(self._slot_pages[slot])
+                    self._slot_pages[slot] = []
+                self._page_table[slot] = SCRATCH_PAGE
         self._update_occupancy_gauges()
 
     def _drop_queued(self, request_id: int) -> bool:
@@ -551,6 +771,16 @@ class ContinuousBatcher:
         """Fill free slots from the queue (FIFO). Each admission is one insert
         dispatch; the first token streams out immediately (TTFT).
 
+        Paged admission is PAGE-based, not slot-based: the request reserves
+        `ceil((prompt + max_new) / page_size)` pool pages minus whatever its
+        prompt prefix already shares from the prefix cache — so a mix of small
+        requests can occupy every slot even when the pool is far smaller than
+        `num_slots * max_length` worst-case rows. When the pool (plus evictable
+        cached prefix pages) cannot cover the next request, it returns to the
+        FRONT of the queue and admission pauses until in-flight requests
+        release pages — FIFO order and guaranteed progress, since reserve-on-
+        admit means every admitted request runs to completion.
+
         Error isolation: an exception from ONE request's insert (transient device
         error, a prompt the compiled program rejects) finishes only that request
         with `finish_reason="error"` — the queue keeps draining and every other
@@ -561,25 +791,87 @@ class ContinuousBatcher:
             slot = self._slot_request.index(None)
             ids = req.input_ids
             p = int(ids.size)
-            bucket = min(_bucket_for(p), self.max_length)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :p] = ids
             result = self.results[req.request_id]
+            pages: List[int] = []
+            hashes: List[str] = []
+            matched_pages = 0
+            matched_len = 0
+            if self.paged:
+                total_pages = -(-(p + req.max_new_tokens) // self.page_size)
+                if self.use_prefix_cache:
+                    hashes = chain_hashes(ids, self.page_size)
+                    # Cap below the whole prompt: the last real token always
+                    # reruns so the insert has first-token logits to sample.
+                    shared = self.pool.match_prefix(hashes, min(len(hashes), (p - 1) // self.page_size))
+                else:
+                    shared = []
+                matched_pages = len(shared)
+                matched_len = matched_pages * self.page_size
+                private = self.pool.reserve(total_pages - matched_pages)
+                if private is None:
+                    if shared:
+                        self.pool.release(shared)
+                    self._queue.appendleft(req)
+                    break
+                pages = shared + private
+                if self.use_prefix_cache:
+                    full_pages = p // self.page_size
+                    self._m_prefix_hits.inc(matched_pages)
+                    self._m_prefix_misses.inc(max(full_pages - matched_pages, 0))
+                    if matched_len:
+                        self._m_prefill_saved.inc(matched_len)
+                suffix = p - matched_len
+                bucket = _bucket_for(suffix)
+                if matched_pages:
+                    # Floor prefix-hit suffix buckets at the page size: deeper
+                    # matches over time (a prompt re-served after registering
+                    # its own pages leaves a 1-token suffix) would otherwise
+                    # mint ever-smaller buckets — fresh compiles at steady
+                    # state. One floor bucket absorbs every small suffix, so a
+                    # warm server stays warm as its prefix cache deepens.
+                    bucket = max(bucket, _bucket_for(self.page_size))
+                bucket = min(bucket, self._padded_length - matched_len)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :suffix] = ids[matched_len:]
+                page_row = np.zeros((self.pages_per_slot,), np.int32)
+                page_row[: len(pages)] = pages
+            else:
+                bucket = min(_bucket_for(p), self.max_length)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :p] = ids
             try:
                 fn = self._insert_fn(bucket)
-                token, self._cache, self._presence, self._rng = fn(
-                    self.params,
-                    self._cache,
-                    self._presence,
-                    jnp.asarray(padded),
-                    _operand(p, np.int32),
-                    _operand(slot, np.int32),
-                    _operand(req.temperature, np.float32),
-                    _operand(req.repetition_penalty, np.float32),
-                    self._rng,
-                )
+                if self.paged:
+                    token, self._cache, self._presence, self._rng = fn(
+                        self.params,
+                        self._cache,
+                        self._presence,
+                        jnp.asarray(padded),
+                        _operand(p - matched_len, np.int32),
+                        _operand(matched_len, np.int32),
+                        _operand(matched_pages, np.int32),
+                        jnp.asarray(page_row),
+                        _operand(slot, np.int32),
+                        _operand(req.temperature, np.float32),
+                        _operand(req.repetition_penalty, np.float32),
+                        self._rng,
+                    )
+                else:
+                    token, self._cache, self._presence, self._rng = fn(
+                        self.params,
+                        self._cache,
+                        self._presence,
+                        jnp.asarray(padded),
+                        _operand(p, np.int32),
+                        _operand(slot, np.int32),
+                        _operand(req.temperature, np.float32),
+                        _operand(req.repetition_penalty, np.float32),
+                        self._rng,
+                    )
                 token = int(token)
             except Exception as exc:  # noqa: BLE001 — isolate, report, keep serving
+                if pages:
+                    self.pool.release(pages)
                 if self.trace_guard is not None:
                     self.trace_guard.observe(exc)
                 logger.warning(
@@ -599,6 +891,12 @@ class ContinuousBatcher:
                     )
                     self._abort_in_flight(exc)
                 continue
+            if self.paged and self.use_prefix_cache:
+                # The insert just wrote this prompt's full pages: register them
+                # so the NEXT request with the same prefix shares instead of
+                # prefilling. Decode writes land at pos >= prompt_len, past
+                # every full prompt page, so registered content stays frozen.
+                self.pool.register_prefix(hashes[: p // self.page_size], pages, start=matched_pages)
             now = time.perf_counter()
             self._m_inserts.inc()
             submitted_at = self._submit_times.get(req.request_id)
@@ -621,7 +919,14 @@ class ContinuousBatcher:
                 self._eos[slot] = eos
                 self._temp[slot] = req.temperature
                 self._pen[slot] = req.repetition_penalty
+                if self.paged:
+                    self._slot_pages[slot] = pages
+                    self._page_table[slot] = page_row
             else:
+                if pages:
+                    # One-token request: its pages release immediately — but a
+                    # prefix it just registered stays CACHED for the next hit.
+                    self.pool.release(pages)
                 self._finish(result, "eos" if token == eos else "length", now=now)
         self._update_occupancy_gauges()
         return events
@@ -660,6 +965,7 @@ class ContinuousBatcher:
                 jnp.asarray(self._eos),
                 jnp.asarray(self._temp),
                 jnp.asarray(self._pen),
+                jnp.asarray(self._page_table),
                 self._rng,
             )
         except Exception as exc:  # noqa: BLE001

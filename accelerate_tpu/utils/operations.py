@@ -512,6 +512,112 @@ def tree_gather_rows(tree, index):
 
 
 # --------------------------------------------------------------------------------------
+# Page-pool gather/scatter over cache pytrees (serving.py's paged KV cache)
+# --------------------------------------------------------------------------------------
+
+# K/V leaves of a PAGED slot cache are pool-shaped: [..., num_pages, page_size,
+# heads, head_dim] — the page axis sits where the dense cache's batch axis sits
+# (4 from the back), so the same rule covers plain stacks and nn.scan-stacked
+# layers ([layers, num_pages, page_size, h, d]).
+_PAGE_AXIS_FROM_BACK = {"cached_key": 4, "cached_value": 4}
+
+
+def _path_names(path):
+    return tuple(_key_name(p) for p in path)
+
+
+def tree_gather_pages(pool, dense_struct, page_ids, cache_index):
+    """Materialize a batch-1 DENSE decode cache from pool pages: for every
+    `cached_key`/`cached_value` leaf, gather `pool_leaf[page_ids]`
+    ([P, page_size, h, d]) and merge the page axes into one contiguous
+    [1, P*page_size, h, d] row; fill `cache_index` leaves with the traced
+    `cache_index` scalar (the number of tokens already valid in the gathered
+    prefix). `dense_struct` is the eval_shape pytree of the dense prefill
+    module's cache — it fixes the output tree layout and shapes.
+
+    jit-traceable (`page_ids` [P] int32 and `cache_index` may be traced
+    operands); the serving engine's paged insert uses this to give a suffix
+    prefill an attention view over shared prefix pages without ever owning a
+    dense per-slot cache."""
+    import jax
+    import jax.numpy as jnp
+
+    pool_leaves = {
+        _path_names(path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(pool)[0]
+    }
+
+    def _build(path, struct):
+        names = _path_names(path)
+        axis_back = _PAGE_AXIS_FROM_BACK.get(names[-1])
+        if axis_back is None:
+            if names[-1] == "cache_index":
+                return jnp.full(struct.shape, jnp.asarray(cache_index, struct.dtype))
+            if names[-1] == "pad_mask":
+                return jnp.ones(struct.shape, struct.dtype)
+            return jnp.zeros(struct.shape, struct.dtype)
+        leaf = pool_leaves.get(names)
+        if leaf is None:
+            raise ValueError(f"pool cache has no leaf at {'/'.join(names)}")
+        axis = leaf.ndim - axis_back
+        pages = jnp.take(leaf, jnp.asarray(page_ids, jnp.int32), axis=axis)
+        merged = pages.reshape(
+            pages.shape[:axis]
+            + (pages.shape[axis] * pages.shape[axis + 1],)
+            + pages.shape[axis + 2 :]
+        )
+        dense = jnp.expand_dims(merged, axis)  # the batch-1 slot axis
+        if dense.shape != struct.shape:
+            raise ValueError(
+                f"gathered pages for {'/'.join(names)} have shape {dense.shape}, "
+                f"dense prefill cache expects {struct.shape} — page count x page "
+                "size must equal the dense cache length"
+            )
+        return dense.astype(struct.dtype)
+
+    return jax.tree_util.tree_map_with_path(_build, dense_struct)
+
+
+def tree_scatter_pages(pool, dense, page_ids):
+    """Write a batch-1 dense cache back into pool pages (the inverse of
+    `tree_gather_pages`): every `cached_key`/`cached_value` leaf is split into
+    [P, page_size] blocks and scattered to `pool_leaf[page_ids[j]]`. Leaves the
+    pool has no entry for in `dense` (the dense path's `cache_index` scalar,
+    meaningless pool-side) keep the pool's value.
+
+    Callers that must not rewrite shared read-only prefix pages redirect those
+    entries of `page_ids` to the reserved scratch page before calling (the
+    serving engine's insert does exactly that), so a registered prefix page is
+    written exactly once — at creation — for its whole lifetime."""
+    import jax
+    import jax.numpy as jnp
+
+    dense_leaves = {
+        _path_names(path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(dense)[0]
+    }
+    ids = jnp.asarray(page_ids, jnp.int32)
+
+    def _scatter(path, leaf):
+        names = _path_names(path)
+        axis_back = _PAGE_AXIS_FROM_BACK.get(names[-1])
+        d = dense_leaves.get(names)
+        if axis_back is None or d is None:
+            return leaf
+        axis = leaf.ndim - axis_back
+        d = jnp.squeeze(d, axis=axis)  # drop the batch-1 slot axis
+        page_size = leaf.shape[axis + 1]
+        num = ids.shape[0]
+        blocks = d.reshape(d.shape[:axis] + (num, page_size) + d.shape[axis + 1 :])
+        pool_front = jnp.moveaxis(leaf, axis, 0)
+        blocks_front = jnp.moveaxis(blocks, axis, 0)
+        out = pool_front.at[ids].set(blocks_front.astype(leaf.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return jax.tree_util.tree_map_with_path(_scatter, pool)
+
+
+# --------------------------------------------------------------------------------------
 # fp32 output conversion (reference operations.py:768-827)
 # --------------------------------------------------------------------------------------
 

@@ -131,6 +131,65 @@ def pct(values, q):
     return float(np.percentile(np.asarray(values), q))
 
 
+def run_prefix_workload(model, args, cfg, max_length, rng):
+    """The prefix-heavy serving workload: every request opens with the SAME
+    `--prefix-tokens`-long system prompt followed by a random tail. Served
+    twice through paged engines — shared-prefix cache ON vs OFF — so the
+    prefill-tokens-saved and TTFT deltas are measured against a same-run
+    baseline, with a fresh TraceGuard armed over each timed pass (the paged
+    cache must hold the 0-recompile / 0-host-transfer discipline too)."""
+    from accelerate_tpu.analysis import TraceGuard
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    prefix = rng.integers(1, cfg.vocab_size, (args.prefix_tokens,)).astype(np.int32)
+    tail_max = max(args.prompt_min, max_length - args.max_new_max - args.prefix_tokens)
+    prompts = [
+        np.concatenate(
+            [prefix, rng.integers(1, cfg.vocab_size, (int(rng.integers(args.prompt_min, tail_max + 1)),)).astype(np.int32)]
+        )
+        for _ in range(args.requests)
+    ]
+    budgets = [int(rng.integers(args.max_new_min, args.max_new_max + 1)) for _ in range(args.requests)]
+    arrivals = np.cumsum(rng.exponential(args.mean_interarrival, size=args.requests))
+
+    result = {"prefix_tokens": args.prefix_tokens}
+    for label, use_prefix in (("uncached", False), ("cached", True)):
+        engine = ContinuousBatcher(
+            model, num_slots=args.num_slots, max_length=max_length,
+            chunk_size=args.chunk_size, paged=True, page_size=args.page_size,
+            prefix_cache=use_prefix,
+        )
+        log(f"prefix workload ({label}): warmup...")
+        run_continuous(engine, prompts, budgets, arrivals)  # compiles; registers the prefix
+        guard = TraceGuard(
+            transfer_guard="disallow", on_violation="record",
+            name=f"serving-bench-prefix-{label}",
+        )
+        engine.trace_guard = guard
+        with guard:
+            tps, ttfts, _iters, span = run_continuous(engine, prompts, budgets, arrivals)
+        if guard.total_recompiles or guard.host_transfers:
+            log(f"TRACE-GUARD VIOLATIONS in prefix workload ({label}): {guard.report().summary()}")
+        stats = engine.stats
+        result[label] = {
+            "tokens_per_sec": round(tps, 2),
+            "ttft_p50_ms": round(pct(ttfts, 50) * 1000, 2),
+            "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 2),
+            "makespan_s": round(span, 3),
+            "prefill_tokens_saved": stats["prefix_cache"]["prefill_tokens_saved"],
+            "prefix_hits": stats["prefix_cache"]["hits"],
+            "prefix_misses": stats["prefix_cache"]["misses"],
+            "prefix_evictions": stats["prefix_cache"]["evictions"],
+            "pages_total": stats["pages_total"],
+            "recompiles": guard.total_recompiles,
+            "host_transfers": guard.host_transfers,
+        }
+    result["ttft_p50_ratio_uncached_over_cached"] = round(
+        result["uncached"]["ttft_p50_ms"] / max(result["cached"]["ttft_p50_ms"], 1e-9), 3
+    )
+    return result
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model", default=None, help="named model (accelerate_tpu.models); default llama-1b on accelerators, llama-tiny on CPU")
@@ -144,6 +203,10 @@ def main(argv=None):
     parser.add_argument("--max-length", type=int, default=None)
     parser.add_argument("--mean-interarrival", type=float, default=0.02, help="Poisson arrival mean gap (virtual seconds)")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--page-size", type=int, default=16, help="KV pool page size in tokens (paged cache)")
+    parser.add_argument("--no-paged", action="store_true", help="use the contiguous per-slot KV layout (disables the prefix workload)")
+    parser.add_argument("--prefix-tokens", type=int, default=None,
+                        help="shared system-prompt length for the prefix-heavy workload; default 64 on accelerators, 24 on CPU; 0 disables")
     args = parser.parse_args(argv)
 
     import jax
@@ -157,6 +220,8 @@ def main(argv=None):
         args.requests = 32 if on_accel else 12
     if args.prompt_max is None:
         args.prompt_max = 256 if on_accel else 96
+    if args.prefix_tokens is None:
+        args.prefix_tokens = 64 if on_accel else 24
     if args.max_new_max is None:
         args.max_new_max = 128 if on_accel else 32
     if args.prompt_min > args.prompt_max:
@@ -190,7 +255,8 @@ def main(argv=None):
     from accelerate_tpu.generation import Generator
 
     engine = ContinuousBatcher(
-        model, num_slots=args.num_slots, max_length=max_length, chunk_size=args.chunk_size
+        model, num_slots=args.num_slots, max_length=max_length, chunk_size=args.chunk_size,
+        paged=not args.no_paged, page_size=args.page_size,
     )
     static_gen = Generator(model, max_new_tokens=max(budgets), max_length=max_length)
 
@@ -220,6 +286,23 @@ def main(argv=None):
         log(f"TRACE-GUARD VIOLATIONS in steady state: {guard.report().summary()}")
     assert engine.trace_counts["decode_chunk"] == 1, engine.trace_counts
 
+    # Prefix-heavy workload: same model, shared system prompt across requests,
+    # prefix cache ON vs OFF (paged engines only — the contiguous layout has no
+    # pages to share).
+    prefix_block = None
+    if not args.no_paged and args.prefix_tokens > 0:
+        max_prefix = max_length - args.max_new_max - args.prompt_min
+        if args.prefix_tokens > max_prefix:
+            log(f"capping prefix_tokens to {max_prefix} for the {max_length}-token cache")
+            args.prefix_tokens = max_prefix
+        if args.prefix_tokens >= args.page_size:
+            prefix_block = run_prefix_workload(model, args, cfg, max_length, rng)
+        else:
+            log(
+                f"prefix_tokens {args.prefix_tokens} < page_size {args.page_size}: "
+                "no full page to share; skipping the prefix workload"
+            )
+
     speedup = c_tps / max(s_tps, 1e-9)
     prefix = "" if on_accel else "cpu-smoke "
 
@@ -247,7 +330,20 @@ def main(argv=None):
         "queue_peak": registry.value("serving_queue_peak"),
         "slot_utilization": registry.value("serving_slot_utilization"),
         "requests_submitted": registry.value("serving_requests_submitted_total"),
+        "pages_total": registry.value("serving_pages_total"),
+        "pages_in_use": registry.value("serving_pages_in_use"),
+        "prefix_cache_hits": registry.value("serving_prefix_cache_hits_total"),
+        "prefix_cache_misses": registry.value("serving_prefix_cache_misses_total"),
+        "prefix_cache_evictions": registry.value("serving_prefix_cache_evictions_total"),
+        "prefill_tokens_saved": registry.value("prefill_tokens_saved_total"),
     }
+    paging_block = {"enabled": not args.no_paged}
+    if not args.no_paged:
+        paging_block.update(
+            page_size=args.page_size,
+            pages_total=engine.stats["pages_total"],
+            prefix_cache=engine.stats["prefix_cache"],
+        )
     result = {
         "metric": f"{prefix}continuous-batching serving tokens/sec "
         f"({model_name}, slots {args.num_slots}, chunk {args.chunk_size}, "
@@ -275,6 +371,11 @@ def main(argv=None):
             "queue_peak": engine.stats["queue_peak"],
             "finish_reasons": dict(engine.stats["finish_reasons"]),
             "telemetry": telemetry_block,
+            # Paged-KV state of the MAIN engine plus the shared-system-prompt
+            # A/B (prefix cache on/off); prefill_tokens_saved > 0 with TTFT no
+            # worse than the uncached run is the prefix-cache acceptance gate.
+            "paging": paging_block,
+            "prefix_workload": prefix_block,
             # Steady-state discipline counters (TraceGuard armed over both
             # timed passes): any nonzero value is a no-recompile regression.
             "recompiles": guard.total_recompiles,

@@ -56,6 +56,20 @@ def test_chaos_subsystem_is_warn_clean():
     )
 
 
+def test_paging_module_is_warn_clean():
+    """The page-pool allocator + prefix cache sit BETWEEN decode dispatches on
+    the serving hot path: a device touch inside `PagePool` (a stray jnp op, a
+    host sync on pool state) would serialize admission against the device and
+    trip the bench's armed TraceGuard. Warn-clean, and the scan must actually
+    see the module — a silent rename would make this pin vacuous."""
+    findings, scanned = analyze_paths([str(REPO / "accelerate_tpu" / "paging.py")])
+    assert scanned == 1, f"paging module missing? scanned {scanned}"
+    flagged = [f for f in findings if severity_at_least(f.severity, "warn")]
+    assert not flagged, "warn+ TPU hazards in paging:\n" + "\n".join(
+        f"  {f.file}:{f.line}: {f.rule_id} {f.message}" for f in flagged
+    )
+
+
 def test_telemetry_subsystem_is_warn_clean():
     """The observability layer rides the serving/train hot paths — it must be
     completely clean at WARN level, not just error-free: a host-sync or

@@ -368,6 +368,61 @@ def test_insert_error_is_isolated_to_one_request():
     assert finished["value"] == 1.0
 
 
+def test_consumed_donation_rebuilds_page_pool_without_leaks():
+    """The paged-KV extension of the consume_donated sweeps: the run_serve
+    workload serves shared-prefix traffic through a PAGED engine, an injected
+    chunk failure deletes the donated pool buffers mid-flight (live refcounts,
+    live prefix registrations), and recovery must rebuild the page pool AND the
+    host ledger — `pages_in_use == 0` after drain, no page both cached and
+    free, and no prefix registration resurrecting a page whose content died
+    with the rebuild."""
+    plan = FaultPlan(
+        name="chunk-consumes-donation-paged",
+        events=[FaultEvent(kind="serve.dispatch_error", at_call=3,
+                           args={"consume_donated": True})],
+    )
+    report = ChaosRunner(plan).run_serve(num_requests=8, max_queue=6)
+    assert report.ok, report.render_text()
+    ledger = next(c for c in report.checks if c.name == "page_ledger")
+    assert ledger.details["pages_in_use_after_drain"] == 0
+    assert ledger.details["consistency_problems"] == []
+    # the workload really exercised the paged machinery, not a vacuous pass
+    assert ledger.details["pages_total"] > 0
+
+
+def test_consumed_donation_recovers_on_the_contiguous_layout_too():
+    """paged=False remains a supported fallback (and the only option for model
+    families without pool-cache support): its blast-radius recovery must stay
+    chaos-covered, not just the paged default's."""
+    plan = FaultPlan(
+        name="chunk-consumes-donation-contiguous",
+        events=[FaultEvent(kind="serve.dispatch_error", at_call=2,
+                           args={"consume_donated": True})],
+    )
+    report = ChaosRunner(plan).run_serve(num_requests=4, max_queue=4, paged=False)
+    assert report.ok, report.render_text()
+    recovered = next(c for c in report.checks if c.name == "engine_recovered")
+    assert recovered.details["requests_after_error"] >= 2
+    ledger = next(c for c in report.checks if c.name == "page_ledger")
+    assert ledger.details.get("note") == "contiguous engine (no pool)"
+
+
+def test_insert_failure_releases_reserved_pages():
+    """An isolated insert failure (no donation consumed) must return the pages
+    it reserved for the doomed request — a leak here exhausts the pool after
+    enough transient admission errors, a failure mode the dense layout never
+    had."""
+    plan = FaultPlan(
+        name="insert-error-paged-ledger",
+        events=[FaultEvent(kind="serve.insert_error", at_call=2)],
+    )
+    report = ChaosRunner(plan).run_serve(num_requests=8, max_queue=6)
+    assert report.ok, report.render_text()
+    ledger = next(c for c in report.checks if c.name == "page_ledger")
+    assert ledger.details["pages_in_use_after_drain"] == 0
+    assert ledger.details["consistency_problems"] == []
+
+
 # ------------------------------------------------------------------ CLI contract
 def _run_cli(capsys, *argv):
     from accelerate_tpu.commands.accelerate_cli import get_command_parser

@@ -1,0 +1,402 @@
+"""Paged KV cache + shared-prefix reuse tests (paging.PagePool, the paged
+`ops/attention.update_slot_cache` mode, `utils/operations.tree_gather_pages`/
+`tree_scatter_pages`, and the `ContinuousBatcher(paged=True)` engine).
+
+The load-bearing contracts:
+  1. the paged scatter/gather ops round-trip against a dense reference,
+     including page-boundary writes and arbitrary pool permutations;
+  2. greedy decode is TOKEN-IDENTICAL between the paged and contiguous cache
+     paths, across slot reuse and shared-prefix scenarios;
+  3. slot/page reuse never exposes a prior occupant's tokens;
+  4. admission is PAGE-based: request mixes whose worst-case rows exceed the
+     old slot capacity are admitted and complete when their actual token
+     footprint fits the pool;
+  5. the PagePool ledger (refcounts, prefix registrations, LRU eviction) stays
+     consistent through every admit/release/reset path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.generation import generate
+from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+from accelerate_tpu.paging import SCRATCH_PAGE, PagePool, chain_hashes
+from accelerate_tpu.serving import ContinuousBatcher, Request
+
+pytestmark = pytest.mark.paging
+
+
+def _model(max_pos=64):
+    cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=max_pos,
+        rope_theta=10000.0,
+    )
+    return create_llama_model(cfg, seq_len=32)
+
+
+def _static_reference(model, prompt, max_new, **kwargs):
+    out = np.asarray(generate(model, prompt[None, :], max_new_tokens=max_new, **kwargs))
+    return out[0, prompt.size:]
+
+
+# ------------------------------------------------------------------ tree ops
+
+
+def _fake_caches(rng, layers=2, pages=7, ps=4, h=2, d=3):
+    """(pool_tree, dense_struct) with the real leaf names at realistic ranks."""
+    pool = {
+        f"layer_{i}": {
+            "attention": {
+                "cached_key": jnp.asarray(rng.normal(size=(pages, ps, h, d)), jnp.float32),
+                "cached_value": jnp.asarray(rng.normal(size=(pages, ps, h, d)), jnp.float32),
+            }
+        }
+        for i in range(layers)
+    }
+    dense_struct = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct((1, 3 * ps, *x.shape[2:]), x.dtype),
+        pool,
+    )
+    for i in range(layers):
+        dense_struct[f"layer_{i}"]["attention"]["cache_index"] = jax.ShapeDtypeStruct(
+            (), jnp.int32
+        )
+    return pool, dense_struct
+
+
+def test_gather_pages_matches_dense_reference():
+    """Gathering pages [ids] must equal concatenating those pool pages in table
+    order — the dense layout the contiguous path would have held."""
+    from accelerate_tpu.utils.operations import tree_gather_pages
+
+    rng = np.random.default_rng(0)
+    pool, struct = _fake_caches(rng)
+    ids = jnp.asarray([5, 2, 6], jnp.int32)
+    dense = tree_gather_pages(pool, struct, ids, jnp.int32(8))
+    for i in range(2):
+        leaf = pool[f"layer_{i}"]["attention"]["cached_key"]
+        expect = np.concatenate([np.asarray(leaf[p]) for p in (5, 2, 6)], axis=0)[None]
+        np.testing.assert_array_equal(
+            np.asarray(dense[f"layer_{i}"]["attention"]["cached_key"]), expect
+        )
+        assert int(dense[f"layer_{i}"]["attention"]["cache_index"]) == 8
+
+
+def test_scatter_pages_roundtrip_and_untouched_pages():
+    """scatter(gather(pool)) is the identity on the table's pages and leaves
+    every OTHER page bit-for-bit untouched (page-boundary writes stay inside
+    their page)."""
+    from accelerate_tpu.utils.operations import tree_gather_pages, tree_scatter_pages
+
+    rng = np.random.default_rng(1)
+    pool, struct = _fake_caches(rng)
+    ids = jnp.asarray([1, 4, 3], jnp.int32)
+    dense = tree_gather_pages(pool, struct, ids, jnp.int32(0))
+    out = tree_scatter_pages(pool, dense, ids)
+    for a, b in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(pool)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # A modified dense row lands in exactly the right page at the right offset.
+    key = dense["layer_0"]["attention"]["cached_key"]
+    key = key.at[0, 5].set(99.0)  # logical position 5 = page ids[1]=4, offset 1
+    dense["layer_0"]["attention"]["cached_key"] = key
+    out = tree_scatter_pages(pool, dense, ids)
+    got = np.asarray(out["layer_0"]["attention"]["cached_key"])
+    np.testing.assert_array_equal(got[4, 1], np.full((2, 3), 99.0))
+    # neighbours of the write untouched
+    src = np.asarray(pool["layer_0"]["attention"]["cached_key"])
+    np.testing.assert_array_equal(got[4, 0], src[4, 0])
+    np.testing.assert_array_equal(got[0], src[0])
+
+
+def test_paged_slot_write_crosses_page_boundaries():
+    """The paged update_slot_cache write lands at pool[table[pos//ps], pos%ps]
+    and the gathered read reproduces the dense logical order, for positions on
+    both sides of every page boundary."""
+    import flax.linen as nn
+
+    from accelerate_tpu.ops.attention import update_slot_cache
+
+    ps, num_pages, P = 4, 6, 3
+
+    class Probe(nn.Module):
+        @nn.compact
+        def __call__(self, k, v, positions, page_table):
+            return update_slot_cache(
+                self, k, v, P * ps, positions, page_table=page_table,
+                page_size=ps, num_pages=num_pages,
+            )
+
+    probe = Probe()
+    table = jnp.asarray([[2, 5, 1], [4, 3, 0]], jnp.int32)  # two slots
+    cache = None
+    rng = np.random.default_rng(2)
+    written = {}
+    for pos in (0, 3, 4, 7, 8, 11):  # page starts and page ends
+        k = jnp.asarray(rng.normal(size=(2, 1, 2, 3)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, 1, 2, 3)), jnp.float32)
+        positions = jnp.full((2, 1), pos, jnp.int32)
+        variables = {"cache": cache} if cache is not None else {}
+        (k_full, v_full, mask), mutated = probe.apply(
+            variables, k, v, positions, table, mutable=["cache"]
+        )
+        cache = mutated["cache"]
+        written[pos] = np.asarray(k)
+        # the gathered logical view holds every row written so far, in order
+        for p_seen, kk in written.items():
+            np.testing.assert_array_equal(np.asarray(k_full)[:, p_seen], kk[:, 0])
+        # mask admits exactly the written prefix
+        np.testing.assert_array_equal(
+            np.asarray(mask)[0, 0, 0], np.arange(P * ps) <= pos
+        )
+    # physical placement: slot 0 wrote pages 2,5,1; slot 1 wrote 4,3,0
+    pool_k = np.asarray(cache["cached_key"])
+    np.testing.assert_array_equal(pool_k[5, 3], written[7][0, 0])  # slot 0, pos 7
+    np.testing.assert_array_equal(pool_k[3, 0], written[4][1, 0])  # slot 1, pos 4
+
+
+# ------------------------------------------------------------------ parity
+
+
+def test_paged_contiguous_and_static_parity_with_slot_reuse():
+    """Acceptance pin: greedy decode is token-identical between the paged and
+    contiguous cache paths across a slot-reuse workload, and both match the
+    static Generator."""
+    model = _model()
+    rng = np.random.default_rng(3)
+    lengths = [5, 9, 3, 12, 7, 4]
+    budgets = [6, 4, 8, 3, 5, 7]
+    prompts = [rng.integers(1, 128, (n,)).astype(np.int32) for n in lengths]
+    requests = lambda: [  # noqa: E731 — fresh Request objects per engine
+        Request(i, p, max_new_tokens=m) for i, (p, m) in enumerate(zip(prompts, budgets))
+    ]
+    paged = ContinuousBatcher(model, num_slots=2, max_length=32, chunk_size=4, page_size=8)
+    contiguous = ContinuousBatcher(model, num_slots=2, max_length=32, chunk_size=4, paged=False)
+    out_p = paged.run(requests())
+    out_c = contiguous.run(requests())
+    for i, (p, m) in enumerate(zip(prompts, budgets)):
+        np.testing.assert_array_equal(out_p[i], out_c[i])
+        np.testing.assert_array_equal(out_p[i], _static_reference(model, p, m))
+    assert paged.trace_counts["decode_chunk"] == 1
+    assert paged.pool.pages_in_use == 0
+    assert paged.pool.check_consistency() == []
+
+
+def test_shared_prefix_parity_and_tokens_saved():
+    """Requests sharing a system prompt: greedy outputs stay token-identical to
+    the static path AND to a prefix-cache-disabled engine, while the prefix
+    cache demonstrably skips prefill work (prefill_tokens_saved > 0)."""
+    model = _model()
+    rng = np.random.default_rng(4)
+    system = rng.integers(1, 128, (13,)).astype(np.int32)  # 3 full pages at ps=4
+    prompts = [
+        np.concatenate([system, rng.integers(1, 128, (n,)).astype(np.int32)])
+        for n in (3, 6, 2, 5)
+    ]
+    requests = lambda: [Request(i, p, max_new_tokens=5) for i, p in enumerate(prompts)]  # noqa: E731
+    cached = ContinuousBatcher(model, num_slots=2, max_length=64, chunk_size=4, page_size=4)
+    plain = ContinuousBatcher(
+        model, num_slots=2, max_length=64, chunk_size=4, page_size=4, prefix_cache=False
+    )
+    out_cached = cached.run(requests())
+    out_plain = plain.run(requests())
+    for i, p in enumerate(prompts):
+        np.testing.assert_array_equal(out_cached[i], out_plain[i])
+        np.testing.assert_array_equal(out_cached[i], _static_reference(model, p, 5))
+    saved = cached.stats["prefix_cache"]["prefill_tokens_saved"]
+    assert saved >= 3 * 4 * 3, saved  # 3 later requests x 3 shared pages x 4 tokens
+    assert cached.stats["prefix_cache"]["hits"] >= 9
+    assert plain.stats["prefix_cache"]["prefill_tokens_saved"] == 0
+    # full-prompt page-aligned hit still produces first-token logits: a request
+    # whose prompt is EXACTLY the cached pages must recompute its last token
+    exact = np.asarray(system[:12])  # exactly 3 pages
+    out = cached.run([Request(10, exact, max_new_tokens=4)])
+    np.testing.assert_array_equal(out[10], _static_reference(model, exact, 4))
+
+
+def test_gpt_neox_paged_parity():
+    """The paged slot cache is model-layer plumbing for BOTH slot families."""
+    import dataclasses
+
+    from accelerate_tpu.models.gpt_neox import create_gpt_neox_model, gpt_neox_tiny
+
+    cfg = dataclasses.replace(gpt_neox_tiny(), max_position_embeddings=64)
+    model = create_gpt_neox_model(cfg, seq_len=32)
+    rng = np.random.default_rng(5)
+    shared = rng.integers(1, cfg.vocab_size, (9,)).astype(np.int32)
+    prompts = [
+        np.concatenate([shared, rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)])
+        for n in (2, 4)
+    ]
+    engine = ContinuousBatcher(model, num_slots=2, max_length=32, chunk_size=4, page_size=8)
+    outputs = engine.run([Request(i, p, max_new_tokens=5) for i, p in enumerate(prompts)])
+    for i, p in enumerate(prompts):
+        np.testing.assert_array_equal(outputs[i], _static_reference(model, p, 5))
+    assert engine.stats["prefix_cache"]["prefill_tokens_saved"] == 8
+
+
+def test_slot_reuse_never_exposes_prior_occupants_tokens():
+    """A slot's (and its freed pages') next occupant with a SHORTER prompt and
+    a longer budget must decode exactly as if the pool were fresh — the masked
+    stale K/V from the previous occupant contributes exactly nothing."""
+    model = _model()
+    rng = np.random.default_rng(6)
+    long_prompt = rng.integers(1, 128, (24,)).astype(np.int32)
+    short_prompt = rng.integers(1, 128, (3,)).astype(np.int32)
+    engine = ContinuousBatcher(model, num_slots=1, max_length=32, chunk_size=4, page_size=4)
+    first = engine.run([Request(0, long_prompt, max_new_tokens=6)])
+    np.testing.assert_array_equal(first[0], _static_reference(model, long_prompt, 6))
+    # same single slot, same pages, different occupant
+    second = engine.run([Request(1, short_prompt, max_new_tokens=12)])
+    np.testing.assert_array_equal(second[1], _static_reference(model, short_prompt, 12))
+
+
+def test_repeated_workload_mints_no_new_insert_buckets():
+    """Steady-state no-recompile pin for prefix serving: re-serving prompts
+    that registered their OWN pages matches deeper (tiny suffixes) — the
+    page-size bucket floor must absorb those instead of minting ever-smaller
+    insert executables. Pass 2 may deepen matches; pass 3 must compile
+    NOTHING new and stay token-identical."""
+    model = _model()
+    rng = np.random.default_rng(8)
+    system = rng.integers(1, 128, (10,)).astype(np.int32)
+    prompts = [
+        np.concatenate([system, rng.integers(1, 128, (n,)).astype(np.int32)])
+        for n in (2, 5, 3)
+    ]
+    engine = ContinuousBatcher(model, num_slots=2, max_length=64, chunk_size=4, page_size=4)
+    outputs = {}
+    for round_no in range(3):
+        if round_no == 2:
+            stable = dict(engine.trace_counts)
+        out = engine.run([Request(round_no * 10 + i, p, max_new_tokens=5) for i, p in enumerate(prompts)])
+        outputs[round_no] = [out[round_no * 10 + i] for i in range(len(prompts))]
+        for i in range(len(prompts)):
+            engine.release(round_no * 10 + i)
+    assert engine.trace_counts == stable, (stable, engine.trace_counts)
+    assert engine.trace_counts["decode_chunk"] == 1
+    for i in range(len(prompts)):
+        np.testing.assert_array_equal(outputs[0][i], outputs[2][i])
+
+
+# ------------------------------------------------------------------ admission
+
+
+def test_page_based_admission_exceeds_old_slot_capacity():
+    """Acceptance pin: a pool of 8x8=64 tokens backs FOUR concurrent slots
+    whose worst-case rows (4 x max_length 64 = 256 tokens) would have required
+    4x the HBM under the contiguous layout — and a fifth request queues on pool
+    exhaustion, then completes once pages free (no deadlock, no error)."""
+    model = _model()
+    rng = np.random.default_rng(7)
+    engine = ContinuousBatcher(
+        model, num_slots=4, max_length=64, chunk_size=2, page_size=8, num_pages=9
+    )
+    prompts = [rng.integers(1, 128, (6,)).astype(np.int32) for _ in range(5)]
+    for i in range(4):
+        engine.submit(Request(i, prompts[i], max_new_tokens=10))  # 2 pages each
+    engine.step()
+    assert engine.free_slots == 0, "all four requests must be in flight at once"
+    assert engine.pool.pages_in_use == 8
+    engine.submit(Request(4, prompts[4], max_new_tokens=10))
+    engine.step()
+    assert not engine.results[4].tokens, "fifth request must wait for pages"
+    outputs = engine.run()
+    for i in range(5):
+        assert engine.results[i].finish_reason == "length"
+        np.testing.assert_array_equal(outputs[i], _static_reference(model, prompts[i], 10))
+    assert engine.pool.pages_in_use == 0
+    assert engine.pool.check_consistency() == []
+
+
+def test_submit_rejects_requests_larger_than_the_pool():
+    model = _model()
+    engine = ContinuousBatcher(
+        model, num_slots=2, max_length=64, chunk_size=2, page_size=8, num_pages=3
+    )
+    with pytest.raises(ValueError, match="KV pages"):
+        engine.submit(Request(0, np.arange(1, 20, dtype=np.int32), max_new_tokens=8))
+    # within the pool: fine
+    engine.submit(Request(1, np.arange(1, 9, dtype=np.int32), max_new_tokens=8))
+    engine.run()
+    assert engine.results[1].finished
+
+
+# ------------------------------------------------------------------ allocator
+
+
+def test_chain_hashes_commit_to_the_whole_prefix():
+    a = chain_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9], 4)
+    b = chain_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    c = chain_hashes([9, 2, 3, 4, 5, 6, 7, 8], 4)
+    assert len(a) == 2 and len(b) == 2 and a == b  # partial trailing page unhashed
+    assert c[0] != a[0] and c[1] != a[1]  # first-token change breaks EVERY page
+
+
+def test_page_pool_refcounts_prefix_cache_and_eviction():
+    pool = PagePool(num_pages=6, page_size=4)
+    hashes = chain_hashes(list(range(8)), 4)
+    pages = pool.reserve(3)
+    assert pages is not None and SCRATCH_PAGE not in pages
+    assert pool.pages_in_use == 3 and pool.pages_free == 2
+    pool.register_prefix(hashes, pages)  # first two pages become shareable
+    # a second request sharing both prefix pages pins them
+    matched = pool.match_prefix(hashes, 2)
+    assert matched == pages[:2]
+    pool.release(matched)
+    pool.release(pages)
+    assert pool.pages_in_use == 0
+    assert pool.pages_cached == 2 and pool.pages_free == 3  # prefix pages stay cached
+    assert pool.check_consistency() == []
+    # exhausting the free list evicts cached prefix pages LRU, oldest first
+    big = pool.reserve(5)
+    assert big is not None and pool.evictions == 2
+    assert pool.prefix_entries == 0 and pool.match_prefix(hashes, 2) == []
+    pool.release(big)
+    assert pool.check_consistency() == []
+    # over-reserve refuses without partially draining
+    assert pool.reserve(6) is None
+    assert pool.pages_free == 5
+
+
+def test_eviction_trims_cached_prefix_chains_from_the_deep_end():
+    """Pool pressure must degrade a cached prefix gracefully: evict the chain
+    TAIL first so the surviving head pages still match — evicting the head
+    would strand every deeper cached page of the chain unmatchable."""
+    pool = PagePool(num_pages=5, page_size=4)
+    hashes = chain_hashes(list(range(12)), 4)  # 3-page chain
+    pages = pool.reserve(3)
+    pool.register_prefix(hashes, pages)
+    pool.release(pages)  # chain order in, all three now cached
+    assert pool.pages_cached == 3 and pool.pages_free == 1
+    taken = pool.reserve(2)  # 1 free + 1 eviction
+    assert pool.evictions == 1
+    # the DEEPEST page went; the head two still serve a partial match
+    assert pool.match_prefix(hashes, 3) == pages[:2]
+    pool.release(pages[:2])
+    pool.release(taken)
+    assert pool.check_consistency() == []
+
+
+def test_page_pool_reset_forgets_prefixes_and_refuses_bad_release():
+    pool = PagePool(num_pages=4, page_size=2)
+    hashes = chain_hashes([1, 2, 3, 4], 2)
+    pages = pool.reserve(2)
+    pool.register_prefix(hashes, pages)
+    pool.reset()
+    assert pool.pages_in_use == 0 and pool.pages_free == 3
+    assert pool.prefix_entries == 0, "reset must forget prefixes (content is gone)"
+    assert pool.match_prefix(hashes, 2) == []
+    with pytest.raises(ValueError, match="refcount"):
+        pool.release([1])
+    with pytest.raises(ValueError, match="scratch"):
+        pool.release([SCRATCH_PAGE])
+    assert pool.check_consistency() == []

@@ -224,15 +224,16 @@ def test_admission_rejects_oversized_and_duplicate_requests():
 
 
 def test_tree_scatter_gather_roundtrip():
-    """tree_gather_rows inverts tree_scatter_rows on the live engine cache, and
-    non-slot leaves (scalars like cache_index) pass through untouched — the
-    debugging contract both helpers document."""
+    """tree_gather_rows inverts tree_scatter_rows on the live CONTIGUOUS engine
+    cache, and non-slot leaves (scalars like cache_index) pass through
+    untouched — the debugging contract both helpers document. (The paged
+    layout's pool gather/scatter twins are pinned in tests/test_paging.py.)"""
     import jax.numpy as jnp
 
     from accelerate_tpu.utils.operations import tree_gather_rows, tree_scatter_rows
 
     model = _model()
-    engine = ContinuousBatcher(model, num_slots=3, max_length=32, chunk_size=2)
+    engine = ContinuousBatcher(model, num_slots=3, max_length=32, chunk_size=2, paged=False)
     engine.run([Request(0, np.arange(1, 6, dtype=np.int32), max_new_tokens=3)])
     row = tree_gather_rows(engine._cache, 1)
     for leaf in jax.tree_util.tree_leaves(row):
