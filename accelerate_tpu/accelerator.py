@@ -57,6 +57,7 @@ from .optimizer import AcceleratedOptimizer, GradScaler
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
 from .telemetry import MetricsRegistry, ProfilerManager, StepTimeline
+from .telemetry.tracing import default_tracer
 from .tracking import LOGGER_TYPE_TO_CLASS, GeneralTracker, filter_trackers
 from .utils import operations as ops
 from .utils.dataclasses import (
@@ -110,6 +111,7 @@ class Accelerator:
         kwargs_handlers: Optional[List[KwargsHandler]] = None,
         step_scheduler_with_optimizer: bool = True,
         analyze: bool = False,
+        tracer=None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -216,7 +218,26 @@ class Accelerator:
         # touch-file / SIGUSR2 on-demand capture. All construction is host-only
         # and free when profiling wasn't requested.
         self.telemetry = MetricsRegistry()
-        self.timeline = StepTimeline(self.telemetry, prefix="train")
+        # Request-scoped tracing + the crash/hang flight recorder: the tracer
+        # comes from the launch env protocol (ACCELERATE_TPU_TRACE_DIR/_ID/
+        # _PARENT, set by `launch --trace_dir` and the Supervisor) unless the
+        # caller hands one in. When a trace dir is armed, exit/SIGTERM dumps,
+        # the compile-event listener, and the hang watchdog
+        # (ACCELERATE_TPU_HANG_DEADLINE_S, default 300 s without a step
+        # heartbeat) arm with it — the next r05-style stall dumps its own
+        # timeline and thread stacks instead of dying silent.
+        self.tracer = tracer if tracer is not None else default_tracer()
+        self.hang_watchdog = None
+        recorder = getattr(self.tracer, "recorder", None)
+        if recorder is not None and getattr(recorder, "log_dir", None):
+            recorder.install_exit_hooks()
+            self.tracer.attach_compile_listener()
+            deadline = float(os.environ.get("ACCELERATE_TPU_HANG_DEADLINE_S", "300") or 0)
+            if deadline > 0:
+                self.hang_watchdog = recorder.start_watchdog(
+                    deadline_s=deadline, tracer=self.tracer
+                )
+        self.timeline = StepTimeline(self.telemetry, prefix="train", tracer=self.tracer)
         self.profiler = ProfilerManager.from_env(registry=self.telemetry)
         self._m_ckpt_saves = self.telemetry.counter(
             "checkpoint_saves_total", help="save_state() completions"
@@ -699,6 +720,7 @@ class Accelerator:
             accumulation_steps=accumulation_steps,
             gradient_state=self.gradient_state,
             steps_per_call=steps_per_call,
+            tracer=self.tracer,
         )
         if self.trace_guard is not None:
             # analyze mode: steady-state steps must neither recompile nor make
@@ -711,16 +733,25 @@ class Accelerator:
     def _instrument_step(self, step_fn: Callable) -> Callable:
         """Telemetry shim around the fused step: each call is timed as the
         timeline's "dispatch" phase (host enqueue — pure perf_counter
-        arithmetic, no device sync) and polls the ProfilerManager so touch-file
-        / SIGUSR2 capture requests are served at step boundaries. Exceptions
-        (including TraceGuardViolation from analyze mode) propagate untouched."""
+        arithmetic, no device sync), wrapped in a `train.step` span, heartbeats
+        the hang watchdog, and polls the ProfilerManager + flight recorder so
+        touch-file / SIGUSR2 capture and trace-dump requests are served at
+        step boundaries. Exceptions (including TraceGuardViolation from
+        analyze mode) propagate untouched."""
         timeline, profiler = self.timeline, self.profiler
+        tracer, recorder = self.tracer, self.tracer.recorder
+        counter = {"step": 0}
 
         def instrumented(*args, **kwargs):
-            with timeline.phase("dispatch"):
+            counter["step"] += 1
+            with timeline.phase("dispatch"), tracer.span(
+                "train.step", category="train", step=counter["step"]
+            ):
                 out = step_fn(*args, **kwargs)
             timeline.step_done(out)
+            recorder.heartbeat()
             profiler.poll()
+            recorder.poll()
             return out
 
         instrumented.__wrapped__ = step_fn  # type: ignore[attr-defined]
@@ -1024,7 +1055,10 @@ class Accelerator:
         `load_state` can verify it."""
         t0 = time.perf_counter()
         try:
-            result = self._save_state_inner(output_dir, **save_model_kwargs)
+            with self.tracer.span(
+                "checkpoint.save", category="checkpoint", step=int(self.save_iteration)
+            ):
+                result = self._save_state_inner(output_dir, **save_model_kwargs)
         finally:
             # Goodput ledger: checkpoint saves are wall clock the run paid that
             # was not a training step (docs/observability.md) — charged even
@@ -1072,7 +1106,8 @@ class Accelerator:
         corrupted newest one to the last good save."""
         t0 = time.perf_counter()
         try:
-            result = self._load_state_inner(input_dir, **load_model_kwargs)
+            with self.tracer.span("checkpoint.load", category="checkpoint"):
+                result = self._load_state_inner(input_dir, **load_model_kwargs)
         finally:
             # Restart-recovery time (resume after a preemption/crash respawn)
             # charges the goodput ledger's "restart" cause; the supervisor-side

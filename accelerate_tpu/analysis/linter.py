@@ -40,6 +40,9 @@ STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
 LAX_TRACED_FN_CONSUMERS = {
     "scan", "while_loop", "fori_loop", "cond", "switch", "map", "associative_scan",
 }
+#: The tracing API surface (`telemetry.tracing`): calls whose arguments are
+#: span annotations, and whose `with` blocks wrap hot-path dispatches.
+SPAN_API_ATTRS = {"span", "start_span", "event", "annotate"}
 
 _SUPPRESS_LINE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
 _SUPPRESS_FILE = re.compile(r"#\s*tpu-lint:\s*disable-file=([A-Za-z0-9_,\- ]+)")
@@ -482,6 +485,120 @@ class _FunctionChecker:
                         "accumulate on device and read once per epoch",
                     )
 
+    # -- tracer instrumentation (TPU112) ----------------------------------------
+    def _device_derived_names(self) -> Set[str]:
+        """Names assigned from jnp/jax-rooted calls: device arrays living in
+        HOST code — perfectly legal until something reads them synchronously.
+        (Deliberately excludes parameters and opaque calls: host code reading
+        back its OWN dispatch outputs at the step boundary is the sanctioned
+        discipline, not a hazard.)"""
+        device: Set[str] = set()
+        for _ in range(2):  # tiny fixpoint, like _infer_traced_locals
+            for node in self._direct_statements():
+                if isinstance(node, ast.Assign) and self._is_device_expr(node.value, device):
+                    for tgt in node.targets:
+                        for name in ast.walk(tgt):
+                            if isinstance(name, ast.Name):
+                                device.add(name.id)
+        return device
+
+    def _is_device_expr(self, node: ast.AST, device: Set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in device
+        if isinstance(node, ast.Attribute):
+            return False  # .shape/.dtype and host attributes alike
+        if isinstance(node, ast.Subscript):
+            return self._is_device_expr(node.value, device)
+        if isinstance(node, ast.Call):
+            func = node.func
+            if self.index.is_jnp_rooted(func):
+                return True
+            if isinstance(func, ast.Attribute) and func.attr in ARRAY_TEST_METHODS:
+                return self._is_device_expr(func.value, device)
+            return False
+        if isinstance(node, ast.BinOp):
+            return self._is_device_expr(node.left, device) or self._is_device_expr(
+                node.right, device
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self._is_device_expr(node.operand, device)
+        return False
+
+    def _device_read(self, node: ast.AST, device: Set[str]) -> Optional[str]:
+        """A call that synchronously pulls a device value to host — `.item()`,
+        `float()/int()/bool()`, `np.asarray`/`np.array`, `jax.device_get` — of
+        a device-derived expression. Returns its spelling, or None."""
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "item"
+            and not node.args
+            and self._is_device_expr(func.value, device)
+        ):
+            return ".item()"
+        if (
+            isinstance(func, ast.Name)
+            and func.id in ("float", "int", "bool")
+            and len(node.args) == 1
+            and self._is_device_expr(node.args[0], device)
+        ):
+            return f"{func.id}()"
+        chain = self.index._attr_root(func)
+        if chain and node.args and self._is_device_expr(node.args[0], device):
+            if chain[0] in self.index.np_aliases and chain[-1] in ("asarray", "array"):
+                return f"{'.'.join(chain)}()"
+            if chain[0] in self.index.jax_aliases and chain[-1] == "device_get":
+                return "jax.device_get()"
+        return None
+
+    @staticmethod
+    def _is_span_api_call(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in SPAN_API_ATTRS
+        )
+
+    def check_span_hazards(self):
+        """TPU112: instrumentation can never reintroduce a host sync. Flags a
+        device-value read feeding a span/event annotation, a device array
+        passed as an annotation outright, and synchronous device reads sitting
+        inside a `with ...span(...)` block (where they would serialize the
+        very dispatch the span is timing)."""
+        device = self._device_derived_names()
+        flagged: Set[int] = set()
+
+        def flag(node: ast.AST, what: str, where: str):
+            if id(node) in flagged:
+                return
+            flagged.add(id(node))
+            self.emit(
+                node,
+                "TPU112",
+                f"{what} {where} hides a blocking device sync in the "
+                "instrumentation — read at the step boundary, annotate with the "
+                "host scalar",
+            )
+
+        for node in self._direct_statements():
+            if self._is_span_api_call(node):
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    read = self._device_read(arg, device)
+                    if read is not None:
+                        flag(arg, read, "in a span annotation")
+                    elif self._is_device_expr(arg, device):
+                        flag(arg, "a device array", "as a span annotation")
+            elif isinstance(node, ast.With) and any(
+                self._is_span_api_call(item.context_expr) for item in node.items
+            ):
+                for stmt in node.body:
+                    for sub in ast.walk(stmt):
+                        read = self._device_read(sub, device)
+                        if read is not None:
+                            flag(sub, read, "inside a `with ...span(...)` block")
+
 
 class _ModuleChecker:
     """Module-scope rules: jit-in-loop, static_argnums misuse, donated reuse,
@@ -733,6 +850,7 @@ def analyze_source(source: str, path: str = "<string>") -> List[Finding]:
             checker.check_traced_rules()
         else:
             checker.check_host_loop_syncs()
+            checker.check_span_hazards()
         findings.extend(checker.findings)
 
     findings.extend(_ModuleChecker(index, path).run())

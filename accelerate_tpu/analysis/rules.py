@@ -137,6 +137,17 @@ RULES = (
         fixit="accumulate on device and read once at the epoch/loop boundary; "
         "a per-step sync serializes dispatch against the device",
     ),
+    Rule(
+        id="TPU112",
+        slug="span-host-sync",
+        severity="warn",
+        summary="device-value read (.item()/float()/np.asarray) used in a "
+        "tracer span/event annotation or inside a `with tracer.span(...)` block",
+        fixit="read device values at the step boundary (np.asarray/.item() on "
+        "already-fetched outputs) and annotate spans with host scalars; an "
+        "instrumentation-side read hides a blocking device sync in the very "
+        "code that exists to observe the hot path",
+    ),
 )
 
 RULES_BY_ID = {r.id: r for r in RULES}

@@ -102,10 +102,17 @@ class ChaosSession:
         plan: FaultPlan,
         registry: Optional[MetricsRegistry] = None,
         clock=None,
+        tracer=None,
     ):
         self.plan = plan
         self.registry = registry if registry is not None else MetricsRegistry()
         self.clock = clock if clock is not None else _RealClock()
+        #: Optional telemetry tracer: every injection additionally lands as a
+        #: `chaos.<kind>` trace event (recorded — and, with a trace dir,
+        #: streamed — BEFORE the fault's damage executes, like `on_inject`),
+        #: so fault sweeps produce readable timelines and the runner's
+        #: trace_complete invariant can reconcile events against counters.
+        self.tracer = tracer
         self._lock = threading.Lock()
         self._armed_at = self.clock.monotonic()
         self._state = [{"calls": 0, "fired": 0} for _ in plan.events]
@@ -174,9 +181,16 @@ class ChaosSession:
                 state["fired"] += 1
                 self._record_locked(ev, step=step, path=path)
                 fired.append(ev)
-        if fired and self.on_inject is not None:
+        if fired:
             for entry in self.injections[-len(fired):]:
-                self.on_inject(dict(entry))
+                if self.tracer is not None:
+                    self.tracer.event(
+                        f"chaos.{entry['kind']}", category="chaos",
+                        step=entry.get("step"), path=entry.get("path"),
+                        t_s=entry["t_s"],
+                    )
+                if self.on_inject is not None:
+                    self.on_inject(dict(entry))
         return fired
 
     def _record_locked(self, event: FaultEvent, step: Optional[int], path: Optional[str]):

@@ -45,6 +45,8 @@ import numpy as np
 
 from ..logging import get_logger
 from ..telemetry import MetricsRegistry
+from ..telemetry.flight_recorder import FlightRecorder, collect_trace_dir
+from ..telemetry.tracing import Tracer
 from .injectors import (
     ChaosSession,
     FilesystemInjector,
@@ -260,9 +262,23 @@ class ChaosRunner:
         plan: FaultPlan,
         registry: Optional[MetricsRegistry] = None,
         clock=None,
+        trace_dir: Optional[str] = None,
+        tracer: Optional[Tracer] = None,
     ):
         self.plan = plan
-        self.session = ChaosSession(plan, registry=registry, clock=clock)
+        # Every chaos run records a timeline: injections land as `chaos.*`
+        # trace events, workload attempts/steps as spans. With a `trace_dir`
+        # the recorder streams span JSONL there (and the supervised workload
+        # inherits the dir through the env protocol), so `accelerate-tpu
+        # trace dump` renders the sweep as one Perfetto timeline; without one
+        # the in-memory ring still backs the trace_complete invariant.
+        self.trace_dir = str(trace_dir) if trace_dir else None
+        if tracer is None:
+            tracer = Tracer(
+                recorder=FlightRecorder(log_dir=self.trace_dir), category="chaos"
+            )
+        self.tracer = tracer
+        self.session = ChaosSession(plan, registry=registry, clock=clock, tracer=tracer)
 
     # ---------------------------------------------------------------- train
     def run_train(
@@ -289,13 +305,31 @@ class ChaosRunner:
         with FilesystemInjector(self.session), HarnessInjector(self.session):
             while True:
                 journal["attempts"] += 1
+                attempt_span = self.tracer.start_span(
+                    "train.attempt", category="train", attempt=journal["attempts"]
+                )
                 try:
-                    self._train_attempt(base_dir, steps, keep_last_n, boundary, journal, ledger)
+                    with self.tracer.activate(attempt_span):
+                        self._train_attempt(base_dir, steps, keep_last_n, boundary, journal, ledger)
+                    attempt_span.annotate(outcome="completed").end()
                     completed = True
                     break
                 except InjectedKill:
-                    pass  # hard kill: nothing in the attempt got to clean up
+                    # hard kill: nothing in the attempt got to clean up. The
+                    # crash boundary is a standalone event (streamed, were this
+                    # a real process, BEFORE the respawn) — what the stitched
+                    # timeline anchors the restart on.
+                    attempt_span.annotate(outcome="killed").end()
+                    self.tracer.event(
+                        "chaos.crash_boundary", category="chaos",
+                        attempt=journal["attempts"], kind="sigkill",
+                    )
                 except _GracefulPreemption:
+                    attempt_span.annotate(outcome="preempted").end()
+                    self.tracer.event(
+                        "chaos.crash_boundary", category="chaos",
+                        attempt=journal["attempts"], kind="sigterm",
+                    )
                     journal["graceful_exits"] += 1
                 restarts += 1
                 if restarts > max_restarts:
@@ -310,6 +344,7 @@ class ChaosRunner:
             self._check_restart_budget(completed, restarts, max_restarts, downtime_s,
                                        downtime_budget_s),
             self._check_ledger_reconciles(ledger, journal),
+            self._check_trace_complete(journal),
         ]
         return self._report("train", checks)
 
@@ -338,6 +373,10 @@ class ChaosRunner:
                 journal["resumes"].append({"attempt": journal["attempts"], **evidence})
                 resumed_step = evidence["step"]
                 start_step = (resumed_step if resumed_step is not None else -1) + 1
+                self.tracer.event(
+                    "train.resume", category="train",
+                    attempt=journal["attempts"], step=resumed_step,
+                )
 
             def batches():
                 while True:
@@ -346,22 +385,28 @@ class ChaosRunner:
 
             stream = batches()
             for step in range(start_step, steps):
-                batch = next(stream)
-                accelerator.backward(model.loss, batch)
-                opt.step()
-                opt.zero_grad()
-                digest = params_digest(model)
-                # Intent BEFORE the save: a kill after the directory rename but
-                # before save_state returns leaves a committed checkpoint the
-                # journal would otherwise not know the digest of.
-                journal["intents"].append({"step": accelerator.save_iteration, "digest": digest})
-                path = accelerator.save_state()
-                journal["saves"].append({
-                    "attempt": journal["attempts"],
-                    "step": manifest_step(path),
-                    "digest": digest,
-                    "path": path,
-                })
+                with self.tracer.span("train.step", category="train", step=step):
+                    batch = next(stream)
+                    accelerator.backward(model.loss, batch)
+                    opt.step()
+                    opt.zero_grad()
+                    digest = params_digest(model)
+                    # Intent BEFORE the save: a kill after the directory rename
+                    # but before save_state returns leaves a committed
+                    # checkpoint the journal would otherwise not know the
+                    # digest of.
+                    journal["intents"].append(
+                        {"step": accelerator.save_iteration, "digest": digest}
+                    )
+                    path = accelerator.save_state()
+                    journal["saves"].append({
+                        "attempt": journal["attempts"],
+                        "step": manifest_step(path),
+                        "digest": digest,
+                        "path": path,
+                    })
+                # Chaos fires AT the boundary, outside the step span: a kill
+                # here models SIGKILL-between-steps, not a mid-step death.
                 boundary.poll(step)
                 if handler.preemption_requested:
                     raise _GracefulPreemption()
@@ -416,6 +461,10 @@ class ChaosRunner:
                 max_backoff_seconds=0.2,
                 monitor_interval=0.05,
                 crash_loop_min_uptime=0.0,  # every attempt imports jax; uptime is not a crash signal here
+                # Attempt spans + trace-context injection: each child re-arms
+                # via Tracer.from_env and parents its spans under the attempt
+                # that spawned it — the restart chain stitches into ONE trace.
+                tracer=self.tracer,
             )
             code = supervisor.run()
             restarts += supervisor.restart_count
@@ -454,6 +503,7 @@ class ChaosRunner:
                 help="faults injected by the chaos subsystem, by kind",
                 labels={"kind": entry["kind"]},
             ).inc()
+        checks.append(self._check_trace_complete(journal, supervised=True))
         return self._report("supervised-train", checks)
 
     @staticmethod
@@ -515,7 +565,7 @@ class ChaosRunner:
         engine = ContinuousBatcher(
             model, num_slots=num_slots, max_length=64, chunk_size=chunk_size,
             max_queue=max_queue, registry=self.session.registry,
-            paged=paged, page_size=4,
+            tracer=self.tracer, paged=paged, page_size=4,
         )
         ServingInjector(self.session).arm(engine)
         rng = np.random.default_rng(self.plan.seed)
@@ -607,6 +657,7 @@ class ChaosRunner:
             self._check_engine_recovered(finish_reasons, first_id_after_error),
             self._check_serve_ledger(engine, accepted),
             self._check_page_ledger(engine),
+            self._check_serve_trace(accepted),
         ]
         return self._report("serve", checks)
 
@@ -669,6 +720,136 @@ class ChaosRunner:
                 "registry_matches_journal": registry_ok,
                 "finished_total": finished_total,
                 "accepted": len(accepted),
+            },
+        )
+
+    # ---------------------------------------------------------------- trace checks
+    def _trace_records(self) -> List[dict]:
+        """Everything THIS run traced: the streamed files when a trace dir is
+        armed (they carry every process, including SIGKILLed children whose
+        ring died with them), else the in-memory ring. Dir records are
+        filtered to this run's trace id — the dir may legitimately hold other
+        tracers' spans (a prior run reusing the dir, the workload
+        Accelerator's own default tracer armed off ACCELERATE_TPU_TRACE_DIR
+        with a different id) and foreign spans must not fail the invariant."""
+        if self.trace_dir:
+            return [
+                r for r in collect_trace_dir(self.trace_dir)
+                if r.get("trace_id") == self.tracer.trace_id
+            ]
+        return self.tracer.recorder.records()
+
+    def _check_trace_complete(
+        self, journal: Dict[str, Any], supervised: bool = False
+    ) -> InvariantCheck:
+        """The stitched timeline must be a complete account of the sweep:
+        every journaled injection appears as a `chaos.*` event (reconciling
+        with `chaos_injected_total`), a kill that fired left a crash boundary,
+        a restart that happened shows up as a post-boundary attempt, every
+        span parents into the timeline (no orphans), and the whole sweep
+        shares ONE trace id across processes."""
+        kill_kinds = {"proc.sigkill", "proc.sigterm", "fs.crash_in_rename"}
+        records = self._trace_records()
+        if supervised and not self.trace_dir:
+            return InvariantCheck(
+                "trace_complete", True,
+                {"note": "no trace_dir armed; child spans were not durable"},
+            )
+        details: Dict[str, Any] = {"records": len(records)}
+        problems: List[str] = []
+
+        spans = [r for r in records if r.get("kind") in ("span", "span_start")]
+        events = [r for r in records if r.get("kind") == "event"]
+        known_ids = {r.get("span_id") for r in spans}
+        orphans = [
+            r.get("name") for r in spans
+            if r.get("parent_id") is not None and r.get("parent_id") not in known_ids
+        ]
+        if orphans:
+            problems.append(f"orphan spans (parent id unresolved): {sorted(set(orphans))}")
+        # _trace_records already scopes to this run's trace id; the check here
+        # is that the run's own processes all STITCHED onto it (a worker that
+        # failed to inherit the id would simply be missing from `records`).
+        details["trace_id"] = self.tracer.trace_id
+
+        injection_events = [
+            e for e in events
+            if e["name"].startswith("chaos.") and e["name"] != "chaos.crash_boundary"
+        ]
+        injected = len(self.session.injections)
+        counter_total = sum(
+            m.get("value", 0) for m in self.session.registry.snapshot()
+            if m["name"] == "chaos_injected_total"
+        )
+        details["injections_journaled"] = injected
+        details["injection_events"] = len(injection_events)
+        details["chaos_injected_total"] = counter_total
+        if len(injection_events) != injected or counter_total != injected:
+            problems.append("injection events do not reconcile with the journal/counters")
+
+        fired_kills = [e for e in self.session.injections if e["kind"] in kill_kinds]
+        details["kill_injections"] = len(fired_kills)
+        if fired_kills:
+            boundaries = [e["t_unix"] for e in events if e["name"] == "chaos.crash_boundary"]
+            boundaries += [
+                e["t_unix"] for e in events
+                if e["name"] == "supervisor.child_exit" and e["attrs"].get("exit_code") != 0
+            ]
+            details["crash_boundaries"] = len(boundaries)
+            if not boundaries:
+                problems.append("kill injections fired but no crash boundary was traced")
+            elif journal["attempts"] > 1:
+                first = min(boundaries)
+                resumed = [
+                    r for r in spans
+                    if r.get("name") == "train.attempt" and r.get("start_unix", 0) > first
+                ] + [e for e in events if e["name"] == "train.resume" and e["t_unix"] > first]
+                details["post_crash_attempts"] = len(resumed)
+                if not resumed:
+                    problems.append(
+                        "restarts happened but no attempt/resume appears after the "
+                        "first crash boundary"
+                    )
+        details["problems"] = problems
+        return InvariantCheck("trace_complete", passed=not problems, details=details)
+
+    def _check_serve_trace(self, accepted: List[int]) -> InvariantCheck:
+        """Serving half of trace completeness: every ACCEPTED request left a
+        `serve.request` span carrying a terminal finish_reason (submit ->
+        finish is fully covered even through blast-radius recoveries), and
+        injected serve faults appear as `chaos.serve.*` events."""
+        from ..serving import FINISH_REASONS
+
+        records = self._trace_records()
+        request_spans = {
+            r["attrs"].get("request_id"): r
+            for r in records
+            if r.get("kind") == "span" and r.get("name") == "serve.request"
+        }
+        missing = [rid for rid in accepted if rid not in request_spans]
+        non_terminal = {
+            rid: request_spans[rid]["attrs"].get("finish_reason")
+            for rid in accepted
+            if rid in request_spans
+            and request_spans[rid]["attrs"].get("finish_reason") not in FINISH_REASONS
+        }
+        injection_events = sum(
+            1 for r in records
+            if r.get("kind") == "event" and r["name"].startswith("chaos.serve.")
+        )
+        serve_injected = sum(
+            1 for e in self.session.injections if e["kind"].startswith("serve.")
+        )
+        return InvariantCheck(
+            "trace_complete",
+            passed=not missing and not non_terminal and injection_events == serve_injected,
+            details={
+                "accepted": len(accepted),
+                "request_spans": len(request_spans),
+                "missing_spans": missing,
+                "non_terminal_spans": non_terminal,
+                "serve_injections": serve_injected,
+                "serve_injection_events": injection_events,
             },
         )
 

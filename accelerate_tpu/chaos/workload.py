@@ -18,6 +18,7 @@ import json
 import os
 import sys
 
+from ..telemetry.tracing import default_tracer
 from .injectors import ChaosSession, FilesystemInjector, HarnessInjector, StepBoundaryInjector
 from .plan import FaultPlan
 from .runner import build_train_workload, manifest_step, params_digest, resume_evidence
@@ -31,7 +32,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     plan = FaultPlan.from_env() or FaultPlan(name="empty")
-    session = ChaosSession(plan)
+    # The worker side of the trace env protocol: ACCELERATE_TPU_TRACE_DIR/_ID/
+    # _PARENT (injected by the Supervisor) stream this attempt's spans into
+    # the shared trace dir, parented under the supervisor's attempt span —
+    # spans completed before a SIGKILL survive as the timeline's evidence.
+    tracer = default_tracer()
+    session = ChaosSession(plan, tracer=tracer)
     journal_path = os.path.join(args.base_dir, "chaos_journal.jsonl")
     os.makedirs(args.base_dir, exist_ok=True)
     journal_file = open(journal_path, "a")
@@ -50,7 +56,8 @@ def main(argv=None) -> int:
     accelerator.register_preemption_checkpoint()  # real SIGTERM latch + exit 143
 
     boundary = StepBoundaryInjector(session, hard=True)
-    with FilesystemInjector(session), HarnessInjector(session):
+    attempt_span = tracer.start_span("train.attempt", category="train", pid=os.getpid())
+    with tracer.activate(attempt_span), FilesystemInjector(session), HarnessInjector(session):
         manager = accelerator.checkpoint_manager()
         start_step = 0
         try:
@@ -63,6 +70,7 @@ def main(argv=None) -> int:
             journal({"type": "resume", **evidence})
             resumed_step = evidence["step"]
             start_step = (resumed_step if resumed_step is not None else -1) + 1
+            tracer.event("train.resume", step=resumed_step, category="train")
 
         def batches():
             while True:
@@ -71,14 +79,15 @@ def main(argv=None) -> int:
 
         stream = batches()
         for step in range(start_step, args.steps):
-            batch = next(stream)
-            accelerator.backward(model.loss, batch)
-            opt.step()
-            opt.zero_grad()
-            digest = params_digest(model)
-            journal({"type": "intent", "step": accelerator.save_iteration, "digest": digest})
-            path = accelerator.save_state()
-            journal({"type": "save", "step": manifest_step(path), "digest": digest, "path": path})
+            with tracer.span("train.step", category="train", step=step):
+                batch = next(stream)
+                accelerator.backward(model.loss, batch)
+                opt.step()
+                opt.zero_grad()
+                digest = params_digest(model)
+                journal({"type": "intent", "step": accelerator.save_iteration, "digest": digest})
+                path = accelerator.save_state()
+                journal({"type": "save", "step": manifest_step(path), "digest": digest, "path": path})
             boundary.poll(step)
             if accelerator.preemption_requested:
                 # Journal the preemption checkpoint's intent first: params are
@@ -87,7 +96,9 @@ def main(argv=None) -> int:
                     "type": "intent", "step": accelerator.save_iteration, "digest": digest,
                 })
                 journal({"type": "graceful_exit", "step": step})
+                attempt_span.annotate(outcome="preempted").end()
                 accelerator.check_preemption()  # saves + SystemExit(143)
+    attempt_span.annotate(outcome="completed").end()
     return 0
 
 

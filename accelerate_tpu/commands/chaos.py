@@ -46,6 +46,13 @@ def register_subcommand(subparsers):
         help="Workload to drive (default: inferred from the plan's fault kinds)",
     )
     run.add_argument("--base-dir", default=None, help="Checkpoint/journal dir (default: a temp dir)")
+    run.add_argument(
+        "--trace-dir",
+        default=None,
+        help="Stream flight-recorder spans (workload lifecycle + injected faults) into "
+        "this dir; render with `accelerate-tpu trace dump --dir DIR`. Default: "
+        "$ACCELERATE_TPU_TRACE_DIR, else in-memory only",
+    )
     run.add_argument("--steps", type=int, default=6, help="Train steps (train workloads)")
     run.add_argument("--requests", type=int, default=8, help="Requests (serve workloads)")
     run.add_argument("--json", action="store_true", dest="as_json", help="Emit the report as JSON")
@@ -95,7 +102,8 @@ def chaos_run_command(args):
 
     plan = _load_plan(args.plan)
     workload = args.workload or _infer_workload(plan)
-    runner = ChaosRunner(plan)
+    trace_dir = args.trace_dir or os.environ.get("ACCELERATE_TPU_TRACE_DIR")
+    runner = ChaosRunner(plan, trace_dir=trace_dir)
     if workload == "serve":
         report = runner.run_serve(num_requests=args.requests)
     else:

@@ -42,6 +42,15 @@ def add_launch_args(parser):
         "traces land in this directory; trigger a capture on a live run by "
         "touching <dir>/CAPTURE or sending SIGUSR2 (docs/reference/cli.md)",
     )
+    parser.add_argument(
+        "--trace_dir",
+        default=None,
+        help="Arm request-scoped tracing + the crash/hang flight recorder in every "
+        "worker (telemetry.tracing): span streams and trace dumps land in this "
+        "directory; `accelerate-tpu trace dump --dir DIR` renders them for "
+        "Perfetto. A trace id is minted once so supervised restarts stitch into "
+        "one timeline (docs/reference/cli.md)",
+    )
     for axis in ("data", "fsdp", "model", "seq", "expert", "stage"):
         parser.add_argument(f"--mesh_{axis}", type=int, default=None, help=f"Mesh axis size for `{axis}`")
     parser.add_argument("--max_restarts", type=int, default=0, help="Restart budget on child failure (elastic supervision)")
@@ -115,6 +124,15 @@ def build_launch_env(args, config: dict) -> dict:
     profile_dir = pick(args.profile_dir, "profile_dir")
     if profile_dir:
         env["ACCELERATE_TPU_PROFILE_DIR"] = str(profile_dir)
+    trace_dir = pick(getattr(args, "trace_dir", None), "trace_dir")
+    if trace_dir:
+        from ..telemetry.tracing import TRACE_DIR_ENV, TRACE_ID_ENV, new_id
+
+        env[TRACE_DIR_ENV] = str(trace_dir)
+        # Mint the trace id ONCE at launch (unless an outer launcher already
+        # did): every worker and every supervised restart shares it, so the
+        # whole job stitches into one Perfetto timeline.
+        env.setdefault(TRACE_ID_ENV, new_id())
     fault_plan = pick(getattr(args, "fault_plan", None), "fault_plan")
     if fault_plan:
         env["ACCELERATE_TPU_FAULT_PLAN"] = str(fault_plan)
@@ -208,6 +226,18 @@ def launch_command(args):
             if args.crash_loop_threshold is not None
             else int(config.get("crash_loop_threshold", 3))
         )
+        tracer = None
+        if env.get("ACCELERATE_TPU_TRACE_DIR"):
+            # Supervisor-side tracing: attempt spans + per-attempt parent ids
+            # injected into each child, so the restart chain stitches.
+            from ..telemetry import FlightRecorder
+            from ..telemetry.tracing import Tracer
+
+            tracer = Tracer(
+                recorder=FlightRecorder(log_dir=env["ACCELERATE_TPU_TRACE_DIR"]),
+                trace_id=env.get("ACCELERATE_TPU_TRACE_ID"),
+                category="supervisor",
+            )
         code = Supervisor(
             cmd,
             env=env,
@@ -216,6 +246,7 @@ def launch_command(args):
             backoff_seconds=backoff,
             max_backoff_seconds=max_backoff,
             crash_loop_threshold=crash_loop,
+            tracer=tracer,
         ).run()
         if code != 0:
             raise SystemExit(code)

@@ -52,9 +52,18 @@ class Supervisor:
         monitor_interval: float = 0.5,
         crash_loop_threshold: int = 3,
         crash_loop_min_uptime: float = 3.0,
+        tracer=None,
     ):
         self.cmd = cmd
         self.env = env
+        # Optional telemetry tracer: each child attempt becomes a
+        # `supervisor.attempt` span and the trace context (trace id, the
+        # attempt span as parent, the trace dir) is injected into the child's
+        # environment — the worker side re-arms via `Tracer.from_env`, so a
+        # supervised restart chain stitches into ONE timeline (the same
+        # two-sided env protocol as ACCELERATE_TPU_FAULT_PLAN). With no
+        # tracer, env handling is byte-identical to before.
+        self.tracer = tracer
         self.max_restarts = max_restarts
         self.grace_period = grace_period
         self.backoff_seconds = backoff_seconds
@@ -121,14 +130,41 @@ class Supervisor:
         with a large restart budget must never sleep unboundedly long."""
         return min(self.backoff_seconds * self.restart_count, self.max_backoff_seconds)
 
+    def _attempt_span(self, attempt: int):
+        if self.tracer is None:
+            return None
+        return self.tracer.start_span(
+            "supervisor.attempt", category="supervisor",
+            attempt=attempt, restarts=self.restart_count,
+        )
+
+    def _child_env(self, span) -> Optional[dict]:
+        if self.tracer is None:
+            return self.env
+        import os as _os
+
+        env = dict(self.env) if self.env is not None else dict(_os.environ)
+        return self.tracer.inject_env(env, parent=span)
+
     def run(self) -> int:
         prev_term = signal.signal(signal.SIGTERM, self._forward_signal)
         prev_int = signal.signal(signal.SIGINT, self._forward_signal)
+        attempt = 0
         try:
             while True:
+                attempt += 1
+                span = self._attempt_span(attempt)
                 spawned_at = time.monotonic()
-                self._child = subprocess.Popen(self.cmd, env=self.env)
+                self._child = subprocess.Popen(self.cmd, env=self._child_env(span))
                 code = self._monitor(self._child)
+                if span is not None:
+                    span.annotate(exit_code=code).end()
+                    # The standalone event streams immediately: the crash
+                    # boundary the chaos trace_complete invariant anchors on.
+                    self.tracer.event(
+                        "supervisor.child_exit", category="supervisor",
+                        attempt=attempt, exit_code=code,
+                    )
                 if code == 0 or code == PREEMPTED_EXIT_CODE or self._terminating:
                     return code
                 uptime = time.monotonic() - spawned_at

@@ -83,6 +83,7 @@ from .generation import (
 from .logging import get_logger
 from .paging import SCRATCH_PAGE, PagePool, chain_hashes
 from .telemetry import MetricsRegistry
+from .telemetry.tracing import default_tracer
 from .utils.operations import tree_gather_pages, tree_scatter_pages, tree_scatter_rows
 
 logger = get_logger(__name__)
@@ -167,6 +168,7 @@ class ContinuousBatcher:
         max_queue: Optional[int] = None,
         trace_guard=None,
         registry: Optional[MetricsRegistry] = None,
+        tracer=None,
         paged: bool = True,
         page_size: int = 16,
         num_pages: Optional[int] = None,
@@ -356,6 +358,15 @@ class ContinuousBatcher:
         )
         self._submit_times: Dict[int, float] = {}  # request_id -> submit() perf_counter
         self._slot_last_event = np.zeros(S, np.float64)  # last drain time per slot
+
+        # Request-scoped tracing (telemetry.tracing): one `serve.request` span
+        # per accepted request from submit() to its terminal finish_reason,
+        # child `serve.insert` spans per admission dispatch, and batched
+        # `serve.decode_chunk` spans with slot annotations. Everything is
+        # host-clock arithmetic — the spans ride the same zero-device-sync
+        # discipline as the metrics (and TPU112 lints the annotations).
+        self.tracer = tracer if tracer is not None else default_tracer()
+        self._request_spans: Dict[int, Any] = {}
 
         # Page-pool + prefix-cache telemetry and the host allocator itself
         # (paged engines only; all updates are host-scalar arithmetic).
@@ -663,6 +674,13 @@ class ContinuousBatcher:
         self._submit_times[request.request_id] = time.perf_counter()
         self._queue.append(dataclasses.replace(request, input_ids=ids))
         self._m_submitted.inc()
+        span = self.tracer.start_span(
+            "serve.request", category="serve",
+            request_id=int(request.request_id), prompt_tokens=int(ids.size),
+            max_new_tokens=int(request.max_new_tokens),
+        )
+        span.event("submitted", queue_depth=len(self._queue))
+        self._request_spans[request.request_id] = span
         self._update_occupancy_gauges()
         return request.request_id
 
@@ -686,6 +704,11 @@ class ContinuousBatcher:
         failing every future request. New admissions overwrite their own rows
         before they are ever attended, exactly as at engine construction."""
         now = time.perf_counter() if now is None else now
+        self.tracer.event(
+            "serve.blast_radius", category="serve",
+            errored_requests=sum(r is not None for r in self._slot_request),
+            error=repr(exc),
+        )
         for slot, result in enumerate(self._slot_request):
             if result is not None:
                 self._finish(result, "error", now=now, slot=slot, error=repr(exc))
@@ -719,6 +742,12 @@ class ContinuousBatcher:
         result.finish_reason = reason
         if error is not None:
             result.error = error
+        span = self._request_spans.pop(result.request_id, None)
+        if span is not None:
+            span.annotate(finish_reason=reason, tokens=len(result.tokens))
+            if error is not None:
+                span.annotate(error=error)
+            span.end()
         self._m_finish[reason].inc()
         self._deadlines.pop(result.request_id, None)
         self._submit_times.pop(result.request_id, None)
@@ -839,6 +868,20 @@ class ContinuousBatcher:
                 bucket = min(_bucket_for(p), self.max_length)
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :p] = ids
+            rspan = self._request_spans.get(req.request_id)
+            admit_t0 = time.perf_counter()
+            if rspan is not None:
+                submitted_at = self._submit_times.get(req.request_id)
+                rspan.event(
+                    "admitted", slot=slot, bucket=int(bucket),
+                    queue_wait_s=round(admit_t0 - submitted_at, 6) if submitted_at is not None else None,
+                    prefix_hit_pages=int(matched_pages), pages_reserved=len(pages),
+                )
+            ispan = self.tracer.start_span(
+                "serve.insert", category="serve", parent=rspan,
+                request_id=int(req.request_id), slot=slot, bucket=int(bucket),
+                suffix_tokens=int(p - matched_len), prefix_hit_pages=int(matched_pages),
+            )
             try:
                 fn = self._insert_fn(bucket)
                 if self.paged:
@@ -869,7 +912,9 @@ class ContinuousBatcher:
                         self._rng,
                     )
                 token = int(token)
+                ispan.end()
             except Exception as exc:  # noqa: BLE001 — isolate, report, keep serving
+                ispan.annotate(error=repr(exc)).end()
                 if pages:
                     self.pool.release(pages)
                 if self.trace_guard is not None:
@@ -902,6 +947,8 @@ class ContinuousBatcher:
             submitted_at = self._submit_times.get(req.request_id)
             if submitted_at is not None:
                 self._m_ttft.observe(now - submitted_at)
+            if rspan is not None:
+                rspan.event("first_token")
             self._slot_last_event[slot] = now
             result.tokens.append(token)
             result.first_token_time = now
@@ -953,6 +1000,16 @@ class ContinuousBatcher:
         if not self._active.any():
             return events
         chunk_t0 = time.perf_counter()
+        # One batched span per chunk dispatch: every active request rides it,
+        # so the slot annotation (not N per-request spans) is what keeps the
+        # flight recorder's ring proportional to dispatches, not tokens.
+        chunk_span = self.tracer.start_span(
+            "serve.decode_chunk", category="serve",
+            chunk_size=self.chunk_size,
+            active_slots=int(self._active.sum()),
+            slots=",".join(str(i) for i in np.nonzero(self._active)[0]),
+            pages_in_use=self.pool.pages_in_use if self.paged else None,
+        )
         try:
             out = self._chunk_fn(
                 self.params,
@@ -968,6 +1025,14 @@ class ContinuousBatcher:
                 jnp.asarray(self._page_table),
                 self._rng,
             )
+            # np.array (copy): np.asarray of a jax buffer is a READ-ONLY view,
+            # and these mirrors are written in-place at the next admission.
+            # The readback sits INSIDE the try: on accelerators the dispatch
+            # is async, so a device-side failure surfaces here rather than at
+            # the enqueue above — it is the same blast radius.
+            new_cache, new_presence = out[0], out[1]
+            token, pos, active, rem = (np.array(x) for x in out[2:6])
+            packed, count = np.asarray(out[7]), int(out[8])
         except Exception as exc:  # noqa: BLE001
             if self.trace_guard is not None:
                 self.trace_guard.observe(exc)
@@ -976,16 +1041,14 @@ class ContinuousBatcher:
             # request errors (partial tokens kept) — but the engine itself stays
             # up: slots free, the queue keeps draining, new admissions rebuild
             # their own cache rows from scratch.
+            in_flight = sum(r is not None for r in self._slot_request)
             logger.warning("decode chunk dispatch failed; erroring %d in-flight request(s): %r",
-                           sum(r is not None for r in self._slot_request), exc)
+                           in_flight, exc)
+            chunk_span.annotate(error=repr(exc)).end()
             self._abort_in_flight(exc)
             return events
-        self._cache, self._presence = out[0], out[1]
-        # np.array (copy): np.asarray of a jax buffer is a READ-ONLY view, and
-        # these mirrors are written in-place at the next admission.
-        token, pos, active, rem = (np.array(x) for x in out[2:6])
+        self._cache, self._presence = new_cache, new_presence
         self._rng = out[6]
-        packed, count = np.asarray(out[7]), int(out[8])
         self._m_chunks.inc()
         self._m_decode_steps.inc(self.chunk_size)
 
@@ -997,6 +1060,8 @@ class ContinuousBatcher:
         # AFTER the np.asarray readback above, so it covers real device work,
         # not just the async enqueue.
         self._m_chunk_latency.observe(max(now - chunk_t0, 0.0))
+        chunk_span.annotate(tokens_streamed=count).end()
+        self.tracer.recorder.poll()  # serve the `trace dump` touch file
         for slot, toks in per_slot.items():
             result = self._slot_request[slot]
             if result is None:  # defensive: stream for a freed slot

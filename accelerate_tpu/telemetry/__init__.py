@@ -1,22 +1,31 @@
 """Runtime telemetry (L4 observability): metrics, step-timeline/goodput
-accounting, and on-demand profiler capture.
+accounting, request-scoped tracing with a crash/hang flight recorder, and
+on-demand profiler capture.
 
-Four modules, one discipline — observe the hot path without perturbing it
+Six modules, one discipline — observe the hot path without perturbing it
 (host scalars only, zero device syncs, bounded memory):
 
   - `metrics` — process-local, thread-safe `MetricsRegistry` with
     Counter/Gauge/Histogram instruments (fixed log-spaced latency buckets).
   - `timeline` — `StepTimeline`: per-step data-wait / dispatch / sampled-block
     phase split plus the goodput ledger (checkpoint saves, restarts,
-    compiles, TraceGuard recompiles).
+    compiles, TraceGuard recompiles) and the unaccounted-time warning.
+  - `tracing` — `Tracer`/`Span`: request-scoped spans on monotonic host
+    clocks, with the ``ACCELERATE_TPU_TRACE_*`` env protocol for
+    cross-process (Supervisor -> worker) causality.
+  - `flight_recorder` — `FlightRecorder`: the bounded span ring buffer,
+    streamed span JSONL, touch-file/exit/SIGTERM dumps, and the
+    `HangWatchdog` (trace tail + all-thread stacks on a stalled step).
   - `profiler` — `ProfilerManager`: programmatic `jax.profiler` sessions with
     touch-file / SIGUSR2 triggers and fixed-duration capture windows.
   - `export` — JSONL snapshots, Prometheus text (file + stdlib HTTP
-    ``/metrics``), and the `tracking.py` bridge.
+    ``/metrics``), Chrome/Perfetto trace-event JSON, and the `tracking.py`
+    bridge.
 
-Importing this package never touches jax: the profiler backend and the
-sampled `block_until_ready` import lazily, so lint-only and host-side tools
-can read metrics without an accelerator stack.
+Importing this package never touches jax: the profiler backend, the sampled
+`block_until_ready`, and the compile-event listener import lazily, so
+lint-only and host-side tools (the `trace` CLI, the chaos invariant checks)
+can read metrics and stitch traces without an accelerator stack.
 """
 
 from .export import (
@@ -24,9 +33,12 @@ from .export import (
     TrackerBridge,
     parse_prometheus_text,
     to_prometheus_text,
+    to_trace_events,
     write_jsonl_snapshot,
     write_prometheus_textfile,
+    write_trace_events,
 )
+from .flight_recorder import FlightRecorder, HangWatchdog, collect_trace_dir, read_span_jsonl
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS,
     Counter,
@@ -37,6 +49,7 @@ from .metrics import (
 )
 from .profiler import ProfilerManager
 from .timeline import StepTimeline
+from .tracing import Span, Tracer, default_tracer, set_default_tracer
 
 __all__ = [
     "Counter",
@@ -47,10 +60,20 @@ __all__ = [
     "log_spaced_buckets",
     "StepTimeline",
     "ProfilerManager",
+    "Tracer",
+    "Span",
+    "default_tracer",
+    "set_default_tracer",
+    "FlightRecorder",
+    "HangWatchdog",
+    "collect_trace_dir",
+    "read_span_jsonl",
     "MetricsHTTPServer",
     "TrackerBridge",
     "to_prometheus_text",
     "parse_prometheus_text",
     "write_prometheus_textfile",
     "write_jsonl_snapshot",
+    "to_trace_events",
+    "write_trace_events",
 ]

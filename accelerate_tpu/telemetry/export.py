@@ -1,5 +1,6 @@
 """Metric exporters: JSONL snapshots, the Prometheus text format (file and
-stdlib-HTTP ``/metrics``), and a bridge into the `tracking.py` trackers.
+stdlib-HTTP ``/metrics``), the Chrome/Perfetto trace-event writer for the
+flight recorder's spans, and a bridge into the `tracking.py` trackers.
 
 Offline-first, like tracking.py: TPU pods often have no egress, so the
 always-works paths are files — an append-only JSONL history a postmortem can
@@ -203,6 +204,114 @@ def write_jsonl_snapshot(registry: MetricsRegistry, path: str, step: Optional[in
     with open(path, "a") as f:
         f.write(json.dumps(record, default=str) + "\n")
     return record
+
+
+# ------------------------------------------------------------------ trace events
+def _us(t_unix: float) -> int:
+    """Trace-event timestamps are integer microseconds."""
+    return int(round(float(t_unix) * 1e6))
+
+
+def _trace_args(record: dict) -> Dict[str, object]:
+    """Span ids ride in `args` so Perfetto's query/selection UI can correlate
+    a request across processes; user attrs come after (and win on clash is
+    impossible — attr names are user-chosen, ids are namespaced)."""
+    args: Dict[str, object] = {
+        "trace_id": record.get("trace_id"),
+        "span_id": record.get("span_id"),
+    }
+    if record.get("parent_id"):
+        args["parent_id"] = record["parent_id"]
+    args.update(record.get("attrs") or {})
+    return args
+
+
+def to_trace_events(records) -> dict:
+    """Render flight-recorder records as a Chrome trace-event JSON object
+    (the format chrome://tracing and Perfetto load directly).
+
+    - completed spans     -> ``"ph": "X"`` complete events (ts + dur, µs);
+    - in-span events      -> ``"ph": "i"`` thread-scoped instants inside them;
+    - standalone events   -> ``"ph": "i"`` process-scoped instants;
+    - dangling span_start -> ``"ph": "B"`` begin events with no matching end —
+      Perfetto renders them as unfinished, which is exactly what a span that
+      died with its process IS (the crash boundary, visually).
+
+    Timestamps are the records' unix-anchored times, so spans streamed by a
+    supervisor and three restarted workers land on ONE comparable timeline.
+    """
+    events = []
+    seen_pids = set()
+    ended = {r.get("span_id") for r in records if r.get("kind") == "span"}
+    for record in records:
+        kind = record.get("kind", "span")
+        pid = record.get("pid", 0)
+        tid = record.get("tid", 0)
+        seen_pids.add(pid)
+        if kind == "span":
+            start = record.get("start_unix", 0.0)
+            end = record.get("end_unix", start)
+            events.append({
+                "ph": "X",
+                "name": record.get("name", "?"),
+                "cat": record.get("cat", "default"),
+                "ts": _us(start),
+                "dur": max(_us(end) - _us(start), 0),
+                "pid": pid,
+                "tid": tid,
+                "args": _trace_args(record),
+            })
+            for ev in record.get("events", ()):
+                events.append({
+                    "ph": "i",
+                    "s": "t",
+                    "name": ev.get("name", "?"),
+                    "cat": record.get("cat", "default"),
+                    "ts": _us(ev.get("t_unix", start)),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": dict(ev.get("attrs") or {}),
+                })
+        elif kind == "span_start":
+            if record.get("span_id") in ended:
+                continue  # the completed span supersedes its start record
+            events.append({
+                "ph": "B",
+                "name": record.get("name", "?"),
+                "cat": record.get("cat", "default"),
+                "ts": _us(record.get("start_unix", 0.0)),
+                "pid": pid,
+                "tid": tid,
+                "args": _trace_args(record),
+            })
+        elif kind == "event":
+            events.append({
+                "ph": "i",
+                "s": "p",
+                "name": record.get("name", "?"),
+                "cat": record.get("cat", "default"),
+                "ts": _us(record.get("t_unix", 0.0)),
+                "pid": pid,
+                "tid": tid,
+                "args": _trace_args(record),
+            })
+    events.sort(key=lambda e: (e["pid"], e["ts"]))
+    meta = [
+        {
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": f"accelerate-tpu pid {pid}"},
+        }
+        for pid in sorted(seen_pids)
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def write_trace_events(records, path: str) -> str:
+    """Atomically write records as a Perfetto-loadable trace JSON (temp +
+    fsync + rename — a dump racing a crash must be whole or absent)."""
+    payload = json.dumps(to_trace_events(records))
+    atomic_write(path, lambda f: f.write(payload), mode="w")
+    return path
 
 
 class MetricsHTTPServer:

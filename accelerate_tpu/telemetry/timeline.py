@@ -32,7 +32,10 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..logging import get_logger
 from .metrics import MetricsRegistry
+
+logger = get_logger(__name__)
 
 #: Step phases with first-class histograms (charge() accepts any cause).
 PHASES = ("data_wait", "dispatch", "block")
@@ -67,11 +70,21 @@ class StepTimeline:
         prefix: str = "step",
         sample_block_every: int = 0,
         clock: Callable[[], float] = time.perf_counter,
+        tracer=None,
+        unaccounted_warn_s: Optional[float] = 60.0,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.prefix = prefix
         self.sample_block_every = int(sample_block_every)
         self._clock = clock
+        # The unaccounted-time alarm: `goodput()` reports `unaccounted_s` but
+        # a number nobody reads is not a diagnostic. When a window's residual
+        # exceeds this threshold, goodput() WARNS (once per window) and drops
+        # a span event through `tracer` — the same "missing time" definition
+        # the hang watchdog dumps on, so the ledger and the watchdog agree.
+        self.tracer = tracer
+        self.unaccounted_warn_s = unaccounted_warn_s
+        self._unaccounted_warned = False
         self._lock = threading.Lock()
         self.steps = 0
         self._phase_totals: Dict[str, float] = {}
@@ -236,13 +249,34 @@ class StepTimeline:
         lost_total = sum(lost.values())
         goodput = productive / total
         self._goodput_gauge.set(goodput)
+        unaccounted = max(total - productive - lost_total, 0.0)
+        if (
+            self.unaccounted_warn_s is not None
+            and unaccounted >= self.unaccounted_warn_s
+            and not self._unaccounted_warned
+        ):
+            # Once per accounting window: the r05-hang signature surfacing at
+            # RUNTIME instead of waiting for a postmortem to read the ledger.
+            self._unaccounted_warned = True
+            logger.warning(
+                "goodput: %.1fs of wall clock is unaccounted (total %.1fs, productive "
+                "%.1fs, lost %.1fs) — the host is stalling outside the instrumented "
+                "loop (backend init, a dead tunnel, or an opaque hang)",
+                unaccounted, total, productive, lost_total,
+            )
+            if self.tracer is not None:
+                self.tracer.event(
+                    "goodput.unaccounted", category="goodput",
+                    unaccounted_s=round(unaccounted, 3), total_s=round(total, 3),
+                    productive_s=round(productive, 3), lost_s=round(lost_total, 3),
+                )
         return {
             "total_s": round(total, 6),
             "steps": steps,
             "productive_s": round(productive, 6),
             "lost_s": {k: round(v, 6) for k, v in sorted(lost.items())},
             "lost_total_s": round(lost_total, 6),
-            "unaccounted_s": round(max(total - productive - lost_total, 0.0), 6),
+            "unaccounted_s": round(unaccounted, 6),
             "phase_s": {k: round(v, 6) for k, v in sorted(phases.items())},
             "goodput": round(goodput, 6),
             "accounted": round(min((productive + lost_total) / total, 1.0), 6),
@@ -258,3 +292,4 @@ class StepTimeline:
             self._productive_s = 0.0
             self._lost = {}
             self._step_open_since = None
+            self._unaccounted_warned = False

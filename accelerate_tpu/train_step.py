@@ -60,9 +60,14 @@ class FusedTrainStep:
         accumulation_steps: int = 1,
         gradient_state=None,
         steps_per_call: int = 1,
+        tracer=None,
     ):
         self.model = model
         self.optimizer = optimizer
+        # Optional telemetry tracer (Accelerator.train_step passes its own):
+        # program (re)builds and skipped fp16 steps become trace events, so a
+        # timeline shows WHY a step was slow (fresh trace) or absent (skip).
+        self.tracer = tracer
         self.loss_fn = loss_fn if loss_fn is not None else model.loss
         self.max_grad_norm = max_grad_norm
         self.accumulation_steps = int(accumulation_steps or 1)
@@ -270,6 +275,10 @@ class FusedTrainStep:
             )
         cache_key = "offload" if opt.offload_opt_state else with_lr
         if cache_key not in self._jitted:
+            if self.tracer is not None:
+                self.tracer.event(
+                    "train.build_program", category="train", key=str(cache_key)
+                )
             self._jitted[cache_key] = self._build(cache_key)
         # Scalars change rarely (scale only on scaler growth/backoff, lr per
         # scheduler step); cache their device buffers so the hot loop doesn't pay
@@ -309,6 +318,11 @@ class FusedTrainStep:
                 logger.warning(
                     "Skipping fused step: non-finite gradients (loss scale -> %s)", scaler.scale
                 )
+                if self.tracer is not None:
+                    self.tracer.event(
+                        "train.step_skipped", category="train",
+                        loss_scale=float(scaler.scale),
+                    )
         else:
             opt.step_was_skipped = False
         # Every fused call IS a full optimizer step: mark the sync boundary so

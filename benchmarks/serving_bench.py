@@ -27,7 +27,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -131,7 +133,7 @@ def pct(values, q):
     return float(np.percentile(np.asarray(values), q))
 
 
-def run_prefix_workload(model, args, cfg, max_length, rng):
+def run_prefix_workload(model, args, cfg, max_length, rng, tracer=None):
     """The prefix-heavy serving workload: every request opens with the SAME
     `--prefix-tokens`-long system prompt followed by a random tail. Served
     twice through paged engines — shared-prefix cache ON vs OFF — so the
@@ -157,10 +159,13 @@ def run_prefix_workload(model, args, cfg, max_length, rng):
         engine = ContinuousBatcher(
             model, num_slots=args.num_slots, max_length=max_length,
             chunk_size=args.chunk_size, paged=True, page_size=args.page_size,
-            prefix_cache=use_prefix,
+            prefix_cache=use_prefix, tracer=tracer,
         )
         log(f"prefix workload ({label}): warmup...")
-        run_continuous(engine, prompts, budgets, arrivals)  # compiles; registers the prefix
+        # Twice: pass 1 compiles per-miss buckets and registers the prefix,
+        # pass 2 compiles the prefix-hit suffix buckets the timed pass uses.
+        run_continuous(engine, prompts, budgets, arrivals)
+        run_continuous(engine, prompts, budgets, arrivals)
         guard = TraceGuard(
             transfer_guard="disallow", on_violation="record",
             name=f"serving-bench-prefix-{label}",
@@ -170,6 +175,12 @@ def run_prefix_workload(model, args, cfg, max_length, rng):
             tps, ttfts, _iters, span = run_continuous(engine, prompts, budgets, arrivals)
         if guard.total_recompiles or guard.host_transfers:
             log(f"TRACE-GUARD VIOLATIONS in prefix workload ({label}): {guard.report().summary()}")
+        # The tracing-overhead pin, prefix half: span instrumentation rides
+        # these timed passes too and must not cost a recompile or a sync.
+        assert guard.total_recompiles == 0 and guard.host_transfers == 0, (
+            f"prefix workload ({label}) regressed the 0-recompile / 0-host-transfer "
+            f"discipline with tracing enabled: {guard.report().summary()}"
+        )
         stats = engine.stats
         result[label] = {
             "tokens_per_sec": round(tps, 2),
@@ -207,6 +218,8 @@ def main(argv=None):
     parser.add_argument("--no-paged", action="store_true", help="use the contiguous per-slot KV layout (disables the prefix workload)")
     parser.add_argument("--prefix-tokens", type=int, default=None,
                         help="shared system-prompt length for the prefix-heavy workload; default 64 on accelerators, 24 on CPU; 0 disables")
+    parser.add_argument("--trace-dir", default=None,
+                        help="flight-recorder trace dir (span JSONL + Perfetto dump); default: a fresh temp dir — the artifact path is emitted in extra.telemetry.trace")
     args = parser.parse_args(argv)
 
     import jax
@@ -253,18 +266,34 @@ def main(argv=None):
     prompts, budgets, arrivals = build_workload(args, cfg.vocab_size, rng)
 
     from accelerate_tpu.generation import Generator
+    from accelerate_tpu.telemetry import FlightRecorder
+    from accelerate_tpu.telemetry.tracing import Tracer
+
+    # Request-scoped tracing rides the whole bench: every request's
+    # submit->finish span streams into the trace dir, and the Perfetto dump
+    # path lands in the JSON (extra.telemetry.trace) so a bench artifact links
+    # straight to its timeline. The armed TraceGuard below is the pin that
+    # this instrumentation costs 0 recompiles / 0 host transfers.
+    trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="serving_bench_trace_")
+    tracer = Tracer(recorder=FlightRecorder(log_dir=trace_dir), category="serve")
 
     engine = ContinuousBatcher(
         model, num_slots=args.num_slots, max_length=max_length, chunk_size=args.chunk_size,
-        paged=not args.no_paged, page_size=args.page_size,
+        paged=not args.no_paged, page_size=args.page_size, tracer=tracer,
     )
     static_gen = Generator(model, max_new_tokens=max(budgets), max_length=max_length)
 
     # Warmup pass: compile every program both paths use (static per batch shape,
-    # continuous per insert bucket + the one chunk program), then measure.
+    # continuous per insert bucket + the one chunk program), then measure. The
+    # continuous path warms TWICE: the first pass registers prompt prefixes,
+    # so the second sees the prefix-HIT suffix buckets (incl. the page-size
+    # floor bucket) the timed pass will use — one pass leaves those cold and
+    # the timed pass would pay (and, under the 0-recompile assert, fail on) a
+    # first-hit insert compile at non-default page sizes.
     log("warmup (compiles)...")
     t0 = time.perf_counter()
     run_static(static_gen, prompts, budgets, arrivals, args.num_slots, max_length)
+    run_continuous(engine, prompts, budgets, arrivals)
     run_continuous(engine, prompts, budgets, arrivals)
     log(f"warmup done in {time.perf_counter() - t0:.1f}s; timed runs...")
 
@@ -285,6 +314,13 @@ def main(argv=None):
     if guard.total_recompiles or guard.host_transfers:
         log(f"TRACE-GUARD VIOLATIONS in steady state: {guard.report().summary()}")
     assert engine.trace_counts["decode_chunk"] == 1, engine.trace_counts
+    # The tracing-overhead pin: span instrumentation (request lifecycles,
+    # insert/chunk spans) rides the timed passes above — it must not have
+    # cost a single recompile or guarded host transfer.
+    assert guard.total_recompiles == 0 and guard.host_transfers == 0, (
+        "timed passes regressed the 0-recompile / 0-host-transfer discipline "
+        f"with tracing enabled: {guard.report().summary()}"
+    )
 
     # Prefix-heavy workload: same model, shared system prompt across requests,
     # prefix cache ON vs OFF (paged engines only — the contiguous layout has no
@@ -296,7 +332,7 @@ def main(argv=None):
             log(f"capping prefix_tokens to {max_prefix} for the {max_length}-token cache")
             args.prefix_tokens = max_prefix
         if args.prefix_tokens >= args.page_size:
-            prefix_block = run_prefix_workload(model, args, cfg, max_length, rng)
+            prefix_block = run_prefix_workload(model, args, cfg, max_length, rng, tracer=tracer)
         else:
             log(
                 f"prefix_tokens {args.prefix_tokens} < page_size {args.page_size}: "
@@ -323,6 +359,15 @@ def main(argv=None):
             "p99_ms": round((hist.quantile(0.99) or 0.0) * 1000, 3),
         }
 
+    # Per-phase span counts + the Perfetto artifact: how many request
+    # lifecycles, admission dispatches and decode chunks the recorder saw
+    # (ring-bounded — the JSONL streams in trace_dir carry the full history).
+    span_counts = {}
+    for record in tracer.recorder.records():
+        if record.get("kind") == "span":
+            span_counts[record["name"]] = span_counts.get(record["name"], 0) + 1
+    trace_artifact = tracer.recorder.dump(reason="bench")
+
     telemetry_block = {
         "ttft": _hist_ms("serving_ttft_seconds"),
         "inter_token": _hist_ms("serving_inter_token_seconds"),
@@ -336,6 +381,11 @@ def main(argv=None):
         "prefix_cache_misses": registry.value("serving_prefix_cache_misses_total"),
         "prefix_cache_evictions": registry.value("serving_prefix_cache_evictions_total"),
         "prefill_tokens_saved": registry.value("prefill_tokens_saved_total"),
+        "trace": {
+            "artifact": trace_artifact,
+            "trace_dir": trace_dir,
+            "span_counts": span_counts,
+        },
     }
     paging_block = {"enabled": not args.no_paged}
     if not args.no_paged:

@@ -1,0 +1,10 @@
+"""TPU112 negative: read device values at the step boundary, annotate spans
+with host scalars."""
+import numpy as np
+
+
+def serve_chunk(tracer, chunk_fn, token):
+    out = chunk_fn(token)  # the dispatch output: host code reads it back...
+    streamed = int(np.asarray(out)[0])  # ...at the step boundary (sanctioned)
+    with tracer.span("decode_chunk", tokens_streamed=streamed) as span:
+        span.event("drained", count=streamed)

@@ -1,0 +1,10 @@
+"""TPU112 span-host-sync: a device-value read feeding a span annotation."""
+import jax.numpy as jnp
+
+
+def serve_chunk(tracer, chunk_fn, token):
+    logits = jnp.ones((4,))
+    # hazard: float() on a device value to annotate the span — a blocking
+    # readback hidden inside the instrumentation itself
+    with tracer.span("decode_chunk", top_logit=float(logits[0])):
+        chunk_fn(token)

@@ -1,0 +1,374 @@
+"""Request-scoped tracing tests: span lifecycle/nesting semantics, the
+flight-recorder ring (eviction order, streamed JSONL, touch-file dumps),
+Perfetto trace-event export schema, hang-watchdog firing on a stalled fake
+step, cross-process trace-id propagation through a real Supervisor child, the
+serving engine's submit->finish span coverage, the goodput unaccounted-time
+alarm, and the chaos smoke-serve dump carrying injected faults as events."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.telemetry import (
+    FlightRecorder,
+    Tracer,
+    collect_trace_dir,
+    read_span_jsonl,
+    to_trace_events,
+)
+from accelerate_tpu.telemetry.flight_recorder import DUMP_TOUCH_FILE
+from accelerate_tpu.telemetry.tracing import TRACE_DIR_ENV, TRACE_ID_ENV, TRACE_PARENT_ENV
+
+pytestmark = pytest.mark.tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ span semantics
+def test_span_lifecycle_and_nesting():
+    recorder = FlightRecorder()
+    tracer = Tracer(recorder=recorder, category="test")
+    with tracer.span("outer", a=1) as outer:
+        assert tracer.current_span is outer
+        outer.event("milestone", note="hi")
+        with tracer.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+        assert tracer.current_span is outer
+    assert tracer.current_span is None
+
+    records = recorder.records()
+    assert [r["name"] for r in records] == ["inner", "outer"]  # completion order
+    outer_rec = records[1]
+    assert outer_rec["attrs"] == {"a": 1}
+    assert outer_rec["events"][0]["name"] == "milestone"
+    assert outer_rec["trace_id"] == records[0]["trace_id"] == tracer.trace_id
+    assert outer_rec["end_unix"] >= outer_rec["start_unix"]
+    # idempotent end: a double-ended span records exactly once
+    span = tracer.start_span("solo")
+    span.end()
+    span.end()
+    assert [r["name"] for r in recorder.records()].count("solo") == 1
+
+
+def test_span_error_annotation_and_propagation():
+    tracer = Tracer(recorder=FlightRecorder())
+    with pytest.raises(RuntimeError):
+        with tracer.span("doomed"):
+            raise RuntimeError("boom")
+    (record,) = tracer.recorder.records()
+    assert "boom" in record["attrs"]["error"]
+    assert tracer.current_span is None  # the stack unwound
+
+
+def test_annotation_host_value_gate():
+    """The runtime half of TPU112: a device-array-shaped value (anything
+    non-host) must raise before it can hide a blocking readback."""
+    tracer = Tracer(recorder=FlightRecorder())
+    with pytest.raises(TypeError, match="host values"):
+        tracer.start_span("bad", payload=np.ones(3))
+    span = tracer.start_span("ok", n=3, f=0.5, s="x", b=True, none=None)
+    with pytest.raises(TypeError, match="host values"):
+        span.event("bad", arr=[1, 2])
+    span.end()
+
+
+# ------------------------------------------------------------------ flight recorder
+def test_ring_buffer_eviction_order():
+    recorder = FlightRecorder(capacity=4)
+    tracer = Tracer(recorder=recorder)
+    for i in range(10):
+        tracer.start_span("s", idx=i).end()
+    records = recorder.records()
+    assert len(records) == 4
+    assert [r["attrs"]["idx"] for r in records] == [6, 7, 8, 9]  # oldest evicted first
+    assert recorder.registry.value("trace_spans_recorded_total") == 10
+    assert recorder.registry.value("trace_spans_evicted_total") == 6
+
+
+def test_streamed_jsonl_survives_torn_tail(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    recorder = FlightRecorder(log_dir=trace_dir)
+    tracer = Tracer(recorder=recorder)
+    open_span = tracer.start_span("unfinished")  # streamed as span_start only
+    tracer.start_span("done").end()
+    tracer.event("marker", k=1)
+    stream = os.path.join(trace_dir, f"spans_{os.getpid()}.jsonl")
+    with open(stream, "a") as f:
+        f.write('{"kind": "span", "name": "torn')  # a killed writer's last line
+    records = read_span_jsonl(stream)
+    kinds = {(r["kind"], r["name"]) for r in records}
+    assert ("span_start", "unfinished") in kinds
+    assert ("span", "done") in kinds
+    assert ("event", "marker") in kinds
+    assert not any(r.get("name") == "torn" for r in records)
+    assert collect_trace_dir(trace_dir) == sorted(
+        records, key=lambda r: r.get("start_unix", r.get("t_unix", 0.0))
+    )
+    open_span.end()
+
+
+def test_perfetto_export_schema_and_roundtrip(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    recorder = FlightRecorder(log_dir=trace_dir)
+    tracer = Tracer(recorder=recorder)
+    with tracer.span("parent", kindof="serve") as parent:
+        parent.event("instant", x=1)
+        with tracer.span("child"):
+            pass
+    tracer.event("standalone")
+    dangling = tracer.start_span("dangling")  # never ended: only span_start streams
+
+    path = recorder.dump(reason="test")
+    data = json.loads(open(path).read())
+    assert set(data) == {"traceEvents", "displayTimeUnit"}
+    events = data["traceEvents"]
+    phases = {e["ph"] for e in events}
+    assert phases <= {"M", "X", "B", "i"}
+    for event in events:
+        assert isinstance(event["ts"], int) if event["ph"] != "M" else True
+        assert "pid" in event and "name" in event
+        if event["ph"] == "X":
+            assert event["dur"] >= 0
+    # monotonic per-pid ordering (what makes the timeline readable)
+    ts = [e["ts"] for e in events if e["ph"] != "M"]
+    assert ts == sorted(ts)
+    by_name = {e["name"] for e in events}
+    assert {"parent", "child", "instant", "standalone"} <= by_name
+    # the dangling span is not in the RING dump (it never completed)...
+    assert "dangling" not in by_name
+    # ...but its streamed span_start exports as an unfinished "B" event.
+    stitched = to_trace_events(collect_trace_dir(trace_dir))["traceEvents"]
+    assert any(e["name"] == "dangling" and e["ph"] == "B" for e in stitched)
+    dangling.end()
+
+
+def test_touch_file_dump_trigger(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    recorder = FlightRecorder(log_dir=trace_dir, poll_every=2)
+    Tracer(recorder=recorder).start_span("work").end()
+    touch = os.path.join(trace_dir, DUMP_TOUCH_FILE)
+    open(touch, "w").close()
+    assert recorder.poll() is False  # off-cadence call: no probe yet
+    assert recorder.poll() is True  # cadence hit: trigger consumed, dump written
+    assert not os.path.exists(touch)
+    dumps = [n for n in os.listdir(trace_dir) if n.startswith("trace_") and n.endswith(".json")]
+    assert len(dumps) == 1
+
+
+# ------------------------------------------------------------------ hang watchdog
+def test_hang_watchdog_fires_on_stalled_fake_step(tmp_path):
+    from accelerate_tpu.chaos.injectors import FakeClock
+
+    clock = FakeClock()
+    trace_dir = str(tmp_path / "trace")
+    recorder = FlightRecorder(log_dir=trace_dir, clock=clock.monotonic)
+    tracer = Tracer(recorder=recorder, clock=clock.monotonic)
+    watchdog = recorder.start_watchdog(
+        deadline_s=30.0, tracer=tracer, clock=clock.monotonic, start_thread=False
+    )
+    clock.sleep(100)
+    assert watchdog.check_once() is False  # unarmed: warmup is not a stall
+    tracer.start_span("train.step", step=0).end()
+    recorder.heartbeat()
+    clock.sleep(10)
+    assert watchdog.check_once() is False  # within deadline
+
+    clock.sleep(25)  # 35s since the last heartbeat: the step stalled
+    assert watchdog.check_once() is True
+    assert watchdog.check_once() is False  # one artifact per stall, not per poll
+
+    # The dump carries the hang marker + the step that preceded the stall...
+    data = json.loads(open(watchdog.last_dump).read())
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "hang.detected" in names and "train.step" in names
+    # ...and the stacks file shows what every thread was doing.
+    stacks = open(watchdog.last_stacks_path).read()
+    assert "thread" in stacks and "test_hang_watchdog_fires_on_stalled_fake_step" in stacks
+
+    recorder.heartbeat()  # the loop came back: the watchdog re-arms
+    clock.sleep(31)
+    assert watchdog.check_once() is True
+    assert watchdog.fired_count == 2
+
+
+# ------------------------------------------------------------------ cross-process
+def test_trace_context_propagates_through_real_supervisor_child(tmp_path):
+    from accelerate_tpu.fault_tolerance import Supervisor
+
+    trace_dir = str(tmp_path / "trace")
+    tracer = Tracer(recorder=FlightRecorder(log_dir=trace_dir), category="supervisor")
+    child_src = (
+        "from accelerate_tpu.telemetry.tracing import Tracer\n"
+        "tracer = Tracer.from_env()\n"
+        "with tracer.span('child.work', category='worker'):\n"
+        "    pass\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    supervisor = Supervisor([sys.executable, "-c", child_src], env=env, tracer=tracer)
+    assert supervisor.run() == 0
+
+    records = collect_trace_dir(trace_dir)
+    attempts = [r for r in records if r["name"] == "supervisor.attempt" and r["kind"] == "span"]
+    child_spans = [r for r in records if r["name"] == "child.work" and r["kind"] == "span"]
+    assert len(attempts) == 1 and len(child_spans) == 1
+    # One trace id across both processes; the child's root span parents under
+    # the supervisor attempt that spawned it.
+    assert child_spans[0]["trace_id"] == attempts[0]["trace_id"] == tracer.trace_id
+    assert child_spans[0]["parent_id"] == attempts[0]["span_id"]
+    assert child_spans[0]["pid"] != attempts[0]["pid"]
+    exits = [r for r in records if r["name"] == "supervisor.child_exit"]
+    assert exits and exits[0]["attrs"]["exit_code"] == 0
+
+
+def test_tracer_from_env_reads_protocol(tmp_path, monkeypatch):
+    monkeypatch.setenv(TRACE_DIR_ENV, str(tmp_path / "t"))
+    monkeypatch.setenv(TRACE_ID_ENV, "cafecafecafe")
+    monkeypatch.setenv(TRACE_PARENT_ENV, "beefbeefbeef")
+    tracer = Tracer.from_env()
+    assert tracer.trace_id == "cafecafecafe"
+    assert tracer.root_parent_id == "beefbeefbeef"
+    assert tracer.recorder.log_dir == str(tmp_path / "t")
+    span = tracer.start_span("root")
+    assert span.parent_id == "beefbeefbeef"
+    span.end()
+    # inject_env round-trips the context for the next hop down
+    env = tracer.inject_env({})
+    assert env[TRACE_ID_ENV] == "cafecafecafe"
+    assert env[TRACE_DIR_ENV] == str(tmp_path / "t")
+
+
+# ------------------------------------------------------------------ serving spans
+def _tiny_llama():
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=10000.0,
+    )
+    return create_llama_model(cfg, seq_len=32)
+
+
+def test_serving_request_lifecycle_spans():
+    from accelerate_tpu.serving import ContinuousBatcher, Request
+
+    recorder = FlightRecorder()
+    tracer = Tracer(recorder=recorder, category="serve")
+    engine = ContinuousBatcher(_tiny_llama(), num_slots=2, max_length=64, chunk_size=4,
+                               tracer=tracer)
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        engine.submit(Request(i, rng.integers(1, 128, (6,)).astype(np.int32), max_new_tokens=5))
+    engine.run()
+    engine.close()
+
+    records = recorder.records()
+    requests = {r["attrs"]["request_id"]: r for r in records if r["name"] == "serve.request"}
+    assert sorted(requests) == [0, 1, 2, 3]
+    for record in requests.values():
+        assert record["attrs"]["finish_reason"] == "length"
+        assert record["attrs"]["tokens"] == 5
+        assert [e["name"] for e in record["events"]] == ["submitted", "admitted", "first_token"]
+        admitted = record["events"][1]["attrs"]
+        assert admitted["queue_wait_s"] >= 0 and "pages_reserved" in admitted
+    inserts = [r for r in records if r["name"] == "serve.insert"]
+    assert len(inserts) == 4
+    assert all(r["parent_id"] in {q["span_id"] for q in requests.values()} for r in inserts)
+    chunks = [r for r in records if r["name"] == "serve.decode_chunk"]
+    assert chunks and all("slots" in r["attrs"] for r in chunks)
+
+
+# ------------------------------------------------------------------ goodput alarm
+def test_goodput_unaccounted_warning_and_span_event():
+    from accelerate_tpu.chaos.injectors import FakeClock
+    from accelerate_tpu.telemetry import StepTimeline
+
+    clock = FakeClock()
+    tracer = Tracer(recorder=FlightRecorder(), clock=clock.monotonic)
+    timeline = StepTimeline(
+        clock=clock.perf_counter, tracer=tracer, unaccounted_warn_s=50.0
+    )
+    with timeline.phase("dispatch"):
+        clock.sleep(1.0)
+    timeline.step_done()
+    clock.sleep(100.0)  # an opaque stall: nothing productive, nothing charged
+    report = timeline.goodput()
+    assert report["unaccounted_s"] >= 50.0
+    events = [r for r in tracer.recorder.records() if r["name"] == "goodput.unaccounted"]
+    assert len(events) == 1
+    assert events[0]["attrs"]["unaccounted_s"] == pytest.approx(report["unaccounted_s"], abs=0.1)
+
+    timeline.goodput()  # once per window, not per call
+    assert len([r for r in tracer.recorder.records() if r["name"] == "goodput.unaccounted"]) == 1
+    timeline.reset()
+    clock.sleep(200.0)
+    timeline.goodput()  # a fresh window re-arms the alarm
+    assert len([r for r in tracer.recorder.records() if r["name"] == "goodput.unaccounted"]) == 2
+
+
+# ------------------------------------------------------------------ chaos dump
+@pytest.mark.chaos
+def test_chaos_smoke_serve_dump_is_perfetto_complete(tmp_path):
+    """The acceptance path: `chaos run smoke-serve` with a trace dir, then
+    `trace dump` — the JSON must hold submit->finish spans for every request
+    and every injected fault as an event."""
+    from accelerate_tpu.chaos import ChaosRunner, builtin_plans
+    from accelerate_tpu.commands.trace import trace_dump_command
+
+    trace_dir = str(tmp_path / "trace")
+    runner = ChaosRunner(builtin_plans()["smoke-serve"], trace_dir=trace_dir)
+    report = runner.run_serve(num_requests=6)
+    assert report.ok, report.render_text()
+    trace_check = next(c for c in report.checks if c.name == "trace_complete")
+    assert trace_check.passed and trace_check.details["request_spans"] >= 6
+
+    class Args:
+        pass
+
+    args = Args()
+    args.trace_dir, args.out, args.wait = trace_dir, None, 0.0
+    with pytest.raises(SystemExit) as exc:
+        trace_dump_command(args)
+    assert exc.value.code == 0
+    data = json.loads(open(os.path.join(trace_dir, "trace.json")).read())
+    names = [e["name"] for e in data["traceEvents"]]
+    finished = [
+        e for e in data["traceEvents"]
+        if e["name"] == "serve.request" and "finish_reason" in e.get("args", {})
+    ]
+    assert len(finished) == trace_check.details["accepted"]
+    for kind in ("serve.dispatch_stall", "serve.queue_burst", "serve.dispatch_error"):
+        assert f"chaos.{kind}" in names  # the injected faults, on the timeline
+    assert "serve.blast_radius" in names  # the dispatch failure's blast radius
+
+
+def test_trace_export_cli_stitches_multiple_streams(tmp_path):
+    from accelerate_tpu.commands.trace import trace_export_command
+
+    trace_dir = str(tmp_path / "trace")
+    recorder = FlightRecorder(log_dir=trace_dir)
+    Tracer(recorder=recorder, trace_id="feedfacefeed").start_span("a").end()
+    # a second "process": same dir, different stream file
+    other = os.path.join(trace_dir, "spans_99999.jsonl")
+    with open(other, "w") as f:
+        f.write(json.dumps({
+            "kind": "span", "name": "b", "cat": "x", "trace_id": "feedfacefeed",
+            "span_id": "0b", "parent_id": None, "pid": 99999, "tid": 1,
+            "start_unix": 1.0, "end_unix": 2.0, "duration_s": 1.0, "attrs": {},
+        }) + "\n")
+
+    class Args:
+        pass
+
+    args = Args()
+    args.inputs, args.out = [trace_dir], str(tmp_path / "out.json")
+    with pytest.raises(SystemExit) as exc:
+        trace_export_command(args)
+    assert exc.value.code == 0
+    data = json.loads(open(args.out).read())
+    pids = {e["pid"] for e in data["traceEvents"] if e["ph"] == "X"}
+    assert len(pids) == 2
