@@ -543,11 +543,15 @@ class ChaosRunner:
         max_new_tokens: int = 4,
         max_cycles: int = 200,
         paged: bool = True,
+        speculative: bool = False,
     ) -> InvariantReport:
         """Serving workload: a tiny llama `ContinuousBatcher` fed one request
         per cycle (plus scripted queue bursts), driven to drain under injected
         dispatch stalls/failures. Chaos shares the engine's metrics registry so
-        the report's snapshot carries both."""
+        the report's snapshot carries both. `speculative=True` runs the same
+        sweeps through the draft/verify chunk (draft window in every admission,
+        history mirror in every blast-radius rebuild), so recovery is proven to
+        reconstruct the speculative state too."""
         from ..models.llama import LlamaConfig, create_llama_model
         from ..serving import FINISH_REASONS, ContinuousBatcher, QueueFull, Request
 
@@ -566,6 +570,7 @@ class ChaosRunner:
             model, num_slots=num_slots, max_length=64, chunk_size=chunk_size,
             max_queue=max_queue, registry=self.session.registry,
             tracer=self.tracer, paged=paged, page_size=4,
+            speculative=speculative, draft_tokens=3,
         )
         ServingInjector(self.session).arm(engine)
         rng = np.random.default_rng(self.plan.seed)

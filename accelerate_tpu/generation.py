@@ -36,6 +36,13 @@ class GenerationConfig:
     repetition_penalty: float = 1.0  # HF CTRL-style: seen tokens' logits /p (if >0) else *p
     eos_token_id: Optional[int] = None
     pad_token_id: Optional[int] = None  # fill for finished rows; defaults to eos
+    # Self-speculative decode (speculative.py): > 0 turns each fused-loop
+    # iteration into an n-gram draft + one (draft_tokens+1)-position verify
+    # dispatch that emits every greedily-confirmed draft plus one bonus token.
+    # Greedy-only (do_sample / repetition_penalty raise) and token-identical
+    # to draft_tokens=0 by construction; both knobs shape the compiled loop.
+    draft_tokens: int = 0
+    draft_ngram: int = 2
 
 
 def _sample(logits, config: GenerationConfig, rng, temperature=None):
@@ -92,6 +99,21 @@ def _bucket_for(max_new: int) -> int:
     return 1 << (max_new - 1).bit_length()  # next power of two >= max_new
 
 
+def _rewind_cache_index(cache, delta):
+    """Roll back every attention module's `cache_index` by `delta` — the
+    speculative accept/reject step: a verify block wrote draft_tokens+1 K/V
+    rows and advanced the shared index past them, but only the accepted prefix
+    may count. The rejected tail stays physically in the cache; it is
+    unreachable (`update_decode_cache` masks `cols < cache_index + s`, and the
+    next block's writes start AT the rewound index, covering the stale region
+    before any query can see it). `delta` may be a traced scalar."""
+    def fix(path, leaf):
+        key = getattr(path[-1], "key", None) if path else None
+        return leaf - delta if key == "cache_index" else leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
 def _operand(value, dtype):
     """Explicit host-to-device push of a scalar/array operand. The numpy hop
     matters: `jnp.asarray(python_scalar)` (and eager jnp ops on Python
@@ -134,11 +156,16 @@ def _params_resolver(model):
 
 
 def make_causal_programs(
-    module, resolve, full_prefill_logits: bool = False, step_mask_operand: bool = False
+    module,
+    resolve,
+    full_prefill_logits: bool = False,
+    step_mask_operand: bool = False,
+    verify_block: bool = False,
 ):
-    """(prefill, step) raw callables for a decode-cache causal-LM module — the
-    factored seam that `Generator` jits directly and `serving.ContinuousBatcher`
-    composes into its slot-insert / chunked-decode programs.
+    """(prefill, step[, verify]) raw callables for a decode-cache causal-LM
+    module — the factored seam that `Generator` jits directly and
+    `serving.ContinuousBatcher` composes into its slot-insert / chunked-decode
+    programs.
 
     `prefill(params, input_ids, positions, attention_mask=None)` writes the whole
     prompt into a fresh cache and returns `(last_logits, cache)` — or the full
@@ -151,7 +178,18 @@ def make_causal_programs(
     the module's `attention_mask`: the PAGED slot cache reads it as the
     [B, pages_per_slot] int32 page table (a traced operand — the one decode
     executable survives every admission), since slot decode never carries a
-    boolean mask of its own."""
+    boolean mask of its own.
+
+    `verify_block=True` appends the speculative-decode seam to the tuple:
+    `verify(params, cache, tokens, positions[, mask])` scores a [B, s] token
+    BLOCK (the pending token plus s-1 draft proposals) in ONE dispatch,
+    writing every block position's K/V and returning the full [B, s, V]
+    logits plus the mutated cache — the multi-token twin of `step`, with the
+    same mask-operand convention. Position j's logits are computed after
+    exactly the block prefix <= j (the cache paths mask per-query), so
+    `argmax(logits[:, j])` is precisely the token greedy decode would emit
+    after accepting the first j block tokens — the property the accept loop
+    relies on for token-identical output."""
 
     def prefill(params, input_ids, positions, attention_mask=None):
         # attention_mask (left-padded batch prompts): rides into the cached
@@ -183,7 +221,22 @@ def make_causal_programs(
         )
         return logits[:, -1, :], mutated["cache"]
 
-    return prefill, (step_with_mask if step_mask_operand else step)
+    def verify(params, cache, tokens, positions):
+        logits, mutated = module.apply(
+            {**resolve(params), "cache": cache}, tokens, None, positions, mutable=["cache"]
+        )
+        return logits, mutated["cache"]
+
+    def verify_with_mask(params, cache, tokens, positions, mask):
+        logits, mutated = module.apply(
+            {**resolve(params), "cache": cache}, tokens, mask, positions, mutable=["cache"]
+        )
+        return logits, mutated["cache"]
+
+    step_fn = step_with_mask if step_mask_operand else step
+    if verify_block:
+        return prefill, step_fn, (verify_with_mask if step_mask_operand else verify)
+    return prefill, step_fn
 
 
 def make_cached_prefill_program(module, resolve):
@@ -226,9 +279,12 @@ class Generator:
         decode_cfg = dataclasses.replace(self.base_config, decode_cache_length=self.max_length)
         self.decode_module = type(model.module)(decode_cfg)
 
-        prefill, step = make_causal_programs(self.decode_module, _params_resolver(model))
+        prefill, step, verify = make_causal_programs(
+            self.decode_module, _params_resolver(model), verify_block=True
+        )
         self._prefill = jax.jit(prefill)
         self._step_inner = step  # un-jitted: traced inside the fused decode loop
+        self._verify_inner = verify  # un-jitted: traced inside the speculative loop
         self._decode_cache = {}
 
     def _decode_fn(self, bucket: int, config: GenerationConfig):
@@ -243,8 +299,16 @@ class Generator:
         # Only WHETHER a penalty applies shapes the program (the presence carry);
         # the penalty VALUE rides as a traced operand like temperature, so
         # sweeping it never recompiles the fused loop.
+        # draft_ngram is inert without draft_tokens: normalize it out of the
+        # key so a draft_tokens=0 control run never recompiles an identical
+        # plain loop per ngram value.
         key = (bucket, config.do_sample, config.eos_token_id, config.pad_token_id,
-               config.repetition_penalty != 1.0)
+               config.repetition_penalty != 1.0, config.draft_tokens,
+               config.draft_ngram if config.draft_tokens else 0)
+        if config.draft_tokens:
+            if key not in self._decode_cache:
+                self._decode_cache[key] = self._speculative_decode_fn(bucket, config)
+            return self._decode_cache[key]
         if config.do_sample:
             # top_k and top_p shape the program (lax.top_k / the nucleus
             # threshold are trace-time); temperature rides in as a traced
@@ -311,6 +375,115 @@ class Generator:
         fn = jax.jit(decode, donate_argnums=(1,))
         self._decode_cache[key] = fn
         return fn
+
+    def _speculative_decode_fn(self, bucket: int, config: GenerationConfig):
+        """The fused decode loop's draft-then-verify variant: each
+        `lax.while_loop` iteration proposes `config.draft_tokens` continuations
+        with the on-device n-gram drafter (`speculative.propose_ngram_drafts`
+        over a history buffer riding the carry), scores the pending token plus
+        all drafts in ONE (draft_tokens+1)-position verify dispatch, and emits
+        the longest greedily-confirmed draft prefix plus one bonus token — so
+        an iteration emits 1..draft_tokens+1 tokens for the latency of one
+        dispatch, and greedy output stays token-identical to the plain loop
+        (every emitted token is the model's own argmax given exactly the
+        accepted prefix).
+
+        Batch rows advance in LOCKSTEP (the dense cache's `cache_index` is
+        shared): the accepted length is the minimum across unfinished rows, so
+        a batch-1 call gets the full speedup and larger batches degrade toward
+        plain decode, never past it. Rows that finish early emit pads, exactly
+        like the plain loop. The rejected K/V tail is rolled back by rewinding
+        `cache_index` (`_rewind_cache_index`); the token/history buffers carry
+        `bucket + draft_tokens` columns of slack so the last block's masked
+        window writes stay in bounds."""
+        from .speculative import greedy_accept_length, propose_ngram_drafts
+
+        if config.do_sample:
+            raise ValueError(
+                "speculative decoding is greedy-only: draft verification accepts "
+                "argmax matches, which is not distribution-preserving under "
+                "sampling — set do_sample=False or draft_tokens=0"
+            )
+        if config.repetition_penalty != 1.0:
+            raise ValueError(
+                "speculative decoding does not compose with repetition_penalty "
+                "(the presence update is order-dependent across a verified "
+                "block); set repetition_penalty=1.0 or draft_tokens=0"
+            )
+        eos = config.eos_token_id
+        pad_id = config.pad_token_id if config.pad_token_id is not None else (eos if eos is not None else 0)
+        verify_inner = self._verify_inner
+        k_draft, m_gram = config.draft_tokens, config.draft_ngram
+
+        def decode(params, cache, first_logits, next_positions, limit, history, hist_base, *extra):
+            # `history` [B, max_length + k] int32: the observed context in
+            # PHYSICAL order (prompt buffer, then generated tokens), seeded
+            # with the prompt by the caller; `hist_base` = prompt buffer width.
+            b = first_logits.shape[0]
+            token = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
+            width = bucket + k_draft
+            tokens = jnp.full((b, width), jnp.int32(pad_id))
+            tokens = tokens.at[:, 0].set(token)
+            history = history.at[jnp.arange(b), hist_base].set(token)
+            finished = (token == eos) if eos is not None else jnp.zeros((b,), bool)
+            js = jnp.arange(k_draft + 1, dtype=jnp.int32)
+
+            def cond(carry):
+                i, tokens, cache, token, finished, history = carry
+                more = i < limit
+                if eos is not None:
+                    more &= ~jnp.all(finished)
+                return more
+
+            def body(carry):
+                i, tokens, cache, token, finished, history = carry
+                hist_len = hist_base + i
+                drafts, valid_len = propose_ngram_drafts(history, hist_len, k_draft, m_gram)
+                block = jnp.concatenate([token[:, None], drafts], axis=1)
+                base = jnp.broadcast_to(next_positions + i - 1, (b,)).astype(jnp.int32)
+                positions = base[:, None] + js[None, :]
+                logits, cache = verify_inner(params, cache, block, positions, *extra)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+                accept = greedy_accept_length(drafts, greedy[:, :k_draft], valid_len)
+                if eos is not None:
+                    # Finished rows emit pads regardless; don't let them drag
+                    # the lockstep minimum below the live rows' acceptance.
+                    accept = jnp.where(finished, k_draft, accept)
+                a_min = jnp.minimum(jnp.min(accept), limit - i - 1)  # scalar
+                emit = js <= a_min
+                if eos is not None:
+                    cols, fin = [], finished
+                    for j in range(k_draft + 1):
+                        e = jnp.where(fin, jnp.int32(pad_id), greedy[:, j])
+                        cols.append(e)
+                        fin = fin | ((e == eos) & emit[j])
+                    emitted = jnp.stack(cols, axis=1)
+                    finished = fin
+                else:
+                    emitted = greedy
+                # Masked window writes: positions past a_min keep their old
+                # buffer contents (the next iteration starts there).
+                window = jax.lax.dynamic_slice(tokens, (jnp.int32(0), i), (b, k_draft + 1))
+                tokens = jax.lax.dynamic_update_slice(
+                    tokens, jnp.where(emit[None, :], emitted, window), (jnp.int32(0), i)
+                )
+                hwin = jax.lax.dynamic_slice(history, (jnp.int32(0), hist_len), (b, k_draft + 1))
+                history = jax.lax.dynamic_update_slice(
+                    history, jnp.where(emit[None, :], emitted, hwin), (jnp.int32(0), hist_len)
+                )
+                token = jax.lax.dynamic_slice_in_dim(emitted, a_min, 1, axis=1)[:, 0]
+                # Count only the accepted prefix: rewind the shared cache index
+                # past the k - a_min rejected draft rows this dispatch wrote.
+                cache = _rewind_cache_index(cache, k_draft - a_min)
+                return (i + a_min + 1, tokens, cache, token, finished, history)
+
+            carry = (jnp.int32(1), tokens, cache, token, finished, history)
+            _, tokens, cache, _, _, _ = jax.lax.while_loop(cond, body, carry)
+            return tokens, cache
+
+        # Donate only the cache: the history buffer has no same-shaped output
+        # to alias (tokens is [B, bucket + k]), so donating it just warns.
+        return jax.jit(decode, donate_argnums=(1,))
 
     def __call__(
         self,
@@ -390,17 +563,34 @@ class Generator:
             )
         params = self.params if "params" in self.params else {"params": self.params}
         logits, cache = self._prefill(params, *prefill_args)
-        generated, _cache = self._decode_fn(_bucket_for(max_new), config)(
-            params,
-            cache,
-            logits,
-            next_positions,
-            _operand(max_new, np.int32),
-            _operand(config.temperature, np.float32),
-            _operand(config.repetition_penalty, np.float32),
-            rng,
-            presence,
-        )
+        if config.draft_tokens:
+            # Speculative loop operands: the history buffer (physical order —
+            # prompt buffer incl. any left pads, then generated tokens) and its
+            # base width. Fixed [B, max_length + k] shape, so varying prompt
+            # lengths reuse the one compiled loop per bucket, like the cache.
+            hist = np.zeros((b, self.max_length + config.draft_tokens), np.int32)
+            hist[:, :prompt_len] = ids_host
+            generated, _cache = self._decode_fn(_bucket_for(max_new), config)(
+                params,
+                cache,
+                logits,
+                next_positions,
+                _operand(max_new, np.int32),
+                jnp.asarray(hist),
+                _operand(prompt_len, np.int32),
+            )
+        else:
+            generated, _cache = self._decode_fn(_bucket_for(max_new), config)(
+                params,
+                cache,
+                logits,
+                next_positions,
+                _operand(max_new, np.int32),
+                _operand(config.temperature, np.float32),
+                _operand(config.repetition_penalty, np.float32),
+                rng,
+                presence,
+            )
         # Host tail entirely in numpy: even a static eager slice on a device
         # array dispatches dynamic_slice with implicitly-pushed start indices,
         # which an armed transfer guard rejects. One explicit drain (the host
@@ -471,6 +661,11 @@ class Seq2SeqGenerator:
         attention_mask = kwargs.pop("attention_mask", None)  # before GenerationConfig(**kwargs)
         explicit_request = generation_config is not None or "max_new_tokens" in kwargs
         config = generation_config or GenerationConfig(**kwargs)
+        if config.draft_tokens:
+            raise ValueError(
+                "speculative decoding (draft_tokens > 0) is causal-LM only; the "
+                "encoder-decoder decode path has no verify-block seam"
+            )
         if rng is None:
             rng = _default_rng()
         input_ids = jnp.asarray(input_ids, jnp.int32)
@@ -579,7 +774,7 @@ def generate(model, input_ids, max_new_tokens: int = 32, **kwargs):
     gen_kwargs = {
         k: kwargs.pop(k)
         for k in ("do_sample", "temperature", "top_k", "top_p", "repetition_penalty",
-                  "eos_token_id", "pad_token_id")
+                  "eos_token_id", "pad_token_id", "draft_tokens", "draft_ngram")
         if k in kwargs
     }
     attention_mask = kwargs.pop("attention_mask", None)

@@ -96,18 +96,31 @@ def update_slot_cache(
 ):
     """Per-ROW cache writes for slot-based continuous batching (serving.py):
     every batch row is an independent request slot with its OWN running position,
-    so the single new K/V of row i lands at `positions[i]` instead of a shared
+    so the new K/V of row i lands at `positions[i]` instead of a shared
     scalar `cache_index`. The scatter (`.at[rows, pos].set`) is the per-slot twin
-    of `update_decode_cache`'s `dynamic_update_slice`; the returned mask lets row
-    i attend exactly to its written prefix `cols <= positions[i]` — stale K/V
+    of `update_decode_cache`'s `dynamic_update_slice`; the returned mask lets each
+    query attend exactly to its written prefix `cols <= its position` — stale K/V
     from a previous slot occupant above the current position is never visible,
     which is what makes slot reuse sound without ever clearing the cache.
 
-    Decode-only (s == 1): slot PREFILL goes through the ordinary
-    `update_decode_cache` path on a batch-1 cache that the serving engine
-    scatters into the slot row (utils/operations.tree_scatter_rows) — or, paged,
-    into the slot's pool pages (tree_scatter_pages) — so one attention code path
-    covers both programs.
+    Decode (s == 1) and speculative VERIFY BLOCKS (s == draft_tokens + 1,
+    positions[i] = pos_i + [0..s)): the s > 1 path writes every block token's
+    K/V at its own position and returns a per-query causal mask, so one
+    dispatch scores all s positions — query j of row i attends
+    `cols <= positions[i, j]`, i.e. the accepted prefix plus the block tokens
+    at or before it, every one of which this same dispatch just wrote. Rejected
+    draft positions need no rollback: the engine simply does not advance the
+    slot's position past the accepted prefix, the mask keeps the stale K/V
+    invisible, and the next dispatch overwrites it before anything attends it.
+    Positions past the cache capacity (a draft window overrunning a finishing
+    request) clip to the last cell, which is never attended — the final token
+    of a capacity-exact request is emitted without ever being dispatched.
+
+    Slot PREFILL goes through the ordinary `update_decode_cache` path on a
+    batch-1 cache that the serving engine scatters into the slot row
+    (utils/operations.tree_scatter_rows) — or, paged, into the slot's pool
+    pages (tree_scatter_pages) — so one attention code path covers both
+    programs.
 
     PAGED mode (`page_size > 0`): the cache collection holds one POOL of
     `num_pages` fixed-size pages ([num_pages, page_size, h, d]) instead of one
@@ -122,7 +135,7 @@ def update_slot_cache(
     page owned by a live request or a shared read-only prefix page.
 
     Args:
-        positions: [B, 1] int32 — each slot's absolute write/attend position.
+        positions: [B, s] int32 — each token's absolute write/attend position.
         page_table: [B, pages_per_slot] int32 pool-page ids per slot (paged only).
         page_size / num_pages: static pool geometry (paged only).
 
@@ -131,11 +144,11 @@ def update_slot_cache(
     import jax.numpy as jnp
 
     b, s, h, d = k.shape
-    if s != 1:
+    if positions.shape != (b, s):
         raise ValueError(
-            f"update_slot_cache is the per-token decode path (seq == 1, got {s}); "
-            "prefill a slot through update_decode_cache on a batch-1 cache and "
-            "scatter it into the slot row (tree_scatter_rows)"
+            f"update_slot_cache needs per-token positions [B, S] = {(b, s)}, "
+            f"got {positions.shape}; slot prefill goes through "
+            "update_decode_cache on a batch-1 cache (tree_scatter_rows)"
         )
     if page_size:
         if page_table is None:
@@ -148,29 +161,29 @@ def update_slot_cache(
         pool_v = module.variable(
             "cache", "cached_value", jnp.zeros, (num_pages, page_size, h, d), v.dtype
         )
-        pos = jnp.clip(positions[:, 0], 0, L - 1).astype(jnp.int32)
+        pos = jnp.clip(positions, 0, L - 1).astype(jnp.int32)  # [B, s]
         table = jnp.asarray(page_table, jnp.int32)
         page_slot = jnp.clip(pos // page_size, 0, pages_per_slot - 1)
-        pid = jnp.take_along_axis(table, page_slot[:, None], axis=1)[:, 0]  # [B]
+        pid = jnp.take_along_axis(table, page_slot, axis=1)  # [B, s]
         off = pos % page_size
-        pool_k.value = pool_k.value.at[pid, off].set(k[:, 0])
-        pool_v.value = pool_v.value.at[pid, off].set(v[:, 0])
+        pool_k.value = pool_k.value.at[pid, off].set(k)
+        pool_v.value = pool_v.value.at[pid, off].set(v)
         # Logical-order read: [B, P, ps, h, d] -> [B, P*ps, h, d]. Same masked
         # attention as the contiguous layout — pool order never leaks.
         k_full = jnp.take(pool_k.value, table, axis=0).reshape(b, L, h, d)
         v_full = jnp.take(pool_v.value, table, axis=0).reshape(b, L, h, d)
-        cols = jnp.arange(L)[None, :]
-        decode_mask = (cols <= pos[:, None])[:, None, None, :]  # [B, 1, 1, L]
+        cols = jnp.arange(L)[None, None, :]
+        decode_mask = (cols <= pos[:, :, None])[:, None, :, :]  # [B, 1, s, L]
         return k_full, v_full, decode_mask
     L = cache_length
     cached_k = module.variable("cache", "cached_key", jnp.zeros, (b, L, h, d), k.dtype)
     cached_v = module.variable("cache", "cached_value", jnp.zeros, (b, L, h, d), v.dtype)
-    pos = jnp.clip(positions[:, 0], 0, L - 1).astype(jnp.int32)
-    rows = jnp.arange(b)
-    cached_k.value = cached_k.value.at[rows, pos].set(k[:, 0])
-    cached_v.value = cached_v.value.at[rows, pos].set(v[:, 0])
-    cols = jnp.arange(L)[None, :]
-    decode_mask = (cols <= pos[:, None])[:, None, None, :]  # [B, 1, 1, L]
+    pos = jnp.clip(positions, 0, L - 1).astype(jnp.int32)  # [B, s]
+    rows = jnp.arange(b)[:, None]
+    cached_k.value = cached_k.value.at[rows, pos].set(k)
+    cached_v.value = cached_v.value.at[rows, pos].set(v)
+    cols = jnp.arange(L)[None, None, :]
+    decode_mask = (cols <= pos[:, :, None])[:, None, :, :]  # [B, 1, s, L]
     return cached_k.value, cached_v.value, decode_mask
 
 

@@ -43,6 +43,16 @@ import numpy as np
 SCRATCH_PAGE = 0
 
 
+def pages_for(num_tokens: int, page_size: int) -> int:
+    """Pages covering `num_tokens` cache positions (ceil division) — the
+    admission footprint formula. Speculative engines pass
+    `prompt + max_new + draft_tokens`: the draft window's rejected writes land
+    through the slot's own page table, so the window counts against the
+    reservation like real tokens (positions past the table's last entry fall
+    through to the scratch page and are discarded)."""
+    return -(-int(num_tokens) // int(page_size))
+
+
 def chain_hashes(tokens, page_size: int) -> List[str]:
     """Chain digest per FULL page of a token sequence: entry i is the SHA-256
     over tokens `[0, (i+1)*page_size)` (running hash, so a page's digest commits

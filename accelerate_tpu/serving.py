@@ -32,6 +32,21 @@ but makes the BATCH dynamic at the host level:
     generation.py's fused loop), EOS + budget masking, and a packed
     `(slot_id, token)` output buffer the host drains for streaming.
 
+  - **speculative decode** (`speculative=True`): each chunk iteration becomes
+    a draft-then-verify step — a host-free n-gram drafter
+    (`speculative.propose_ngram_drafts`) proposes `draft_tokens` continuations
+    from the slot's own observed context, ONE multi-token verify dispatch
+    (`make_causal_programs(..., verify_block=True)` over
+    `update_slot_cache`'s multi-position path) scores all of them, and the
+    longest greedily-confirmed prefix plus one bonus token is emitted — 1 to
+    draft_tokens+1 tokens per dispatch instead of exactly 1, with greedy
+    output token-identical to the plain path by construction. The accept/
+    reject loop, EOS-in-block truncation, and history maintenance are all
+    traced ops inside the one decode executable; the host only pushes its
+    [S, max_length] context mirror as one more per-dispatch operand. Greedy
+    engines only (sampling/repetition-penalty engines raise); paged admission
+    reserves the draft window's pages alongside the request footprint.
+
 Between chunks the host frees finished slots and admits queued requests — a
 late-arriving request starts decoding while earlier long requests are still
 mid-flight. Stale K/V from a slot's previous occupant is never visible: each row
@@ -81,7 +96,13 @@ from .generation import (
     make_causal_programs,
 )
 from .logging import get_logger
-from .paging import SCRATCH_PAGE, PagePool, chain_hashes
+from .paging import SCRATCH_PAGE, PagePool, chain_hashes, pages_for
+from .speculative import (
+    DEFAULT_DRAFT_NGRAM,
+    DEFAULT_DRAFT_TOKENS,
+    greedy_accept_length,
+    propose_ngram_drafts,
+)
 from .telemetry import MetricsRegistry
 from .telemetry.tracing import default_tracer
 from .utils.operations import tree_gather_pages, tree_scatter_pages, tree_scatter_rows
@@ -173,6 +194,9 @@ class ContinuousBatcher:
         page_size: int = 16,
         num_pages: Optional[int] = None,
         prefix_cache: bool = True,
+        speculative: bool = False,
+        draft_tokens: int = DEFAULT_DRAFT_TOKENS,
+        draft_ngram: int = DEFAULT_DRAFT_NGRAM,
     ):
         if getattr(model, "module", None) is None or not hasattr(model.module, "config"):
             raise ValueError("ContinuousBatcher needs a Model bundle built from an in-tree flax module")
@@ -199,6 +223,24 @@ class ContinuousBatcher:
         self.use_repetition_penalty = use_repetition_penalty
         if self.num_slots < 1 or self.chunk_size < 1:
             raise ValueError("num_slots and chunk_size must be >= 1")
+        self.speculative = bool(speculative)
+        self.draft_tokens = int(draft_tokens)
+        self.draft_ngram = int(draft_ngram)
+        if self.speculative:
+            if self.draft_tokens < 1 or self.draft_ngram < 1:
+                raise ValueError("speculative decode needs draft_tokens >= 1 and draft_ngram >= 1")
+            if do_sample:
+                raise ValueError(
+                    "speculative decode is greedy-only: draft verification accepts "
+                    "argmax matches, which is not distribution-preserving under "
+                    "sampling — pass do_sample=False or speculative=False"
+                )
+            if use_repetition_penalty:
+                raise ValueError(
+                    "speculative decode does not compose with use_repetition_penalty "
+                    "(the presence update is order-dependent across a verified "
+                    "block); disable one of the two"
+                )
         self.paged = bool(paged)
         self.page_size = int(page_size)
         if self.paged:
@@ -250,7 +292,9 @@ class ContinuousBatcher:
         prefill_module = type(model.module)(prefill_cfg)
         step_module = type(model.module)(step_cfg)
         self._prefill_raw, _ = make_causal_programs(prefill_module, resolve, full_prefill_logits=True)
-        _, self._step_raw = make_causal_programs(step_module, resolve, step_mask_operand=self.paged)
+        _, self._step_raw, self._verify_raw = make_causal_programs(
+            step_module, resolve, step_mask_operand=self.paged, verify_block=True
+        )
         self._step_module = step_module
         self._resolve = resolve
         if self.paged:
@@ -271,7 +315,7 @@ class ContinuousBatcher:
 
         self._rng = rng if rng is not None else jax.random.key(0)
         self._insert_fns: Dict[int, Any] = {}
-        self._chunk_fn = self._build_chunk()
+        self._chunk_fn = self._build_spec_chunk() if self.speculative else self._build_chunk()
         self._cache = self._init_cache()
         self._presence = (
             jnp.zeros((self.num_slots, base.vocab_size), bool) if use_repetition_penalty else None
@@ -293,6 +337,13 @@ class ContinuousBatcher:
         # dummy so the chunk signature stays uniform (the operand is unused).
         self._page_table = np.zeros((S, self.pages_per_slot if self.paged else 1), np.int32)
         self._slot_pages: List[List[int]] = [[] for _ in range(S)]
+        # Speculative engines: host mirror of each slot's observed context
+        # (prompt + generated, packed from index 0), pushed as a traced operand
+        # each chunk dispatch — the same mirror discipline as _token/_pos. The
+        # device updates its copy inside the scan (drafts must see tokens
+        # emitted earlier in the SAME chunk); the host re-derives identical
+        # content from the drained stream, so nothing is ever read back.
+        self._history = np.zeros((S, self.max_length if self.speculative else 1), np.int32)
 
         self._slot_request: List[Optional[RequestResult]] = [None] * S
         self._queue: deque = deque()
@@ -399,6 +450,33 @@ class ContinuousBatcher:
                 on_evict=self._m_prefix_evictions.inc,
             )
             self._m_pages_total.set(self.pool.pages_total)
+
+        # Speculative-decode telemetry (host-scalar arithmetic over the chunk
+        # readback; docs/observability.md documents the instruments). The
+        # headline derived number — accepted_tokens_per_step — is
+        # (verify_steps + accepted) / verify_steps, surfaced in `stats`.
+        if self.speculative:
+            self._m_spec_steps = self.metrics.counter(
+                "serving_spec_verify_steps_total",
+                help="verify-block loop iterations with an active slot (each emits >= 1 token)",
+            )
+            self._m_spec_drafted = self.metrics.counter(
+                "serving_spec_draft_tokens_total",
+                help="draft tokens proposed by the n-gram drafter (valid proposals only)",
+            )
+            self._m_spec_accepted = self.metrics.counter(
+                "serving_spec_accepted_draft_tokens_total",
+                help="draft tokens confirmed by verification and emitted",
+            )
+            self._m_spec_rejected = self.metrics.counter(
+                "serving_spec_rejected_draft_tokens_total",
+                help="draft tokens the verify step discarded",
+            )
+            self._m_spec_hist = self.metrics.histogram(
+                "serving_spec_accepted_tokens",
+                help="tokens emitted per verify step (accepted drafts + 1 bonus)",
+                buckets=[float(i) for i in range(1, self.draft_tokens + 2)],
+            )
 
     # ------------------------------------------------------------------ programs
 
@@ -576,6 +654,110 @@ class ContinuousBatcher:
         donate = (1, 2) if use_pen else (1,)
         return jax.jit(decode_chunk, donate_argnums=donate)
 
+    def _build_spec_chunk(self):
+        """THE decode executable, speculative flavor: each of the `chunk_size`
+        scan iterations drafts `draft_tokens` continuations per slot with the
+        on-device n-gram drafter, scores the pending token plus every draft in
+        ONE (draft_tokens+1)-position verify dispatch
+        (`make_causal_programs(..., verify_block=True)` through
+        `ops.attention.update_slot_cache`'s multi-token path), and emits the
+        longest greedily-confirmed draft prefix plus one bonus token — up to
+        draft_tokens+1 tokens per slot for one dispatch's latency, 1..k+1
+        always, so it can only match or beat the plain chunk. Accept/reject,
+        EOS-in-block truncation, budget capping, and the history update all
+        run as traced ops: steady state stays this one executable, zero
+        recompiles, zero host reads.
+
+        Rejected draft K/V needs no rollback in either cache mode: the slot's
+        position simply doesn't advance past the accepted prefix, the
+        per-query `cols <= pos` mask keeps stale rows invisible, and the next
+        verify block overwrites them before anything can attend them. (Paged:
+        rejected writes land through the slot's OWN page table — the draft
+        window is part of the admission reservation — or fall through to the
+        scratch page past the table's last real entry.)
+
+        An EOS inside the verified block terminates the request THERE: the
+        block's tail is discarded (not emitted, not counted against the
+        budget), pos stops at the EOS, and the drained result ends with the
+        EOS token — exactly the one-token path's `_trim_at_eos` semantics.
+
+        Beyond the plain chunk's outputs it returns two [chunk, S] int32
+        matrices: tokens emitted per (iteration, slot) and valid drafts
+        proposed — the host folds them into the spec counters/histogram."""
+        S, chunk = self.num_slots, self.chunk_size
+        H = self.max_length
+        verify_inner = self._verify_raw
+        paged = self.paged
+        k_draft, m_gram = self.draft_tokens, self.draft_ngram
+
+        def decode_chunk(params, cache, presence, token, pos, active, rem, eos_ids, temperature, penalty, page_table, rng, history):
+            self.trace_counts["decode_chunk"] += 1
+            js = jnp.arange(k_draft + 1, dtype=jnp.int32)
+            rows = jnp.arange(S)
+
+            def body(carry, _):
+                cache, token, pos, active, rem, history = carry
+                hist_len = pos + 1  # the pending token sits at history[pos]
+                drafts, valid_len = propose_ngram_drafts(history, hist_len, k_draft, m_gram)
+                block = jnp.concatenate([token[:, None], drafts], axis=1)  # [S, k+1]
+                positions = pos[:, None] + js[None, :]
+                if paged:
+                    logits, cache = verify_inner(params, cache, block, positions, page_table)
+                else:
+                    logits, cache = verify_inner(params, cache, block, positions)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1]
+                accept = greedy_accept_length(drafts, greedy[:, :k_draft], valid_len)
+                # Budget cap: emit at most `rem` tokens (accept + 1 bonus).
+                accept = jnp.clip(accept, 0, rem - 1)
+                emit = active[:, None] & (js[None, :] <= accept[:, None])
+                # EOS inside the block ends the request there: keep the EOS,
+                # discard the tail.
+                eos_hit = emit & (eos_ids[:, None] >= 0) & (greedy == eos_ids[:, None])
+                first_eos = jnp.min(jnp.where(eos_hit, js[None, :], k_draft + 1), axis=1)
+                emit &= js[None, :] <= first_eos[:, None]
+                n_emit = emit.sum(axis=1).astype(jnp.int32)  # [S], 0 for inactive
+                new_pos = pos + n_emit
+                new_rem = rem - n_emit
+                finished_eos = first_eos <= accept
+                new_active = active & ~finished_eos & (new_rem > 0)
+                last = jnp.take_along_axis(greedy, jnp.clip(n_emit - 1, 0, k_draft)[:, None], axis=1)[:, 0]
+                new_token = jnp.where(active, last, token)
+                # Append the emitted tokens to the history (the next iteration
+                # drafts over them). Emitted index j lands at history[pos+1+j];
+                # masked positions write back their own gathered values.
+                idx = jnp.clip(pos[:, None] + 1 + js[None, :], 0, H - 1)
+                old = jnp.take_along_axis(history, idx, axis=1)
+                history = history.at[rows[:, None], idx].set(jnp.where(emit, greedy, old))
+                out_tok = jnp.where(emit, greedy, jnp.int32(-1))
+                proposed = jnp.where(active, valid_len, 0).astype(jnp.int32)
+                carry = (cache, new_token, new_pos, new_active, new_rem, history)
+                return carry, (out_tok, emit, n_emit, proposed)
+
+            carry = (cache, token, pos, active, rem, history)
+            carry, (toks, valids, emitted_mat, proposed_mat) = jax.lax.scan(body, carry, None, length=chunk)
+            cache, token, pos, active, rem, history = carry
+            # Pack [chunk, S, k+1] -> (slot, token) stream, time-major per slot
+            # (row-major flatten keeps (iteration, block-index) order within a
+            # slot), valid entries first — same composite key as the plain chunk.
+            n = chunk * S * (k_draft + 1)
+            flat_tok = toks.reshape(n)
+            flat_valid = valids.reshape(n)
+            flat_slot = jnp.broadcast_to(rows[None, :, None], (chunk, S, k_draft + 1)).reshape(n)
+            order = jnp.argsort(jnp.where(flat_valid, 0, n) + jnp.arange(n))
+            packed = jnp.stack(
+                [
+                    jnp.where(flat_valid[order], flat_slot[order], -1),
+                    jnp.where(flat_valid[order], flat_tok[order], -1),
+                ],
+                axis=-1,
+            ).astype(jnp.int32)
+            return (
+                cache, presence, token, pos, active, rem, rng, packed, flat_valid.sum(),
+                emitted_mat, proposed_mat,
+            )
+
+        return jax.jit(decode_chunk, donate_argnums=(1,))
+
     # ---------------------------------------------------------------- host plane
 
     @property
@@ -607,6 +789,20 @@ class ContinuousBatcher:
                 reason: int(counter.value) for reason, counter in self._m_finish.items()
             },
         }
+        if self.speculative:
+            steps = int(self._m_spec_steps.value)
+            accepted = int(self._m_spec_accepted.value)
+            view["speculative"] = {
+                "draft_tokens": self.draft_tokens,
+                "draft_ngram": self.draft_ngram,
+                "verify_steps": steps,
+                "drafted": int(self._m_spec_drafted.value),
+                "accepted": accepted,
+                "rejected": int(self._m_spec_rejected.value),
+                # The headline: mean tokens emitted per verify step. 1.0 means
+                # speculation never helped; k+1 is the ceiling.
+                "accepted_tokens_per_step": round((steps + accepted) / steps, 4) if steps else None,
+            }
         if self.paged:
             view["pages_total"] = self.pool.pages_total
             view["pages_in_use"] = self.pool.pages_in_use
@@ -653,12 +849,14 @@ class ContinuousBatcher:
                 f"exceeds the {self.max_length}-token slot capacity"
             )
         if self.paged:
-            need = -(-(int(ids.size) + request.max_new_tokens) // self.page_size)
+            need = self._pages_needed(int(ids.size), request.max_new_tokens)
             if need > self.pool.pages_total:
                 raise ValueError(
                     f"request needs {need} KV pages ({ids.size} prompt + "
-                    f"{request.max_new_tokens} new tokens at page_size "
-                    f"{self.page_size}) but the pool holds {self.pool.pages_total}"
+                    f"{request.max_new_tokens} new tokens"
+                    + (f" + {self.draft_tokens} draft-window" if self.speculative else "")
+                    + f" at page_size {self.page_size}) but the pool holds "
+                    f"{self.pool.pages_total}"
                 )
         if request.request_id in self.results:
             raise ValueError(f"duplicate request_id {request.request_id}")
@@ -683,6 +881,14 @@ class ContinuousBatcher:
         self._request_spans[request.request_id] = span
         self._update_occupancy_gauges()
         return request.request_id
+
+    def _pages_needed(self, prompt_tokens: int, max_new: int) -> int:
+        """A request's page reservation: its worst-case token footprint, plus —
+        speculative engines — the draft window, whose rejected verify writes
+        land through the slot's own page table (capped at the table width; the
+        cache clips overshoot to its never-attended last cell)."""
+        window = self.draft_tokens if self.speculative else 0
+        return min(pages_for(prompt_tokens + max_new + window, self.page_size), self.pages_per_slot)
 
     # ------------------------------------------------------------- fault isolation
     def _cache_consumed(self) -> bool:
@@ -716,6 +922,11 @@ class ContinuousBatcher:
         self._cache = self._init_cache()
         if self._presence is not None:
             self._presence = jnp.zeros((self.num_slots, self.base_config.vocab_size), bool)
+        if self.speculative:
+            # The speculative state dies with the cache: every slot's drafting
+            # context belonged to a request that just errored. Admissions
+            # reseed their own rows.
+            self._history[:] = 0
         if self.paged:
             # The pool CONTENT died with the donated buffers: every refcount,
             # page-table row and — critically — prefix registration goes with
@@ -826,7 +1037,7 @@ class ContinuousBatcher:
             matched_pages = 0
             matched_len = 0
             if self.paged:
-                total_pages = -(-(p + req.max_new_tokens) // self.page_size)
+                total_pages = self._pages_needed(p, req.max_new_tokens)
                 if self.use_prefix_cache:
                     hashes = chain_hashes(ids, self.page_size)
                     # Cap below the whole prompt: the last real token always
@@ -966,6 +1177,13 @@ class ContinuousBatcher:
                 self._eos[slot] = eos
                 self._temp[slot] = req.temperature
                 self._pen[slot] = req.repetition_penalty
+                if self.speculative:
+                    # Seed the drafter's context: full prompt (prefix-cache
+                    # hits included — the host has the whole prompt even when
+                    # the insert only saw the suffix) plus the first token.
+                    self._history[slot, :p] = ids
+                    self._history[slot, p] = token
+                    self._history[slot, p + 1:] = 0
                 if self.paged:
                     self._slot_pages[slot] = pages
                     self._page_table[slot] = page_row
@@ -1010,8 +1228,9 @@ class ContinuousBatcher:
             slots=",".join(str(i) for i in np.nonzero(self._active)[0]),
             pages_in_use=self.pool.pages_in_use if self.paged else None,
         )
+        pos_before = self._pos.copy()  # spec: where each slot's drained tokens append
         try:
-            out = self._chunk_fn(
+            args = [
                 self.params,
                 self._cache,
                 self._presence,
@@ -1024,7 +1243,10 @@ class ContinuousBatcher:
                 jnp.asarray(self._pen),
                 jnp.asarray(self._page_table),
                 self._rng,
-            )
+            ]
+            if self.speculative:
+                args.append(jnp.asarray(self._history))
+            out = self._chunk_fn(*args)
             # np.array (copy): np.asarray of a jax buffer is a READ-ONLY view,
             # and these mirrors are written in-place at the next admission.
             # The readback sits INSIDE the try: on accelerators the dispatch
@@ -1033,6 +1255,8 @@ class ContinuousBatcher:
             new_cache, new_presence = out[0], out[1]
             token, pos, active, rem = (np.array(x) for x in out[2:6])
             packed, count = np.asarray(out[7]), int(out[8])
+            spec_emitted = np.asarray(out[9]) if self.speculative else None
+            spec_proposed = np.asarray(out[10]) if self.speculative else None
         except Exception as exc:  # noqa: BLE001
             if self.trace_guard is not None:
                 self.trace_guard.observe(exc)
@@ -1051,6 +1275,25 @@ class ContinuousBatcher:
         self._rng = out[6]
         self._m_chunks.inc()
         self._m_decode_steps.inc(self.chunk_size)
+        if self.speculative:
+            # Fold the chunk's per-(iteration, slot) emit/propose matrices into
+            # the spec ledger. Every count is a host scalar off the readback.
+            steps = int((spec_emitted > 0).sum())
+            emitted_total = int(spec_emitted.sum())
+            proposed_total = int(spec_proposed.sum())
+            accepted = emitted_total - steps  # each step emits accepted + 1
+            self._m_spec_steps.inc(steps)
+            self._m_spec_drafted.inc(proposed_total)
+            self._m_spec_accepted.inc(accepted)
+            self._m_spec_rejected.inc(proposed_total - accepted)
+            for v in spec_emitted[spec_emitted > 0]:
+                self._m_spec_hist.observe(float(v))
+            chunk_span.annotate(
+                spec_verify_steps=steps,
+                spec_tokens_emitted=emitted_total,
+                spec_drafts_accepted=accepted,
+                spec_drafts_proposed=proposed_total,
+            )
 
         per_slot: Dict[int, List[int]] = {}
         for slot, tok in packed[:count]:
@@ -1067,6 +1310,12 @@ class ContinuousBatcher:
             if result is None:  # defensive: stream for a freed slot
                 continue
             result.tokens.extend(toks)
+            if self.speculative:
+                # Mirror the device-side history update (emitted token j of the
+                # chunk landed at history[pos_before + 1 + j]) so the next
+                # dispatch pushes an identical context.
+                start = int(pos_before[slot]) + 1
+                self._history[slot, start : start + len(toks)] = toks
             events.append((result.request_id, toks))
             # Inter-token latency: the host drains a slot's tokens once per
             # chunk, so the per-token gap is the drain gap amortized over the

@@ -1,0 +1,108 @@
+"""On-device self-speculation primitives: n-gram / prompt-lookup drafting and
+greedy draft verification.
+
+Classic draft-then-verify speculation amortizes one model dispatch over k
+candidate tokens: a cheap DRAFTER proposes k continuations, one multi-token
+VERIFY dispatch scores all k+1 positions at once, and the longest draft prefix
+that matches the model's own greedy choices is accepted — plus one "bonus"
+token from the verify logits, so every verify step emits at least as much as a
+plain decode step. Greedy output is token-identical to non-speculative decode
+by construction: every emitted token IS the model's argmax given exactly the
+accepted prefix.
+
+This module implements the SELF-speculation variant (Saxena's prompt-lookup
+decoding): the drafter is an n-gram matcher over the request's own observed
+context (prompt + generated tokens), so there is no second model to load,
+shard, or keep in sync — which is what lets the fused decode loop stay ONE
+executable. Both helpers here are pure jax functions with static shapes,
+designed to be traced INSIDE the decode program (`generation.Generator`'s
+fused loop, `serving.ContinuousBatcher`'s chunk scan): no host round-trip ever
+happens between draft, verify, and accept. They are deliberately tiny —
+O(B * H * ngram) integer compares — next to the verify matmuls they ride with.
+
+Degenerate inputs degrade to plain decode, never to wrong output: no n-gram
+match, a context shorter than the n-gram, or an exhausted continuation all
+yield `valid_len == 0`, and `greedy_accept_length` masks every draft position
+at or past `valid_len`, so a useless draft costs one verify dispatch (exactly
+one plain step's work) and emits the same one token a plain step would.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: Default number of draft tokens proposed per verify step.
+DEFAULT_DRAFT_TOKENS = 4
+#: Default n-gram length the drafter matches on (bigram, the prompt-lookup
+#: sweet spot: long enough to be selective, short enough to fire often).
+DEFAULT_DRAFT_NGRAM = 2
+
+
+def propose_ngram_drafts(history, hist_len, draft_tokens: int, ngram: int = DEFAULT_DRAFT_NGRAM):
+    """Prompt-lookup draft proposal, fully on device.
+
+    For each row, take the trailing `ngram` tokens of the observed context,
+    find the MOST RECENT earlier occurrence of that n-gram in the context, and
+    propose the `draft_tokens` tokens that followed it. Proposals are therefore
+    always verbatim continuations of observed context — never out-of-vocab,
+    never fabricated.
+
+    Args:
+        history: [B, H] int32 — observed tokens (prompt + generated) packed at
+            the start of each row; entries at index >= hist_len are ignored.
+        hist_len: [B] (or scalar) int32 — observed length per row, INCLUDING
+            the pending token the next verify step will score.
+        draft_tokens: static k, number of proposals per row.
+        ngram: static match length m (>= 1).
+
+    Returns:
+        (drafts, valid_len): drafts [B, k] int32 and valid_len [B] int32 in
+        [0, k]. Only `drafts[:, :valid_len]` are meaningful proposals (always
+        observed-context continuations); positions at or past valid_len are
+        clipped gather artifacts the verifier must mask (and
+        `greedy_accept_length` does).
+    """
+    if draft_tokens < 1:
+        raise ValueError("draft_tokens must be >= 1")
+    if ngram < 1:
+        raise ValueError("ngram must be >= 1")
+    b, h = history.shape
+    k, m = int(draft_tokens), int(ngram)
+    hist_len = jnp.broadcast_to(jnp.asarray(hist_len, jnp.int32), (b,))
+    starts = jnp.arange(h, dtype=jnp.int32)
+    # Trailing n-gram per row (the query): history[hist_len - m : hist_len].
+    tail_idx = jnp.clip(hist_len[:, None] - m + jnp.arange(m, dtype=jnp.int32)[None, :], 0, h - 1)
+    tail = jnp.take_along_axis(history, tail_idx, axis=1)  # [B, m]
+    # match[b, i] == True iff history[b, i : i + m] equals the tail n-gram.
+    # jnp.roll(-t) aligns history[i + t] at column i; columns where i + t wraps
+    # past H are masked off.
+    match = jnp.ones((b, h), bool)
+    for t in range(m):
+        shifted = jnp.roll(history, -t, axis=1)
+        match &= (shifted == tail[:, t : t + 1]) & ((starts + t) < h)[None, :]
+    # Exclude the trailing occurrence itself (its continuation is the unknown
+    # future) and any start whose n-gram isn't fully inside the observed
+    # context. hist_len < m + 1 leaves no admissible start at all.
+    match &= starts[None, :] < (hist_len[:, None] - m)
+    j = jnp.max(jnp.where(match, starts[None, :], -1), axis=1)  # most recent hit
+    found = j >= 0
+    cont = jnp.clip(j[:, None] + m + jnp.arange(k, dtype=jnp.int32)[None, :], 0, h - 1)
+    drafts = jnp.take_along_axis(history, cont, axis=1).astype(jnp.int32)
+    # Never propose past the observed context: a hit right before the tail has
+    # fewer than k observed continuation tokens.
+    valid_len = jnp.where(found, jnp.minimum(k, hist_len - (j + m)), 0).astype(jnp.int32)
+    return drafts, valid_len
+
+
+def greedy_accept_length(drafts, greedy_targets, valid_len):
+    """Longest accepted draft prefix under greedy verification.
+
+    `greedy_targets[:, i]` is the model's argmax at verify position i — the
+    token the model itself would have emitted after the (accepted) prefix
+    ending at draft i-1. Draft i is accepted iff every earlier draft was
+    accepted, it is a real proposal (`i < valid_len`), and it matches the
+    model's choice. Returns [B] int32 counts in [0, k].
+    """
+    b, k = drafts.shape
+    ok = (drafts == greedy_targets) & (jnp.arange(k, dtype=jnp.int32)[None, :] < valid_len[:, None])
+    return jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1).astype(jnp.int32)

@@ -133,6 +133,87 @@ def pct(values, q):
     return float(np.percentile(np.asarray(values), q))
 
 
+def run_spec_workload(model, args, cfg, max_length, rng, tracer=None):
+    """The speculative A/B: a repetition-heavy workload (each prompt tiles a
+    short motif — prompt-lookup's natural habitat, and greedy decode of small
+    models collapses into loops anyway) served through two otherwise-identical
+    engines, speculation OFF vs ON. The ON pass runs under an armed TraceGuard
+    with the same hard 0-recompile / 0-host-transfer gate as the main timed
+    passes, and reports accepted_tokens_per_step measured over the TIMED pass
+    only — the speedup is a number in the artifact, not a claim."""
+    from accelerate_tpu.analysis import TraceGuard
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    def motif_prompt():
+        motif = rng.integers(1, cfg.vocab_size, (6,)).astype(np.int32)
+        length = int(rng.integers(args.prompt_min, max(args.prompt_min + 1, args.prompt_max // 2)))
+        return np.tile(motif, -(-length // motif.size))[:length].astype(np.int32)
+
+    prompts = [motif_prompt() for _ in range(args.requests)]
+    # Decode-heavy on purpose: full budgets give greedy decode time to settle
+    # into its loops, which is where prompt-lookup acceptance compounds.
+    budgets = [args.max_new_max for _ in range(args.requests)]
+    arrivals = np.cumsum(rng.exponential(args.mean_interarrival, size=args.requests))
+
+    result = {"draft_tokens": args.draft_tokens, "draft_ngram": args.draft_ngram}
+    for label, spec_on in (("plain", False), ("speculative", True)):
+        engine = ContinuousBatcher(
+            model, num_slots=args.num_slots, max_length=max_length,
+            chunk_size=args.chunk_size, paged=not args.no_paged,
+            page_size=args.page_size, tracer=tracer, speculative=spec_on,
+            draft_tokens=args.draft_tokens, draft_ngram=args.draft_ngram,
+        )
+        log(f"speculative workload ({label}): warmup...")
+        # Twice, like the prefix workload: pass 1 compiles per-miss buckets and
+        # registers prefixes, pass 2 compiles the prefix-hit suffix buckets the
+        # timed pass will use.
+        run_continuous(engine, prompts, budgets, arrivals)
+        run_continuous(engine, prompts, budgets, arrivals)
+        registry = engine.metrics
+        steps0 = registry.value("serving_spec_verify_steps_total") or 0
+        accepted0 = registry.value("serving_spec_accepted_draft_tokens_total") or 0
+        guard = TraceGuard(
+            transfer_guard="disallow", on_violation="record",
+            name=f"serving-bench-spec-{label}",
+        )
+        engine.trace_guard = guard
+        with guard:
+            tps, ttfts, iters, span = run_continuous(engine, prompts, budgets, arrivals)
+        if guard.total_recompiles or guard.host_transfers:
+            log(f"TRACE-GUARD VIOLATIONS in speculative workload ({label}): {guard.report().summary()}")
+        # The speculation-overhead pin: the draft/verify chunk must hold the
+        # same steady-state discipline as the plain one.
+        assert guard.total_recompiles == 0 and guard.host_transfers == 0, (
+            f"speculative workload ({label}) regressed the 0-recompile / "
+            f"0-host-transfer discipline: {guard.report().summary()}"
+        )
+        block = {
+            "tokens_per_sec": round(tps, 2),
+            "ttft_p50_ms": round(pct(ttfts, 50) * 1000, 2),
+            "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 2),
+            "makespan_s": round(span, 3),
+            "decode_iterations": iters,
+            "recompiles": guard.total_recompiles,
+            "host_transfers": guard.host_transfers,
+        }
+        if spec_on:
+            steps = (registry.value("serving_spec_verify_steps_total") or 0) - steps0
+            accepted = (registry.value("serving_spec_accepted_draft_tokens_total") or 0) - accepted0
+            block["verify_steps"] = int(steps)
+            block["accepted_draft_tokens"] = int(accepted)
+            block["accepted_tokens_per_step"] = (
+                round((steps + accepted) / steps, 4) if steps else None
+            )
+            block["cumulative"] = engine.stats["speculative"]
+        result[label] = block
+    spec, plain = result["speculative"], result["plain"]
+    result["accepted_tokens_per_step"] = spec["accepted_tokens_per_step"]
+    result["decode_iterations_ratio_plain_over_spec"] = round(
+        plain["decode_iterations"] / max(spec["decode_iterations"], 1), 3
+    )
+    return result
+
+
 def run_prefix_workload(model, args, cfg, max_length, rng, tracer=None):
     """The prefix-heavy serving workload: every request opens with the SAME
     `--prefix-tokens`-long system prompt followed by a random tail. Served
@@ -218,6 +299,12 @@ def main(argv=None):
     parser.add_argument("--no-paged", action="store_true", help="use the contiguous per-slot KV layout (disables the prefix workload)")
     parser.add_argument("--prefix-tokens", type=int, default=None,
                         help="shared system-prompt length for the prefix-heavy workload; default 64 on accelerators, 24 on CPU; 0 disables")
+    parser.add_argument("--no-speculative", action="store_true",
+                        help="skip the speculative-decode A/B workload")
+    parser.add_argument("--draft-tokens", type=int, default=4,
+                        help="draft tokens per verify step in the speculative workload")
+    parser.add_argument("--draft-ngram", type=int, default=2,
+                        help="n-gram length the speculative drafter matches on")
     parser.add_argument("--trace-dir", default=None,
                         help="flight-recorder trace dir (span JSONL + Perfetto dump); default: a fresh temp dir — the artifact path is emitted in extra.telemetry.trace")
     args = parser.parse_args(argv)
@@ -339,6 +426,18 @@ def main(argv=None):
                 "no full page to share; skipping the prefix workload"
             )
 
+    # Speculative-decode A/B: repetition-heavy workload, speculation off vs on,
+    # TraceGuard-armed timed passes (hard 0/0 gate with speculation enabled).
+    spec_block = None
+    if not args.no_speculative:
+        spec_block = run_spec_workload(model, args, cfg, max_length, rng, tracer=tracer)
+        if (spec_block["accepted_tokens_per_step"] or 0) <= 1.0:
+            log(
+                "speculation accepted no drafts on the repetitive workload "
+                f"(accepted_tokens_per_step={spec_block['accepted_tokens_per_step']}) "
+                "— output is still token-identical, but check drafter knobs"
+            )
+
     speedup = c_tps / max(s_tps, 1e-9)
     prefix = "" if on_accel else "cpu-smoke "
 
@@ -426,6 +525,10 @@ def main(argv=None):
             # worse than the uncached run is the prefix-cache acceptance gate.
             "paging": paging_block,
             "prefix_workload": prefix_block,
+            # Speculative A/B (repetition-heavy workload): tokens/sec and
+            # accepted_tokens_per_step, spec-off vs spec-on, both timed passes
+            # TraceGuard-verified at 0 recompiles / 0 host transfers.
+            "speculative_workload": spec_block,
             # Steady-state discipline counters (TraceGuard armed over both
             # timed passes): any nonzero value is a no-recompile regression.
             "recompiles": guard.total_recompiles,

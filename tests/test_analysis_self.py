@@ -70,6 +70,26 @@ def test_paging_module_is_warn_clean():
     )
 
 
+def test_speculative_path_is_warn_clean():
+    """The draft/verify machinery is traced INSIDE the decode executables —
+    the drafter, the accept loop, and the serving/generation integrations must
+    be warn-clean: a stray host sync or jit hazard here would serialize every
+    verify step against the host, the exact overhead speculation exists to
+    amortize away. The scan pins the three files that carry the path so a
+    rename can't make the gate vacuous."""
+    roots = [
+        REPO / "accelerate_tpu" / "speculative.py",
+        REPO / "accelerate_tpu" / "serving.py",
+        REPO / "accelerate_tpu" / "generation.py",
+    ]
+    findings, scanned = analyze_paths([str(r) for r in roots])
+    assert scanned == 3, f"speculative-path files missing? scanned {scanned}"
+    flagged = [f for f in findings if severity_at_least(f.severity, "warn")]
+    assert not flagged, "warn+ TPU hazards on the speculative path:\n" + "\n".join(
+        f"  {f.file}:{f.line}: {f.rule_id} {f.message}" for f in flagged
+    )
+
+
 def test_telemetry_subsystem_is_warn_clean():
     """The observability layer rides the serving/train hot paths — it must be
     completely clean at WARN level, not just error-free: a host-sync or

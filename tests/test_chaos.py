@@ -390,6 +390,33 @@ def test_consumed_donation_rebuilds_page_pool_without_leaks():
     assert ledger.details["pages_total"] > 0
 
 
+def test_consumed_donation_recovers_with_speculation_enabled():
+    """The speculative chunk widens the blast radius's state surface: the
+    draft/verify loop carries a per-slot context history and every paged
+    admission reserves a draft window. An injected chunk failure that consumes
+    the donated cache must rebuild the speculative state too — history
+    reseeded per admission, window pages released with the request — and the
+    post-recovery probes must complete through the draft/verify executable,
+    with the page ledger closing at zero."""
+    plan = FaultPlan(
+        name="chunk-consumes-donation-speculative",
+        events=[FaultEvent(kind="serve.dispatch_error", at_call=3,
+                           args={"consume_donated": True})],
+    )
+    report = ChaosRunner(plan).run_serve(num_requests=8, max_queue=6, speculative=True)
+    assert report.ok, report.render_text()
+    recovered = next(c for c in report.checks if c.name == "engine_recovered")
+    assert recovered.details["requests_after_error"] >= 2
+    ledger = next(c for c in report.checks if c.name == "page_ledger")
+    assert ledger.details["pages_in_use_after_drain"] == 0
+    assert ledger.details["consistency_problems"] == []
+    # the sweep drove the speculative executable, not the plain chunk
+    steps = next(
+        m for m in report.metrics if m["name"] == "serving_spec_verify_steps_total"
+    )
+    assert steps["value"] > 0
+
+
 def test_consumed_donation_recovers_on_the_contiguous_layout_too():
     """paged=False remains a supported fallback (and the only option for model
     families without pool-cache support): its blast-radius recovery must stay
