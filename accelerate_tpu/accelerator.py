@@ -43,11 +43,18 @@ from typing import Any, Callable, List, Optional, Union
 import numpy as np
 
 from .checkpointing import (
+    AsyncCommitter,
+    CheckpointCommitError,
     CheckpointManager,
+    is_sharded_checkpoint_dir,
     load_accelerator_state,
     load_custom_state,
+    load_sharded_accelerator_state,
     save_accelerator_state,
     save_custom_state,
+    sharded_manifest_extra,
+    snapshot_accelerator_state,
+    write_accelerator_snapshot,
     write_checkpoint_manifest,
 )
 from .data_loader import DataLoaderDispatcher, DataLoaderShard, SimpleDataLoader, prepare_data_loader, skip_first_batches
@@ -112,6 +119,8 @@ class Accelerator:
         step_scheduler_with_optimizer: bool = True,
         analyze: bool = False,
         tracer=None,
+        async_save: Optional[bool] = None,
+        sharded_save: Optional[bool] = None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -247,6 +256,30 @@ class Accelerator:
         )
         self._m_ckpt_loads = self.telemetry.counter(
             "checkpoint_loads_total", help="load_state() completions (restart recoveries)"
+        )
+
+        # Async/sharded checkpointing (docs/guides/checkpointing.md): with
+        # `async_save` the train loop only pays for the device->host snapshot
+        # (and a barrier on the PREVIOUS commit when it is still in flight);
+        # serialize+fsync+publish run on a background committer whose time is
+        # `checkpoint_async_commit_seconds`, not goodput-lost step time. With
+        # `sharded_save` each process writes only its addressable shards into a
+        # per-host subdirectory. Defaults ride the launch env protocol
+        # (`launch --async_save` / `--sharded_save`).
+        if async_save is None:
+            async_save = parse_flag_from_env("ACCELERATE_TPU_ASYNC_SAVE")
+        if sharded_save is None:
+            sharded_save = parse_flag_from_env("ACCELERATE_TPU_SHARDED_SAVE")
+        self.async_save = bool(async_save)
+        self.sharded_save = bool(sharded_save)
+        self._async_committer: Optional[AsyncCommitter] = None
+        self._m_ckpt_commit_seconds = self.telemetry.histogram(
+            "checkpoint_async_commit_seconds",
+            help="background (async) checkpoint commit wall-clock — overlapped "
+            "with training, NOT charged to the goodput ledger",
+        )
+        self._g_ckpt_in_flight = self.telemetry.gauge(
+            "checkpoint_commits_in_flight", help="async checkpoint commits currently running"
         )
 
         if self.compilation_config.cache_dir:
@@ -861,6 +894,17 @@ class Accelerator:
             return False
         from .fault_tolerance import PREEMPTED_EXIT_CODE
 
+        # Flush the in-flight async commit BEFORE the preemption save: the
+        # handoff must not leave a background commit racing process exit. A
+        # commit that FAILED is logged, not raised — the preemption checkpoint
+        # about to be written supersedes it.
+        try:
+            self.drain_checkpoints()
+        except CheckpointCommitError as exc:
+            logger.warning(
+                "in-flight async checkpoint commit failed during preemption flush "
+                "(%s); the preemption checkpoint will supersede it", exc,
+            )
         preemption_dir = getattr(self, "_preemption_dir", None)
         if preemption_dir is not None and not self.project_configuration.automatic_checkpoint_naming:
             # The registered dir is a manager base: numbered, rotated, atomically
@@ -869,12 +913,17 @@ class Accelerator:
             manager = CheckpointManager(preemption_dir, keep_last_n=2)
             path = manager.save(
                 manager.next_step(),
-                self._write_state_artifacts,
+                lambda staging: self._write_state_artifacts(staging, None, self.sharded_save),
                 is_main=self.is_main_process,
                 barrier=self.wait_for_everyone,
+                manifest_extra=sharded_manifest_extra(self.num_processes)
+                if self.sharded_save
+                else None,
             )
         else:
-            path = self.save_state(preemption_dir)
+            # ALWAYS synchronous: the process exits right after this save, and
+            # an async commit would race its own death.
+            path = self.save_state(preemption_dir, async_save=False)
         self.print(f"preemption checkpoint saved to {path}")
         if getattr(self, "_preemption_exit", True):
             raise SystemExit(PREEMPTED_EXIT_CODE)
@@ -989,7 +1038,10 @@ class Accelerator:
             tracker.log(values, step=step, **log_kwargs.get(tracker.name, {}))
 
     def end_training(self):
-        """(reference accelerator.py:2678)"""
+        """(reference accelerator.py:2678). Also the shutdown barrier for async
+        checkpointing: the last async commit must land (or surface its failure)
+        before the run is declared over."""
+        self.drain_checkpoints()
         for tracker in self.trackers:
             tracker.finish()
         self.wait_for_everyone()
@@ -1012,20 +1064,55 @@ class Accelerator:
 
     def checkpoint_manager(self, base_dir: Optional[str] = None) -> CheckpointManager:
         """The crash-safe checkpoint store for this run: rooted at the project's
-        `checkpoints/` dir (or an explicit base), rotating to `total_limit`."""
+        `checkpoints/` dir (or an explicit base), rotating to `total_limit`.
+
+        Memoized per (base_dir, keep_last_n): the manager's in-flight-step
+        registry is what makes `next_step()` race-safe against a background
+        committer, and that registry only protects callers sharing the SAME
+        instance — a fresh manager per save_state would never see the step a
+        previous call's commit still has staged."""
         if base_dir is None:
             if self.project_dir is None:
                 raise ValueError("checkpoint_manager needs a project_dir or an explicit base_dir")
             base_dir = os.path.join(self.project_dir, "checkpoints")
-        return CheckpointManager(base_dir, keep_last_n=self.project_configuration.total_limit)
+        key = (str(base_dir), self.project_configuration.total_limit)
+        cache = getattr(self, "_checkpoint_managers", None)
+        if cache is None:
+            cache = self._checkpoint_managers = {}
+        if key not in cache:
+            cache[key] = CheckpointManager(base_dir, keep_last_n=key[1])
+        return cache[key]
 
-    def _write_state_artifacts(self, output_dir: str, save_model_kwargs: Optional[dict] = None):
+    def _write_state_artifacts(
+        self, output_dir: str, save_model_kwargs: Optional[dict] = None, sharded: bool = False
+    ):
         """Write every state artifact into `output_dir` (all processes). The
-        caller owns directory-level atomicity/commit."""
+        caller owns directory-level atomicity/commit. `sharded=True` routes
+        through the snapshot writer so each process lands only its addressable
+        shards in its own `host_*/` subdirectory."""
         for hook in self._save_model_hooks:
             hook(self._models, None, output_dir)
 
         rng_key = self._models[0]._rng if self._models else None
+        if sharded:
+            snapshot = snapshot_accelerator_state(
+                self._models,
+                self._optimizers,
+                self._schedulers,
+                self._dataloaders,
+                rng_key=rng_key,
+                sharded=True,
+                custom_objects=tuple(self._custom_objects),
+            )
+            write_accelerator_snapshot(
+                snapshot,
+                output_dir,
+                process_index=self.process_index,
+                num_processes=self.num_processes,
+                is_main=self.is_main_process,
+                save_on_each_node=self.project_configuration.save_on_each_node,
+            )
+            return
         save_accelerator_state(
             output_dir,
             self._models,
@@ -1041,7 +1128,13 @@ class Accelerator:
             if self.is_main_process:
                 save_custom_state(obj, output_dir, i)
 
-    def save_state(self, output_dir: Optional[str] = None, **save_model_kwargs) -> str:
+    def save_state(
+        self,
+        output_dir: Optional[str] = None,
+        async_save: Optional[bool] = None,
+        sharded: Optional[bool] = None,
+        **save_model_kwargs,
+    ) -> str:
         """Save everything prepared + registered (reference accelerator.py:2830).
 
         With `automatic_checkpoint_naming`, commits
@@ -1052,13 +1145,27 @@ class Accelerator:
         `total_limit`. A kill at ANY byte offset leaves only committed
         checkpoints visible. An explicit `output_dir` writes in place (each
         artifact individually atomic) and finishes with the digest manifest so
-        `load_state` can verify it."""
+        `load_state` can verify it.
+
+        `async_save`/`sharded` override the Accelerator-level knobs per call.
+        An async save blocks only for the device->host snapshot (plus a barrier
+        on the previous commit if it is still in flight); the atomic commit
+        pipeline runs on a background thread, its wall-clock lands in
+        `checkpoint_async_commit_seconds` (a `checkpoint.commit` span) instead
+        of the goodput ledger, and a FAILED commit surfaces as
+        `CheckpointCommitError` on the next save/`drain_checkpoints()` — never
+        silently dropped. The returned path is where the checkpoint WILL
+        publish; call `drain_checkpoints()` before reading it."""
+        async_save = self.async_save if async_save is None else bool(async_save)
+        sharded = self.sharded_save if sharded is None else bool(sharded)
+        if async_save:
+            return self._save_state_async(output_dir, sharded, **save_model_kwargs)
         t0 = time.perf_counter()
         try:
             with self.tracer.span(
                 "checkpoint.save", category="checkpoint", step=int(self.save_iteration)
             ):
-                result = self._save_state_inner(output_dir, **save_model_kwargs)
+                result = self._save_state_inner(output_dir, sharded=sharded, **save_model_kwargs)
         finally:
             # Goodput ledger: checkpoint saves are wall clock the run paid that
             # was not a training step (docs/observability.md) — charged even
@@ -1070,7 +1177,9 @@ class Accelerator:
         self._m_ckpt_seconds.observe(time.perf_counter() - t0)
         return result
 
-    def _save_state_inner(self, output_dir: Optional[str] = None, **save_model_kwargs) -> str:
+    def _save_state_inner(
+        self, output_dir: Optional[str] = None, sharded: bool = False, **save_model_kwargs
+    ) -> str:
         if self.project_configuration.automatic_checkpoint_naming:
             manager = self.checkpoint_manager()
             logger.info(
@@ -1078,9 +1187,10 @@ class Accelerator:
             )
             output_dir = manager.save(
                 self.save_iteration,
-                lambda staging: self._write_state_artifacts(staging, save_model_kwargs),
+                lambda staging: self._write_state_artifacts(staging, save_model_kwargs, sharded),
                 is_main=self.is_main_process,
                 barrier=self.wait_for_everyone,
+                manifest_extra=sharded_manifest_extra(self.num_processes) if sharded else None,
             )
             self.project_configuration.iteration += 1
             return output_dir
@@ -1089,12 +1199,158 @@ class Accelerator:
         self.wait_for_everyone()
         os.makedirs(output_dir, exist_ok=True)
         logger.info("Saving current state to %s", output_dir)
-        self._write_state_artifacts(output_dir, save_model_kwargs)
+        self._write_state_artifacts(output_dir, save_model_kwargs, sharded)
         self.wait_for_everyone()  # every process's artifacts land before the digest scan
         if self.is_main_process:
-            write_checkpoint_manifest(output_dir)
+            write_checkpoint_manifest(
+                output_dir, extra=sharded_manifest_extra(self.num_processes) if sharded else None
+            )
         self.project_configuration.iteration += 1
         return output_dir
+
+    # ------------------------------------------------------------------ async checkpointing
+    def _committer(self) -> AsyncCommitter:
+        if self._async_committer is None:
+            self._async_committer = AsyncCommitter()
+        return self._async_committer
+
+    def _save_state_async(
+        self, output_dir: Optional[str], sharded: bool, **save_model_kwargs
+    ) -> str:
+        """Snapshot-then-commit: the train loop pays only for (a) a barrier on
+        the PREVIOUS commit when it is still in flight and (b) the device->host
+        state snapshot; serialize+fsync+atomic-publish run on the background
+        committer. Only the blocking portion charges the goodput ledger."""
+        if self.num_processes > 1 and not sharded:
+            raise ValueError(
+                "async_save with num_processes > 1 requires sharded=True: the background "
+                "committer cannot run collective barriers, so cross-host commits "
+                "coordinate through the per-host shard sentinels"
+            )
+        t0 = time.perf_counter()
+        committer = self._committer()
+        step = int(self.save_iteration)
+        try:
+            with self.tracer.span(
+                "checkpoint.save", category="checkpoint", step=step, mode="async"
+            ):
+                # The barrier: the previous async commit must finish before its
+                # successor snapshots (one in-flight commit bounds host memory),
+                # and ITS failure surfaces here instead of being dropped.
+                committer.wait()
+                if self._save_model_hooks:
+                    logger.warning(
+                        "async_save runs registered save-state hooks on the committer "
+                        "thread against live objects; use synchronous saves if a hook "
+                        "reads state that training mutates"
+                    )
+                rng_key = self._models[0]._rng if self._models else None
+                snapshot = snapshot_accelerator_state(
+                    self._models,
+                    self._optimizers,
+                    self._schedulers,
+                    self._dataloaders,
+                    rng_key=rng_key,
+                    sharded=sharded,
+                    custom_objects=tuple(self._custom_objects),
+                )
+                if self.project_configuration.automatic_checkpoint_naming:
+                    manager = self.checkpoint_manager()
+                    final = os.path.join(manager.base_dir, f"checkpoint_{step}")
+
+                    def writer(abort):
+                        manager.save(
+                            step,
+                            lambda staging: self._commit_snapshot(staging, snapshot, abort),
+                            is_main=self.is_main_process,
+                            abort=abort,
+                            manifest_extra=sharded_manifest_extra(self.num_processes)
+                            if sharded
+                            else None,
+                        )
+                else:
+                    if output_dir is None:
+                        raise ValueError(
+                            "output_dir is required when automatic_checkpoint_naming is off"
+                        )
+                    final = str(output_dir)
+
+                    def writer(abort):
+                        os.makedirs(final, exist_ok=True)
+                        self._commit_snapshot(final, snapshot, abort)
+                        if self.is_main_process:
+                            write_checkpoint_manifest(
+                                final,
+                                extra=sharded_manifest_extra(self.num_processes)
+                                if sharded
+                                else None,
+                            )
+
+                self.project_configuration.iteration += 1
+        finally:
+            # Only the BLOCKING portion is goodput-lost step time; the
+            # background commit reports through checkpoint_async_commit_seconds.
+            blocking = time.perf_counter() - t0
+            self.timeline.charge("checkpoint", blocking)
+        self._m_ckpt_seconds.observe(blocking)
+        logger.info("Async save of step %d accepted; committing to %s in background", step, final)
+
+        def timed_commit(abort):
+            c0 = time.perf_counter()
+            self._g_ckpt_in_flight.set(1)
+            try:
+                with self.tracer.span(
+                    "checkpoint.commit", category="checkpoint", step=step, mode="async"
+                ):
+                    writer(abort)
+            finally:
+                self._g_ckpt_in_flight.set(0)
+                self._m_ckpt_commit_seconds.observe(time.perf_counter() - c0)
+            self._m_ckpt_saves.inc()  # success only, like the sync path
+
+        committer.submit(timed_commit, label=f"checkpoint_{step}")
+        return final
+
+    def _commit_snapshot(self, output_dir: str, snapshot: dict, abort=None):
+        """Committer-thread artifact writer: save hooks (live objects — see the
+        async_save warning) + the snapshot serialization."""
+        for hook in self._save_model_hooks:
+            hook(self._models, None, output_dir)
+        write_accelerator_snapshot(
+            snapshot,
+            output_dir,
+            process_index=self.process_index,
+            num_processes=self.num_processes,
+            is_main=self.is_main_process,
+            save_on_each_node=self.project_configuration.save_on_each_node,
+            abort=abort,
+        )
+
+    def drain_checkpoints(self, timeout: Optional[float] = None):
+        """Barrier on the in-flight async commit. Raises `CheckpointCommitError`
+        if it failed — the failure-surfacing contract's shutdown edge: call
+        before reading a just-saved checkpoint, at end of training, or before a
+        preemption handoff."""
+        if self._async_committer is not None:
+            self._async_committer.drain(timeout)
+
+    def poll_async_checkpoint(self):
+        """Non-blocking: re-raise a process-death-class failure (an injected
+        kill, KeyboardInterrupt) from the background committer. Ordinary commit
+        failures keep to the barrier contract and surface at the next
+        save/drain. Call at step boundaries (chaos and supervised loops do)."""
+        if self._async_committer is not None:
+            self._async_committer.poll()
+
+    def abort_async_checkpoint(self, timeout: float = 30.0):
+        """Hard shutdown: abort the in-flight commit (it will NOT publish) and
+        join without raising. Returns the commit's stored failure, if any. The
+        committer is single-use after an abort; the next async save builds a
+        fresh one."""
+        committer, self._async_committer = self._async_committer, None
+        if committer is None:
+            return None
+        return committer.abort_and_join(timeout)
 
     def load_state(self, input_dir: Optional[str] = None, **load_model_kwargs):
         """(reference accelerator.py:2995)
@@ -1117,6 +1373,14 @@ class Accelerator:
         return result
 
     def _load_state_inner(self, input_dir: Optional[str] = None, **load_model_kwargs):
+        # A resume in the same process as an async save must see the commit
+        # land (or fall back past it) — resolve() before the drain could miss
+        # the newest checkpoint. A FAILED commit downgrades to a warning: the
+        # whole point of resolve() is falling back to the last good save.
+        try:
+            self.drain_checkpoints()
+        except CheckpointCommitError as exc:
+            logger.warning("async commit failed before load_state (%s); resolving past it", exc)
         if input_dir == "latest":
             input_dir = None
         if input_dir is None:
@@ -1142,9 +1406,18 @@ class Accelerator:
         for hook in self._load_model_hooks:
             hook(self._models, input_dir)
 
-        rng_key = load_accelerator_state(
-            input_dir, self._models, self._optimizers, self._schedulers, self._dataloaders
-        )
+        if is_sharded_checkpoint_dir(input_dir):
+            # Per-host sharded checkpoint: gather-on-load assembles each tree
+            # from every host's shard files, then placement re-shards onto the
+            # CURRENT mesh — the same code path restores a pod checkpoint on
+            # its own topology or on a single recovery host.
+            rng_key = load_sharded_accelerator_state(
+                input_dir, self._models, self._optimizers, self._schedulers, self._dataloaders
+            )
+        else:
+            rng_key = load_accelerator_state(
+                input_dir, self._models, self._optimizers, self._schedulers, self._dataloaders
+            )
         if rng_key is not None and self._models:
             self._models[0]._rng = rng_key
         for i, obj in enumerate(self._custom_objects):

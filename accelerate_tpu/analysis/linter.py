@@ -43,6 +43,26 @@ LAX_TRACED_FN_CONSUMERS = {
 #: The tracing API surface (`telemetry.tracing`): calls whose arguments are
 #: span annotations, and whose `with` blocks wrap hot-path dispatches.
 SPAN_API_ATTRS = {"span", "start_span", "event", "annotate"}
+#: Blocking checkpoint-I/O entry points (`accelerate_tpu.checkpointing` + the
+#: Accelerator facade): serialize/fsync/digest work that must never run inside
+#: a traced program (rule TPU113). Matched as a bare name or the final
+#: attribute of a call chain (`accelerator.save_state(...)`, `mgr.save(...)`
+#: is deliberately NOT here — `.save` alone is too generic).
+CHECKPOINT_IO_CALLS = {
+    "save_pytree",
+    "save_pytree_host_shards",
+    "save_pytree_shards",
+    "save_accelerator_state",
+    "write_accelerator_snapshot",
+    "save_state",
+    "load_state",
+    "atomic_write",
+    "atomic_write_bytes",
+    "atomic_write_json",
+    "file_sha256",
+    "write_checkpoint_manifest",
+    "save_custom_state",
+}
 
 _SUPPRESS_LINE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
 _SUPPRESS_FILE = re.compile(r"#\s*tpu-lint:\s*disable-file=([A-Za-z0-9_,\- ]+)")
@@ -381,6 +401,7 @@ class _FunctionChecker:
                 self._check_item(node)
                 self._check_scalar_cast(node)
                 self._check_numpy_transfer(node)
+                self._check_checkpoint_io(node)
             elif isinstance(node, (ast.If, ast.While)):
                 if self._is_traced_expr(node.test):
                     kind = "if" if isinstance(node, ast.If) else "while"
@@ -398,6 +419,26 @@ class _FunctionChecker:
                 "TPU101",
                 ".item() inside jit-reachable code syncs the device and fails "
                 "under tracing",
+            )
+
+    def _check_checkpoint_io(self, node: ast.Call):
+        """TPU113: blocking checkpoint I/O in jit-reachable code. Serialize +
+        fsync under trace is a host sync when it works and a tracer leak when
+        it doesn't; checkpoints belong at step boundaries (async_save moves
+        even the boundary cost to a background committer)."""
+        func = node.func
+        name = None
+        if isinstance(func, ast.Name) and func.id in CHECKPOINT_IO_CALLS:
+            name = func.id
+        elif isinstance(func, ast.Attribute) and func.attr in CHECKPOINT_IO_CALLS:
+            name = func.attr
+        if name is not None:
+            self.emit(
+                node,
+                "TPU113",
+                f"{name}() is blocking checkpoint I/O inside jit-reachable code — "
+                "checkpoint from host code at the step boundary (async_save commits "
+                "in the background)",
             )
 
     def _check_scalar_cast(self, node: ast.Call):

@@ -148,6 +148,17 @@ RULES = (
         "instrumentation-side read hides a blocking device sync in the very "
         "code that exists to observe the hot path",
     ),
+    Rule(
+        id="TPU113",
+        slug="blocking-ckpt-in-jit",
+        severity="error",
+        summary="blocking checkpoint I/O (save_pytree/atomic_write/save_state/"
+        "file_sha256/...) called inside jit-reachable code",
+        fixit="checkpoint at the step boundary from host code — snapshot the "
+        "state (snapshot_pytree) and hand it to save_state (async_save=True "
+        "commits it on the background committer); serialize+fsync inside a "
+        "traced program is a host sync at best and a trace error at worst",
+    ),
 )
 
 RULES_BY_ID = {r.id: r for r in RULES}

@@ -261,7 +261,15 @@ class FilesystemInjector:
 
     def on_publish_rename(self, staging: str, final: str):
         """Before `CheckpointManager._publish`'s directory rename (transient
-        publish I/O errors land here)."""
+        publish I/O errors land here, and so does the publish-window kill: the
+        staged checkpoint — manifest included — is fully on disk, the rename
+        has not run, so a death here must leave the PREVIOUS published
+        checkpoint as the resolvable latest. The async-commit sweeps aim this
+        at the background committer thread)."""
+        for ev in self.session.fire("fs.crash_in_rename", path=final):
+            raise InjectedKill(
+                f"chaos: killed in publish-rename window of {os.path.basename(final)}"
+            )
         for ev in self.session.fire("fs.io_error", path=final):
             code = _ERRNO_BY_NAME.get(str(ev.args.get("errno", "EIO")).upper(), _errno.EIO)
             raise OSError(code, os.strerror(code), final)

@@ -40,8 +40,10 @@ FAULT_KINDS: Dict[str, str] = {
     ),
     "fs.slow_fsync": "stall args.delay_s seconds (default 0.05) inside a matching artifact's fsync",
     "fs.crash_in_rename": (
-        "die (InjectedKill) inside atomic_write's rename window — after the payload fsync, "
-        "before os.replace commits the matching artifact"
+        "die (InjectedKill) inside a rename window: atomic_write's (payload fsynced, os.replace "
+        "not yet run) for a matching artifact, or CheckpointManager._publish's directory rename "
+        "for a matching checkpoint dir (pattern 'checkpoint_*') — on an async save this kills "
+        "the background committer mid-commit"
     ),
     "proc.sigkill": (
         "hard kill at a matching step boundary: SIGKILL to self in subprocess workloads, "
@@ -109,20 +111,32 @@ class FaultEvent:
         return cls(**data)
 
 
+#: Workloads a plan may declare as its intended harness (`ChaosRunner` entry
+#: points; the CLI's default when `--workload` is omitted).
+PLAN_WORKLOADS = ("train", "async-train", "serve", "supervised-train")
+
+
 @dataclass
 class FaultPlan:
     """A named, seeded fault schedule. The seed drives every random choice a
-    chaos workload makes (data, prompts), so one plan is one exact repro."""
+    chaos workload makes (data, prompts), so one plan is one exact repro.
+    `workload` optionally names the harness the plan was written against
+    (e.g. ``async-train`` for the async-commit-boundary sweeps)."""
 
     name: str = "chaos"
     seed: int = 0
     events: List[FaultEvent] = field(default_factory=list)
     notes: str = ""
+    workload: Optional[str] = None
 
     def __post_init__(self):
         self.events = [
             ev if isinstance(ev, FaultEvent) else FaultEvent.from_dict(ev) for ev in self.events
         ]
+        if self.workload is not None and self.workload not in PLAN_WORKLOADS:
+            raise ValueError(
+                f"unknown plan workload {self.workload!r}; known: {PLAN_WORKLOADS}"
+            )
 
     # ------------------------------------------------------------------ (de)serialization
     def to_dict(self) -> dict:
@@ -131,6 +145,7 @@ class FaultPlan:
             "seed": self.seed,
             "events": [ev.to_dict() for ev in self.events],
             **({"notes": self.notes} if self.notes else {}),
+            **({"workload": self.workload} if self.workload else {}),
         }
 
     def to_json(self, indent: Optional[int] = 2) -> str:
@@ -143,6 +158,7 @@ class FaultPlan:
             seed=int(data.get("seed", 0)),
             events=[FaultEvent.from_dict(ev) for ev in data.get("events", [])],
             notes=data.get("notes", ""),
+            workload=data.get("workload"),
         )
 
     @classmethod
@@ -207,6 +223,29 @@ def builtin_plans() -> Dict[str, FaultPlan]:
                 FaultEvent(kind="serve.dispatch_stall", at_call=2, args={"delay_s": 0.02}),
                 FaultEvent(kind="serve.queue_burst", at_step=1, args={"count": 6}),
                 FaultEvent(kind="serve.dispatch_error", at_call=4),
+            ],
+        ),
+        "smoke-async-ckpt": FaultPlan(
+            name="smoke-async-ckpt",
+            seed=0,
+            workload="async-train",
+            notes="async-checkpoint recovery chain: a SIGKILL lands while a slowed background "
+            "commit is still in flight (the commit must not publish after the death), a later "
+            "committer dies inside an artifact's rename window, and a post-publish torn write "
+            "corrupts the newest checkpoint — resume exactness and no-torn-resolved must hold "
+            "with every commit running on the background committer",
+            events=[
+                # Slow the step-1 commit's model fsync so the step-boundary kill
+                # below fires while that commit is provably still in flight.
+                FaultEvent(kind="fs.slow_fsync", path_pattern="model.npz*", at_call=2,
+                           args={"delay_s": 0.25}),
+                FaultEvent(kind="proc.sigkill", at_step=1),
+                # After the restart: a committer death inside an artifact's
+                # rename window (the commit must abort unpublished).
+                FaultEvent(kind="fs.crash_in_rename", path_pattern="optimizer.npz*", at_call=5),
+                # And a post-publish torn write: resolve() must fall back.
+                FaultEvent(kind="fs.torn_write", path_pattern="model.npz*", at_call=6,
+                           args={"offset": 1}),
             ],
         ),
         "seeded-regression": FaultPlan(

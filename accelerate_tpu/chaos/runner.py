@@ -127,11 +127,12 @@ def params_digest(model) -> str:
     return h.hexdigest()
 
 
-def build_train_workload(base_dir: str, keep_last_n: int, seed: int):
+def build_train_workload(base_dir: str, keep_last_n: int, seed: int, async_save: bool = False):
     """The canonical tiny train workload — shared by the in-process runner and
     the subprocess `chaos.workload`, so both sides of the supervised story
     exercise (and journal) the same thing. Returns (accelerator, model, opt,
-    prepared_dataloader)."""
+    prepared_dataloader). `async_save=True` arms snapshot-then-commit saves
+    (the async-commit-boundary sweeps' workload)."""
     import optax
 
     from .. import Accelerator, SimpleDataLoader
@@ -144,7 +145,8 @@ def build_train_workload(base_dir: str, keep_last_n: int, seed: int):
             project_dir=str(base_dir),
             automatic_checkpoint_naming=True,
             total_limit=keep_last_n,
-        )
+        ),
+        async_save=async_save,
     )
     n = 16
     data = [RegressionDataset(length=n, seed=seed)[i] for i in range(n)]
@@ -288,14 +290,27 @@ class ChaosRunner:
         max_restarts: int = 16,
         keep_last_n: int = 3,
         downtime_budget_s: float = 5.0,
+        async_save: bool = False,
     ) -> InvariantReport:
         """In-process supervised train loop: RegressionModel, one checkpoint per
         step, chaos polled at every boundary. An `InjectedKill` ends an attempt
         exactly like a SIGKILL ends a process (no cleanup runs in the workload);
         the runner then 'respawns' — fresh Accelerator, resume from latest —
-        until the run completes or the restart budget is spent."""
+        until the run completes or the restart budget is spent.
+
+        `async_save=True` runs every save through the snapshot-then-commit
+        background committer: a kill while a commit is in flight ABORTS the
+        commit before 'respawning' (a dead process cannot publish), a committer
+        that dies of an injected kill surfaces at the next step boundary
+        exactly like a process death, and an ordinary commit failure (EIO
+        retries exhausted) surfaces as `CheckpointCommitError` on the next
+        save's barrier — counted as a crash, restarted, and the previously
+        published checkpoint must still resolve."""
+        from ..checkpointing import CheckpointCommitError
+
         journal: Dict[str, Any] = {
-            "attempts": 0, "graceful_exits": 0, "saves": [], "intents": [], "resumes": [],
+            "attempts": 0, "graceful_exits": 0, "commit_failures": 0,
+            "saves": [], "intents": [], "resumes": [],
         }
         ledger: Dict[str, float] = {}
         restarts = 0
@@ -310,7 +325,10 @@ class ChaosRunner:
                 )
                 try:
                     with self.tracer.activate(attempt_span):
-                        self._train_attempt(base_dir, steps, keep_last_n, boundary, journal, ledger)
+                        self._train_attempt(
+                            base_dir, steps, keep_last_n, boundary, journal, ledger,
+                            async_save=async_save,
+                        )
                     attempt_span.annotate(outcome="completed").end()
                     completed = True
                     break
@@ -323,6 +341,16 @@ class ChaosRunner:
                     self.tracer.event(
                         "chaos.crash_boundary", category="chaos",
                         attempt=journal["attempts"], kind="sigkill",
+                    )
+                except CheckpointCommitError:
+                    # A failed (not killed) background commit surfaced at the
+                    # barrier: production's train loop crashes on it and the
+                    # supervisor restarts — the runner plays both parts.
+                    journal["commit_failures"] += 1
+                    attempt_span.annotate(outcome="commit_failed").end()
+                    self.tracer.event(
+                        "chaos.crash_boundary", category="chaos",
+                        attempt=journal["attempts"], kind="commit_failure",
                     )
                 except _GracefulPreemption:
                     attempt_span.annotate(outcome="preempted").end()
@@ -343,10 +371,10 @@ class ChaosRunner:
             self._check_no_torn_resolved(journal, checkpoint_base),
             self._check_restart_budget(completed, restarts, max_restarts, downtime_s,
                                        downtime_budget_s),
-            self._check_ledger_reconciles(ledger, journal),
+            self._check_ledger_reconciles(ledger, journal, async_save=async_save),
             self._check_trace_complete(journal),
         ]
-        return self._report("train", checks)
+        return self._report("async-train" if async_save else "train", checks)
 
     def _train_attempt(
         self,
@@ -356,10 +384,14 @@ class ChaosRunner:
         boundary: StepBoundaryInjector,
         journal: Dict[str, Any],
         ledger: Dict[str, float],
+        async_save: bool = False,
     ):
-        accelerator, model, opt, pdl = build_train_workload(base_dir, keep_last_n, self.plan.seed)
+        accelerator, model, opt, pdl = build_train_workload(
+            base_dir, keep_last_n, self.plan.seed, async_save=async_save
+        )
         handler = accelerator.register_preemption_checkpoint(exit_on_save=False)
         stream = None
+        finished_cleanly = False
         try:
             manager = accelerator.checkpoint_manager()
             start_step = 0
@@ -395,28 +427,51 @@ class ChaosRunner:
                     # but before save_state returns leaves a committed
                     # checkpoint the journal would otherwise not know the
                     # digest of.
+                    intended_step = accelerator.save_iteration
                     journal["intents"].append(
-                        {"step": accelerator.save_iteration, "digest": digest}
+                        {"step": intended_step, "digest": digest}
                     )
                     path = accelerator.save_state()
                     journal["saves"].append({
                         "attempt": journal["attempts"],
-                        "step": manifest_step(path),
+                        # An async save's manifest does not exist yet when
+                        # save_state returns — the intended step is the record
+                        # (the intent above already carries the same pair).
+                        "step": intended_step if async_save else manifest_step(path),
                         "digest": digest,
                         "path": path,
                     })
                 # Chaos fires AT the boundary, outside the step span: a kill
                 # here models SIGKILL-between-steps, not a mid-step death.
                 boundary.poll(step)
+                # A background committer that died of an injected kill is a
+                # process death: surface it at the boundary, like a SIGKILL.
+                accelerator.poll_async_checkpoint()
                 if handler.preemption_requested:
                     raise _GracefulPreemption()
+            # A completed run's final commit must land (or surface its failure)
+            # before the attempt is declared done.
+            accelerator.drain_checkpoints()
+            finished_cleanly = True
         finally:
             if stream is not None:
                 # A kill mid-iteration leaves the loader generator suspended;
                 # close it here instead of letting GC tear it down mid-suite.
                 stream.close()
+            if not finished_cleanly:
+                # Process-death semantics for the background committer: a dead
+                # process cannot publish. Abort the in-flight commit (it stops
+                # at the next phase boundary, leaving only staging litter) and
+                # join without raising — the attempt is already dying of the
+                # original kill.
+                accelerator.abort_async_checkpoint()
             for cause, seconds in accelerator.timeline.goodput()["lost_s"].items():
                 ledger[cause] = ledger.get(cause, 0.0) + seconds
+            commit_hist = getattr(accelerator, "_m_ckpt_commit_seconds", None)
+            if commit_hist is not None and commit_hist.count:
+                ledger["checkpoint_async_commit"] = (
+                    ledger.get("checkpoint_async_commit", 0.0) + commit_hist.sum
+                )
             handler.uninstall()
 
     # ---------------------------------------------------------------- supervised train
@@ -426,11 +481,15 @@ class ChaosRunner:
         steps: int = 5,
         max_restarts: int = 4,
         downtime_budget_s: float = 30.0,
+        async_save: bool = False,
     ) -> InvariantReport:
         """The end-to-end path: the real `Supervisor` restarting a real
         subprocess workload (`python -m accelerate_tpu.chaos.workload`), the
         plan propagated through ``ACCELERATE_TPU_FAULT_PLAN`` exactly as
-        `accelerate-tpu launch --fault_plan` would."""
+        `accelerate-tpu launch --fault_plan` would. With `async_save` the
+        workload saves through the background committer and a `proc.sigkill`
+        is a REAL SIGKILL — a commit genuinely in flight dies mid-write, the
+        strongest form of the kill-during-background-commit sweep."""
         from ..fault_tolerance import PREEMPTED_EXIT_CODE, Supervisor
 
         base_dir = str(base_dir)
@@ -442,7 +501,7 @@ class ChaosRunner:
         cmd = [
             sys.executable, "-m", "accelerate_tpu.chaos.workload",
             "--base-dir", base_dir, "--steps", str(steps),
-        ]
+        ] + (["--async-save"] if async_save else [])
         # A clean preemption handoff (exit 143) ENDS supervision by design —
         # in production the scheduler respawns the whole job. The runner plays
         # the scheduler: re-run the supervisor after each handoff (counted
@@ -924,7 +983,7 @@ class ChaosRunner:
         )
 
     def _check_ledger_reconciles(
-        self, ledger: Dict[str, float], journal: Dict[str, Any]
+        self, ledger: Dict[str, float], journal: Dict[str, Any], async_save: bool = False
     ) -> InvariantCheck:
         counts = self.session.counts()
         registry_ok = all(
@@ -937,10 +996,23 @@ class ChaosRunner:
             for i, ev in enumerate(self.plan.events)
             if ev.kind == "fs.slow_fsync"
         )
-        # Injected fsync stalls happen inside save_state, so the goodput
-        # ledger's "checkpoint" cause must carry at least that much (10%
-        # scheduling tolerance); every resume charges "restart".
-        checkpoint_ok = ledger.get("checkpoint", 0.0) >= 0.9 * injected_fsync_s
+        if async_save:
+            # Async saves: an injected stall runs on the background committer,
+            # so its time must land in checkpoint_async_commit_seconds (folded
+            # into the ledger as "checkpoint_async_commit") and/or in the
+            # blocking barrier charge when the next save caught the commit in
+            # flight — never vanish. An ABORTED commit (killed mid-stall)
+            # legitimately truncates its recording, so the sweep-stable
+            # assertion is existence, not magnitude: stalls injected => commit
+            # and/or blocking time was accounted. The exact only-blocking-time
+            # split is pinned by the deterministic goodput property test.
+            accounted = ledger.get("checkpoint", 0.0) + ledger.get("checkpoint_async_commit", 0.0)
+            checkpoint_ok = injected_fsync_s == 0.0 or accounted > 0.0
+        else:
+            # Injected fsync stalls happen inside save_state, so the goodput
+            # ledger's "checkpoint" cause must carry at least that much (10%
+            # scheduling tolerance); every resume charges "restart".
+            checkpoint_ok = ledger.get("checkpoint", 0.0) >= 0.9 * injected_fsync_s
         restart_ok = (not journal["resumes"]) or ledger.get("restart", 0.0) > 0.0
         return InvariantCheck(
             "ledger_reconciles",
@@ -950,6 +1022,7 @@ class ChaosRunner:
                 "registry_matches_journal": registry_ok,
                 "goodput_ledger_s": {k: round(v, 6) for k, v in sorted(ledger.items())},
                 "injected_fsync_s": round(injected_fsync_s, 6),
+                "async_save": async_save,
             },
         )
 

@@ -29,6 +29,12 @@ def main(argv=None) -> int:
     parser.add_argument("--base-dir", required=True, help="project dir (checkpoints + journal)")
     parser.add_argument("--steps", type=int, default=5)
     parser.add_argument("--keep-last-n", type=int, default=3)
+    parser.add_argument(
+        "--async-save", action="store_true",
+        help="save through the background committer (snapshot-then-commit): a real "
+        "SIGKILL at a step boundary then lands while the commit is genuinely in "
+        "flight on another thread",
+    )
     args = parser.parse_args(argv)
 
     plan = FaultPlan.from_env() or FaultPlan(name="empty")
@@ -52,7 +58,9 @@ def main(argv=None) -> int:
     session.on_inject = lambda entry: journal({"type": "injection", **entry})
     journal({"type": "attempt", "pid": os.getpid()})
 
-    accelerator, model, opt, pdl = build_train_workload(args.base_dir, args.keep_last_n, plan.seed)
+    accelerator, model, opt, pdl = build_train_workload(
+        args.base_dir, args.keep_last_n, plan.seed, async_save=args.async_save
+    )
     accelerator.register_preemption_checkpoint()  # real SIGTERM latch + exit 143
 
     boundary = StepBoundaryInjector(session, hard=True)
@@ -85,10 +93,19 @@ def main(argv=None) -> int:
                 opt.step()
                 opt.zero_grad()
                 digest = params_digest(model)
-                journal({"type": "intent", "step": accelerator.save_iteration, "digest": digest})
+                intended_step = accelerator.save_iteration
+                journal({"type": "intent", "step": intended_step, "digest": digest})
                 path = accelerator.save_state()
-                journal({"type": "save", "step": manifest_step(path), "digest": digest, "path": path})
+                journal({
+                    "type": "save",
+                    # Async: the manifest lands when the background commit
+                    # publishes; the intended step is the journal record.
+                    "step": intended_step if args.async_save else manifest_step(path),
+                    "digest": digest,
+                    "path": path,
+                })
             boundary.poll(step)
+            accelerator.poll_async_checkpoint()
             if accelerator.preemption_requested:
                 # Journal the preemption checkpoint's intent first: params are
                 # unchanged since this step's save, so the digest carries over.
@@ -97,7 +114,10 @@ def main(argv=None) -> int:
                 })
                 journal({"type": "graceful_exit", "step": step})
                 attempt_span.annotate(outcome="preempted").end()
-                accelerator.check_preemption()  # saves + SystemExit(143)
+                accelerator.check_preemption()  # flushes async commits, saves + SystemExit(143)
+        # A completed run's last background commit must be durable before the
+        # worker reports success to the Supervisor.
+        accelerator.drain_checkpoints()
     attempt_span.annotate(outcome="completed").end()
     return 0
 

@@ -30,6 +30,7 @@ through `CheckpointManager` (reference accelerator.py:2868-2894).
 
 from __future__ import annotations
 
+import copy
 import hashlib
 import json
 import os
@@ -37,9 +38,10 @@ import pickle
 import random
 import shutil
 import tempfile
+import threading
 import time
 from pathlib import Path
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -63,6 +65,13 @@ CHECKPOINT_MANIFEST_NAME = "MANIFEST.json"
 LATEST_POINTER_NAME = "latest"
 _STAGING_PREFIX = ".tmp-"
 
+# Per-host sharded layout: each process writes only its addressable shards into
+# `host_{process_index:04d}/` inside the checkpoint directory; `SHARD_DONE` is
+# the host's last artifact (the cross-host commit sentinel rank 0 waits on
+# before the digest scan).
+SHARD_HOST_PREFIX = "host_"
+SHARD_DONE_NAME = "SHARD_DONE"
+
 # Chaos seam (`accelerate_tpu.chaos.injectors.FilesystemInjector`): when armed,
 # consulted at the fault-relevant points of the commit sequence — artifact
 # write entry, the payload fsync, the rename window, the directory publish.
@@ -72,6 +81,15 @@ _chaos_hooks = None
 
 class CheckpointCorruptError(RuntimeError):
     """An artifact failed digest verification (torn write, bit rot, truncation)."""
+
+
+class CheckpointCommitError(RuntimeError):
+    """A checkpoint commit failed (or was aborted) after the save was accepted.
+
+    For asynchronous saves this is how the failure-surfacing contract is kept:
+    the background committer stores its failure and the NEXT barrier — the
+    following `save_state`, an explicit `drain()`, or the shutdown flush —
+    raises it. A failed async commit is never silently dropped."""
 
 
 def _fsync_directory(path: str):
@@ -212,6 +230,296 @@ def load_pytree(path: str, verify: bool = True):
             arr = arr.view(jnp.bfloat16)
         leaves.append(arr)
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ------------------------------------------------------------------ snapshots
+def snapshot_pytree(tree):
+    """Copy an array pytree to host NOW, so the caller may keep mutating (or
+    donating) the originals while a background committer serializes the copy.
+
+    Device-to-host copies are started non-blocking for every leaf first
+    (`copy_to_host_async`, where the backend exposes it) and only then
+    gathered, so the transfers overlap instead of serializing per leaf. This
+    is the blocking half of an async save — cheap host RAM traffic, no disk.
+
+    Non-fully-addressable leaves (multi-host sharded arrays) cannot be
+    snapshotted whole on one process; use the per-host sharded layout
+    (`snapshot_shards`) for those."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            if not leaf.is_fully_addressable:
+                raise ValueError(
+                    "snapshot_pytree cannot snapshot a non-fully-addressable array; "
+                    "save with sharded=True so each host snapshots only its shards"
+                )
+            try:
+                leaf.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — optional fast path only
+                pass
+    host = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            host.append(np.asarray(jax.device_get(leaf)))
+        elif isinstance(leaf, np.ndarray):
+            # A numpy leaf is HOST state the train loop may mutate in place
+            # while the background committer serializes — alias it and the
+            # commit tears; copy it like everything else.
+            host.append(np.array(leaf, copy=True))
+        else:
+            host.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, host)
+
+
+def _index_bounds(index, shape) -> List[List[int]]:
+    """Normalize a shard index (tuple of slices) to [[start, stop], ...]."""
+    bounds = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        bounds.append([start, stop])
+    return bounds
+
+
+def snapshot_shards(tree):
+    """This process's addressable shards of an array pytree, copied to host.
+
+    Returns ``(entries, treedef)`` where each entry is ``{"path",
+    "global_shape", "dtype", "shards": [(bounds, np.ndarray), ...]}`` and
+    ``bounds`` is ``[[start, stop], ...]`` per dimension in the GLOBAL array.
+    Replicated shards (several local devices holding the same slice) are
+    deduplicated by bounds — each process persists each distinct slice once.
+    Works for fully-addressable arrays too (one shard covering everything), so
+    single-host sharded checkpoints use the same format."""
+    import jax
+
+    flat, treedef = _flatten_with_paths(tree)
+    # Start every device->host copy before gathering any (overlapped DMA).
+    for _path, leaf in flat:
+        if isinstance(leaf, jax.Array):
+            for shard in leaf.addressable_shards:
+                try:
+                    shard.data.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — optional fast path only
+                    pass
+    entries = []
+    for path, leaf in flat:
+        if isinstance(leaf, jax.Array):
+            shape = tuple(int(d) for d in leaf.shape)
+            dtype = leaf.dtype
+            seen: Dict[tuple, Any] = {}
+            for shard in leaf.addressable_shards:
+                bounds = _index_bounds(shard.index, shape)
+                key = tuple(tuple(b) for b in bounds)
+                if key not in seen:
+                    seen[key] = (bounds, np.asarray(jax.device_get(shard.data)))
+            shards = list(seen.values())
+        else:
+            # Copy, never alias (same contract as snapshot_pytree): a numpy
+            # leaf the train loop mutates in place would tear mid-serialize
+            # under the background committer.
+            arr = np.array(leaf, copy=True)
+            shape = tuple(int(d) for d in arr.shape)
+            dtype = arr.dtype
+            shards = [([[0, d] for d in shape], arr)]
+        entries.append(
+            {"path": path, "global_shape": list(shape), "dtype": dtype, "shards": shards}
+        )
+    return entries, treedef
+
+
+def shard_host_dir(process_index: int) -> str:
+    return f"{SHARD_HOST_PREFIX}{int(process_index):04d}"
+
+
+def shard_host_dirs(directory: str) -> List[str]:
+    """Sorted per-host subdirectories of a sharded checkpoint."""
+    directory = str(directory)
+    if not os.path.isdir(directory):
+        return []
+    return sorted(
+        os.path.join(directory, name)
+        for name in os.listdir(directory)
+        if name.startswith(SHARD_HOST_PREFIX)
+        and name[len(SHARD_HOST_PREFIX):].isdigit()
+        and os.path.isdir(os.path.join(directory, name))
+    )
+
+
+def is_sharded_checkpoint_dir(directory: str) -> bool:
+    return bool(shard_host_dirs(directory))
+
+
+def save_pytree_shards(entries, treedef, path: str, process_index: int = 0):
+    """Write one process's shard set (from `snapshot_shards`) as `<path>.npz`
+    plus a manifest: the per-host sibling of `save_pytree`. Same commit order
+    (payload first, then the digest-carrying manifest) and the same bf16
+    uint16-view convention, so `write_checkpoint_manifest`'s digest reuse and
+    `verify_checkpoint_dir` treat shard files like any other pytree artifact."""
+    arrays = {}
+    manifest: Dict[str, Any] = {
+        "format": 1,
+        "kind": "shards",
+        "process_index": int(process_index),
+        "paths": [],
+        "dtypes": [],
+        "global_shapes": [],
+        "shards": [],
+    }
+    for i, entry in enumerate(entries):
+        dtype = np.dtype(entry["dtype"]) if not hasattr(entry["dtype"], "name") else entry["dtype"]
+        is_bf16 = getattr(dtype, "name", str(dtype)) == _BF16_MARKER
+        manifest["paths"].append(entry["path"])
+        manifest["dtypes"].append(_BF16_MARKER if is_bf16 else str(dtype))
+        manifest["global_shapes"].append(list(entry["global_shape"]))
+        shard_meta = []
+        for j, (bounds, arr) in enumerate(entry["shards"]):
+            key = f"arr_{i}_s{j}"
+            arrays[key] = arr.view(np.uint16) if _has_bf16(arr) else arr
+            shard_meta.append({"key": key, "bounds": [list(b) for b in bounds]})
+        manifest["shards"].append(shard_meta)
+    manifest["treedef"] = pickle.dumps(treedef).hex()
+    path = str(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    npz_path = path if path.endswith(".npz") else path + ".npz"
+    atomic_write(npz_path, lambda f: np.savez_compressed(f, **arrays))
+    manifest["npz_sha256"] = file_sha256(npz_path)
+    atomic_write_json(_manifest_path(path), manifest)
+
+
+def save_pytree_host_shards(tree, path: str, process_index: int = 0):
+    """`snapshot_shards` + `save_pytree_shards` in one call (the synchronous
+    sharded-save convenience)."""
+    entries, treedef = snapshot_shards(tree)
+    save_pytree_shards(entries, treedef, path, process_index=process_index)
+
+
+def _load_shard_file(path: str, verify: bool = True):
+    """One host's shard file -> (manifest, npz data). Digest-verified like
+    `load_pytree`."""
+    path = str(path)
+    npz_path = path if path.endswith(".npz") else path + ".npz"
+    with open(_manifest_path(path)) as f:
+        manifest = json.load(f)
+    expected = manifest.get("npz_sha256")
+    if verify and expected is not None:
+        actual = file_sha256(npz_path)
+        if actual != expected:
+            raise CheckpointCorruptError(
+                f"{npz_path}: SHA-256 mismatch (manifest {expected[:12]}…, file {actual[:12]}…) "
+                "— torn or corrupted shard artifact"
+            )
+    return manifest, np.load(npz_path)
+
+
+def load_pytree_gathered(checkpoint_dir: str, name: str, verify: bool = True):
+    """Gather-on-load: assemble the FULL pytree `name` from every
+    `host_*/<name>` shard file of a per-host sharded checkpoint.
+
+    Works on any topology that can see all the host files (shared filesystem,
+    or a single-host restore of a pod checkpoint — the test/recovery path the
+    sharded layout must always support). Every leaf's shards must cover its
+    global shape; a missing host file or an uncovered region raises instead of
+    returning silently-zero parameters."""
+    import jax
+    import jax.numpy as jnp
+
+    host_dirs = shard_host_dirs(checkpoint_dir)
+    if not host_dirs:
+        raise FileNotFoundError(f"{checkpoint_dir} has no {SHARD_HOST_PREFIX}* shard dirs")
+    reference = None
+    leaves_by_path: Dict[str, np.ndarray] = {}
+    covered: Dict[str, int] = {}
+    seen_bounds: Dict[str, set] = {}
+    for host_dir in host_dirs:
+        path = os.path.join(host_dir, name)
+        if not os.path.isfile(path if path.endswith(".npz") else path + ".npz"):
+            raise FileNotFoundError(
+                f"sharded checkpoint {checkpoint_dir} is missing {os.path.basename(host_dir)}/{name}"
+            )
+        manifest, data = _load_shard_file(path, verify=verify)
+        if reference is None:
+            reference = manifest
+        for i, leaf_path in enumerate(manifest["paths"]):
+            dtype = manifest["dtypes"][i]
+            shape = tuple(manifest["global_shapes"][i])
+            if leaf_path not in leaves_by_path:
+                np_dtype = np.uint16 if dtype == _BF16_MARKER else np.dtype(dtype)
+                leaves_by_path[leaf_path] = np.zeros(shape, np_dtype)
+                covered[leaf_path] = 0
+                seen_bounds[leaf_path] = set()
+            target = leaves_by_path[leaf_path]
+            for shard in manifest["shards"][i]:
+                bounds = shard["bounds"]
+                key = tuple(tuple(b) for b in bounds)
+                arr = data[shard["key"]]
+                slices = tuple(slice(b[0], b[1]) for b in bounds)
+                target[slices] = arr
+                if key not in seen_bounds[leaf_path]:
+                    seen_bounds[leaf_path].add(key)
+                    covered[leaf_path] += int(np.prod([b[1] - b[0] for b in bounds]) if bounds else 1)
+    assert reference is not None
+    for i, leaf_path in enumerate(reference["paths"]):
+        total = int(np.prod(reference["global_shapes"][i]) if reference["global_shapes"][i] else 1)
+        if covered.get(leaf_path, 0) < total:
+            raise CheckpointCorruptError(
+                f"sharded checkpoint {checkpoint_dir}: shards of {leaf_path!r} cover "
+                f"{covered.get(leaf_path, 0)}/{total} elements — a host's shard file is missing"
+            )
+    leaves = []
+    for i, leaf_path in enumerate(reference["paths"]):
+        arr = leaves_by_path[leaf_path]
+        if reference["dtypes"][i] == _BF16_MARKER:
+            arr = arr.view(jnp.bfloat16)
+        leaves.append(arr)
+    treedef = pickle.loads(bytes.fromhex(reference["treedef"]))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def wait_for_path(
+    path: str,
+    timeout_s: float = 600.0,
+    poll_s: float = 0.05,
+    abort: Optional[threading.Event] = None,
+):
+    """Poll until `path` exists — the non-main side of a file handshake (a
+    collective barrier is illegal on a background committer thread)."""
+    deadline = time.monotonic() + timeout_s
+    while not os.path.exists(str(path)):
+        if abort is not None and abort.is_set():
+            raise CheckpointCommitError(f"aborted while waiting for {path}")
+        if time.monotonic() >= deadline:
+            raise CheckpointCommitError(f"timed out after {timeout_s:.0f}s waiting for {path}")
+        time.sleep(poll_s)
+
+
+def wait_for_shard_hosts(
+    directory: str,
+    num_hosts: int,
+    timeout_s: float = 600.0,
+    poll_s: float = 0.05,
+    abort: Optional[threading.Event] = None,
+):
+    """Block until every host's `SHARD_DONE` sentinel exists under
+    `directory/host_*/` — the cross-host commit barrier rank 0 runs before the
+    digest scan. File-based on purpose: it is safe on a background committer
+    thread, where a collective barrier is not."""
+    deadline = time.monotonic() + timeout_s
+    expected = [os.path.join(str(directory), shard_host_dir(i), SHARD_DONE_NAME) for i in range(num_hosts)]
+    while True:
+        missing = [p for p in expected if not os.path.isfile(p)]
+        if not missing:
+            return
+        if abort is not None and abort.is_set():
+            raise CheckpointCommitError("sharded commit aborted while waiting for host shards")
+        if time.monotonic() >= deadline:
+            raise CheckpointCommitError(
+                f"timed out after {timeout_s:.0f}s waiting for host shard sentinels: "
+                f"{[os.path.relpath(p, str(directory)) for p in missing]}"
+            )
+        time.sleep(poll_s)
 
 
 def save_sharded(tree, directory: str):
@@ -485,6 +793,174 @@ def _find_seedable_sampler(dataloader):
     return None
 
 
+# ------------------------------------------------------------ snapshot-then-commit state
+def _sampler_payload(dl) -> Optional[dict]:
+    """The versioned sampler envelope `save_accelerator_state` writes (format 2:
+    sampler state + the loader's pass counter), or None when the loader has no
+    seedable sampler."""
+    sampler = _find_seedable_sampler(dl)
+    if sampler is None:
+        return None
+    payload = {"format": 2, "sampler": copy.deepcopy(sampler.state_dict())}
+    if hasattr(dl, "iteration"):
+        payload["loader_iteration"] = dl.iteration
+    return payload
+
+
+def snapshot_accelerator_state(
+    models: list,
+    optimizers: list,
+    schedulers: list,
+    dataloaders: list,
+    rng_key=None,
+    sharded: bool = False,
+    custom_objects: tuple = (),
+) -> dict:
+    """The BLOCKING half of an async save: copy every piece of training state
+    to host memory and return it as plain data, so a background committer can
+    serialize and fsync it while the train loop keeps stepping (and donating
+    the very buffers this snapshot copied).
+
+    With ``sharded=True``, array trees snapshot as this process's addressable
+    shards (`snapshot_shards`) — the per-host layout each process later writes
+    under its own ``host_*/`` subdirectory. Host-side objects (schedulers,
+    sampler envelopes, custom state) are deep-copied: the live objects keep
+    mutating the moment this returns."""
+    snap_tree = snapshot_shards if sharded else snapshot_pytree
+    snapshot: Dict[str, Any] = {"sharded": bool(sharded)}
+    snapshot["models"] = [snap_tree(m.state_dict()) for m in models]
+    snapshot["optimizers"] = [snap_tree(opt.state_dict()["opt_state"]) for opt in optimizers]
+    snapshot["scalers"] = [
+        copy.deepcopy(opt.scaler.state_dict()) if opt.scaler is not None else None
+        for opt in optimizers
+    ]
+    snapshot["schedulers"] = [copy.deepcopy(s.state_dict()) for s in schedulers]
+    snapshot["samplers"] = [_sampler_payload(dl) for dl in dataloaders]
+    rng_states: Dict[str, Any] = {"python": random.getstate(), "numpy": np.random.get_state()}
+    if rng_key is not None:
+        import jax
+
+        rng_states["jax"] = np.asarray(jax.random.key_data(rng_key))
+    snapshot["rng"] = rng_states
+    snapshot["custom"] = [copy.deepcopy(obj.state_dict()) for obj in custom_objects]
+    return snapshot
+
+
+def write_accelerator_snapshot(
+    snapshot: dict,
+    output_dir: str,
+    process_index: int = 0,
+    num_processes: int = 1,
+    is_main: bool = True,
+    save_on_each_node: bool = False,
+    abort: Optional[threading.Event] = None,
+    shard_barrier_timeout_s: float = 600.0,
+) -> str:
+    """Serialize a `snapshot_accelerator_state` snapshot into `output_dir` —
+    the COMMIT half, safe on a background thread (no live objects, no device
+    arrays, no collectives).
+
+    Unsharded snapshots reproduce `save_accelerator_state`'s exact file layout,
+    so `load_accelerator_state` reads them unchanged. Sharded snapshots write
+    this process's array shards under ``host_{process_index:04d}/`` (model,
+    optimizer, and this process's RNG stream), finish the host dir with the
+    ``SHARD_DONE`` sentinel, and — on the main process — wait for every other
+    host's sentinel (file barrier: a collective would be illegal here) before
+    returning, so the caller's digest scan sees the complete shard set.
+    Host-side objects (schedulers, samplers, scalers, custom state) stay
+    top-level and main-process-owned in both layouts."""
+    output_dir = Path(output_dir)
+    if is_main or num_processes == 1:
+        os.makedirs(output_dir, exist_ok=True)
+    else:
+        # Non-main hosts must NOT create the (staging) directory themselves:
+        # the main host clears and recreates it at the start of the commit, so
+        # a non-main host that raced ahead would have its freshly-written
+        # shards rmtree'd from under it. Wait for main's mkdir instead — the
+        # file-handshake half of the barrier the committer thread cannot run
+        # as a collective. (Worst case — staging litter from a KILLED previous
+        # save of the same step satisfies this wait early and main's recreate
+        # reaps this host's writes: the SHARD_DONE wait then times the commit
+        # out. A failed save, never a published checkpoint missing a host.)
+        wait_for_path(str(output_dir), timeout_s=shard_barrier_timeout_s, abort=abort)
+    sharded = bool(snapshot.get("sharded"))
+    if sharded:
+        host_root = output_dir / shard_host_dir(process_index)
+        os.makedirs(host_root, exist_ok=True)
+        array_dir = host_root
+    else:
+        array_dir = output_dir
+
+    def check_abort(where: str):
+        if abort is not None and abort.is_set():
+            raise CheckpointCommitError(f"checkpoint commit aborted before {where}")
+
+    host_files: List[str] = []
+    for i, tree in enumerate(snapshot["models"]):
+        name = f"{MODEL_NAME}.npz" if i == 0 else f"{MODEL_NAME}_{i}.npz"
+        check_abort(name)
+        if sharded:
+            entries, treedef = tree
+            save_pytree_shards(entries, treedef, str(array_dir / name), process_index)
+        elif is_main or save_on_each_node:
+            save_pytree(tree, str(array_dir / name))
+        host_files.append(name)
+    for i, tree in enumerate(snapshot["optimizers"]):
+        name = f"{OPTIMIZER_NAME}.npz" if i == 0 else f"{OPTIMIZER_NAME}_{i}.npz"
+        check_abort(name)
+        if sharded:
+            entries, treedef = tree
+            save_pytree_shards(entries, treedef, str(array_dir / name), process_index)
+        elif is_main or save_on_each_node:
+            save_pytree(tree, str(array_dir / name))
+        host_files.append(name)
+        scaler_state = snapshot["scalers"][i]
+        if scaler_state is not None and (is_main or save_on_each_node):
+            atomic_write_json(output_dir / f"{SCALER_NAME}_{i}.json", scaler_state)
+
+    rng_name = f"{RNG_STATE_NAME}_{process_index}.pkl"
+    rng_target = (array_dir if sharded else output_dir) / rng_name
+    atomic_write(rng_target, lambda f: pickle.dump(snapshot["rng"], f))
+
+    if is_main:
+        for i, sched_state in enumerate(snapshot["schedulers"]):
+            name = f"{SCHEDULER_NAME}.bin" if i == 0 else f"{SCHEDULER_NAME}_{i}.bin"
+            atomic_write(output_dir / name, lambda f, s=sched_state: pickle.dump(s, f))
+        for i, payload in enumerate(snapshot["samplers"]):
+            if payload is None:
+                continue
+            name = f"{SAMPLER_NAME}.bin" if i == 0 else f"{SAMPLER_NAME}_{i}.bin"
+            atomic_write(output_dir / name, lambda f, p=payload: pickle.dump(p, f))
+        for i, obj_state in enumerate(snapshot.get("custom", [])):
+            location = output_dir / f"custom_checkpoint_{i}.pkl"
+            atomic_write(location, lambda f, s=obj_state: pickle.dump(s, f))
+
+    if sharded:
+        # The host's last artifact: its commit sentinel. Written atomically so
+        # its presence means every file it names is fully on disk.
+        atomic_write_json(
+            host_root / SHARD_DONE_NAME,
+            {"process_index": int(process_index), "files": sorted(host_files)},
+        )
+        if is_main and num_processes > 1:
+            check_abort("host shard barrier")
+            wait_for_shard_hosts(
+                str(output_dir), num_processes, timeout_s=shard_barrier_timeout_s, abort=abort
+            )
+    return str(output_dir)
+
+
+def sharded_manifest_extra(num_processes: int) -> dict:
+    """The topology block a sharded checkpoint's MANIFEST.json carries, so
+    resolve/restore tooling knows the shard set without globbing."""
+    return {
+        "sharded": {
+            "num_hosts": int(num_processes),
+            "hosts": [shard_host_dir(i) for i in range(num_processes)],
+        }
+    }
+
+
 def load_accelerator_state(
     input_dir: str,
     models: list,
@@ -496,11 +972,6 @@ def load_accelerator_state(
     """Restore the complete training state (reference checkpointing.py:152-254).
 
     Returns the restored jax RNG key if one was saved (or None)."""
-    import jax
-
-    from .state import PartialState
-
-    state = PartialState()
     input_dir = Path(input_dir)
 
     for i, model in enumerate(models):
@@ -524,6 +995,28 @@ def load_accelerator_state(
             with open(scaler_path) as f:
                 scaler_state = json.load(f)
         opt.load_state_dict({"opt_state": opt_state, "scaler": scaler_state})
+
+    return _load_host_side_state(input_dir, schedulers, dataloaders, load_rng)
+
+
+def _load_host_side_state(
+    input_dir: Path,
+    schedulers: list,
+    dataloaders: list,
+    load_rng: bool,
+    rng_dir: Optional[Path] = None,
+):
+    """Schedulers, sampler envelopes, and RNG streams — the host-side half of a
+    restore, shared by the flat and per-host-sharded layouts (`rng_dir` points
+    at the host subdirectory holding this process's RNG pickle when sharded).
+    Returns the restored jax RNG key, or None."""
+    import jax
+
+    from .state import PartialState
+
+    state = PartialState()
+    input_dir = Path(input_dir)
+    rng_dir = Path(rng_dir) if rng_dir is not None else input_dir
 
     for i, sched in enumerate(schedulers):
         name = f"{SCHEDULER_NAME}.bin" if i == 0 else f"{SCHEDULER_NAME}_{i}.bin"
@@ -565,7 +1058,11 @@ def load_accelerator_state(
 
     rng_key = None
     if load_rng:
-        rng_path = input_dir / f"{RNG_STATE_NAME}_{state.process_index}.pkl"
+        rng_path = rng_dir / f"{RNG_STATE_NAME}_{state.process_index}.pkl"
+        if not rng_path.exists() and rng_dir != input_dir:
+            # Gather-on-load of a pod checkpoint on fewer hosts: fall back to
+            # host 0's RNG stream (process indices shifted under it).
+            rng_path = input_dir / shard_host_dir(0) / f"{RNG_STATE_NAME}_0.pkl"
         if rng_path.exists():
             with open(rng_path, "rb") as f:
                 rng_states = pickle.load(f)
@@ -576,12 +1073,159 @@ def load_accelerator_state(
     return rng_key
 
 
+def load_sharded_accelerator_state(
+    input_dir: str,
+    models: list,
+    optimizers: list,
+    schedulers: list,
+    dataloaders: list,
+    load_rng: bool = True,
+):
+    """Restore from a per-host sharded checkpoint (``host_*/`` layout).
+
+    Array trees gather-on-load (`load_pytree_gathered`) — every host's shard
+    files are read and assembled into full host arrays, which placement
+    (`load_state_dict` -> the model's shardings) then re-shards onto the
+    CURRENT mesh. This restores on the same topology AND on a single host (the
+    preemption-recovery and test path); the cost is one full-tree
+    materialization per process, the price of topology independence. Returns
+    the restored jax RNG key, or None."""
+    from .state import PartialState
+
+    state = PartialState()
+    input_dir = Path(input_dir)
+
+    for i, model in enumerate(models):
+        name = f"{MODEL_NAME}.npz" if i == 0 else f"{MODEL_NAME}_{i}.npz"
+        params = load_pytree_gathered(str(input_dir), name)
+        model.load_state_dict(params)
+        logger.info("Model weights gathered from shards of %s", input_dir / name)
+
+    for i, opt in enumerate(optimizers):
+        name = f"{OPTIMIZER_NAME}.npz" if i == 0 else f"{OPTIMIZER_NAME}_{i}.npz"
+        opt_state = load_pytree_gathered(str(input_dir), name)
+        scaler_state = None
+        scaler_path = input_dir / f"{SCALER_NAME}_{i}.json"
+        if scaler_path.exists():
+            with open(scaler_path) as f:
+                scaler_state = json.load(f)
+        opt.load_state_dict({"opt_state": opt_state, "scaler": scaler_state})
+
+    rng_dir = input_dir / shard_host_dir(state.process_index)
+    return _load_host_side_state(input_dir, schedulers, dataloaders, load_rng, rng_dir=rng_dir)
+
+
 def save_custom_state(obj, path: str, index: int = 0):
     """Pickle an object exposing state_dict() (reference checkpointing.py:257)."""
     location = Path(path) / f"custom_checkpoint_{index}.pkl"
     logger.info("Saving the state of %s to %s", type(obj).__name__, location)
     obj_state = obj.state_dict()
     atomic_write(location, lambda f: pickle.dump(obj_state, f))
+
+
+# ------------------------------------------------------------------ async committer
+class AsyncCommitter:
+    """One background checkpoint commit at a time, with the barrier-surfacing
+    failure contract.
+
+    ``submit(fn, label)`` first barriers on the previous commit (raising its
+    stored failure, if any) and then runs ``fn(abort_event)`` on a daemon
+    thread. ``wait()`` joins the in-flight commit and raises its failure;
+    ``drain()`` is the shutdown alias. ``abort_and_join()`` sets the abort
+    event — consulted by `CheckpointManager.save` at every phase boundary — and
+    joins WITHOUT raising: the hard-shutdown path, where the process is dying
+    and an unpublished commit must stay unpublished (a half-dead process must
+    never publish a checkpoint).
+
+    Failure wrapping: ordinary exceptions surface as `CheckpointCommitError`
+    (with ``__cause__`` preserved); BaseExceptions that are not Exceptions
+    (KeyboardInterrupt, an injected kill) re-raise as themselves — they mean
+    "this process is dying", not "this commit failed"."""
+
+    def __init__(self, name: str = "ckpt-committer"):
+        self.name = name
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._abort = threading.Event()
+        self._label: Optional[str] = None
+        self._lock = threading.Lock()
+
+    @property
+    def in_flight(self) -> bool:
+        thread = self._thread
+        return thread is not None and thread.is_alive()
+
+    @property
+    def label(self) -> Optional[str]:
+        return self._label
+
+    def _raise_pending(self):
+        error, self._error = self._error, None
+        if error is None:
+            return
+        if isinstance(error, Exception):
+            raise CheckpointCommitError(
+                f"background checkpoint commit failed ({self._label}): {error}"
+            ) from error
+        raise error  # process-death class (KeyboardInterrupt / injected kill)
+
+    def poll(self):
+        """Non-blocking surface of a DEAD committer's process-death failure
+        (BaseException-not-Exception only — an ordinary commit failure keeps
+        to the barrier contract and waits for the next `wait()`)."""
+        if self.in_flight:
+            return
+        if self._error is not None and not isinstance(self._error, Exception):
+            error, self._error = self._error, None
+            raise error
+
+    def wait(self, timeout: Optional[float] = None):
+        """Barrier on the in-flight commit; raises its failure (and any stored
+        failure from an earlier commit)."""
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
+                raise CheckpointCommitError(
+                    f"background checkpoint commit still running after {timeout}s ({self._label})"
+                )
+            self._thread = None
+        self._raise_pending()
+
+    def drain(self, timeout: Optional[float] = None):
+        self.wait(timeout)
+
+    def abort_and_join(self, timeout: float = 30.0) -> Optional[BaseException]:
+        """Hard shutdown: request abort, join, and RETURN (not raise) whatever
+        the commit died of. The abort event is left set — this committer is
+        done; build a fresh one to save again."""
+        self._abort.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+            self._thread = None
+        error, self._error = self._error, None
+        return error
+
+    def submit(self, fn: Callable[[threading.Event], Any], label: str = "checkpoint"):
+        """Barrier on the previous commit, then start `fn(abort_event)` in the
+        background. Raises the previous commit's failure HERE — the contract's
+        "surfaces on the next save" barrier."""
+        with self._lock:
+            self.wait()
+            if self._abort.is_set():
+                raise CheckpointCommitError("committer was aborted; create a fresh one")
+            self._label = label
+
+            def run():
+                try:
+                    fn(self._abort)
+                except BaseException as exc:  # noqa: BLE001 — stored, surfaced at the barrier
+                    self._error = exc
+                    logger.warning("background checkpoint commit (%s) failed: %r", label, exc)
+
+            self._thread = threading.Thread(target=run, name=self.name, daemon=True)
+            self._thread.start()
 
 
 # ------------------------------------------------------------------ crash-safe manager
@@ -597,10 +1241,13 @@ def _rmtree_missing_ok(path: str):
         pass
 
 
-def write_checkpoint_manifest(directory: str, step: Optional[int] = None) -> str:
+def write_checkpoint_manifest(
+    directory: str, step: Optional[int] = None, extra: Optional[dict] = None
+) -> str:
     """Commit record for a checkpoint DIRECTORY: scan every artifact, digest it,
     and atomically write `MANIFEST.json`. Written LAST — its presence asserts
-    every file it names was fully on disk first."""
+    every file it names was fully on disk first. `extra` merges additional
+    top-level fields into the record (e.g. the sharded-layout topology block)."""
     directory = str(directory)
     entries = []
     for root, dirs, names in os.walk(directory):
@@ -631,7 +1278,10 @@ def write_checkpoint_manifest(directory: str, step: Optional[int] = None) -> str
         rel: known.get(rel) or file_sha256(os.path.join(directory, rel)) for rel, _ in entries
     }
     manifest_path = os.path.join(directory, CHECKPOINT_MANIFEST_NAME)
-    atomic_write_json(manifest_path, {"format": 1, "step": step, "files": files})
+    record = {"format": 1, "step": step, "files": files}
+    if extra:
+        record.update(extra)
+    atomic_write_json(manifest_path, record)
     return manifest_path
 
 
@@ -702,6 +1352,12 @@ class CheckpointManager:
         self.keep_last_n = keep_last_n
         self.retries = retries
         self.backoff_seconds = backoff_seconds
+        # Steps staged by in-flight save() calls (a background committer's
+        # checkpoint is invisible on disk until its publish rename): consulted
+        # by next_step() under the lock so two overlapping saves can never be
+        # handed the same step number.
+        self._step_lock = threading.Lock()
+        self._inflight_steps: set = set()
 
     # ---------------------------------------------------------------- inventory
     def checkpoints(self) -> List[Tuple[int, str]]:
@@ -719,8 +1375,16 @@ class CheckpointManager:
         return sorted(out)
 
     def next_step(self) -> int:
-        ckpts = self.checkpoints()
-        return ckpts[-1][0] + 1 if ckpts else 0
+        """Next unused step number — race-safe against a background committer:
+        a step whose `save()` is still in flight (staged, not yet published, so
+        invisible to the directory listing) is already taken. Callers that
+        interleave `next_step()` with async `save()`s therefore never collide;
+        the regression this pins is two overlapping saves both minting step N."""
+        with self._step_lock:
+            ckpts = self.checkpoints()
+            disk_next = ckpts[-1][0] + 1 if ckpts else 0
+            inflight_next = max(self._inflight_steps) + 1 if self._inflight_steps else 0
+            return max(disk_next, inflight_next)
 
     def latest_verified(self) -> Optional[str]:
         """Newest checkpoint whose digests verify; corrupt/torn ones are skipped
@@ -804,54 +1468,85 @@ class CheckpointManager:
             if name.startswith(_STAGING_PREFIX):
                 shutil.rmtree(os.path.join(self.base_dir, name), ignore_errors=True)
 
+    @staticmethod
+    def _check_abort(abort: Optional[threading.Event], where: str):
+        """Abort is the committer-shutdown analogue of a kill: consulted at
+        every phase boundary of the commit sequence so an aborted background
+        commit stops BEFORE the publish rename — a dying process must leave
+        staging litter, never a newly-visible checkpoint."""
+        if abort is not None and abort.is_set():
+            raise CheckpointCommitError(f"checkpoint commit aborted before {where}")
+
     def save(
         self,
         step: int,
         write_fn: Callable[[str], Any],
         is_main: bool = True,
         barrier: Optional[Callable[[], Any]] = None,
+        abort: Optional[threading.Event] = None,
+        manifest_extra: Optional[dict] = None,
     ) -> str:
         """Stage -> digest-manifest -> atomic publish -> latest pointer -> rotate.
 
         `write_fn(staging_dir)` writes every artifact. The checkpoint only becomes
         visible (and `latest` only advances) after everything it contains — and
-        the manifest describing it — is fully on disk."""
+        the manifest describing it — is fully on disk. `abort` (an Event, set by
+        `AsyncCommitter.abort_and_join`) stops the commit at the next phase
+        boundary without publishing; `manifest_extra` merges extra fields into
+        the commit record (the sharded-layout topology block)."""
         barrier = barrier or (lambda: None)
         final = os.path.join(self.base_dir, f"checkpoint_{step}")
-        replace_torn = False
-        if os.path.exists(final):
-            # A resumed run that fell back past a torn newest checkpoint will
-            # re-save its step number: replacing a directory whose manifest
-            # FAILS is safe (it can never serve a resume). A verified one — or
-            # a manifest-less LEGACY one, which resume may still fall back to —
-            # is never clobbered.
-            has_manifest = os.path.isfile(os.path.join(final, CHECKPOINT_MANIFEST_NAME))
-            if not has_manifest or verify_checkpoint_dir(final):
+        with self._step_lock:
+            if step in self._inflight_steps:
                 raise ValueError(
-                    f"Checkpoint directory {final} already exists; use a different step "
-                    "or a fresh base directory."
+                    f"checkpoint step {step} already has a save in flight; overlapping "
+                    "saves must use distinct steps (next_step() hands them out race-safely)"
                 )
-            logger.warning("replacing unverifiable existing checkpoint %s", final)
-            replace_torn = True
-        staging = os.path.join(self.base_dir, f"{_STAGING_PREFIX}checkpoint_{step}")
-        if is_main:
-            os.makedirs(self.base_dir, exist_ok=True)
-            shutil.rmtree(staging, ignore_errors=True)
-            os.makedirs(staging)
-        barrier()  # staging dir exists before any process writes into it
-        write_fn(staging)
-        barrier()  # every process's artifacts are in before the digest scan
-        if is_main:
-            self._retry(lambda: write_checkpoint_manifest(staging, step), "manifest write")
-            if replace_torn:
-                # Retire the torn dir just before publishing: the new checkpoint
-                # (manifest included) is already fully on disk in staging, so a
-                # kill in this window loses nothing that could have been loaded.
-                self._retry(lambda: _rmtree_missing_ok(final), f"reap of torn {final}")
-            self._retry(lambda: self._publish(staging, final), "checkpoint publish")
-            self._rotate(keep=final)
-        barrier()
-        return final
+            self._inflight_steps.add(step)
+        try:
+            replace_torn = False
+            if os.path.exists(final):
+                # A resumed run that fell back past a torn newest checkpoint will
+                # re-save its step number: replacing a directory whose manifest
+                # FAILS is safe (it can never serve a resume). A verified one — or
+                # a manifest-less LEGACY one, which resume may still fall back to —
+                # is never clobbered.
+                has_manifest = os.path.isfile(os.path.join(final, CHECKPOINT_MANIFEST_NAME))
+                if not has_manifest or verify_checkpoint_dir(final):
+                    raise ValueError(
+                        f"Checkpoint directory {final} already exists; use a different step "
+                        "or a fresh base directory."
+                    )
+                logger.warning("replacing unverifiable existing checkpoint %s", final)
+                replace_torn = True
+            staging = os.path.join(self.base_dir, f"{_STAGING_PREFIX}checkpoint_{step}")
+            if is_main:
+                os.makedirs(self.base_dir, exist_ok=True)
+                shutil.rmtree(staging, ignore_errors=True)
+                os.makedirs(staging)
+            barrier()  # staging dir exists before any process writes into it
+            self._check_abort(abort, "artifact write")
+            write_fn(staging)
+            barrier()  # every process's artifacts are in before the digest scan
+            self._check_abort(abort, "manifest write")
+            if is_main:
+                self._retry(
+                    lambda: write_checkpoint_manifest(staging, step, extra=manifest_extra),
+                    "manifest write",
+                )
+                if replace_torn:
+                    # Retire the torn dir just before publishing: the new checkpoint
+                    # (manifest included) is already fully on disk in staging, so a
+                    # kill in this window loses nothing that could have been loaded.
+                    self._retry(lambda: _rmtree_missing_ok(final), f"reap of torn {final}")
+                self._check_abort(abort, "publish")
+                self._retry(lambda: self._publish(staging, final), "checkpoint publish")
+                self._rotate(keep=final)
+            barrier()
+            return final
+        finally:
+            with self._step_lock:
+                self._inflight_steps.discard(step)
 
     def _publish(self, staging: str, final: str):
         # Idempotent under `_retry` (chaos-surfaced bug): a transient failure

@@ -42,8 +42,9 @@ def register_subcommand(subparsers):
     run.add_argument(
         "--workload",
         default=None,
-        choices=(None, "train", "serve", "supervised-train"),
-        help="Workload to drive (default: inferred from the plan's fault kinds)",
+        choices=(None, "train", "async-train", "serve", "supervised-train"),
+        help="Workload to drive (default: the plan's own `workload` field, else inferred "
+        "from its fault kinds; `async-train` saves through the background committer)",
     )
     run.add_argument("--base-dir", default=None, help="Checkpoint/journal dir (default: a temp dir)")
     run.add_argument(
@@ -92,6 +93,8 @@ def _load_plan(spec: str):
 
 
 def _infer_workload(plan) -> str:
+    if getattr(plan, "workload", None):
+        return plan.workload
     return "serve" if any(ev.kind.startswith("serve.") for ev in plan.events) else "train"
 
 
@@ -117,7 +120,9 @@ def chaos_run_command(args):
             if workload == "supervised-train":
                 report = runner.run_supervised_train(base_dir, steps=args.steps)
             else:
-                report = runner.run_train(base_dir, steps=args.steps)
+                report = runner.run_train(
+                    base_dir, steps=args.steps, async_save=(workload == "async-train")
+                )
     if args.report_out:
         report.save(args.report_out)
     print(report.to_json() if args.as_json else report.render_text())
@@ -125,10 +130,15 @@ def chaos_run_command(args):
 
 
 def chaos_list_faults_command(args):
-    from ..chaos import catalog
+    from ..chaos import builtin_plans, catalog
 
     for kind, description in sorted(catalog().items()):
         print(f"{kind:<28} {description}")
+    print()
+    print("builtin plans (chaos run --plan NAME):")
+    for name, plan in sorted(builtin_plans().items()):
+        workload = plan.workload or "(inferred)"
+        print(f"{name:<28} workload={workload:<16} {plan.notes}")
     raise SystemExit(0)
 
 

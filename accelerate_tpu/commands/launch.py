@@ -85,6 +85,20 @@ def add_launch_args(parser):
         help="Chaos fault plan (JSON file) exported to every worker as ACCELERATE_TPU_FAULT_PLAN "
         "(accelerate-tpu chaos; docs/fault_tolerance.md) — fault-injection runs only",
     )
+    parser.add_argument(
+        "--async_save",
+        action="store_true",
+        help="Asynchronous checkpointing in every worker (ACCELERATE_TPU_ASYNC_SAVE): "
+        "save_state blocks only for the device->host snapshot; serialize+fsync+publish "
+        "run on a background committer (docs/guides/checkpointing.md)",
+    )
+    parser.add_argument(
+        "--sharded_save",
+        action="store_true",
+        help="Per-host sharded checkpoints (ACCELERATE_TPU_SHARDED_SAVE): each process "
+        "writes only its addressable mesh shards into its own host_*/ subdirectory; "
+        "restore gathers on load (docs/guides/checkpointing.md)",
+    )
     parser.add_argument("--tpu_use_cluster", action="store_true", help="Launch on every worker of a TPU pod")
     parser.add_argument("--tpu_name", default=None)
     parser.add_argument("--tpu_zone", default=None)
@@ -136,6 +150,10 @@ def build_launch_env(args, config: dict) -> dict:
     fault_plan = pick(getattr(args, "fault_plan", None), "fault_plan")
     if fault_plan:
         env["ACCELERATE_TPU_FAULT_PLAN"] = str(fault_plan)
+    if getattr(args, "async_save", False) or config.get("async_save"):
+        env["ACCELERATE_TPU_ASYNC_SAVE"] = "1"
+    if getattr(args, "sharded_save", False) or config.get("sharded_save"):
+        env["ACCELERATE_TPU_SHARDED_SAVE"] = "1"
 
     # Plugin blocks from the questionnaire YAML -> the env protocol the worker-side
     # dataclasses' __post_init__ reads (reference utils/launch.py:226-267 FSDP_* block).

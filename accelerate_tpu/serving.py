@@ -498,6 +498,90 @@ class ContinuousBatcher:
         )
         return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
+    @staticmethod
+    def plan_admission_bucket(
+        p: int, matched_pages: int, page_size: int, padded_length: int
+    ) -> Tuple[int, int]:
+        """Pure admission planner: (insert bucket, matched pages to KEEP) for a
+        `p`-token prompt with `matched_pages` prefix-cache hits.
+
+        The bucket set this can return is CLOSED — powers of two (prefix-hit
+        suffixes floored at the page-size bucket, so a deepening cache never
+        mints ever-smaller buckets) plus the single capped value
+        `padded_length` (a full-window prompt with no prefix hit). A pow2
+        suffix bucket that would overflow the cache window (`matched_len +
+        bucket > padded_length`) DROPS trailing matched pages until it fits
+        instead of shrinking the bucket to a matched_len-dependent remainder:
+        an open set of remainder-sized buckets is exactly what used to compile
+        a fresh insert executable on the first deep prefix hit of a timed run.
+        `warm_inserts` precompiles the whole closed set."""
+        floor_bucket = _bucket_for(page_size)
+        matched_len = matched_pages * page_size
+        while matched_pages and (
+            matched_len + max(_bucket_for(p - matched_len), floor_bucket) > padded_length
+        ):
+            matched_pages -= 1
+            matched_len -= page_size
+        bucket = _bucket_for(p - matched_len)
+        if matched_pages:
+            bucket = max(bucket, floor_bucket)
+        # Only binds when matched_pages == 0: the single fixed top bucket.
+        bucket = min(bucket, padded_length - matched_len)
+        return bucket, matched_pages
+
+    def insert_bucket_ladder(self) -> List[int]:
+        """Every insert bucket any admission of this engine can mint: the pow2
+        ladder below the cache window plus the capped top value. Closed by
+        `plan_admission_bucket` (paged) / the `min(bucket, max_length)` cap
+        (contiguous)."""
+        limit = self._padded_length if self.paged else self.max_length
+        ladder = []
+        b = 1
+        while b < limit:
+            ladder.append(b)
+            b <<= 1
+        ladder.append(limit)
+        return ladder
+
+    def warm_inserts(self) -> List[int]:
+        """Precompile the full insert-bucket ladder so NO admission — whatever
+        prompt length or prefix-cache depth it arrives with — compiles at
+        serving time. Each warm call donates a THROWAWAY zero cache (never the
+        engine's), so engine state is untouched. Returns the buckets warmed.
+
+        Cost: one small compile per ladder rung (log2 of the cache window), a
+        few seconds at init; the payoff is a mechanical 0-recompile guarantee
+        across the whole admission space instead of 'whatever the warmup
+        traffic happened to mint'."""
+        import jax
+
+        warmed = []
+        for bucket in self.insert_bucket_ladder():
+            fn = self._insert_fn(bucket)
+            dummy_cache = jax.tree_util.tree_map(jnp.zeros_like, self._cache)
+            dummy_presence = (
+                jax.tree_util.tree_map(jnp.zeros_like, self._presence)
+                if self._presence is not None
+                else None
+            )
+            ids = jnp.zeros((1, bucket), jnp.int32)
+            if self.paged:
+                fn(
+                    self.params, dummy_cache, dummy_presence, ids,
+                    _operand(1, np.int32), _operand(0, np.int32), _operand(0, np.int32),
+                    jnp.asarray(np.zeros((self.pages_per_slot,), np.int32)),
+                    _operand(0, np.int32), _operand(1.0, np.float32),
+                    _operand(1.0, np.float32), self._rng,
+                )
+            else:
+                fn(
+                    self.params, dummy_cache, dummy_presence, ids,
+                    _operand(1, np.int32), _operand(0, np.int32),
+                    _operand(1.0, np.float32), _operand(1.0, np.float32), self._rng,
+                )
+            warmed.append(bucket)
+        return warmed
+
     def _insert_fn(self, bucket: int):
         """One compiled insert per power-of-two prompt bucket (paged: per
         SUFFIX bucket — the unmatched tail after prefix-cache hits). The real
@@ -1046,6 +1130,19 @@ class ContinuousBatcher:
                 else:
                     shared = []
                 matched_pages = len(shared)
+                # Closed-bucket planning: when the pow2 suffix bucket would
+                # overflow the cache window (`matched_len + bucket >
+                # _padded_length`), DROP trailing matched pages instead of
+                # minting a matched_len-dependent capped bucket — an open set
+                # of bucket sizes no warmup can enumerate, and the source of
+                # the first-hit insert recompiles the bench's 0-recompile
+                # assert used to trip at non-default --max-new-max sizes.
+                _bucket, keep_pages = self.plan_admission_bucket(
+                    p, matched_pages, self.page_size, self._padded_length
+                )
+                while matched_pages > keep_pages:
+                    self.pool.release([shared.pop()])
+                    matched_pages -= 1
                 matched_len = matched_pages * self.page_size
                 private = self.pool.reserve(total_pages - matched_pages)
                 if private is None:
@@ -1061,16 +1158,7 @@ class ContinuousBatcher:
                     if matched_len:
                         self._m_prefill_saved.inc(matched_len)
                 suffix = p - matched_len
-                bucket = _bucket_for(suffix)
-                if matched_pages:
-                    # Floor prefix-hit suffix buckets at the page size: deeper
-                    # matches over time (a prompt re-served after registering
-                    # its own pages leaves a 1-token suffix) would otherwise
-                    # mint ever-smaller buckets — fresh compiles at steady
-                    # state. One floor bucket absorbs every small suffix, so a
-                    # warm server stays warm as its prefix cache deepens.
-                    bucket = max(bucket, _bucket_for(self.page_size))
-                bucket = min(bucket, self._padded_length - matched_len)
+                bucket = _bucket
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :suffix] = ids[matched_len:]
                 page_row = np.zeros((self.pages_per_slot,), np.int32)

@@ -887,6 +887,12 @@ def main():
         from benchmarks.serving_bench import main as serving_main
 
         sys.exit(serving_main(rest))
+    if known.mode == "checkpoint":
+        # Same pre-routing as serving: the checkpoint bench (sync vs async
+        # save_state A/B, benchmarks/checkpoint_bench.py) owns its own args.
+        from benchmarks.checkpoint_bench import main as checkpoint_main
+
+        sys.exit(checkpoint_main(rest))
     args = parse_args(argv)
     if args.mode == "train" and args.model in ("gptj-6b", "gpt-neox-20b", "opt-30b"):
         # These sizes can't TRAIN on one 16GB chip (params + Adam state alone

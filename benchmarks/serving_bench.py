@@ -164,9 +164,10 @@ def run_spec_workload(model, args, cfg, max_length, rng, tracer=None):
             draft_tokens=args.draft_tokens, draft_ngram=args.draft_ngram,
         )
         log(f"speculative workload ({label}): warmup...")
-        # Twice, like the prefix workload: pass 1 compiles per-miss buckets and
-        # registers prefixes, pass 2 compiles the prefix-hit suffix buckets the
-        # timed pass will use.
+        # The closed bucket ladder, then twice through the real traffic (pass 1
+        # registers prefixes, pass 2 runs the prefix-hit path) like the prefix
+        # workload.
+        engine.warm_inserts()
         run_continuous(engine, prompts, budgets, arrivals)
         run_continuous(engine, prompts, budgets, arrivals)
         registry = engine.metrics
@@ -243,8 +244,10 @@ def run_prefix_workload(model, args, cfg, max_length, rng, tracer=None):
             prefix_cache=use_prefix, tracer=tracer,
         )
         log(f"prefix workload ({label}): warmup...")
-        # Twice: pass 1 compiles per-miss buckets and registers the prefix,
-        # pass 2 compiles the prefix-hit suffix buckets the timed pass uses.
+        # The closed bucket ladder first (no admission can mint a fresh
+        # bucket), then twice through the real traffic: pass 1 registers the
+        # prefix, pass 2 runs the prefix-HIT suffix path before timing.
+        engine.warm_inserts()
         run_continuous(engine, prompts, budgets, arrivals)
         run_continuous(engine, prompts, budgets, arrivals)
         guard = TraceGuard(
@@ -371,15 +374,18 @@ def main(argv=None):
     static_gen = Generator(model, max_new_tokens=max(budgets), max_length=max_length)
 
     # Warmup pass: compile every program both paths use (static per batch shape,
-    # continuous per insert bucket + the one chunk program), then measure. The
-    # continuous path warms TWICE: the first pass registers prompt prefixes,
-    # so the second sees the prefix-HIT suffix buckets (incl. the page-size
-    # floor bucket) the timed pass will use — one pass leaves those cold and
-    # the timed pass would pay (and, under the 0-recompile assert, fail on) a
-    # first-hit insert compile at non-default page sizes.
+    # continuous per insert bucket + the one chunk program), then measure.
+    # `warm_inserts` precompiles the engine's CLOSED insert-bucket ladder — a
+    # mechanical guarantee that no admission of the timed pass can mint a fresh
+    # bucket, whatever prefix-cache depth it arrives at (the first-hit insert
+    # recompile that used to trip the 0-recompile assert at non-default
+    # --max-new-max / --page-size combinations). The continuous path still
+    # warms TWICE: the first pass registers prompt prefixes, so the second
+    # runs the prefix-HIT suffix path end to end before timing.
     log("warmup (compiles)...")
     t0 = time.perf_counter()
     run_static(static_gen, prompts, budgets, arrivals, args.num_slots, max_length)
+    log(f"insert buckets warmed: {engine.warm_inserts()}")
     run_continuous(engine, prompts, budgets, arrivals)
     run_continuous(engine, prompts, budgets, arrivals)
     log(f"warmup done in {time.perf_counter() - t0:.1f}s; timed runs...")

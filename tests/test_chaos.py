@@ -517,3 +517,166 @@ def test_launch_exports_fault_plan_env(tmp_path):
     args = parser.parse_args(["--fault_plan", plan_file, "script.py"])
     env = build_launch_env(args, {})
     assert env[FAULT_PLAN_ENV] == plan_file
+
+
+# ------------------------------------------------------------------ async-commit sweeps
+def test_async_sigkill_at_every_boundary_resumes_exactly(tmp_path):
+    """The async analogue of THE acceptance sweep: SIGKILL at every step
+    boundary of an 8-step run whose every save runs through the background
+    committer. A kill with a commit in flight aborts it (a dead process cannot
+    publish); every resume still lands exactly on the last PUBLISHED
+    checkpoint, and no torn checkpoint ever resolves."""
+    plan = FaultPlan(
+        name="async-kill-every-boundary",
+        workload="async-train",
+        events=[FaultEvent(kind="proc.sigkill", at_step=k) for k in range(8)],
+    )
+    runner = ChaosRunner(plan)
+    report = runner.run_train(str(tmp_path), steps=8, max_restarts=16, async_save=True)
+    assert report.ok, report.render_text()
+    assert report.workload == "async-train"
+    by_name = {c.name: c for c in report.checks}
+    # 8 kills -> 8 restarts. The step-0 commit legitimately races its abort
+    # (the kill lands the instant the save is accepted): when it aborted,
+    # attempt 2 has nothing to resume FROM — 7 resumes; when it published in
+    # time — 8. Every resume that happened must be exact either way.
+    assert by_name["resume_exactness"].details["resumes"] in (7, 8)
+    assert by_name["restart_budget"].details["restarts"] == 8
+    assert by_name["restart_budget"].details["completed"] is True
+
+
+def test_async_kill_with_commit_in_flight_never_corrupts_previous(tmp_path):
+    """ISSUE acceptance boundary 'commit in flight': a slowed background commit
+    is provably still running when the step-boundary SIGKILL lands. The abort
+    keeps it from publishing; the previously published checkpoint must be the
+    verified latest the next attempt resumes from."""
+    plan = FaultPlan(
+        name="async-kill-in-flight",
+        workload="async-train",
+        events=[
+            # Stall step-1's commit (model.npz write #2) for longer than the
+            # boundary takes to kill; the commit is mid-fsync when the run dies.
+            FaultEvent(kind="fs.slow_fsync", path_pattern="model.npz", at_call=2,
+                       args={"delay_s": 0.3}),
+            FaultEvent(kind="proc.sigkill", at_step=1),
+        ],
+    )
+    report = ChaosRunner(plan).run_train(str(tmp_path), steps=4, async_save=True)
+    assert report.ok, report.render_text()
+    by_name = {c.name: c for c in report.checks}
+    # resumed exactly once, from a checkpoint that independently verifies
+    assert by_name["resume_exactness"].details["resumes"] == 1
+    assert by_name["no_torn_resolved"].details["final_verified_latest_step"] == 3
+
+
+def test_async_committer_killed_in_rename_window_surfaces_and_recovers(tmp_path):
+    """Boundary 'commit mid-write': the committer dies inside an artifact's
+    rename window (InjectedKill on the committer thread). The death surfaces at
+    the next step boundary like a process kill, the unpublished commit leaves
+    only staging litter, and the restart chain completes."""
+    plan = FaultPlan(
+        name="async-rename-crash",
+        workload="async-train",
+        events=[FaultEvent(kind="fs.crash_in_rename", path_pattern="optimizer.npz*", at_call=3)],
+    )
+    report = ChaosRunner(plan).run_train(str(tmp_path), steps=4, async_save=True)
+    assert report.ok, report.render_text()
+    assert [e["kind"] for e in report.injections] == ["fs.crash_in_rename"]
+
+
+def test_async_kill_in_publish_rename_window(tmp_path):
+    """Boundary 'publish mid-rename': the committer dies between the staged
+    manifest write and the directory rename — the checkpoint is fully on disk
+    in staging but must never become visible; the previous one stays latest."""
+    plan = FaultPlan(
+        name="async-publish-crash",
+        workload="async-train",
+        events=[FaultEvent(kind="fs.crash_in_rename", path_pattern="checkpoint_2", at_call=1)],
+    )
+    report = ChaosRunner(plan).run_train(str(tmp_path), steps=4, async_save=True)
+    assert report.ok, report.render_text()
+
+
+def test_async_post_publish_torn_write_falls_back(tmp_path):
+    """Boundary 'post-publish': corruption lands AFTER an async commit
+    published. resolve() must fall back past the torn newest checkpoint on the
+    next resume, async exactly like sync."""
+    plan = FaultPlan(
+        name="async-torn",
+        workload="async-train",
+        events=[
+            FaultEvent(kind="fs.torn_write", path_pattern="model.npz", at_call=2,
+                       args={"offset": 1}),
+            FaultEvent(kind="proc.sigkill", at_step=1),
+        ],
+    )
+    report = ChaosRunner(plan).run_train(str(tmp_path), steps=4, async_save=True)
+    assert report.ok, report.render_text()
+    by_name = {c.name: c for c in report.checks}
+    assert by_name["no_torn_resolved"].details["resumes"] == 1
+
+
+def test_async_eio_exhaustion_is_a_commit_failure_crash(tmp_path):
+    """Boundary 'commit I/O failure': every write of one step's model artifact
+    raises EIO, exhausting the manager's retries inside the background commit.
+    The failure surfaces as CheckpointCommitError on the next save's barrier —
+    counted as a crash, restarted, run completes."""
+    plan = FaultPlan(
+        name="async-eio",
+        workload="async-train",
+        # times=4 with no at_call: the first model.npz write AND its 3 retries
+        # all fail — the manager's retry budget is exhausted inside the commit.
+        events=[FaultEvent(kind="fs.io_error", path_pattern="model.npz", times=4,
+                           args={"errno": "EIO"})],
+    )
+    report = ChaosRunner(plan).run_train(str(tmp_path), steps=4, async_save=True)
+    assert report.ok, report.render_text()
+    assert all(e["kind"] == "fs.io_error" for e in report.injections)
+    assert len(report.injections) == 4  # initial try + 3 retries, all scripted
+
+
+def test_smoke_async_ckpt_builtin_plan_is_green(tmp_path):
+    """The shipped async-checkpoint chaos fixture holds every invariant."""
+    plan = builtin_plans()["smoke-async-ckpt"]
+    assert plan.workload == "async-train"
+    report = ChaosRunner(plan).run_train(str(tmp_path), steps=6, async_save=True)
+    assert report.ok, report.render_text()
+
+
+def test_supervised_async_preemption_flushes_commits(tmp_path):
+    """End-to-end with real signals: the subprocess workload saves through the
+    background committer, a REAL SIGTERM lands mid-run, and check_preemption's
+    flush + synchronous preemption save hand off cleanly (exit 143, exact
+    resume, completion)."""
+    plan = FaultPlan(name="supervised-async-term", events=[
+        FaultEvent(kind="fs.slow_fsync", path_pattern="model.npz", at_call=2,
+                   args={"delay_s": 0.2}),
+        FaultEvent(kind="proc.sigterm", at_step=1),
+    ])
+    runner = ChaosRunner(plan)
+    report = runner.run_supervised_train(str(tmp_path), steps=4, async_save=True)
+    assert report.ok, report.render_text()
+    supervisor_check = next(c for c in report.checks if c.name == "supervisor")
+    assert supervisor_check.details["preemption_handoffs"] == 1
+
+
+def test_cli_run_smoke_async_ckpt_uses_plan_workload(capsys, tmp_path):
+    """`chaos run --plan smoke-async-ckpt` picks the plan's own workload
+    (async-train) without an explicit --workload flag and exits 0."""
+    code, out = _run_cli(
+        capsys, "chaos", "run", "--plan", "smoke-async-ckpt", "--steps", "5",
+        "--base-dir", str(tmp_path / "run"), "--json",
+    )
+    assert code == 0, out
+    emitted = json.loads(out)
+    assert emitted["ok"] is True
+    assert emitted["workload"] == "async-train"
+    assert emitted["plan"]["workload"] == "async-train"
+
+
+def test_cli_list_faults_lists_builtin_plans(capsys):
+    code, out = _run_cli(capsys, "chaos", "list-faults")
+    assert code == 0
+    for name in ("smoke-train", "smoke-serve", "smoke-async-ckpt", "seeded-regression"):
+        assert name in out
+    assert "workload=async-train" in out

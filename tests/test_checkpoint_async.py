@@ -1,0 +1,408 @@
+"""Async + per-host-sharded checkpointing: the snapshot-then-commit contract.
+
+Pins, on CPU inside tier-1 time:
+
+  1. `AsyncCommitter` mechanics — one in-flight commit, submit barriers on the
+     previous one, a FAILED commit surfaces at the next barrier (never silently
+     dropped), `abort_and_join` stops an in-flight commit before publish;
+  2. `CheckpointManager.next_step` race-safety — a step staged by a background
+     committer (invisible on disk until the publish rename) is already taken,
+     and two overlapping saves of the SAME step are refused;
+  3. the Accelerator round trips — async save == sync load parity, sharded
+     save -> single-host gather-on-load parity, async+sharded combined;
+  4. the goodput property — an async save charges ONLY its blocking portion to
+     the ledger's `checkpoint` cause; the (injected-slow) commit lands in
+     `checkpoint_async_commit_seconds` instead. The same injected delay under
+     a sync save charges the ledger in full — the A/B the bench reports;
+  5. failure modes — repeated EIO exhausts the commit's retries and raises
+     `CheckpointCommitError` from the NEXT save; a committer killed mid-commit
+     leaves the PREVIOUS published checkpoint as the loadable latest;
+  6. the per-host shard layout — manifest/digest verification covers host
+     subdirectories, a simulated two-host checkpoint gathers to exact parity,
+     and a torn shard file fails directory verification;
+  7. `launch --async_save/--sharded_save` join the env protocol.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.chaos.injectors import FilesystemInjector, ChaosSession, InjectedKill
+from accelerate_tpu.chaos.plan import FaultEvent, FaultPlan
+from accelerate_tpu.chaos.runner import params_digest
+from accelerate_tpu.checkpointing import (
+    AsyncCommitter,
+    CheckpointCommitError,
+    CheckpointManager,
+    is_sharded_checkpoint_dir,
+    load_pytree_gathered,
+    save_pytree_host_shards,
+    save_pytree_shards,
+    shard_host_dir,
+    snapshot_pytree,
+    snapshot_shards,
+    verify_checkpoint_dir,
+    write_checkpoint_manifest,
+)
+
+pytestmark = pytest.mark.checkpoint_async
+
+
+def build_accelerator(base_dir, async_save=False, sharded_save=False, total_limit=None, seed=0):
+    import optax
+
+    from accelerate_tpu import Accelerator, SimpleDataLoader
+    from accelerate_tpu.data_loader import BatchSampler
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+    from accelerate_tpu.utils import ProjectConfiguration
+
+    accelerator = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(base_dir), automatic_checkpoint_naming=True, total_limit=total_limit
+        ),
+        async_save=async_save,
+        sharded_save=sharded_save,
+    )
+    n = 16
+    data = [RegressionDataset(length=n, seed=seed)[i] for i in range(n)]
+    dl = SimpleDataLoader(data, BatchSampler(range(n), 8))
+    model, opt, pdl = accelerator.prepare(RegressionModel(), optax.sgd(0.05), dl)
+    return accelerator, model, opt, pdl
+
+
+def train_steps(accelerator, model, opt, pdl, steps, save_each=True):
+    stream = (b for _ in iter(int, 1) for b in pdl)
+    paths = []
+    for _ in range(steps):
+        batch = next(stream)
+        accelerator.backward(model.loss, batch)
+        opt.step()
+        opt.zero_grad()
+        if save_each:
+            paths.append(accelerator.save_state())
+    stream.close()
+    return paths
+
+
+# ------------------------------------------------------------------ committer mechanics
+def test_committer_serializes_commits_and_surfaces_failure_at_barrier():
+    committer = AsyncCommitter()
+    order = []
+    committer.submit(lambda abort: (time.sleep(0.05), order.append("first")), "first")
+    # submit barriers on the previous commit: "first" lands before "second" starts
+    committer.submit(lambda abort: order.append("second"), "second")
+    committer.wait()
+    assert order == ["first", "second"]
+
+    def fails(abort):
+        raise OSError("disk on fire")
+
+    committer.submit(fails, "third")
+    with pytest.raises(CheckpointCommitError, match="disk on fire"):
+        committer.submit(lambda abort: None, "fourth")
+    # the failure is consumed at the barrier that surfaced it — not re-raised forever
+    committer.wait()
+
+
+def test_committer_poll_surfaces_only_process_death_class():
+    committer = AsyncCommitter()
+
+    def killed(abort):
+        raise InjectedKill("chaos: kill inside commit")
+
+    committer.submit(killed, "killed")
+    time.sleep(0.05)
+    with pytest.raises(InjectedKill):
+        committer.poll()
+
+    committer = AsyncCommitter()
+    committer.submit(lambda abort: (_ for _ in ()).throw(OSError("eio")), "eio")
+    time.sleep(0.05)
+    committer.poll()  # ordinary Exception keeps to the barrier contract
+    with pytest.raises(CheckpointCommitError):
+        committer.wait()
+
+
+def test_committer_abort_stops_commit_before_publish(tmp_path):
+    manager = CheckpointManager(str(tmp_path))
+    committer = AsyncCommitter()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def write_fn(staging):
+        entered.set()
+        release.wait(timeout=5)
+        with open(os.path.join(staging, "artifact.bin"), "wb") as f:
+            f.write(b"x" * 16)
+
+    committer.submit(lambda abort: manager.save(7, write_fn, abort=abort), "ckpt7")
+    assert entered.wait(timeout=5)
+    committer._abort.set()
+    release.set()
+    error = committer.abort_and_join()
+    assert isinstance(error, CheckpointCommitError)
+    # aborted BEFORE the publish rename: no checkpoint_7, only staging litter
+    assert manager.checkpoints() == []
+    with pytest.raises(CheckpointCommitError):
+        committer.submit(lambda abort: None, "after-abort")  # single-use after abort
+
+
+# ------------------------------------------------------------------ next_step race safety
+def test_next_step_counts_inflight_background_saves(tmp_path):
+    """Satellite regression: two overlapping saves must never mint the same
+    step. A save staged by the background committer is invisible to the
+    directory listing until its publish rename — next_step() must count it."""
+    manager = CheckpointManager(str(tmp_path))
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_write(staging):
+        started.set()
+        release.wait(timeout=5)
+        with open(os.path.join(staging, "artifact.bin"), "wb") as f:
+            f.write(b"a" * 8)
+
+    worker = threading.Thread(target=lambda: manager.save(0, slow_write))
+    worker.start()
+    try:
+        assert started.wait(timeout=5)
+        # nothing published yet — the OLD next_step() returned 0 here (collision)
+        assert manager.checkpoints() == []
+        assert manager.next_step() == 1
+        # overlapping save of the SAME in-flight step is refused outright
+        with pytest.raises(ValueError, match="already has a save in flight"):
+            manager.save(0, lambda staging: None)
+    finally:
+        release.set()
+        worker.join(timeout=5)
+    assert manager.next_step() == 1
+    assert [step for step, _ in manager.checkpoints()] == [0]
+
+
+def test_two_overlapping_accelerator_saves_publish_distinct_steps(tmp_path):
+    accelerator, model, opt, pdl = build_accelerator(tmp_path, async_save=True)
+    train_steps(accelerator, model, opt, pdl, 1, save_each=False)
+    first = accelerator.save_state()
+    second = accelerator.save_state()  # barriers on the first commit
+    accelerator.drain_checkpoints()
+    assert first != second
+    assert os.path.isdir(first) and os.path.isdir(second)
+    assert verify_checkpoint_dir(first) and verify_checkpoint_dir(second)
+
+
+# ------------------------------------------------------------------ round trips
+@pytest.mark.parametrize("sharded", [False, True], ids=["flat", "sharded"])
+def test_async_save_round_trips_through_sync_load(tmp_path, sharded):
+    accelerator, model, opt, pdl = build_accelerator(
+        tmp_path, async_save=True, sharded_save=sharded
+    )
+    train_steps(accelerator, model, opt, pdl, 3)
+    accelerator.drain_checkpoints()
+    digest = params_digest(model)
+
+    fresh, model2, opt2, pdl2 = build_accelerator(tmp_path)
+    fresh.load_state("latest")
+    assert params_digest(model2) == digest
+    # the next save after resume does not collide with existing checkpoints
+    path = fresh.save_state()
+    assert verify_checkpoint_dir(path)
+
+
+def test_sharded_layout_and_manifest(tmp_path):
+    accelerator, model, opt, pdl = build_accelerator(tmp_path, sharded_save=True)
+    train_steps(accelerator, model, opt, pdl, 1)
+    ckpt = accelerator.checkpoint_manager().resolve("latest")
+    assert is_sharded_checkpoint_dir(ckpt)
+    host = os.path.join(ckpt, shard_host_dir(0))
+    assert os.path.isfile(os.path.join(host, "model.npz"))
+    assert os.path.isfile(os.path.join(host, "SHARD_DONE"))
+    manifest = json.load(open(os.path.join(ckpt, "MANIFEST.json")))
+    assert manifest["sharded"] == {"num_hosts": 1, "hosts": [shard_host_dir(0)]}
+    # the directory manifest digests the host subdir's files too
+    assert any(rel.startswith(shard_host_dir(0) + os.sep) for rel in manifest["files"])
+    assert verify_checkpoint_dir(ckpt)
+    # a torn shard payload fails directory verification
+    target = os.path.join(host, "model.npz")
+    with open(target, "r+b") as f:
+        f.truncate(os.path.getsize(target) // 2)
+    assert not verify_checkpoint_dir(ckpt)
+
+
+def test_simulated_two_host_checkpoint_gathers_to_parity(tmp_path):
+    """The multi-host layout, exercised without multiple processes: two hosts
+    each write only their row slice of every leaf; gather-on-load must
+    reassemble the exact full tree (the single-host pod-recovery path)."""
+    import jax
+
+    rng = np.random.default_rng(0)
+    full = {
+        "w": rng.standard_normal((8, 6)).astype(np.float32),
+        "inner": {"b": rng.standard_normal((4,)).astype(np.float32)},
+    }
+    _, treedef = jax.tree_util.tree_flatten(full)
+    for host, rows in ((0, (0, 4)), (1, (4, 8))):
+        host_dir = tmp_path / shard_host_dir(host)
+        os.makedirs(host_dir)
+        entries = [
+            {
+                "path": "inner/b",
+                "global_shape": [4],
+                "dtype": np.dtype(np.float32),
+                # replicated small leaf: both hosts write the whole thing
+                "shards": [([[0, 4]], full["inner"]["b"])],
+            },
+            {
+                "path": "w",
+                "global_shape": [8, 6],
+                "dtype": np.dtype(np.float32),
+                "shards": [([[rows[0], rows[1]], [0, 6]], full["w"][rows[0]:rows[1]])],
+            },
+        ]
+        leaf_treedef = jax.tree_util.tree_structure({"inner": {"b": 0}, "w": 0})
+        save_pytree_shards(entries, leaf_treedef, str(host_dir / "model.npz"), host)
+    gathered = load_pytree_gathered(str(tmp_path), "model.npz")
+    np.testing.assert_array_equal(gathered["w"], full["w"])
+    np.testing.assert_array_equal(gathered["inner"]["b"], full["inner"]["b"])
+    # and the directory-level manifest covers both hosts' files
+    write_checkpoint_manifest(str(tmp_path), step=0)
+    assert verify_checkpoint_dir(str(tmp_path))
+
+
+def test_snapshot_pytree_is_a_true_copy(tmp_path):
+    import jax.numpy as jnp
+
+    tree = {"a": jnp.arange(8.0), "b": np.arange(4, dtype=np.int32)}
+    snap = snapshot_pytree(tree)
+    assert isinstance(snap["a"], np.ndarray)
+    snap["b"][0] = 99  # mutating the snapshot must not touch the original
+    assert tree["b"][0] == 0 or snap["b"] is not tree["b"]
+    entries, _ = snapshot_shards(tree)
+    assert {e["path"] for e in entries} == {"a", "b"}
+    save_pytree_host_shards(tree, str(tmp_path / shard_host_dir(0) / "t.npz"))
+    out = load_pytree_gathered(str(tmp_path), "t.npz")
+    np.testing.assert_array_equal(out["a"], np.arange(8.0))
+
+
+# ------------------------------------------------------------------ goodput property
+def test_async_save_charges_only_blocking_time(tmp_path):
+    """THE satellite property: with a 0.4 s injected fsync stall, a SYNC save
+    charges >= 0.4 s to the ledger's `checkpoint` cause; the SAME stall under
+    an ASYNC save leaves the blocking charge far below it, with the stall
+    showing up in `checkpoint_async_commit_seconds` instead."""
+    delay = 0.4
+    results = {}
+    for mode in ("sync", "async"):
+        base = tmp_path / mode
+        plan = FaultPlan(events=[
+            FaultEvent(kind="fs.slow_fsync", path_pattern="model.npz", at_call=1,
+                       args={"delay_s": delay}),
+        ])
+        session = ChaosSession(plan)
+        accelerator, model, opt, pdl = build_accelerator(base, async_save=(mode == "async"))
+        with FilesystemInjector(session):
+            train_steps(accelerator, model, opt, pdl, 1)
+            accelerator.drain_checkpoints()
+        results[mode] = {
+            "lost_checkpoint_s": accelerator.timeline.goodput()["lost_s"].get("checkpoint", 0.0),
+            "commit_s": accelerator._m_ckpt_commit_seconds.sum,
+            "commits": accelerator._m_ckpt_commit_seconds.count,
+        }
+    assert results["sync"]["lost_checkpoint_s"] >= 0.9 * delay
+    assert results["sync"]["commits"] == 0
+    assert results["async"]["lost_checkpoint_s"] <= 0.5 * delay
+    assert results["async"]["commits"] == 1
+    assert results["async"]["commit_s"] >= 0.9 * delay
+
+
+# ------------------------------------------------------------------ failure surfacing
+def test_failed_async_commit_surfaces_on_next_save(tmp_path):
+    """Repeated EIO on the model artifact exhausts the manager's retries inside
+    the background commit; the NEXT save's barrier must raise — a failed async
+    commit is never silently dropped."""
+    plan = FaultPlan(events=[
+        FaultEvent(kind="fs.io_error", path_pattern="model.npz", times=0,
+                   args={"errno": "EIO"}),
+    ])
+    session = ChaosSession(plan)
+    accelerator, model, opt, pdl = build_accelerator(tmp_path, async_save=True)
+    with FilesystemInjector(session):
+        train_steps(accelerator, model, opt, pdl, 1)
+        time.sleep(0.05)
+        with pytest.raises(CheckpointCommitError):
+            # the barrier of the next save surfaces the dead commit
+            train_steps(accelerator, model, opt, pdl, 1)
+    # the failed step never published
+    assert accelerator.checkpoint_manager().checkpoints() == []
+
+
+def test_kill_mid_background_commit_keeps_previous_checkpoint_loadable(tmp_path):
+    """ISSUE acceptance: a kill during a background commit never corrupts the
+    previously published checkpoint. The committer of step 1 dies inside the
+    model artifact's rename window; checkpoint_0 must stay the verified,
+    loadable latest."""
+    plan = FaultPlan(events=[
+        FaultEvent(kind="fs.crash_in_rename", path_pattern="model.npz", at_call=2),
+    ])
+    session = ChaosSession(plan)
+    accelerator, model, opt, pdl = build_accelerator(tmp_path, async_save=True)
+    digests = []
+    with FilesystemInjector(session):
+        for _ in range(2):
+            train_steps(accelerator, model, opt, pdl, 1, save_each=False)
+            digests.append(params_digest(model))
+            accelerator.save_state()
+        with pytest.raises(InjectedKill):
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                accelerator.poll_async_checkpoint()
+                time.sleep(0.01)
+        # process-death semantics: the dying run aborts its committer
+        accelerator.abort_async_checkpoint()
+    manager = accelerator.checkpoint_manager()
+    resolved = manager.resolve("latest")
+    assert resolved.endswith("checkpoint_0")
+    assert verify_checkpoint_dir(resolved)
+    fresh, model2, _opt2, _pdl2 = build_accelerator(tmp_path)
+    fresh.load_state("latest")
+    assert params_digest(model2) == digests[0]
+
+
+def test_preemption_flushes_inflight_commit(tmp_path):
+    """PreemptionHandler path: check_preemption() drains the in-flight commit
+    before writing the preemption checkpoint, so the handoff never races a
+    background commit."""
+    import signal
+
+    accelerator, model, opt, pdl = build_accelerator(tmp_path, async_save=True)
+    handler = accelerator.register_preemption_checkpoint(exit_on_save=False)
+    train_steps(accelerator, model, opt, pdl, 1)
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert handler.preemption_requested
+    assert accelerator.check_preemption() is True
+    # both the async step-0 save and the preemption save are committed + verified
+    manager = accelerator.checkpoint_manager()
+    steps = [step for step, path in manager.checkpoints() if verify_checkpoint_dir(path)]
+    assert steps == [0, 1]
+    handler.uninstall()
+
+
+# ------------------------------------------------------------------ CLI env protocol
+def test_launch_exports_async_and_sharded_save_env(tmp_path):
+    import argparse
+
+    from accelerate_tpu.commands.launch import add_launch_args, build_launch_env
+
+    parser = argparse.ArgumentParser()
+    add_launch_args(parser)
+    args = parser.parse_args(["--async_save", "--sharded_save", "script.py"])
+    env = build_launch_env(args, {})
+    assert env["ACCELERATE_TPU_ASYNC_SAVE"] == "1"
+    assert env["ACCELERATE_TPU_SHARDED_SAVE"] == "1"
+    # and the Accelerator-side default reads them
+    args = parser.parse_args(["script.py"])
+    env = build_launch_env(args, {"async_save": True})
+    assert env["ACCELERATE_TPU_ASYNC_SAVE"] == "1"

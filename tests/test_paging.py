@@ -400,3 +400,52 @@ def test_page_pool_reset_forgets_prefixes_and_refuses_bad_release():
     with pytest.raises(ValueError, match="scratch"):
         pool.release([SCRATCH_PAGE])
     assert pool.check_consistency() == []
+
+
+def test_admission_bucket_planner_is_a_closed_set():
+    """Satellite pin (the serving_bench first-hit recompile fix): over the
+    WHOLE admission domain — every prompt length x prefix-match depth x
+    several pool geometries — the planned insert bucket is a power of two or
+    the single capped top value, the kept prefix still fits the cache window,
+    and the suffix still fits the bucket. An open set of matched_len-dependent
+    remainder buckets is exactly what used to compile a fresh insert on the
+    first deep prefix hit of a timed run."""
+    for page_size, padded in ((16, 128), (16, 120), (4, 40), (8, 72), (4, 24)):
+        ladder_limit = padded
+        for p in range(1, padded + 1):
+            for matched in range(0, p // page_size + 1):
+                bucket, keep = ContinuousBatcher.plan_admission_bucket(
+                    p, matched, page_size, padded
+                )
+                matched_len = keep * page_size
+                assert keep <= matched
+                assert p - matched_len <= bucket, (p, matched, bucket, keep)
+                assert matched_len + bucket <= padded, (p, matched, bucket, keep)
+                assert bucket & (bucket - 1) == 0 or bucket == ladder_limit, (
+                    p, matched, bucket,
+                )
+
+
+def test_warm_inserts_precompiles_every_reachable_bucket():
+    """After warm_inserts(), NO admission — whatever prompt length or
+    prefix-cache depth — compiles a new insert executable, and warming leaves
+    engine state untouched (admissions still serve token-identically)."""
+    model = _model()
+    engine = ContinuousBatcher(model, num_slots=2, max_length=24, chunk_size=4, page_size=4)
+    warmed = engine.warm_inserts()
+    assert warmed == engine.insert_bucket_ladder() == [1, 2, 4, 8, 16, 24]
+    baseline = dict(engine.trace_counts)
+    rng = np.random.default_rng(3)
+    system = rng.integers(1, 128, (8,)).astype(np.int32)
+    rid = 0
+    for trial in range(10):
+        tail = rng.integers(1, 128, (int(rng.integers(1, 17)),)).astype(np.int32)
+        prompt = np.concatenate([system, tail])[:20] if trial % 2 else tail
+        out = engine.run([Request(rid, prompt, max_new_tokens=4)])
+        reference = _static_reference(model, prompt, 4)
+        np.testing.assert_array_equal(np.asarray(out[rid]), reference)
+        engine.release(rid)
+        rid += 1
+    assert engine.trace_counts["insert"] == baseline["insert"], (
+        baseline, engine.trace_counts,
+    )

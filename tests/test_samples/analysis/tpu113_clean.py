@@ -1,0 +1,18 @@
+"""TPU113 negative: checkpoint at the step boundary, from host code."""
+import jax
+
+from accelerate_tpu.checkpointing import save_pytree
+
+
+@jax.jit
+def train_step(params, batch):
+    return params  # the traced program only computes
+
+
+def train(params, batches, ckpt_dir):
+    for step, batch in enumerate(batches):
+        params = train_step(params, batch)
+        if step % 100 == 0:
+            # sanctioned: blocking I/O at the step boundary, outside the trace
+            save_pytree(params, f"{ckpt_dir}/model_{step}.npz")
+    return params

@@ -1,0 +1,13 @@
+"""TPU113 blocking-ckpt-in-jit: checkpoint I/O inside a jitted program."""
+import jax
+
+from accelerate_tpu.checkpointing import save_pytree
+
+
+@jax.jit
+def train_step(params, batch):
+    grads = params  # stand-in update
+    # hazard: serialize+fsync inside the traced program — a host sync at best,
+    # a tracer leak at worst
+    save_pytree(grads, "/tmp/ckpt/model.npz")
+    return grads
