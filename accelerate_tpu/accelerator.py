@@ -43,6 +43,7 @@ from typing import Any, Callable, List, Optional, Union
 import numpy as np
 
 from .checkpointing import (
+    AdaptiveSaveInterval,
     AsyncCommitter,
     CheckpointCommitError,
     CheckpointManager,
@@ -121,6 +122,8 @@ class Accelerator:
         tracer=None,
         async_save: Optional[bool] = None,
         sharded_save: Optional[bool] = None,
+        save_interval: Optional[Union[int, str]] = None,
+        lost_checkpoint_s: float = 300.0,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -273,6 +276,20 @@ class Accelerator:
         self.async_save = bool(async_save)
         self.sharded_save = bool(sharded_save)
         self._async_committer: Optional[AsyncCommitter] = None
+        # Checkpoint cadence (ROADMAP 4b): `save_interval="auto"` derives the
+        # save interval from the goodput ledger's measured blocking save cost
+        # against the `lost_checkpoint_s` budget (work a crash may lose); an
+        # int is the classic fixed every-N-steps cadence. Either arms
+        # `maybe_save_state()` as the step-boundary driver.
+        self.save_controller: Optional[AdaptiveSaveInterval] = None
+        if save_interval == "auto":
+            self.save_controller = AdaptiveSaveInterval(lost_checkpoint_s=lost_checkpoint_s)
+        elif save_interval is not None:
+            self.save_controller = AdaptiveSaveInterval(
+                lost_checkpoint_s=lost_checkpoint_s, fixed_interval=int(save_interval)
+            )
+        self._steps_since_save = 0
+        self._last_step_boundary: Optional[float] = None
         self._m_ckpt_commit_seconds = self.telemetry.histogram(
             "checkpoint_async_commit_seconds",
             help="background (async) checkpoint commit wall-clock — overlapped "
@@ -1127,6 +1144,39 @@ class Accelerator:
         for i, obj in enumerate(self._custom_objects):
             if self.is_main_process:
                 save_custom_state(obj, output_dir, i)
+
+    def maybe_save_state(self, output_dir: Optional[str] = None, **save_kwargs) -> Optional[str]:
+        """Step-boundary checkpoint driver for the `save_interval` cadence:
+        call once per training step; it times the step gap, asks the
+        controller whether a save is due, and — when it is — runs
+        `save_state()` and feeds the controller the goodput ledger's measured
+        blocking cost (for `save_interval="auto"`, that measurement is what
+        sets the NEXT interval against the `lost_checkpoint_s` budget).
+        Returns the checkpoint path when a save ran, else None."""
+        if self.save_controller is None:
+            raise RuntimeError(
+                "maybe_save_state() needs a cadence: construct the Accelerator with "
+                'save_interval="auto" (goodput-driven) or save_interval=<steps>'
+            )
+        now = time.perf_counter()
+        if self._last_step_boundary is not None:
+            self.save_controller.observe_step(now - self._last_step_boundary)
+        self._last_step_boundary = now
+        self._steps_since_save += 1
+        if not self.save_controller.should_save(self._steps_since_save):
+            return None
+        charged_before = self.timeline.goodput()["lost_s"].get("checkpoint", 0.0)
+        t0 = time.perf_counter()
+        path = self.save_state(output_dir, **save_kwargs)
+        blocked = time.perf_counter() - t0
+        charged = self.timeline.goodput()["lost_s"].get("checkpoint", 0.0) - charged_before
+        # The ledger's charge IS the blocking cost (async saves charge only
+        # snapshot+barrier); fall back to the local wall clock if a custom
+        # timeline did not record one.
+        self.save_controller.observe_save(charged if charged > 0 else blocked)
+        self._steps_since_save = 0
+        self._last_step_boundary = time.perf_counter()  # save time is not step time
+        return path
 
     def save_state(
         self,

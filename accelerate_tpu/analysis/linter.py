@@ -110,6 +110,10 @@ class _ModuleIndex:
     def __init__(self, tree: ast.Module):
         self.tree = tree
         self.jax_aliases: Set[str] = set()
+        #: A REAL jax/jax.numpy import was seen (the conventional jnp/np
+        #: fallbacks below don't count): the "jit-adjacent module" signal
+        #: rules like TPU114 scope themselves to.
+        self.imports_jax = False
         self.jnp_aliases: Set[str] = set()
         self.np_aliases: Set[str] = set()
         self.lax_aliases: Set[str] = set()
@@ -136,14 +140,18 @@ class _ModuleIndex:
                     name, bound = alias.name, alias.asname or alias.name.split(".")[0]
                     if name == "jax":
                         self.jax_aliases.add(bound)
+                        self.imports_jax = True
                     elif name in ("jax.numpy",):
                         self.jnp_aliases.add(alias.asname or "jax")
+                        self.imports_jax = True
                     elif name in ("numpy",):
                         self.np_aliases.add(bound)
                     elif name == "functools":
                         pass
             elif isinstance(node, ast.ImportFrom):
                 mod = node.module or ""
+                if mod == "jax" or mod.startswith("jax."):
+                    self.imports_jax = True
                 for alias in node.names:
                     bound = alias.asname or alias.name
                     if mod == "jax" and alias.name == "numpy":
@@ -660,7 +668,56 @@ class _ModuleChecker:
         self._check_pjit_annotations()
         self._check_static_argnums_and_donation()
         self._check_closure_capture()
+        self._check_serving_construction()
         return self.findings
+
+    # -- serving-engine construction (TPU114) -----------------------------------
+    #: Serving front-end constructors whose robustness knobs this rule audits.
+    _SERVING_CTORS = {"ContinuousBatcher", "Router"}
+
+    def _check_serving_construction(self):
+        """TPU114: a serving engine/router built in jit-adjacent code (the
+        module really imports jax) without bounded queue backpressure — or a
+        Router without a default deadline — fails open under overload:
+        the host queue grows without limit and a stalled replica can hold a
+        request forever instead of surfacing a terminal finish_reason."""
+        if not self.index.imports_jax:
+            return
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = None
+            if isinstance(func, ast.Name) and func.id in self._SERVING_CTORS:
+                name = func.id
+            elif isinstance(func, ast.Attribute) and func.attr in self._SERVING_CTORS:
+                name = func.attr
+            if name is None:
+                continue
+            kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            max_queue = kwargs.get("max_queue")
+            if "max_queue" not in kwargs or (
+                isinstance(max_queue, ast.Constant) and max_queue.value is None
+            ):
+                self.emit(
+                    node,
+                    "TPU114",
+                    f"{name}(...) without a bounded max_queue grows the host wait "
+                    "queue without limit under overload — pass max_queue=<bound> "
+                    "so backpressure surfaces as QueueFull",
+                )
+            if name == "Router":
+                deadline = kwargs.get("default_deadline_s")
+                if "default_deadline_s" not in kwargs or (
+                    isinstance(deadline, ast.Constant) and deadline.value is None
+                ):
+                    self.emit(
+                        node,
+                        "TPU114",
+                        "Router(...) without default_deadline_s lets a request wait "
+                        "forever on a stalled replica — give the fleet a default "
+                        "per-request deadline",
+                    )
 
     def _check_jit_placement(self):
         for call in self.index.jit_calls:

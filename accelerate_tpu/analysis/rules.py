@@ -159,6 +159,18 @@ RULES = (
         "commits it on the background committer); serialize+fsync inside a "
         "traced program is a host sync at best and a trace error at worst",
     ),
+    Rule(
+        id="TPU114",
+        slug="unbounded-serving-queue",
+        severity="warn",
+        summary="ContinuousBatcher/Router constructed without bounded queue "
+        "backpressure (max_queue) — or a Router without a default request "
+        "deadline — in jit-adjacent serving code",
+        fixit="pass max_queue=<bound> so overload surfaces as QueueFull "
+        "backpressure instead of unbounded host-memory growth, and give "
+        "Router a default_deadline_s=<seconds> so every request reaches a "
+        "terminal finish_reason even when a replica stalls",
+    ),
 )
 
 RULES_BY_ID = {r.id: r for r in RULES}

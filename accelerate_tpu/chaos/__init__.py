@@ -32,6 +32,7 @@ from .injectors import (
     HarnessInjector,
     InjectedBackendError,
     InjectedKill,
+    RouterInjector,
     ServingInjector,
     StepBoundaryInjector,
     catalog,
@@ -52,6 +53,7 @@ __all__ = [
     "HarnessInjector",
     "InjectedBackendError",
     "InjectedKill",
+    "RouterInjector",
     "ServingInjector",
     "StepBoundaryInjector",
     "ChaosRunner",

@@ -371,6 +371,51 @@ class ServingInjector:
         return self
 
 
+class RouterInjector:
+    """Per-replica fault seams on a serving `Router`'s fleet: wraps EVERY
+    replica engine's decode-chunk dispatch, identifying the replica through the
+    `path` trigger channel (``path_pattern: "replica_0"`` targets replica 0;
+    `at_call` then counts that replica's own dispatches). Re-arms automatically
+    when the `ReplicaSet` rebuilds a killed replica's engine, so a rejoined
+    replica is chaos-visible again.
+
+      - ``router.replica_stall``  — sleep before the dispatch (degraded signal)
+      - ``router.replica_poison`` — raise `InjectedBackendError` (engine blast
+        radius; the replica survives, the router's failure counter observes it)
+      - ``router.replica_kill``   — raise `InjectedKill` (a BaseException the
+        engine's fault isolation must NOT swallow: the in-process analogue of
+        a worker process SIGKILL — the router must eject and recover)
+    """
+
+    def __init__(self, session: ChaosSession):
+        self.session = session
+
+    def arm(self, router) -> "RouterInjector":
+        session = self.session
+
+        def wrap(index, engine):
+            real_chunk = engine._chunk_fn
+            token = f"replica_{index}"
+
+            def chunk_with_chaos(*args, **kwargs):
+                for ev in session.fire("router.replica_stall", path=token):
+                    session.clock.sleep(float(ev.args.get("delay_s", 0.05)))
+                for ev in session.fire("router.replica_poison", path=token):
+                    raise InjectedBackendError(
+                        f"chaos: poisoned decode dispatch on replica {index}"
+                    )
+                for ev in session.fire("router.replica_kill", path=token):
+                    raise InjectedKill(f"chaos: killed replica {index}")
+                return real_chunk(*args, **kwargs)
+
+            engine._chunk_fn = chunk_with_chaos
+
+        for replica in router.replica_set.replicas:
+            wrap(replica.index, replica.engine)
+        router.replica_set.on_engine_built.append(wrap)
+        return self
+
+
 def _consume_donated_state(engine):
     """Model the accelerator-only half of a dispatch failure: a program that
     started executing CONSUMES its donated operands even when it fails, leaving

@@ -69,6 +69,22 @@ FAULT_KINDS: Dict[str, str] = {
         "submit args.count (default 8) extra requests in one burst at a matching serve step "
         "(drives the bounded queue into QueueFull backpressure)"
     ),
+    "router.replica_kill": (
+        "kill one replica of a serving Router mid-traffic: the replica's decode dispatch "
+        "raises InjectedKill (the in-process analogue of a worker SIGKILL — no engine "
+        "handler may swallow it), the router must eject it, re-dispatch never-streamed "
+        "requests and surface finish_reason=replica_lost for streamed ones. Target via "
+        "path_pattern 'replica_N' (at_call counts that replica's dispatches)"
+    ),
+    "router.replica_stall": (
+        "stall args.delay_s (default 0.05) before one replica's decode dispatch (the "
+        "degraded-health signal); target via path_pattern 'replica_N'"
+    ),
+    "router.replica_poison": (
+        "one replica's decode dispatch raises InjectedBackendError (the engine-level "
+        "blast radius: its in-flight requests error, the replica survives and the router's "
+        "failure counters observe it); target via path_pattern 'replica_N'"
+    ),
     "harness.disable_verification": (
         "seeded-regression fixture: neuter checkpoint digest verification so torn checkpoints "
         "resolve — the invariant report MUST go red (proves the harness detects regressions)"
@@ -113,7 +129,7 @@ class FaultEvent:
 
 #: Workloads a plan may declare as its intended harness (`ChaosRunner` entry
 #: points; the CLI's default when `--workload` is omitted).
-PLAN_WORKLOADS = ("train", "async-train", "serve", "supervised-train")
+PLAN_WORKLOADS = ("train", "async-train", "serve", "supervised-train", "router")
 
 
 @dataclass
@@ -246,6 +262,26 @@ def builtin_plans() -> Dict[str, FaultPlan]:
                 # And a post-publish torn write: resolve() must fall back.
                 FaultEvent(kind="fs.torn_write", path_pattern="model.npz*", at_call=6,
                            args={"offset": 1}),
+            ],
+        ),
+        "smoke-router": FaultPlan(
+            name="smoke-router",
+            seed=0,
+            workload="router",
+            notes="replicated-fleet degradation chain: stall one replica (degraded), poison "
+            "another's dispatch (blast radius, replica survives), then kill a third outright "
+            "(eject -> re-dispatch/replica_lost -> rejoin) — every request must reach a "
+            "terminal finish_reason, no token stream may duplicate, the fleet must recover, "
+            "and the router must never route to an ejected replica",
+            events=[
+                # Burst first so least-loaded routing actually spreads work
+                # over the whole fleet (per-replica at_call triggers below
+                # count each replica's OWN dispatches).
+                FaultEvent(kind="serve.queue_burst", at_step=1, args={"count": 8}),
+                FaultEvent(kind="router.replica_stall", path_pattern="replica_1", at_call=2,
+                           args={"delay_s": 0.02}),
+                FaultEvent(kind="router.replica_poison", path_pattern="replica_2", at_call=2),
+                FaultEvent(kind="router.replica_kill", path_pattern="replica_0", at_call=4),
             ],
         ),
         "seeded-regression": FaultPlan(

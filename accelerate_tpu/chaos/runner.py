@@ -52,6 +52,7 @@ from .injectors import (
     FilesystemInjector,
     HarnessInjector,
     InjectedKill,
+    RouterInjector,
     ServingInjector,
     StepBoundaryInjector,
 )
@@ -62,6 +63,14 @@ logger = get_logger(__name__)
 
 class _GracefulPreemption(Exception):
     """In-process stand-in for the SIGTERM -> checkpoint -> exit-143 handoff."""
+
+
+def _reason_counts(finish_reasons: Dict[int, Optional[str]]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for reason in finish_reasons.values():
+        key = reason if reason is not None else "none"
+        out[key] = out.get(key, 0) + 1
+    return out
 
 
 # ------------------------------------------------------------------ independent evidence
@@ -190,6 +199,12 @@ class InvariantReport:
     checks: List[InvariantCheck] = field(default_factory=list)
     injections: List[dict] = field(default_factory=list)
     metrics: List[dict] = field(default_factory=list)
+    #: Tagged runner diagnostics that are not invariant verdicts — e.g.
+    #: ``{"tag": "crash_loop", ...}`` when a sweep was cut short because the
+    #: workload made no forward progress across restarts (the async at_step
+    #: SIGKILL livelock): the report says WHY it stopped instead of burning
+    #: the whole restart budget on a deterministic loop.
+    diagnostics: List[dict] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -208,6 +223,7 @@ class InvariantReport:
             "checks": [c.to_dict() for c in self.checks],
             "injections": self.injections,
             "metrics": self.metrics,
+            "diagnostics": self.diagnostics,
         }
 
     def to_json(self, indent: Optional[int] = 2) -> str:
@@ -229,6 +245,7 @@ class InvariantReport:
             ],
             injections=data.get("injections", []),
             metrics=data.get("metrics", []),
+            diagnostics=data.get("diagnostics", []),
         )
 
     @classmethod
@@ -252,6 +269,9 @@ class InvariantReport:
             counts[entry["kind"]] = counts.get(entry["kind"], 0) + 1
         for kind in sorted(counts):
             lines.append(f"  injected {kind} x{counts[kind]}")
+        for diag in self.diagnostics:
+            detail = " ".join(f"{k}={v}" for k, v in sorted(diag.items()) if k != "tag")
+            lines.append(f"  diagnostic [{diag.get('tag', '?')}] {detail}")
         return "\n".join(lines)
 
 
@@ -291,6 +311,7 @@ class ChaosRunner:
         keep_last_n: int = 3,
         downtime_budget_s: float = 5.0,
         async_save: bool = False,
+        no_progress_threshold: int = 6,
     ) -> InvariantReport:
         """In-process supervised train loop: RegressionModel, one checkpoint per
         step, chaos polled at every boundary. An `InjectedKill` ends an attempt
@@ -305,7 +326,17 @@ class ChaosRunner:
         exactly like a process death, and an ordinary commit failure (EIO
         retries exhausted) surfaces as `CheckpointCommitError` on the next
         save's barrier — counted as a crash, restarted, and the previously
-        published checkpoint must still resolve."""
+        published checkpoint must still resolve.
+
+        `no_progress_threshold`: after that many CONSECUTIVE restarts with no
+        new independently-verified checkpoint published (the same step being
+        killed over and over — e.g. an every-match `at_step` SIGKILL whose
+        async commit can never publish), the runner stops sweeping and tags a
+        ``crash_loop`` diagnostic instead of spending the whole restart budget
+        on a deterministic livelock. The default leaves headroom for
+        legitimate transient-fault storms (a several-retry EIO burst clears
+        after a few fruitless restarts and must not be cut short); 0 disables
+        the detector."""
         from ..checkpointing import CheckpointCommitError
 
         journal: Dict[str, Any] = {
@@ -316,6 +347,10 @@ class ChaosRunner:
         restarts = 0
         downtime_s = 0.0
         completed = False
+        checkpoint_base = os.path.join(str(base_dir), "checkpoints")
+        diagnostics: List[dict] = []
+        last_progress = independent_latest_step(checkpoint_base)
+        no_progress = 0
         boundary = StepBoundaryInjector(self.session, hard=False)
         with FilesystemInjector(self.session), HarnessInjector(self.session):
             while True:
@@ -362,10 +397,33 @@ class ChaosRunner:
                 restarts += 1
                 if restarts > max_restarts:
                     break
+                # No-forward-progress detection: a restart that resumes with
+                # the SAME newest verified checkpoint as the last one made no
+                # progress; K in a row is a livelock, not a recovery chain.
+                progress = independent_latest_step(checkpoint_base)
+                if progress == last_progress:
+                    no_progress += 1
+                else:
+                    no_progress = 0
+                last_progress = progress
+                if no_progress_threshold and no_progress >= no_progress_threshold:
+                    diagnostics.append({
+                        "tag": "crash_loop",
+                        "why": "no_forward_progress",
+                        "restarts_without_new_checkpoint": no_progress,
+                        "stuck_at_verified_step": progress,
+                        "restarts": restarts,
+                    })
+                    logger.error(
+                        "chaos: CRASH LOOP — %d consecutive restarts with no new "
+                        "published checkpoint (stuck at verified step %s); stopping "
+                        "the sweep. diagnostic=crash_loop",
+                        no_progress, progress,
+                    )
+                    break
                 backoff = min(0.01 * restarts, 0.05)
                 self.session.clock.sleep(backoff)
                 downtime_s += backoff
-        checkpoint_base = os.path.join(str(base_dir), "checkpoints")
         checks = [
             self._check_resume_exactness(journal),
             self._check_no_torn_resolved(journal, checkpoint_base),
@@ -374,7 +432,9 @@ class ChaosRunner:
             self._check_ledger_reconciles(ledger, journal, async_save=async_save),
             self._check_trace_complete(journal),
         ]
-        return self._report("async-train" if async_save else "train", checks)
+        return self._report(
+            "async-train" if async_save else "train", checks, diagnostics=diagnostics
+        )
 
     def _train_attempt(
         self,
@@ -482,6 +542,7 @@ class ChaosRunner:
         max_restarts: int = 4,
         downtime_budget_s: float = 30.0,
         async_save: bool = False,
+        no_progress_threshold: int = 6,
     ) -> InvariantReport:
         """The end-to-end path: the real `Supervisor` restarting a real
         subprocess workload (`python -m accelerate_tpu.chaos.workload`), the
@@ -510,6 +571,8 @@ class ChaosRunner:
         preemption_handoffs = 0
         downtime_s = 0.0
         crash_loop = False
+        crash_loop_reason = None
+        checkpoint_base = os.path.join(base_dir, "checkpoints")
         while True:
             supervisor = Supervisor(
                 cmd,
@@ -520,6 +583,16 @@ class ChaosRunner:
                 max_backoff_seconds=0.2,
                 monitor_interval=0.05,
                 crash_loop_min_uptime=0.0,  # every attempt imports jax; uptime is not a crash signal here
+                # No-forward-progress detection: each subprocess attempt
+                # re-arms the plan from env, so an every-attempt at_step kill
+                # whose (async) checkpoint can never publish would otherwise
+                # re-kill the SAME step until the budget burns — the newest
+                # independently-verified checkpoint is the progress token.
+                # Same headroom rationale as run_train's default: a transient
+                # fault storm may burn a few attempts before the first publish
+                # and must not be cut short.
+                progress_fn=lambda: independent_latest_step(checkpoint_base),
+                no_progress_threshold=no_progress_threshold,
                 # Attempt spans + trace-context injection: each child re-arms
                 # via Tracer.from_env and parents its spans under the attempt
                 # that spawned it — the restart chain stitches into ONE trace.
@@ -529,12 +602,22 @@ class ChaosRunner:
             restarts += supervisor.restart_count
             downtime_s += supervisor.downtime_s
             crash_loop = crash_loop or supervisor.crash_loop_detected
+            crash_loop_reason = crash_loop_reason or supervisor.crash_loop_reason
+            if supervisor.crash_loop_detected:
+                break
             if code == PREEMPTED_EXIT_CODE and preemption_handoffs + restarts < max_restarts:
                 preemption_handoffs += 1
                 continue
             break
         journal = self._read_workload_journal(base_dir)
-        checkpoint_base = os.path.join(base_dir, "checkpoints")
+        diagnostics: List[dict] = []
+        if crash_loop:
+            diagnostics.append({
+                "tag": "crash_loop",
+                "why": crash_loop_reason or "unknown",
+                "restarts": restarts,
+                "stuck_at_verified_step": independent_latest_step(checkpoint_base),
+            })
         checks = [
             self._check_resume_exactness(journal),
             self._check_no_torn_resolved(journal, checkpoint_base),
@@ -550,6 +633,7 @@ class ChaosRunner:
                     "downtime_s": round(downtime_s, 6),
                     "downtime_budget_s": downtime_budget_s,
                     "crash_loop_detected": crash_loop,
+                    "crash_loop_reason": crash_loop_reason,
                 },
             ),
         ]
@@ -563,7 +647,7 @@ class ChaosRunner:
                 labels={"kind": entry["kind"]},
             ).inc()
         checks.append(self._check_trace_complete(journal, supervised=True))
-        return self._report("supervised-train", checks)
+        return self._report("supervised-train", checks, diagnostics=diagnostics)
 
     @staticmethod
     def _read_workload_journal(base_dir: str) -> Dict[str, Any]:
@@ -724,6 +808,264 @@ class ChaosRunner:
             self._check_serve_trace(accepted),
         ]
         return self._report("serve", checks)
+
+    # ---------------------------------------------------------------- router
+    def run_router(
+        self,
+        num_requests: int = 12,
+        replicas: int = 3,
+        num_slots: int = 2,
+        chunk_size: int = 4,
+        max_queue: int = 8,
+        max_new_tokens: int = 4,
+        max_cycles: int = 400,
+        hedge_after_s: Optional[float] = None,
+    ) -> InvariantReport:
+        """Replicated-fleet workload: a `router.Router` over N in-process
+        engines fed one request per cycle, driven to drain while the
+        `RouterInjector` kills / stalls / poisons individual replicas
+        mid-traffic. The machine-checked invariants:
+
+          - **terminal_finish_reasons** — every accepted request reaches a
+            terminal reason from `ROUTER_FINISH_REASONS` (``replica_lost``
+            included) and the workload drains without stalling;
+          - **no_duplicate_streams** — the concatenation of every stream event
+            the router forwarded for a request equals that request's final
+            token list EXACTLY (a retried or hedged request can never deliver
+            a token twice);
+          - **fleet_recovered** — requests submitted AFTER the first injected
+            replica fault still complete normally, and a killed replica is
+            back in a routable state by drain;
+          - **no_route_to_ejected** — the routing journal contains no decision
+            that placed work on a replica while it was ejected (or draining);
+          - **ledger_reconciles** — chaos counters match the injection journal
+            and `router_retries_total` matches the routing journal's retries.
+        """
+        from ..models.llama import LlamaConfig, create_llama_model
+        from ..router import ROUTER_FINISH_REASONS, Router
+        from ..serving import QueueFull, Request
+
+        cfg = LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+            rope_theta=10000.0,
+        )
+        model = create_llama_model(cfg, seq_len=32)
+        router = Router(
+            model, replicas=replicas, num_slots=num_slots, max_length=64,
+            chunk_size=chunk_size, max_queue=max_queue, default_deadline_s=60.0,
+            hedge_after_s=hedge_after_s, registry=self.session.registry,
+            tracer=self.tracer, paged=True, page_size=4,
+            rejoin_cooldown_s=0.05, probation_steps=2, stall_degrade_s=None,
+        )
+        RouterInjector(self.session).arm(router)
+        rng = np.random.default_rng(self.plan.seed)
+
+        next_id = 0
+        rejected = 0
+        accepted: List[int] = []
+        streamed: Dict[int, List[int]] = {}
+        first_id_after_fault: Optional[int] = None
+
+        def submit_one() -> bool:
+            nonlocal next_id, rejected
+            prompt = rng.integers(1, cfg.vocab_size, (int(rng.integers(2, 9)),)).astype(np.int32)
+            request = Request(next_id, prompt, max_new_tokens=max_new_tokens)
+            next_id += 1
+            try:
+                router.submit(request)
+            except QueueFull:
+                rejected += 1
+                return False
+            accepted.append(request.request_id)
+            streamed[request.request_id] = []
+            return True
+
+        router_kinds = ("router.replica_kill", "router.replica_stall", "router.replica_poison")
+        fault_planned = any(ev.kind in router_kinds for ev in self.plan.events)
+        recovery_probes = 3 if fault_planned else 0
+        probes_sent = 0
+        faults_before = 0
+        cycles = 0
+        stalled = False
+        while (
+            len(accepted) < num_requests
+            or router.pending
+            or (first_id_after_fault is not None and probes_sent < recovery_probes)
+        ):
+            if cycles >= max_cycles:
+                stalled = True
+                break
+            if len(accepted) < num_requests:
+                submit_one()
+            elif first_id_after_fault is not None and probes_sent < recovery_probes:
+                if submit_one():
+                    probes_sent += 1
+            for ev in self.session.fire("serve.queue_burst", step=cycles):
+                for _ in range(int(ev.args.get("count", 8))):
+                    submit_one()
+            for rid, toks in router.step():
+                if rid in streamed:
+                    streamed[rid].extend(toks)
+            fault_count = sum(
+                1 for e in self.session.injections if e["kind"] in router_kinds
+            )
+            if fault_count > faults_before and first_id_after_fault is None:
+                first_id_after_fault = next_id
+            faults_before = fault_count
+            cycles += 1
+        results = dict(router.drain())
+        # Recovery phase: a replica killed late in the run is still inside its
+        # rejoin cooldown when the traffic drains — keep cycling (bounded)
+        # until the health machine brings every replica back, so
+        # `fleet_recovered` measures actual recovery, not drain timing.
+        while (
+            any(s == "ejected" for s in router.replica_states.values())
+            and cycles < max_cycles
+        ):
+            self.session.clock.sleep(0.01)
+            router.step()
+            cycles += 1
+        for _ in range(router.replica_set.probation_steps + 1):
+            router.step()
+        final_states = dict(router.replica_states)
+        routing_log = list(router.routing_log)
+        state_log = list(router.replica_set.state_log)
+        retries_counter = int(router.stats["retries"])
+        router.close()
+
+        finish_reasons = {
+            rid: results[rid].finish_reason if rid in results else None for rid in accepted
+        }
+        non_terminal = {
+            rid: reason for rid, reason in finish_reasons.items()
+            if reason not in ROUTER_FINISH_REASONS
+        }
+        duplicate_streams = {
+            rid: {"streamed": streamed[rid], "result": list(results[rid].tokens)}
+            for rid in accepted
+            if rid in results and streamed[rid] != list(results[rid].tokens)
+        }
+        checks = [
+            InvariantCheck(
+                "terminal_finish_reasons",
+                passed=not non_terminal and not stalled,
+                details={
+                    "accepted": len(accepted), "rejected_queue_full": rejected,
+                    "non_terminal": non_terminal, "stalled": stalled, "cycles": cycles,
+                    "reasons": _reason_counts(finish_reasons),
+                },
+            ),
+            InvariantCheck(
+                "no_duplicate_streams",
+                passed=not duplicate_streams,
+                details={"mismatched": duplicate_streams},
+            ),
+            self._check_fleet_recovered(
+                finish_reasons, first_id_after_fault, final_states, fault_planned
+            ),
+            self._check_no_route_to_ejected(routing_log, state_log),
+            self._check_router_ledger(routing_log, retries_counter, accepted, finish_reasons),
+        ]
+        return self._report("router", checks)
+
+    def _check_fleet_recovered(
+        self,
+        finish_reasons: Dict[int, Optional[str]],
+        first_id_after_fault: Optional[int],
+        final_states: Dict[int, str],
+        fault_planned: bool,
+    ) -> InvariantCheck:
+        """After a replica fault, LATER requests must complete normally (the
+        fleet degraded instead of failing) and no replica may end the run
+        ejected — the cooldown/rejoin machinery must have brought it back."""
+        if not fault_planned:
+            return InvariantCheck(
+                "fleet_recovered", True, {"note": "no router fault in plan"}
+            )
+        later = {
+            rid: fr for rid, fr in finish_reasons.items()
+            if first_id_after_fault is not None and rid >= first_id_after_fault
+        }
+        bad = {
+            rid: fr for rid, fr in later.items()
+            if fr not in ("eos", "length", "timeout")
+        }
+        still_ejected = {i: s for i, s in final_states.items() if s == "ejected"}
+        return InvariantCheck(
+            "fleet_recovered",
+            passed=bool(later) and not bad and not still_ejected,
+            details={
+                "requests_after_fault": len(later),
+                "abnormal_after_fault": bad,
+                "final_replica_states": final_states,
+                "first_id_after_fault": first_id_after_fault,
+            },
+        )
+
+    @staticmethod
+    def _check_no_route_to_ejected(
+        routing_log: List[dict], state_log: List[dict]
+    ) -> InvariantCheck:
+        """Audit every routing decision against the health history: the router
+        journals the replica's state at decision time, and the state log lets
+        us independently reconstruct ejected/draining windows."""
+        bad = [e for e in routing_log if e.get("state") in ("ejected", "draining")]
+        # Independent reconstruction: walk the state log and verify no routing
+        # timestamp lands inside an (ejected -> rejoining) window.
+        windows: Dict[int, List[List[float]]] = {}
+        for tr in state_log:
+            if tr["to"] == "ejected":
+                windows.setdefault(tr["replica"], []).append([tr["t"], float("inf")])
+            elif tr["from"] == "ejected" and tr["replica"] in windows:
+                spans = windows[tr["replica"]]
+                if spans and spans[-1][1] == float("inf"):
+                    spans[-1][1] = tr["t"]
+        inside = [
+            e for e in routing_log
+            if any(
+                lo < e["t"] < hi
+                for lo, hi in windows.get(e["replica"], [])
+            )
+        ]
+        return InvariantCheck(
+            "no_route_to_ejected",
+            passed=not bad and not inside,
+            details={
+                "decisions": len(routing_log),
+                "routed_while_unroutable": bad,
+                "routed_inside_ejected_window": inside,
+                "ejection_windows": {k: v for k, v in windows.items()},
+            },
+        )
+
+    def _check_router_ledger(
+        self,
+        routing_log: List[dict],
+        retries_counter: int,
+        accepted: List[int],
+        finish_reasons: Dict[int, Optional[str]],
+    ) -> InvariantCheck:
+        counts = self.session.counts()
+        registry_ok = all(
+            self.session.registry.value("chaos_injected_total", {"kind": kind}) == count
+            for kind, count in counts.items()
+        )
+        journal_retries = sum(1 for e in routing_log if e["kind"] == "retry")
+        finished_total = sum(1 for r in finish_reasons.values() if r is not None)
+        return InvariantCheck(
+            "ledger_reconciles",
+            passed=registry_ok and journal_retries == retries_counter
+            and finished_total == len(accepted),
+            details={
+                "injected_counts": counts,
+                "registry_matches_journal": registry_ok,
+                "router_retries_total": retries_counter,
+                "journal_retries": journal_retries,
+                "finished_total": finished_total,
+                "accepted": len(accepted),
+            },
+        )
 
     @staticmethod
     def _check_page_ledger(engine) -> InvariantCheck:
@@ -1027,11 +1369,17 @@ class ChaosRunner:
         )
 
     # ---------------------------------------------------------------- report assembly
-    def _report(self, workload: str, checks: List[InvariantCheck]) -> InvariantReport:
+    def _report(
+        self,
+        workload: str,
+        checks: List[InvariantCheck],
+        diagnostics: Optional[List[dict]] = None,
+    ) -> InvariantReport:
         return InvariantReport(
             plan=self.plan.to_dict(),
             workload=workload,
             checks=checks,
             injections=list(self.session.injections),
             metrics=self.session.registry.snapshot(),
+            diagnostics=list(diagnostics or []),
         )

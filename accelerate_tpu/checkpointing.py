@@ -1612,3 +1612,139 @@ def load_custom_state(obj, path: str, index: int = 0):
         )
     with open(location, "rb") as f:
         obj.load_state_dict(pickle.load(f))
+
+
+# ------------------------------------------------------------------ adaptive cadence
+class AdaptiveSaveInterval:
+    """Goodput-driven checkpoint cadence: derive *how often to save* from the
+    MEASURED cost of saving versus a lost-work budget, instead of a fixed step
+    count (ROADMAP 4b).
+
+    Two observations feed the controller (both host-side seconds, typically
+    straight out of the goodput ledger's "checkpoint" cause):
+
+      - ``observe_step(seconds)``  — one training step's wall clock;
+      - ``observe_save(seconds)``  — one save's BLOCKING cost (for async saves
+        this is only the snapshot+barrier time, exactly what the ledger
+        charges — the background commit is free cadence-wise).
+
+    Both are folded into exponential moving averages, and the interval is::
+
+        budget_cap     = lost_checkpoint_s / avg_step_s      # save at least
+                                                             # this often: a
+                                                             # crash loses at
+                                                             # most the budget
+        overhead_floor = avg_save_s / (overhead_fraction * avg_step_s)
+                                                             # save at most
+                                                             # this often: save
+                                                             # cost stays under
+                                                             # the goodput
+                                                             # fraction
+        interval = clamp(budget_cap, min_interval, max_interval)
+        interval = max(interval, overhead_floor)             # goodput wins a
+                                                             # conflict (warned
+                                                             # once): a budget
+                                                             # you cannot
+                                                             # afford degrades
+                                                             # rather than
+                                                             # drowning the run
+                                                             # in saves
+
+    A ``fixed_interval`` turns the controller into the classic every-N-steps
+    cadence (observations still recorded, so flipping to adaptive later has
+    warm EMAs). The controller is pure observation -> arithmetic: no clocks,
+    no I/O — unit-testable against a `chaos.FakeClock`-driven ledger.
+    """
+
+    def __init__(
+        self,
+        lost_checkpoint_s: float = 300.0,
+        overhead_fraction: float = 0.05,
+        min_interval: int = 1,
+        max_interval: int = 100_000,
+        ema: float = 0.3,
+        fixed_interval: Optional[int] = None,
+    ):
+        if lost_checkpoint_s <= 0:
+            raise ValueError("lost_checkpoint_s must be > 0")
+        if not 0 < overhead_fraction < 1:
+            raise ValueError("overhead_fraction must be in (0, 1)")
+        if min_interval < 1 or max_interval < min_interval:
+            raise ValueError("need 1 <= min_interval <= max_interval")
+        if not 0 < ema <= 1:
+            raise ValueError("ema must be in (0, 1]")
+        if fixed_interval is not None and fixed_interval < 1:
+            raise ValueError("fixed_interval must be >= 1")
+        self.lost_checkpoint_s = float(lost_checkpoint_s)
+        self.overhead_fraction = float(overhead_fraction)
+        self.min_interval = int(min_interval)
+        self.max_interval = int(max_interval)
+        self.ema = float(ema)
+        self.fixed_interval = fixed_interval
+        self.avg_step_s: Optional[float] = None
+        self.avg_save_s: Optional[float] = None
+        self.steps_observed = 0
+        self.saves_observed = 0
+        self._warned_unaffordable = False
+
+    def _fold(self, current: Optional[float], sample: float) -> float:
+        sample = max(float(sample), 0.0)
+        if current is None:
+            return sample
+        return (1.0 - self.ema) * current + self.ema * sample
+
+    def observe_step(self, seconds: float):
+        self.avg_step_s = self._fold(self.avg_step_s, seconds)
+        self.steps_observed += 1
+
+    def observe_save(self, seconds: float):
+        self.avg_save_s = self._fold(self.avg_save_s, seconds)
+        self.saves_observed += 1
+
+    @property
+    def interval(self) -> Optional[int]:
+        """Steps between saves under the current measurements; None until at
+        least one step has been observed (no cadence without a step clock)."""
+        if self.fixed_interval is not None:
+            return self.fixed_interval
+        if self.avg_step_s is None:
+            return None
+        step_s = max(self.avg_step_s, 1e-9)
+        budget_cap = int(self.lost_checkpoint_s / step_s)
+        interval = max(self.min_interval, min(budget_cap, self.max_interval))
+        if self.avg_save_s is not None and self.avg_save_s > 0:
+            overhead_floor = int(
+                -(-self.avg_save_s // (self.overhead_fraction * step_s))
+            )
+            if overhead_floor > interval:
+                if not self._warned_unaffordable and overhead_floor > budget_cap:
+                    self._warned_unaffordable = True
+                    logger.warning(
+                        "adaptive save interval: a save costs %.3fs against %.4fs steps — "
+                        "holding the lost-work budget of %.1fs would spend more than "
+                        "%.0f%% of wall clock on checkpoints; stretching the interval to "
+                        "%d steps (effective exposure %.1fs). Cut save cost (async_save/"
+                        "sharded_save) or raise lost_checkpoint_s.",
+                        self.avg_save_s, step_s, self.lost_checkpoint_s,
+                        self.overhead_fraction * 100, overhead_floor,
+                        overhead_floor * step_s,
+                    )
+                interval = min(overhead_floor, self.max_interval)
+        return interval
+
+    def should_save(self, steps_since_save: int) -> bool:
+        interval = self.interval
+        return interval is not None and steps_since_save >= interval
+
+    def describe(self) -> dict:
+        """Controller state for logs/telemetry (host scalars only)."""
+        return {
+            "interval": self.interval,
+            "fixed": self.fixed_interval,
+            "avg_step_s": self.avg_step_s,
+            "avg_save_s": self.avg_save_s,
+            "steps_observed": self.steps_observed,
+            "saves_observed": self.saves_observed,
+            "lost_checkpoint_s": self.lost_checkpoint_s,
+            "overhead_fraction": self.overhead_fraction,
+        }

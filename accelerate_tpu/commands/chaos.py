@@ -42,9 +42,10 @@ def register_subcommand(subparsers):
     run.add_argument(
         "--workload",
         default=None,
-        choices=(None, "train", "async-train", "serve", "supervised-train"),
+        choices=(None, "train", "async-train", "serve", "supervised-train", "router"),
         help="Workload to drive (default: the plan's own `workload` field, else inferred "
-        "from its fault kinds; `async-train` saves through the background committer)",
+        "from its fault kinds; `async-train` saves through the background committer; "
+        "`router` drives a replicated serving fleet under per-replica faults)",
     )
     run.add_argument("--base-dir", default=None, help="Checkpoint/journal dir (default: a temp dir)")
     run.add_argument(
@@ -55,7 +56,8 @@ def register_subcommand(subparsers):
         "$ACCELERATE_TPU_TRACE_DIR, else in-memory only",
     )
     run.add_argument("--steps", type=int, default=6, help="Train steps (train workloads)")
-    run.add_argument("--requests", type=int, default=8, help="Requests (serve workloads)")
+    run.add_argument("--requests", type=int, default=8, help="Requests (serve/router workloads)")
+    run.add_argument("--replicas", type=int, default=3, help="Fleet size (router workload)")
     run.add_argument("--json", action="store_true", dest="as_json", help="Emit the report as JSON")
     run.add_argument("--report-out", default=None, help="Also save the report JSON to this path")
     run.set_defaults(func=chaos_run_command)
@@ -95,6 +97,8 @@ def _load_plan(spec: str):
 def _infer_workload(plan) -> str:
     if getattr(plan, "workload", None):
         return plan.workload
+    if any(ev.kind.startswith("router.") for ev in plan.events):
+        return "router"
     return "serve" if any(ev.kind.startswith("serve.") for ev in plan.events) else "train"
 
 
@@ -109,6 +113,8 @@ def chaos_run_command(args):
     runner = ChaosRunner(plan, trace_dir=trace_dir)
     if workload == "serve":
         report = runner.run_serve(num_requests=args.requests)
+    elif workload == "router":
+        report = runner.run_router(num_requests=args.requests, replicas=args.replicas)
     else:
         # Default scratch dirs are cleaned up after the report is assembled
         # (checkpoint trees add up across CI runs); an explicit --base-dir is

@@ -99,6 +99,14 @@ def add_launch_args(parser):
         "writes only its addressable mesh shards into its own host_*/ subdirectory; "
         "restore gathers on load (docs/guides/checkpointing.md)",
     )
+    parser.add_argument(
+        "--replicas",
+        type=int,
+        default=None,
+        help="Serving fleet size exported as ACCELERATE_TPU_SERVE_REPLICAS: a serving "
+        "script that builds a router.Router(replicas=None) sizes its engine fleet from "
+        "the launcher (docs/serving.md Replication)",
+    )
     parser.add_argument("--tpu_use_cluster", action="store_true", help="Launch on every worker of a TPU pod")
     parser.add_argument("--tpu_name", default=None)
     parser.add_argument("--tpu_zone", default=None)
@@ -154,6 +162,9 @@ def build_launch_env(args, config: dict) -> dict:
         env["ACCELERATE_TPU_ASYNC_SAVE"] = "1"
     if getattr(args, "sharded_save", False) or config.get("sharded_save"):
         env["ACCELERATE_TPU_SHARDED_SAVE"] = "1"
+    replicas = pick(getattr(args, "replicas", None), "replicas")
+    if replicas:
+        env["ACCELERATE_TPU_SERVE_REPLICAS"] = str(replicas)
 
     # Plugin blocks from the questionnaire YAML -> the env protocol the worker-side
     # dataclasses' __post_init__ reads (reference utils/launch.py:226-267 FSDP_* block).

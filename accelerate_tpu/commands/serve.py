@@ -1,0 +1,165 @@
+"""`accelerate-tpu serve` — drive a replicated serving fleet from the CLI.
+
+Builds a `router.Router` over `--replicas` in-process engines (the
+`ContinuousBatcher` slot/paged machinery behind a health-routed front-end:
+least-loaded routing, bounded per-replica backpressure, never-streamed retry,
+`finish_reason=replica_lost` for streamed requests on a dead replica, rolling
+`swap_weights` — docs/serving.md "Replication") and serves a batch of requests
+through it, emitting one JSON line per finished request on stdout::
+
+    accelerate-tpu serve --model llama-tiny --replicas 3 --requests 16 \
+        --max-new 32 --deadline-s 60
+
+Prompts are synthetic token ids by default (`--requests N --seed S`, the bench
+workload shape); ``--prompts-file FILE`` reads one JSON object per line with a
+``"tokens": [int, ...]`` field instead. Exit code 0 when every request reached
+a normal terminal reason, 1 when any finished `error`/`replica_lost`/`timeout`.
+
+`--replicas` defaults to the launch env protocol
+(``ACCELERATE_TPU_SERVE_REPLICAS``, exported by ``accelerate-tpu launch
+--replicas N``), so a supervised serving job sizes its fleet from the launcher.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "serve",
+        help="Serve a batch of requests through a replicated (health-routed) engine fleet",
+        description=__doc__,
+    )
+    parser.add_argument("--model", default="llama-tiny", help="Named model (accelerate_tpu.models)")
+    parser.add_argument(
+        "--replicas", type=int, default=None,
+        help="Engine fleet size (default: $ACCELERATE_TPU_SERVE_REPLICAS, else 2)",
+    )
+    parser.add_argument("--num-slots", type=int, default=4, help="Slots per replica engine")
+    parser.add_argument("--chunk-size", type=int, default=8, help="Decode tokens per dispatch")
+    parser.add_argument("--max-length", type=int, default=None, help="Per-slot cache length")
+    parser.add_argument(
+        "--max-queue", type=int, default=64,
+        help="Bounded wait queue PER REPLICA (backpressure surfaces as queue_full)",
+    )
+    parser.add_argument(
+        "--deadline-s", type=float, default=120.0,
+        help="Default per-request wall-clock deadline (finish_reason=timeout past it)",
+    )
+    parser.add_argument(
+        "--hedge-after-s", type=float, default=None,
+        help="TTFT hedging: duplicate a still-queued request onto a second replica "
+        "after this many seconds (default: disabled)",
+    )
+    parser.add_argument("--requests", type=int, default=8, help="Synthetic request count")
+    parser.add_argument("--max-new", type=int, default=32, help="max_new_tokens per request")
+    parser.add_argument("--prompt-min", type=int, default=4)
+    parser.add_argument("--prompt-max", type=int, default=32)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--prompts-file", default=None,
+        help='JSONL with one {"tokens": [...], "max_new_tokens": N?} object per line '
+        "(replaces the synthetic workload)",
+    )
+    parser.add_argument("--no-paged", action="store_true", help="Contiguous per-slot KV layout")
+    parser.set_defaults(func=serve_command)
+    return parser
+
+
+def _load_requests(args, vocab_size):
+    import numpy as np
+
+    from ..serving import Request
+
+    if args.prompts_file:
+        requests = []
+        with open(args.prompts_file) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                record = json.loads(line)
+                requests.append(Request(
+                    i, np.asarray(record["tokens"], np.int32),
+                    max_new_tokens=int(record.get("max_new_tokens", args.max_new)),
+                ))
+        return requests
+    rng = np.random.default_rng(args.seed)
+    lo, hi = args.prompt_min, max(args.prompt_min, args.prompt_max)
+    return [
+        Request(
+            i,
+            rng.integers(1, vocab_size, (int(rng.integers(lo, hi + 1)),)).astype(np.int32),
+            max_new_tokens=args.max_new,
+        )
+        for i in range(args.requests)
+    ]
+
+
+def serve_command(args):
+    from ..models import create_named_model, get_model_family
+    from ..router import Router
+
+    _fam, cfg = get_model_family(args.model)
+    requests = _load_requests(args, cfg.vocab_size)
+    if not requests:
+        print("accelerate-tpu serve: no requests to serve", file=sys.stderr)
+        raise SystemExit(2)
+    longest = max(int(len(r.input_ids)) + r.max_new_tokens for r in requests)
+    max_length = args.max_length or min(cfg.max_position_embeddings, longest)
+    model = create_named_model(args.model, seq_len=min(128, max_length))
+    router = Router(
+        model,
+        replicas=args.replicas,
+        num_slots=args.num_slots,
+        max_length=max_length,
+        chunk_size=args.chunk_size,
+        max_queue=args.max_queue,
+        default_deadline_s=args.deadline_s,
+        hedge_after_s=args.hedge_after_s,
+        paged=not args.no_paged,
+    )
+    print(
+        f"[serve] model {args.model} | {router.num_replicas} replica(s) x "
+        f"{args.num_slots} slots, chunk {args.chunk_size}, cache {max_length} | "
+        f"{len(requests)} request(s)",
+        file=sys.stderr, flush=True,
+    )
+    # Pace submissions against the fleet's backpressure: a workload larger
+    # than replicas * max_queue must wait for capacity, not crash on the
+    # QueueFull signal the bounded queues exist to raise.
+    from collections import deque
+
+    from ..serving import QueueFull
+
+    pending = deque(requests)
+    while pending or router.pending:
+        while pending:
+            try:
+                router.submit(pending[0])
+            except QueueFull:
+                break
+            pending.popleft()
+        router.step()
+    results = router.drain()
+    abnormal = 0
+    for rid in sorted(results):
+        result = results[rid]
+        if result.finish_reason not in ("eos", "length"):
+            abnormal += 1
+        print(json.dumps({
+            "request_id": rid,
+            "finish_reason": result.finish_reason,
+            "tokens": [int(t) for t in result.tokens],
+        }))
+    stats = router.stats
+    print(
+        f"[serve] done: {len(results)} finished ({abnormal} abnormal) | "
+        f"retries {stats['retries']} hedges {stats['hedges']} "
+        f"ejected {stats['ejected']} | states {stats['replica_states']}",
+        file=sys.stderr, flush=True,
+    )
+    router.close()
+    raise SystemExit(0 if abnormal == 0 else 1)

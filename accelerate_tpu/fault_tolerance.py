@@ -52,6 +52,8 @@ class Supervisor:
         monitor_interval: float = 0.5,
         crash_loop_threshold: int = 3,
         crash_loop_min_uptime: float = 3.0,
+        progress_fn: Optional[Callable[[], object]] = None,
+        no_progress_threshold: int = 0,
         tracer=None,
     ):
         self.cmd = cmd
@@ -80,7 +82,21 @@ class Supervisor:
         # by restart N+1. 0 disables the detector.
         self.crash_loop_threshold = crash_loop_threshold
         self.crash_loop_min_uptime = crash_loop_min_uptime
+        # No-forward-progress detection (the uptime detector's complement): a
+        # child can run for seconds, die, restart, and land in exactly the
+        # same place — e.g. an async checkpoint that is killed before every
+        # publish, so each resume replays the same step (the PR-9 livelock).
+        # `progress_fn` returns an opaque progress token (typically the newest
+        # published checkpoint step); `no_progress_threshold` consecutive
+        # failed attempts with an UNCHANGED token abort supervision with a
+        # tagged `crash_loop` diagnostic. 0 disables the detector.
+        self.progress_fn = progress_fn
+        self.no_progress_threshold = no_progress_threshold
         self.crash_loop_detected = False
+        #: Which detector tripped: "fast_identical_exits" | "no_forward_progress".
+        self.crash_loop_reason: Optional[str] = None
+        self._consecutive_no_progress = 0
+        self._last_progress_token: object = None
         self._consecutive_fast_identical = 0
         self._last_exit_code: Optional[int] = None
         self.restart_count = 0
@@ -150,6 +166,8 @@ class Supervisor:
         prev_term = signal.signal(signal.SIGTERM, self._forward_signal)
         prev_int = signal.signal(signal.SIGINT, self._forward_signal)
         attempt = 0
+        if self.progress_fn is not None:
+            self._last_progress_token = self.progress_fn()
         try:
             while True:
                 attempt += 1
@@ -168,6 +186,26 @@ class Supervisor:
                 if code == 0 or code == PREEMPTED_EXIT_CODE or self._terminating:
                     return code
                 uptime = time.monotonic() - spawned_at
+                if self.progress_fn is not None and self.no_progress_threshold > 0:
+                    token = self.progress_fn()
+                    if token == self._last_progress_token:
+                        self._consecutive_no_progress += 1
+                    else:
+                        self._consecutive_no_progress = 0
+                    self._last_progress_token = token
+                    if self._consecutive_no_progress >= self.no_progress_threshold:
+                        self.crash_loop_detected = True
+                        self.crash_loop_reason = "no_forward_progress"
+                        logger.error(
+                            "supervisor: CRASH LOOP — %d consecutive failed attempts "
+                            "with no forward progress (progress token stuck at %r); "
+                            "refusing further restarts (%d restart(s) left unused). "
+                            "diagnostic=crash_loop",
+                            self._consecutive_no_progress,
+                            token,
+                            max(self.max_restarts - self.restart_count, 0),
+                        )
+                        return code
                 fast = uptime < self.crash_loop_min_uptime
                 if fast and code == self._last_exit_code:
                     self._consecutive_fast_identical += 1
@@ -182,6 +220,7 @@ class Supervisor:
                     # sleep; aborting here just refuses to burn the rest of the
                     # budget on a deterministic failure.
                     self.crash_loop_detected = True
+                    self.crash_loop_reason = "fast_identical_exits"
                     logger.error(
                         "supervisor: CRASH LOOP — %d consecutive crashes with identical "
                         "exit code %d, each alive < %.1fs; refusing further restarts "

@@ -1,0 +1,958 @@
+"""Replicated serving fleet: a health-routed front-end over N engines.
+
+A single `ContinuousBatcher` is one process-wide failure domain: a SIGKILL, a
+hung dispatch, or a poisoned executable takes down ALL traffic. This module
+splits the fleet from the engine:
+
+  - `ReplicaSet` owns N engine workers (in-process `ContinuousBatcher`s by
+    default; the `engine_factory` seam is where subprocess/mesh-spanning
+    engines plug in) plus the per-replica **health state machine**::
+
+        live -> degraded -> ejected -> rejoining -> live
+                   ^------------------------------------'
+
+    driven by heartbeats (a replica with work that stops finishing steps),
+    queue-depth / step-latency signals (degraded), and consecutive dispatch
+    failures (ejected). An ejected replica re-enters through a cooldown and a
+    `rejoining` probation window before it is `live` again; a replica whose
+    engine died outright is rebuilt from the factory on rejoin.
+
+  - `Router` is the front-end with the SAME surface as `ContinuousBatcher`
+    (`submit` / `cancel` / `step` / `run` / `drain` / `close` / `release`,
+    `results`, `pending`, `stats`): least-loaded routing over the routable
+    replicas with bounded per-replica backpressure (`max_queue` rides down to
+    every engine; a fleet-wide full queue surfaces as `QueueFull`), a default
+    per-request deadline (`default_deadline_s`) so no request can wait
+    forever, and safe failure handling:
+
+      * a request that NEVER streamed a token is re-dispatched to another
+        replica (`router_retries_total`, bounded by `max_retries`);
+      * a request that already emitted tokens is finished with
+        ``finish_reason="replica_lost"`` — partial tokens kept, never a
+        silently duplicated stream;
+      * optional **TTFT hedging**: a request still queued (zero tokens) after
+        `hedge_after_s` is duplicated onto a second replica; the first copy to
+        stream wins, the loser is cancelled, and only the winner's tokens are
+        ever forwarded.
+
+  - `swap_weights(params)` is the zero-downtime rolling deploy: one replica at
+    a time is drained (unroutable, finishes its own work while the rest keep
+    serving), its params are replaced in place (same pytree structure — no
+    recompile; params are per-dispatch operands), and it rejoins before the
+    next replica drains. The fleet never drops below N-1 serving capacity.
+
+Everything here is host-side bookkeeping on host scalars — the device-facing
+work stays inside each engine, and the router adds zero device syncs (the same
+discipline `analysis` rule TPU114 lints the construction side of).
+
+Telemetry: `router_retries_total`, `router_ejected_total`,
+`router_hedges_total` / `router_hedge_wins_total`, per-replica state/load
+gauges, and one `serve.route` span per request (the engine's `serve.request`
+span stitches under it), all documented in docs/observability.md and
+docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .logging import get_logger
+from .serving import (
+    FINISH_REASONS,
+    ContinuousBatcher,
+    EngineClosed,
+    QueueFull,
+    Request,
+    RequestResult,
+)
+from .telemetry import MetricsRegistry
+from .telemetry.tracing import default_tracer
+
+logger = get_logger(__name__)
+
+#: Env var `accelerate-tpu launch --replicas` exports: the fleet size a serving
+#: script should build when it does not hard-code one (`Router(replicas=None)`).
+SERVE_REPLICAS_ENV = "ACCELERATE_TPU_SERVE_REPLICAS"
+
+#: Terminal finish reasons a Router result can carry: the engine set plus
+#: `replica_lost` (the request's replica failed after it had already streamed
+#: tokens — re-dispatching would duplicate output, so the router surfaces the
+#: loss explicitly with the partial tokens kept).
+ROUTER_FINISH_REASONS = FINISH_REASONS + ("replica_lost",)
+
+#: Health states, in escalation order. `draining` is the rolling-swap state —
+#: unroutable like `ejected`, but healthy and finishing its own work.
+REPLICA_STATES = ("live", "degraded", "ejected", "rejoining", "draining")
+_STATE_CODE = {s: i for i, s in enumerate(REPLICA_STATES)}
+
+
+class ReplicaLost(RuntimeError):
+    """Internal marker for a replica-level failure (engine death)."""
+
+
+def default_replicas() -> int:
+    """Fleet size when the caller does not pass one: the launch env protocol
+    (`launch --replicas N` -> ``ACCELERATE_TPU_SERVE_REPLICAS``), else 2."""
+    raw = os.environ.get(SERVE_REPLICAS_ENV, "").strip()
+    if raw.isdigit() and int(raw) >= 1:
+        return int(raw)
+    return 2
+
+
+def _normalize_params(params_or_model) -> Dict[str, Any]:
+    """Accept a params pytree or a Model bundle; return the engine-shaped
+    ``{"params": ...}`` dict (`ContinuousBatcher.params` convention)."""
+    params = getattr(params_or_model, "params", params_or_model)
+    return params if "params" in params else {"params": params}
+
+
+@dataclass
+class Replica:
+    """One engine worker plus its health bookkeeping (all host scalars)."""
+
+    index: int
+    engine: ContinuousBatcher
+    state: str = "live"
+    consecutive_failures: int = 0
+    #: Engine is gone (process death / fatal dispatch): rejoin must rebuild.
+    dead: bool = False
+    #: Last time this replica finished a step (or went idle) successfully.
+    last_ok: float = 0.0
+    #: When the replica entered `ejected` (cooldown anchor).
+    ejected_at: Optional[float] = None
+    #: Router cycles survived in `rejoining` (probation counter).
+    probation_ok: int = 0
+    #: When degraded pressure was last observed (recovery anchor).
+    unhealthy_at: Optional[float] = None
+
+    @property
+    def routable(self) -> bool:
+        return self.state in ("live", "degraded", "rejoining")
+
+
+class ReplicaSet:
+    """Owns the N engine workers and the per-replica health state machine.
+
+    The set never routes — that is the `Router`'s job — it answers "which
+    replicas may take work, in what preference order" and performs the state
+    transitions (eject / cooldown / probation / rejoin / drain-for-swap),
+    journaling every transition to `state_log` with the clock the Router
+    shares, so chaos invariants can audit routing decisions against health
+    history.
+    """
+
+    def __init__(
+        self,
+        model,
+        replicas: int,
+        engine_kwargs: Optional[Dict[str, Any]] = None,
+        engine_factory: Optional[Callable[[int], ContinuousBatcher]] = None,
+        registry: Optional[MetricsRegistry] = None,
+        tracer=None,
+        clock: Callable[[], float] = time.perf_counter,
+        eject_after_failures: int = 3,
+        rejoin_cooldown_s: float = 1.0,
+        probation_steps: int = 2,
+        stall_degrade_s: Optional[float] = 5.0,
+        degrade_recover_s: float = 1.0,
+        heartbeat_timeout_s: Optional[float] = 30.0,
+    ):
+        if replicas < 1:
+            raise ValueError("a ReplicaSet needs at least one replica")
+        self.model = model
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.tracer = tracer if tracer is not None else default_tracer()
+        self._clock = clock
+        self.eject_after_failures = int(eject_after_failures)
+        self.rejoin_cooldown_s = float(rejoin_cooldown_s)
+        self.probation_steps = int(probation_steps)
+        self.stall_degrade_s = stall_degrade_s
+        self.degrade_recover_s = float(degrade_recover_s)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        #: Hooks called with (index, engine) after every engine build/rebuild —
+        #: the chaos `RouterInjector` re-arms its dispatch wraps through this.
+        self.on_engine_built: List[Callable[[int, ContinuousBatcher], None]] = []
+        #: Weights applied to rebuilt engines (updated by rolling swaps).
+        self.current_params: Optional[Dict[str, Any]] = None
+        #: Every state transition: {"t", "replica", "from", "to", "why"}.
+        #: Bounded like the Router's routing journal (transitions are rare,
+        #: but a flapping replica over months must not grow host memory).
+        self.state_log: deque = deque(maxlen=10_000)
+        self._engine_factory = engine_factory
+        self._m_ejected = self.registry.counter(
+            "router_ejected_total", help="replica ejections (health machine -> ejected)"
+        )
+        self._g_live = self.registry.gauge(
+            "router_replicas_live", help="replicas currently in the live state"
+        )
+        self._g_state = {
+            i: self.registry.gauge(
+                "router_replica_state",
+                help="health state code (0=live 1=degraded 2=ejected 3=rejoining 4=draining)",
+                labels={"replica": str(i)},
+            )
+            for i in range(replicas)
+        }
+        self._g_load = {
+            i: self.registry.gauge(
+                "router_replica_load",
+                help="queued + in-flight requests on this replica",
+                labels={"replica": str(i)},
+            )
+            for i in range(replicas)
+        }
+        self.replicas: List[Replica] = []
+        now = self._clock()
+        for i in range(replicas):
+            replica = Replica(index=i, engine=self._build_engine(i), last_ok=now)
+            self.replicas.append(replica)
+        self._refresh_gauges()
+
+    # ------------------------------------------------------------------ build
+    def _build_engine(self, index: int) -> ContinuousBatcher:
+        if self._engine_factory is not None:
+            engine = self._engine_factory(index)
+        else:
+            engine = ContinuousBatcher(self.model, tracer=self.tracer, **self.engine_kwargs)
+        if self.current_params is not None:
+            engine.params = self.current_params
+        for hook in self.on_engine_built:
+            hook(index, engine)
+        return engine
+
+    # ------------------------------------------------------------------ state
+    def set_state(self, replica: Replica, state: str, why: str):
+        if state not in REPLICA_STATES:
+            raise ValueError(f"unknown replica state {state!r}")
+        if replica.state == state:
+            return
+        old = replica.state
+        replica.state = state
+        now = self._clock()
+        self.state_log.append(
+            {"t": now, "replica": replica.index, "from": old, "to": state, "why": why}
+        )
+        self.tracer.event(
+            "router.replica_state", category="router",
+            replica=replica.index, **{"from": old, "to": state}, why=why,
+        )
+        logger.info(
+            "router: replica %d %s -> %s (%s)", replica.index, old, state, why
+        )
+        if state == "ejected":
+            replica.ejected_at = now
+            self._m_ejected.inc()
+        if state == "rejoining":
+            replica.probation_ok = 0
+        if state == "live":
+            replica.consecutive_failures = 0
+            replica.ejected_at = None
+            replica.unhealthy_at = None
+        self._refresh_gauges()
+
+    def _refresh_gauges(self):
+        self._g_live.set(sum(r.state == "live" for r in self.replicas))
+        for r in self.replicas:
+            self._g_state[r.index].set(_STATE_CODE[r.state])
+            self._g_load[r.index].set(0 if r.dead else r.engine.load)
+
+    # ------------------------------------------------------------------ health
+    def record_step(self, replica: Replica, duration_s: float, errored: bool):
+        """Fold one driven engine step into the health machine: failures feed
+        the consecutive counter (ejecting at the threshold), slow steps degrade,
+        clean fast steps heal and advance probation."""
+        now = self._clock()
+        if errored:
+            replica.consecutive_failures += 1
+            replica.unhealthy_at = now
+            if replica.state == "rejoining":
+                self.set_state(replica, "ejected", "failure during rejoin probation")
+            elif replica.consecutive_failures >= self.eject_after_failures:
+                self.set_state(
+                    replica, "ejected",
+                    f"{replica.consecutive_failures} consecutive dispatch failures",
+                )
+            elif replica.state == "live":
+                self.set_state(replica, "degraded", "dispatch failure")
+            return
+        replica.consecutive_failures = 0
+        replica.last_ok = now
+        slow = self.stall_degrade_s is not None and duration_s > self.stall_degrade_s
+        pressured = (
+            replica.engine.max_queue is not None
+            and replica.engine.queue_depth >= replica.engine.max_queue
+        )
+        if slow or pressured:
+            replica.unhealthy_at = now
+            if replica.state == "live":
+                self.set_state(
+                    replica, "degraded",
+                    f"slow step ({duration_s:.3f}s)" if slow else "queue at capacity",
+                )
+            return
+        if replica.state == "degraded" and (
+            replica.unhealthy_at is None
+            or now - replica.unhealthy_at >= self.degrade_recover_s
+        ):
+            self.set_state(replica, "live", "healthy again")
+        elif replica.state == "rejoining":
+            replica.probation_ok += 1
+            if replica.probation_ok >= self.probation_steps:
+                self.set_state(replica, "live", "probation passed")
+
+    def heartbeat_expired(self, replica: Replica) -> bool:
+        """A replica that HAS work but has not finished a step inside the
+        heartbeat window is hung (the subprocess-worker seam; in-process
+        engines step synchronously and rarely trip this)."""
+        if self.heartbeat_timeout_s is None or replica.dead:
+            return False
+        if not replica.engine.pending:
+            replica.last_ok = self._clock()
+            return False
+        return self._clock() - replica.last_ok > self.heartbeat_timeout_s
+
+    def poll(self):
+        """Cooldown sweep: ejected replicas whose cooldown elapsed re-enter as
+        `rejoining` (rebuilding the engine first when it died with the fault)."""
+        now = self._clock()
+        for replica in self.replicas:
+            if replica.state != "ejected" or replica.ejected_at is None:
+                continue
+            if now - replica.ejected_at < self.rejoin_cooldown_s:
+                continue
+            if replica.dead:
+                replica.engine = self._build_engine(replica.index)
+                replica.dead = False
+            self.set_state(replica, "rejoining", "cooldown elapsed")
+        self._refresh_gauges()
+
+    # ------------------------------------------------------------------ routing view
+    def candidates(self) -> List[Replica]:
+        """Routable replicas in preference order: live first, then degraded,
+        then rejoining (probation traffic) — least-loaded within each class.
+        Ejected and draining replicas are NEVER returned."""
+        order = {"live": 0, "degraded": 1, "rejoining": 2}
+        routable = [r for r in self.replicas if r.routable and not r.dead]
+        return sorted(routable, key=lambda r: (order[r.state], r.engine.load, r.index))
+
+
+class Router:
+    """The replicated serving front-end: same surface as `ContinuousBatcher`,
+    N engines behind it. See the module docstring for the full contract.
+
+    Typical driving loop (identical to the single-engine one)::
+
+        router = Router(model, replicas=3, num_slots=8, max_queue=64,
+                        default_deadline_s=60.0)
+        for r in requests:
+            router.submit(r)
+        while router.pending:
+            for request_id, new_tokens in router.step():
+                stream(request_id, new_tokens)
+        router.swap_weights(new_model)   # rolling deploy, fleet stays >= N-1
+    """
+
+    def __init__(
+        self,
+        model,
+        replicas: Optional[int] = None,
+        max_queue: Optional[int] = 64,
+        default_deadline_s: Optional[float] = None,
+        hedge_after_s: Optional[float] = None,
+        max_retries: int = 1,
+        retry_window_s: float = 5.0,
+        registry: Optional[MetricsRegistry] = None,
+        tracer=None,
+        clock: Callable[[], float] = time.perf_counter,
+        engine_factory: Optional[Callable[[int], ContinuousBatcher]] = None,
+        eject_after_failures: int = 3,
+        rejoin_cooldown_s: float = 1.0,
+        probation_steps: int = 2,
+        stall_degrade_s: Optional[float] = 5.0,
+        degrade_recover_s: float = 1.0,
+        heartbeat_timeout_s: Optional[float] = 30.0,
+        **engine_kwargs,
+    ):
+        n = default_replicas() if replicas is None else int(replicas)
+        self._clock = clock
+        self.metrics = registry if registry is not None else MetricsRegistry()
+        self.tracer = tracer if tracer is not None else default_tracer()
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.default_deadline_s = default_deadline_s
+        self.hedge_after_s = hedge_after_s
+        self.max_retries = int(max_retries)
+        self.retry_window_s = float(retry_window_s)
+        engine_kwargs = dict(engine_kwargs)
+        engine_kwargs.setdefault("max_queue", self.max_queue)
+        self.replica_set = ReplicaSet(
+            model,
+            n,
+            engine_kwargs=engine_kwargs,
+            engine_factory=engine_factory,
+            registry=self.metrics,
+            tracer=self.tracer,
+            clock=clock,
+            eject_after_failures=eject_after_failures,
+            rejoin_cooldown_s=rejoin_cooldown_s,
+            probation_steps=probation_steps,
+            stall_degrade_s=stall_degrade_s,
+            degrade_recover_s=degrade_recover_s,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+        )
+        self.results: Dict[int, RequestResult] = {}
+        #: request_id -> tracking record (attempts, stream state, span).
+        self._tracked: Dict[int, Dict[str, Any]] = {}
+        #: engine-level id -> (request_id, attempt dict); engine ids are
+        #: globally unique across replicas so retries/hedges never collide.
+        self._engine_map: Dict[int, Tuple[int, Dict[str, Any]]] = {}
+        self._next_engine_id = 0
+        self._retry_queue: deque = deque()
+        self._no_capacity_since: Optional[float] = None
+        self._closed = False
+        self._draining = False
+        #: Pending rolling swap: {"params", "queue": [indices], "active": idx}.
+        self._swap: Optional[Dict[str, Any]] = None
+        #: Every routing decision: {"t", "request_id", "replica", "kind",
+        #: "state"} — the chaos no-route-to-ejected invariant audits this.
+        #: Bounded (newest-kept ring, like the flight recorder) so a
+        #: long-running fleet's journal cannot grow host memory without limit.
+        self.routing_log: deque = deque(maxlen=10_000)
+
+        self._m_requests = self.metrics.counter(
+            "router_requests_total", help="requests accepted by the router"
+        )
+        self._m_retries = self.metrics.counter(
+            "router_retries_total",
+            help="never-streamed requests re-dispatched after a replica failure",
+        )
+        self._m_hedges = self.metrics.counter(
+            "router_hedges_total", help="TTFT hedge copies dispatched"
+        )
+        self._m_hedge_wins = self.metrics.counter(
+            "router_hedge_wins_total", help="requests whose hedge copy streamed first"
+        )
+        self._m_finish = {
+            reason: self.metrics.counter(
+                "router_requests_finished_total",
+                help="router-level terminal finish reasons",
+                labels={"reason": reason},
+            )
+            for reason in ROUTER_FINISH_REASONS
+        }
+
+    # ------------------------------------------------------------------ views
+    @property
+    def num_replicas(self) -> int:
+        return len(self.replica_set.replicas)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def pending(self) -> bool:
+        return any(not t["result"].finished for t in self._tracked.values())
+
+    @property
+    def swap_in_progress(self) -> bool:
+        return self._swap is not None
+
+    @property
+    def replica_states(self) -> Dict[int, str]:
+        return {r.index: r.state for r in self.replica_set.replicas}
+
+    @property
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "replicas": self.num_replicas,
+            "replica_states": self.replica_states,
+            "retries": int(self._m_retries.value),
+            "ejected": int(self.replica_set._m_ejected.value),
+            "hedges": int(self._m_hedges.value),
+            "hedge_wins": int(self._m_hedge_wins.value),
+            "finish_reasons": {
+                reason: int(counter.value) for reason, counter in self._m_finish.items()
+            },
+            "per_replica": [
+                None if r.dead else r.engine.stats for r in self.replica_set.replicas
+            ],
+        }
+
+    def warm_inserts(self) -> Dict[int, List[int]]:
+        """Precompile every replica's insert-bucket ladder (the bench's
+        mechanical 0-recompile guarantee, fleet edition)."""
+        return {
+            r.index: r.engine.warm_inserts()
+            for r in self.replica_set.replicas
+            if not r.dead
+        }
+
+    # ------------------------------------------------------------------ submit
+    def submit(self, request: Request) -> int:
+        """Route + enqueue on the least-loaded routable replica. Same caller
+        contract as the engine: `ValueError` for malformed requests,
+        `QueueFull` when EVERY routable replica's bounded queue is at capacity,
+        `EngineClosed` after `close()`/mid-`drain()`."""
+        if self._closed:
+            raise EngineClosed("router is closed")
+        if self._draining:
+            raise EngineClosed("router is draining; resubmit after drain() returns")
+        if request.request_id in self.results:
+            raise ValueError(f"duplicate request_id {request.request_id}")
+        ids = np.asarray(request.input_ids, np.int32).reshape(-1)
+        deadline_s = request.deadline_s
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        now = self._clock()
+        tracked: Dict[str, Any] = {
+            "request": dataclasses.replace(request, input_ids=ids, deadline_s=deadline_s),
+            "result": RequestResult(request.request_id, arrival_time=request.arrival_time),
+            "attempts": [],
+            "winner": None,  # engine_id of the attempt whose tokens we forward
+            "retries": 0,
+            "hedged": False,
+            "submit_t": now,
+            "deadline_at": None if deadline_s is None else now + float(deadline_s),
+            "span": None,
+        }
+        span = self.tracer.start_span(
+            "serve.route", category="router",
+            request_id=int(request.request_id), replicas=self.num_replicas,
+        )
+        tracked["span"] = span
+        try:
+            attempt = self._dispatch(tracked, kind="submit")
+        except ValueError:
+            span.annotate(error="invalid_request").end()
+            raise
+        if attempt is None:
+            span.annotate(error="queue_full").end()
+            raise QueueFull(
+                "every routable replica's queue is at capacity; shed load or retry later"
+            )
+        self.results[request.request_id] = tracked["result"]
+        self._tracked[request.request_id] = tracked
+        self._m_requests.inc()
+        return request.request_id
+
+    def _dispatch(self, tracked: Dict[str, Any], kind: str) -> Optional[Dict[str, Any]]:
+        """Place one attempt of `tracked` on the best routable replica (skipping
+        replicas that already host an attempt). Returns the attempt record, or
+        None when no replica could take it. `ValueError` from engine validation
+        propagates (the caller's bug, reported synchronously, like the engine)."""
+        exclude = {a["replica"] for a in tracked["attempts"] if not a["done"]}
+        if kind == "retry":
+            # Do not retry onto the replica that just failed the request.
+            exclude |= {a["replica"] for a in tracked["attempts"]}
+        request = tracked["request"]
+        now = self._clock()
+        deadline_at = tracked["deadline_at"]
+        remaining = None if deadline_at is None else max(deadline_at - now, 0.0)
+        for replica in self.replica_set.candidates():
+            if replica.index in exclude:
+                continue
+            engine_id = self._next_engine_id
+            engine_request = dataclasses.replace(
+                request, request_id=engine_id, deadline_s=remaining
+            )
+            try:
+                replica.engine.submit(engine_request)
+            except QueueFull:
+                continue
+            except EngineClosed:
+                continue
+            self._next_engine_id += 1
+            attempt = {"replica": replica.index, "engine_id": engine_id,
+                       "kind": kind, "done": False}
+            tracked["attempts"].append(attempt)
+            self._engine_map[engine_id] = (request.request_id, attempt)
+            self.routing_log.append({
+                "t": now, "request_id": request.request_id,
+                "replica": replica.index, "kind": kind, "state": replica.state,
+            })
+            span = tracked["span"]
+            if span is not None:
+                span.event(kind, replica=replica.index, engine_id=engine_id)
+            self.replica_set._refresh_gauges()
+            return attempt
+        return None
+
+    # ------------------------------------------------------------------ cancel / release
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a queued or in-flight request on whichever replica(s) own it:
+        the result finishes `cancelled` with partial tokens kept — the same
+        terminal contract as the single-engine path. Returns False when already
+        finished; raises KeyError for an unknown id."""
+        tracked = self._tracked[request_id]
+        if tracked["result"].finished:
+            return False
+        self._finish(tracked, "cancelled")
+        return True
+
+    def release(self, request_id: int) -> RequestResult:
+        """Drop a FINISHED request's result (host-memory hygiene, engine
+        contract)."""
+        result = self.results[request_id]
+        if not result.finished:
+            raise ValueError(f"request {request_id} is still in flight")
+        del self.results[request_id]
+        self._tracked.pop(request_id, None)
+        return result
+
+    def _abandon_attempt(self, attempt: Dict[str, Any]):
+        """Cancel one engine-level attempt and drop its mapping (router-initiated:
+        the engine's `cancelled` result must never resurface as ours)."""
+        if attempt["done"]:
+            return
+        attempt["done"] = True
+        self._engine_map.pop(attempt["engine_id"], None)
+        replica = self.replica_set.replicas[attempt["replica"]]
+        if replica.dead:
+            return
+        try:
+            replica.engine.cancel(attempt["engine_id"])
+            replica.engine.release(attempt["engine_id"])
+        except (KeyError, ValueError):
+            pass
+
+    def _finish(self, tracked: Dict[str, Any], reason: str, error: Optional[str] = None):
+        for attempt in tracked["attempts"]:
+            self._abandon_attempt(attempt)
+        result = tracked["result"]
+        if result.finished:
+            return
+        result.finished = True
+        result.finish_time = self._clock()
+        result.finish_reason = reason
+        if error is not None:
+            result.error = error
+        self._m_finish[reason].inc()
+        span = tracked["span"]
+        if span is not None:
+            span.annotate(finish_reason=reason, tokens=len(result.tokens),
+                          retries=tracked["retries"])
+            if error is not None:
+                span.annotate(error=error)
+            span.end()
+
+    # ------------------------------------------------------------------ failure handling
+    def _handle_attempt_failure(self, tracked: Dict[str, Any], attempt: Dict[str, Any],
+                                error: str):
+        """The safe re-dispatch rule: a request that already streamed tokens
+        surfaces `replica_lost` (tokens kept, never duplicated); a never-
+        streamed one retries on another replica inside its retry budget."""
+        attempt["done"] = True
+        self._engine_map.pop(attempt["engine_id"], None)
+        result = tracked["result"]
+        if result.finished:
+            return
+        if any(not a["done"] for a in tracked["attempts"]):
+            return  # a hedge copy is still running; it carries the request
+        if result.tokens:
+            self._finish(tracked, "replica_lost", error=error)
+            return
+        if tracked["retries"] >= self.max_retries:
+            self._finish(tracked, "error", error=error)
+            return
+        tracked["retries"] += 1
+        self._retry_queue.append(tracked["request"].request_id)
+
+    def fail_replica(self, index: int, reason: str = "killed", dead: bool = True):
+        """Handle an observed replica failure (the chaos / ops seam; also what
+        `step()` calls when an engine dies under it). Every request with an
+        attempt on the replica goes through the re-dispatch rule; the replica
+        is ejected and — when `dead` — its engine is rebuilt on rejoin."""
+        replica = self.replica_set.replicas[index]
+        victims = [
+            (rid, attempt) for eid, (rid, attempt) in list(self._engine_map.items())
+            if attempt["replica"] == index and not attempt["done"]
+        ]
+        for rid, attempt in victims:
+            if not dead and not replica.dead:
+                # Engine is still healthy (soft kill): free its slot/queue entry.
+                try:
+                    replica.engine.cancel(attempt["engine_id"])
+                    replica.engine.release(attempt["engine_id"])
+                except (KeyError, ValueError):
+                    pass
+            tracked = self._tracked.get(rid)
+            if tracked is not None:
+                self._handle_attempt_failure(tracked, attempt, error=f"replica {index} {reason}")
+        replica.dead = replica.dead or bool(dead)
+        self.replica_set.set_state(replica, "ejected", reason)
+
+    # ------------------------------------------------------------------ hedging
+    def _hedge_sweep(self):
+        if self.hedge_after_s is None:
+            return
+        now = self._clock()
+        for tracked in self._tracked.values():
+            result = tracked["result"]
+            if result.finished or result.tokens or tracked["hedged"]:
+                continue
+            if now - tracked["submit_t"] < self.hedge_after_s:
+                continue
+            if sum(not a["done"] for a in tracked["attempts"]) != 1:
+                continue
+            attempt = self._dispatch(tracked, kind="hedge")
+            if attempt is not None:
+                tracked["hedged"] = True
+                self._m_hedges.inc()
+
+    # ------------------------------------------------------------------ retries
+    def _retry_sweep(self):
+        if not self._retry_queue:
+            self._no_capacity_since = None
+            return
+        pending = len(self._retry_queue)
+        for _ in range(pending):
+            rid = self._retry_queue.popleft()
+            tracked = self._tracked.get(rid)
+            if tracked is None or tracked["result"].finished:
+                continue
+            deadline_at = tracked["deadline_at"]
+            now = self._clock()
+            if deadline_at is not None and now >= deadline_at:
+                self._finish(tracked, "timeout")
+                continue
+            attempt = self._dispatch(tracked, kind="retry")
+            if attempt is None:
+                self._retry_queue.append(rid)
+            else:
+                # Counted at DISPATCH (not at queue time) so the counter and
+                # the routing journal's `retry` entries reconcile exactly.
+                self._m_retries.inc()
+        if self._retry_queue:
+            now = self._clock()
+            if self._no_capacity_since is None:
+                self._no_capacity_since = now
+            elif now - self._no_capacity_since > self.retry_window_s:
+                # The whole fleet has been unroutable for the retry window:
+                # surface the loss instead of queueing invisibly forever.
+                while self._retry_queue:
+                    tracked = self._tracked.get(self._retry_queue.popleft())
+                    if tracked is not None and not tracked["result"].finished:
+                        self._finish(tracked, "error", error="no routable replica")
+        else:
+            self._no_capacity_since = None
+
+    # ------------------------------------------------------------------ swap
+    def swap_weights(self, params_or_model, wait: bool = True) -> List[Tuple[int, List[int]]]:
+        """Rolling weight swap: one replica at a time drains (unroutable,
+        finishing its own work while the rest serve), gets the new params
+        applied in place (per-dispatch operands — no recompile), and rejoins
+        before the next drains; the fleet never drops below N-1 routable.
+
+        `wait=True` (default) drives `step()` until the swap completes and
+        returns the stream events those steps produced (nothing is dropped);
+        `wait=False` just arms the swap — the caller's own `step()` loop
+        advances it."""
+        if self._closed:
+            raise EngineClosed("router is closed")
+        if self._swap is not None:
+            raise RuntimeError("a weight swap is already in progress")
+        params = _normalize_params(params_or_model)
+        self._swap = {
+            "params": params,
+            "queue": deque(r.index for r in self.replica_set.replicas),
+            "active": None,
+        }
+        events: List[Tuple[int, List[int]]] = []
+        if wait:
+            while self._swap is not None:
+                events.extend(self.step())
+        return events
+
+    def _advance_swap(self):
+        swap = self._swap
+        if swap is None:
+            return
+        if swap["active"] is None:
+            if not swap["queue"]:
+                self.replica_set.current_params = swap["params"]
+                self.tracer.event("router.swap_complete", category="router")
+                self._swap = None
+                return
+            index = swap["queue"].popleft()
+            replica = self.replica_set.replicas[index]
+            if replica.dead or replica.state == "ejected":
+                # A dead/ejected replica gets the new params via the rebuild
+                # path on rejoin — nothing to drain.
+                self.replica_set.current_params = swap["params"]
+                return self._advance_swap()
+            swap["active"] = index
+            self.replica_set.set_state(replica, "draining", "rolling weight swap")
+            return
+        replica = self.replica_set.replicas[swap["active"]]
+        if replica.dead or replica.state == "ejected":
+            # The draining replica failed mid-swap: it will pick the new
+            # params up through the rebuild/rejoin path instead.
+            self.replica_set.current_params = swap["params"]
+            swap["active"] = None
+            return self._advance_swap()
+        if not replica.engine.pending:
+            replica.engine.params = swap["params"]
+            self.replica_set.set_state(replica, "live", "weights swapped")
+            self.tracer.event(
+                "router.replica_swapped", category="router", replica=replica.index
+            )
+            swap["active"] = None
+            self._advance_swap()
+
+    # ------------------------------------------------------------------ step
+    def step(self) -> List[Tuple[int, List[int]]]:
+        """One fleet cycle: advance swaps/cooldowns, re-dispatch retries, hedge
+        stale queued requests, drive every replica's engine one step, forward
+        the winning attempts' tokens, and fold failures through the health
+        machine. Returns `(request_id, new_tokens)` in stream order, exactly
+        like the engine."""
+        if self._closed:
+            return []
+        self.replica_set.poll()
+        self._advance_swap()
+        self._retry_sweep()
+        self._hedge_sweep()
+        events: List[Tuple[int, List[int]]] = []
+        for replica in self.replica_set.replicas:
+            if replica.dead or replica.state == "ejected":
+                continue
+            if not replica.engine.pending and replica.state not in ("rejoining", "degraded"):
+                replica.last_ok = self._clock()
+                continue
+            t0 = self._clock()
+            try:
+                engine_events = replica.engine.step()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as exc:  # noqa: BLE001 — a dead engine must not kill the fleet
+                # An exception ESCAPING the engine (its own fault isolation
+                # swallows ordinary dispatch errors) is replica death — the
+                # in-process analogue of a serving worker SIGKILL.
+                logger.warning("router: replica %d died in step(): %r", replica.index, exc)
+                self.fail_replica(replica.index, reason=f"engine died: {exc!r}", dead=True)
+                continue
+            events.extend(self._forward_events(replica, engine_events))
+            errored = self._collect_finished(replica)
+            self.replica_set.record_step(replica, self._clock() - t0, errored)
+            if self.replica_set.heartbeat_expired(replica):
+                self.fail_replica(
+                    replica.index, reason="heartbeat expired (hung engine)", dead=True
+                )
+        self.replica_set._refresh_gauges()
+        return events
+
+    def _forward_events(self, replica: Replica,
+                        engine_events: List[Tuple[int, List[int]]]) -> List[Tuple[int, List[int]]]:
+        out: List[Tuple[int, List[int]]] = []
+        for engine_id, toks in engine_events:
+            mapped = self._engine_map.get(engine_id)
+            if mapped is None or not toks:
+                continue
+            rid, attempt = mapped
+            tracked = self._tracked.get(rid)
+            if tracked is None or tracked["result"].finished:
+                continue
+            if tracked["winner"] is None:
+                tracked["winner"] = engine_id
+                if attempt["kind"] == "hedge":
+                    self._m_hedge_wins.inc()
+                # First token decided the race: cancel every other copy so the
+                # loser can never stream a duplicate.
+                for other in tracked["attempts"]:
+                    if other is not attempt:
+                        self._abandon_attempt(other)
+                span = tracked["span"]
+                if span is not None:
+                    span.event("first_token", replica=replica.index,
+                               hedge=attempt["kind"] == "hedge")
+            if tracked["winner"] != engine_id:
+                continue  # a losing copy raced a token out before its cancel
+            tracked["result"].tokens.extend(toks)
+            if tracked["result"].first_token_time is None:
+                tracked["result"].first_token_time = self._clock()
+            out.append((rid, list(toks)))
+        return out
+
+    def _collect_finished(self, replica: Replica) -> bool:
+        """Scan the replica's finished engine results, map them to router
+        outcomes, and release them from the engine. Returns True when any
+        attempt failed at the replica level this step (feeds the health
+        machine's consecutive-failure counter)."""
+        errored = False
+        finished = [
+            (eid, res) for eid, res in replica.engine.results.items() if res.finished
+        ]
+        for engine_id, res in finished:
+            mapped = self._engine_map.get(engine_id)
+            if mapped is None:
+                # A copy we already abandoned (hedge loser / router cancel).
+                try:
+                    replica.engine.release(engine_id)
+                except (KeyError, ValueError):
+                    pass
+                continue
+            rid, attempt = mapped
+            tracked = self._tracked.get(rid)
+            replica.engine.release(engine_id)
+            if tracked is None or tracked["result"].finished:
+                attempt["done"] = True
+                self._engine_map.pop(engine_id, None)
+                continue
+            reason = res.finish_reason
+            if reason == "error":
+                errored = True
+                self._handle_attempt_failure(tracked, attempt, error=res.error or "error")
+                continue
+            attempt["done"] = True
+            self._engine_map.pop(engine_id, None)
+            if tracked["winner"] not in (None, engine_id):
+                continue  # the losing copy of a hedge finished; winner carries on
+            # Forward any tokens the engine finished with that we have not
+            # streamed yet (first-token-at-insert of a winning copy whose
+            # terminal landed in the same engine step).
+            if len(res.tokens) > len(tracked["result"].tokens) and reason in ("eos", "length"):
+                missing = res.tokens[len(tracked["result"].tokens):]
+                tracked["result"].tokens.extend(missing)
+            self._finish(tracked, reason, error=res.error)
+        return errored
+
+    # ------------------------------------------------------------------ drive / lifecycle
+    def run(self, requests: Optional[List[Request]] = None) -> Dict[int, np.ndarray]:
+        for req in requests or ():
+            self.submit(req)
+        while self.pending:
+            self.step()
+        return {rid: np.asarray(r.tokens, np.int32) for rid, r in self.results.items()}
+
+    def drain(self) -> Dict[int, RequestResult]:
+        """Flush: refuse new submissions while finishing everything in flight
+        across the fleet, then reopen."""
+        self._draining = True
+        try:
+            while self.pending:
+                self.step()
+        finally:
+            self._draining = False
+        return self.results
+
+    def close(self) -> Dict[int, RequestResult]:
+        """Terminal shutdown: unfinished requests finish `cancelled` (partial
+        tokens kept), every engine closes, the router refuses new work."""
+        if self._closed:
+            return self.results
+        for tracked in self._tracked.values():
+            if not tracked["result"].finished:
+                self._finish(tracked, "cancelled")
+        for replica in self.replica_set.replicas:
+            if not replica.dead:
+                replica.engine.close()
+        self._closed = True
+        return self.results

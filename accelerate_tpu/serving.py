@@ -856,6 +856,21 @@ class ContinuousBatcher:
         return sum(r is None for r in self._slot_request)
 
     @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot (the routing/backpressure signal)."""
+        return len(self._queue)
+
+    @property
+    def slots_in_use(self) -> int:
+        return sum(r is not None for r in self._slot_request)
+
+    @property
+    def load(self) -> int:
+        """Queued + in-flight request count — what least-loaded routing
+        compares across replicas (`router.Router`)."""
+        return len(self._queue) + sum(r is not None for r in self._slot_request)
+
+    @property
     def closed(self) -> bool:
         return self._closed
 

@@ -133,6 +133,132 @@ def pct(values, q):
     return float(np.percentile(np.asarray(values), q))
 
 
+def run_router_workload(model, args, cfg, max_length, rng, tracer=None):
+    """The replicated-fleet A/B (`--replicas N`): the mixed workload served
+    through a `router.Router` over N engines — once clean (baseline), once
+    with replica 0 killed mid-traffic (the chaos-kill shape, through the
+    router's ops seam so the engine's warm executables are reused on rejoin).
+    Reports throughput for both passes, the dip during the degraded window,
+    and the measured recovery time (kill -> replica live again), under the
+    same hard 0-recompile / 0-host-transfer gate as the single-engine passes
+    (one process-wide TraceGuard: zero total means zero per engine)."""
+    from accelerate_tpu.analysis import TraceGuard
+    from accelerate_tpu.router import Router
+    from accelerate_tpu.serving import Request
+
+    prompts, budgets, arrivals = build_workload(args, cfg.vocab_size, rng)
+    router = Router(
+        model, replicas=args.replicas, num_slots=args.num_slots,
+        max_length=max_length, chunk_size=args.chunk_size,
+        max_queue=args.requests + 16, default_deadline_s=600.0,
+        paged=not args.no_paged, page_size=args.page_size, tracer=tracer,
+        rejoin_cooldown_s=0.2, probation_steps=1, stall_degrade_s=None,
+    )
+
+    def run_traffic(kill_fraction=None):
+        """Arrival-gated traffic on the virtual clock. With `kill_fraction`,
+        replica 0 is failed once that fraction of requests has finished;
+        returns per-pass measurements including the kill/recovery marks."""
+        clock = 0.0
+        n = len(prompts)
+        submitted = 0
+        first_seen = {}
+        token_marks = []  # (virtual clock, tokens streamed in this event)
+        killed = False
+        kill_clock = recover_clock = None
+        kill_wall = recover_wall = None
+        while submitted < n or router.pending or (killed and recover_wall is None):
+            while submitted < n and float(arrivals[submitted]) <= clock:
+                router.submit(Request(submitted, prompts[submitted],
+                                      max_new_tokens=budgets[submitted]))
+                submitted += 1
+            if not router.pending and submitted < n:
+                clock = float(arrivals[submitted])
+                continue
+            t0 = time.perf_counter()
+            events = router.step()
+            clock += time.perf_counter() - t0
+            for rid, toks in events:
+                first_seen.setdefault(rid, clock)
+                token_marks.append((clock, len(toks)))
+            if kill_fraction is not None and not killed and submitted == n:
+                finished = sum(router.results[i].finished for i in range(n))
+                if finished >= n * kill_fraction:
+                    killed = True
+                    kill_clock, kill_wall = clock, time.perf_counter()
+                    log(f"kill A/B: failing replica 0 after {finished}/{n} requests")
+                    router.fail_replica(0, reason="bench kill A/B", dead=False)
+            if killed and recover_wall is None and router.replica_states[0] == "live":
+                recover_clock, recover_wall = clock, time.perf_counter()
+            if killed and recover_wall is None and not router.pending:
+                time.sleep(0.02)  # idle: let the rejoin cooldown elapse
+        delivered = sum(len(router.results[i].tokens) for i in range(n))
+        reasons = {}
+        for i in range(n):
+            reason = router.results[i].finish_reason
+            reasons[reason] = reasons.get(reason, 0) + 1
+        ttfts = [first_seen.get(i, clock) - float(arrivals[i]) for i in range(n)]
+        makespan = clock - float(arrivals[0])
+        out = {
+            "tokens_per_sec": round(delivered / max(makespan, 1e-9), 2),
+            "tokens_delivered": delivered,
+            "ttft_p50_ms": round(pct(ttfts, 50) * 1000, 2),
+            "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 2),
+            "makespan_s": round(makespan, 3),
+            "finish_reasons": reasons,
+        }
+        if killed:
+            out["recovery_s"] = (
+                round(recover_wall - kill_wall, 3) if recover_wall is not None else None
+            )
+            if recover_clock is not None and recover_clock > kill_clock:
+                window = [t for t in token_marks if kill_clock <= t[0] <= recover_clock]
+                out["degraded_window_tokens_per_sec"] = round(
+                    sum(c for _, c in window) / (recover_clock - kill_clock), 2
+                )
+        for i in range(n):
+            router.release(i)
+        return out
+
+    log(f"router workload ({args.replicas} replicas): warmup...")
+    warmed = router.warm_inserts()
+    log(f"router insert buckets warmed: {sorted(set(sum(warmed.values(), [])))}")
+    run_traffic()
+    run_traffic()
+    guard = TraceGuard(
+        transfer_guard="disallow", on_violation="record", name="serving-bench-router"
+    )
+    with guard:
+        baseline = run_traffic()
+        killed = run_traffic(kill_fraction=1 / 3)
+    if guard.total_recompiles or guard.host_transfers:
+        log(f"TRACE-GUARD VIOLATIONS in router workload: {guard.report().summary()}")
+    # The fleet pin: routing, retry, soft-kill recovery and rejoin must all
+    # reuse the warm per-engine executables — 0 recompiles, 0 host transfers
+    # across every engine (a process-wide zero is a per-engine zero).
+    assert guard.total_recompiles == 0 and guard.host_transfers == 0, (
+        "router workload regressed the 0-recompile / 0-host-transfer discipline: "
+        f"{guard.report().summary()}"
+    )
+    stats = router.stats
+    result = {
+        "replicas": args.replicas,
+        "baseline": baseline,
+        "kill_ab": killed,
+        "throughput_dip_ratio": round(
+            killed["tokens_per_sec"] / max(baseline["tokens_per_sec"], 1e-9), 3
+        ),
+        "recovery_s": killed.get("recovery_s"),
+        "retries": stats["retries"],
+        "ejected": stats["ejected"],
+        "replica_states": stats["replica_states"],
+        "recompiles": guard.total_recompiles,
+        "host_transfers": guard.host_transfers,
+    }
+    router.close()
+    return result
+
+
 def run_spec_workload(model, args, cfg, max_length, rng, tracer=None):
     """The speculative A/B: a repetition-heavy workload (each prompt tiles a
     short motif — prompt-lookup's natural habitat, and greedy decode of small
@@ -162,6 +288,7 @@ def run_spec_workload(model, args, cfg, max_length, rng, tracer=None):
             chunk_size=args.chunk_size, paged=not args.no_paged,
             page_size=args.page_size, tracer=tracer, speculative=spec_on,
             draft_tokens=args.draft_tokens, draft_ngram=args.draft_ngram,
+            max_queue=args.requests,
         )
         log(f"speculative workload ({label}): warmup...")
         # The closed bucket ladder, then twice through the real traffic (pass 1
@@ -241,7 +368,7 @@ def run_prefix_workload(model, args, cfg, max_length, rng, tracer=None):
         engine = ContinuousBatcher(
             model, num_slots=args.num_slots, max_length=max_length,
             chunk_size=args.chunk_size, paged=True, page_size=args.page_size,
-            prefix_cache=use_prefix, tracer=tracer,
+            prefix_cache=use_prefix, tracer=tracer, max_queue=args.requests,
         )
         log(f"prefix workload ({label}): warmup...")
         # The closed bucket ladder first (no admission can mint a fresh
@@ -308,6 +435,9 @@ def main(argv=None):
                         help="draft tokens per verify step in the speculative workload")
     parser.add_argument("--draft-ngram", type=int, default=2,
                         help="n-gram length the speculative drafter matches on")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="run the replicated-router workload over N engines with a "
+                        "kill-one-replica A/B (throughput dip + recovery time); 1 disables")
     parser.add_argument("--trace-dir", default=None,
                         help="flight-recorder trace dir (span JSONL + Perfetto dump); default: a fresh temp dir — the artifact path is emitted in extra.telemetry.trace")
     args = parser.parse_args(argv)
@@ -370,6 +500,7 @@ def main(argv=None):
     engine = ContinuousBatcher(
         model, num_slots=args.num_slots, max_length=max_length, chunk_size=args.chunk_size,
         paged=not args.no_paged, page_size=args.page_size, tracer=tracer,
+        max_queue=args.requests,
     )
     static_gen = Generator(model, max_new_tokens=max(budgets), max_length=max_length)
 
@@ -443,6 +574,12 @@ def main(argv=None):
                 f"(accepted_tokens_per_step={spec_block['accepted_tokens_per_step']}) "
                 "— output is still token-identical, but check drafter knobs"
             )
+
+    # Replicated-router A/B: the same workload behind a health-routed fleet,
+    # with one replica chaos-killed mid-traffic (dip + recovery measured).
+    router_block = None
+    if args.replicas > 1:
+        router_block = run_router_workload(model, args, cfg, max_length, rng, tracer=tracer)
 
     speedup = c_tps / max(s_tps, 1e-9)
     prefix = "" if on_accel else "cpu-smoke "
@@ -535,6 +672,11 @@ def main(argv=None):
             # accepted_tokens_per_step, spec-off vs spec-on, both timed passes
             # TraceGuard-verified at 0 recompiles / 0 host transfers.
             "speculative_workload": spec_block,
+            # Replicated-fleet A/B (--replicas N): baseline vs kill-one-replica
+            # throughput, degraded-window tokens/sec, measured recovery
+            # seconds, retry/replica_lost accounting — still 0 recompiles /
+            # 0 host transfers per engine.
+            "router_workload": router_block,
             # Steady-state discipline counters (TraceGuard armed over both
             # timed passes): any nonzero value is a no-recompile regression.
             "recompiles": guard.total_recompiles,

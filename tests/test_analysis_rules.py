@@ -143,6 +143,27 @@ def test_closure_capture_ignores_array_accumulators():
     assert [f.rule_id for f in analyze_source(counter)] == ["TPU105"]
 
 
+def test_tpu114_router_variants():
+    """The Router half of TPU114: an explicit max_queue=None and a missing
+    default_deadline_s each flag; the bounded+deadlined spelling is clean; and
+    a module with no real jax import is out of scope (host-side tooling that
+    merely mentions a Router is not jit-adjacent serving code)."""
+    hazard = (
+        "import jax\n"
+        "from accelerate_tpu.router import Router\n"
+        "def fleet(model):\n"
+        "    return Router(model, replicas=3, max_queue=None)\n"
+    )
+    findings = analyze_source(hazard)
+    assert [f.rule_id for f in findings] == ["TPU114", "TPU114"]  # queue + deadline
+    clean = hazard.replace(
+        "max_queue=None", "max_queue=64, default_deadline_s=60.0"
+    )
+    assert not analyze_source(clean)
+    no_jax = hazard.replace("import jax\n", "")
+    assert not analyze_source(no_jax)
+
+
 def test_analyze_paths_walks_the_tree():
     findings, scanned = analyze_paths([str(SAMPLES)])
     assert scanned >= 2 * len(RULES) + 1  # flag + clean per rule + suppressed.py

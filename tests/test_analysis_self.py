@@ -90,6 +90,20 @@ def test_speculative_path_is_warn_clean():
     )
 
 
+def test_router_is_warn_clean():
+    """The replicated-serving front-end sits between callers and every engine
+    dispatch: a host-sync or recompile hazard in the router would serialize
+    the WHOLE fleet, and an unbounded queue there (its own rule, TPU114)
+    would defeat the backpressure it exists to provide. Warn-clean, and the
+    scan must actually see the module so a rename can't make the pin vacuous."""
+    findings, scanned = analyze_paths([str(REPO / "accelerate_tpu" / "router.py")])
+    assert scanned == 1, f"router module missing? scanned {scanned}"
+    flagged = [f for f in findings if severity_at_least(f.severity, "warn")]
+    assert not flagged, "warn+ TPU hazards in router:\n" + "\n".join(
+        f"  {f.file}:{f.line}: {f.rule_id} {f.message}" for f in flagged
+    )
+
+
 def test_telemetry_subsystem_is_warn_clean():
     """The observability layer rides the serving/train hot paths — it must be
     completely clean at WARN level, not just error-free: a host-sync or

@@ -680,3 +680,106 @@ def test_cli_list_faults_lists_builtin_plans(capsys):
     for name in ("smoke-train", "smoke-serve", "smoke-async-ckpt", "seeded-regression"):
         assert name in out
     assert "workload=async-train" in out
+
+
+# ------------------------------------------------------------------ router sweeps
+@pytest.mark.router
+def test_smoke_router_builtin_plan_is_green():
+    """The acceptance sweep: N=3 replicas under live traffic with a stall, a
+    poisoned dispatch AND a kill of distinct replicas — every request reaches
+    a terminal finish_reason, no token stream duplicates, the fleet recovers,
+    and the router never routed to an ejected replica."""
+    plan = builtin_plans()["smoke-router"]
+    report = ChaosRunner(plan).run_router(num_requests=10, replicas=3)
+    assert report.ok, report.render_text()
+    kinds = {e["kind"] for e in report.injections}
+    assert {"router.replica_kill", "router.replica_stall", "router.replica_poison"} <= kinds
+    names = {c.name for c in report.checks}
+    assert {"terminal_finish_reasons", "no_duplicate_streams", "fleet_recovered",
+            "no_route_to_ejected", "ledger_reconciles"} <= names
+
+
+@pytest.mark.router
+def test_router_kill_mid_traffic_redispatch_and_recovery():
+    """A lone kill of the busiest replica mid-traffic: re-dispatch/replica_lost
+    semantics hold and the killed replica is back by drain."""
+    plan = FaultPlan(
+        name="kill-only", seed=3,
+        events=[
+            FaultEvent(kind="serve.queue_burst", at_step=1, args={"count": 6}),
+            FaultEvent(kind="router.replica_kill", path_pattern="replica_0", at_call=3),
+        ],
+    )
+    report = ChaosRunner(plan).run_router(num_requests=8, replicas=3)
+    assert report.ok, report.render_text()
+    assert any(e["kind"] == "router.replica_kill" for e in report.injections)
+
+
+@pytest.mark.router
+def test_router_hedging_under_stall():
+    """A stalled replica with hedging armed: the hedge copy wins without
+    duplicating a stream (the no_duplicate_streams invariant is the pin)."""
+    plan = FaultPlan(
+        name="stall-hedge", seed=5,
+        events=[
+            FaultEvent(kind="serve.queue_burst", at_step=1, args={"count": 8}),
+            FaultEvent(kind="router.replica_stall", path_pattern="replica_1", at_call=1,
+                       args={"delay_s": 0.05}, times=3),
+        ],
+    )
+    report = ChaosRunner(plan).run_router(
+        num_requests=8, replicas=2, hedge_after_s=0.0
+    )
+    assert report.ok, report.render_text()
+
+
+@pytest.mark.router
+def test_cli_run_router_workload(capsys, tmp_path):
+    from accelerate_tpu.commands.accelerate_cli import get_command_parser
+
+    report_path = tmp_path / "router_report.json"
+    parser = get_command_parser()
+    args = parser.parse_args([
+        "chaos", "run", "--plan", "smoke-router", "--requests", "8",
+        "--replicas", "3", "--json", "--report-out", str(report_path),
+    ])
+    with pytest.raises(SystemExit) as exit_info:
+        args.func(args)
+    assert exit_info.value.code == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["workload"] == "router" and payload["ok"]
+    assert InvariantReport.load(str(report_path)).ok
+
+
+# ------------------------------------------------------------------ crash-loop livelock
+def test_async_at_step_kill_livelock_surfaces_crash_loop(tmp_path):
+    """The PR-9 livelock regression (at_step SIGKILL + async saves, re-armed
+    every attempt): the same step is killed before its commit can ever
+    publish. The runner must detect the no-forward-progress loop, stop early,
+    and tag a `crash_loop` diagnostic — not grind the whole restart budget."""
+    plan = FaultPlan(
+        name="livelock",
+        events=[FaultEvent(kind="proc.sigkill", at_step=1, times=0)],
+    )
+    report = ChaosRunner(plan).run_train(
+        str(tmp_path), steps=4, async_save=True, max_restarts=16
+    )
+    diags = [d for d in report.diagnostics if d.get("tag") == "crash_loop"]
+    assert diags, report.render_text()
+    assert diags[0]["why"] == "no_forward_progress"
+    budget = next(c for c in report.checks if c.name == "restart_budget")
+    assert budget.details["restarts"] < 16, "detector must stop the sweep early"
+    assert not report.ok  # a livelocked plan is honestly red
+    # round trip: the diagnostic survives save/load
+    path = str(tmp_path / "report.json")
+    report.save(path)
+    assert InvariantReport.load(path).diagnostics == report.diagnostics
+
+
+def test_single_kill_sweep_does_not_false_positive_crash_loop(tmp_path):
+    """A legitimate recovery chain (one kill, checkpoint published, resume
+    makes progress) must NOT trip the detector."""
+    plan = FaultPlan(name="one-kill", events=[FaultEvent(kind="proc.sigkill", at_step=1)])
+    report = ChaosRunner(plan).run_train(str(tmp_path), steps=4, async_save=True)
+    assert report.ok, report.render_text()
+    assert not report.diagnostics

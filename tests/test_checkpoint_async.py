@@ -406,3 +406,153 @@ def test_launch_exports_async_and_sharded_save_env(tmp_path):
     args = parser.parse_args(["script.py"])
     env = build_launch_env(args, {"async_save": True})
     assert env["ACCELERATE_TPU_ASYNC_SAVE"] == "1"
+
+
+# ------------------------------------------------------------------ adaptive cadence
+@pytest.mark.checkpoint_async
+class TestAdaptiveSaveInterval:
+    """The goodput-driven cadence controller (ROADMAP 4b): pure observation ->
+    arithmetic, driven here by a chaos FakeClock ledger."""
+
+    def _controller(self, **kw):
+        from accelerate_tpu.checkpointing import AdaptiveSaveInterval
+
+        return AdaptiveSaveInterval(**kw)
+
+    def test_no_cadence_before_first_step_observation(self):
+        ctl = self._controller(lost_checkpoint_s=10.0)
+        assert ctl.interval is None
+        assert not ctl.should_save(10_000)
+
+    def test_budget_cap_from_fakeclock_ledger(self):
+        from accelerate_tpu.chaos import FakeClock
+
+        clock = FakeClock()
+        ctl = self._controller(lost_checkpoint_s=10.0, overhead_fraction=0.1)
+        for _ in range(20):
+            t0 = clock.perf_counter()
+            clock.sleep(0.1)  # a 100ms step
+            ctl.observe_step(clock.perf_counter() - t0)
+        # 10s of acceptable lost work / 0.1s steps -> save every 100 steps
+        assert ctl.interval == 100
+        assert ctl.should_save(100) and not ctl.should_save(99)
+        # a cheap save (0.5s at 10% overhead -> floor 50) does not change it
+        t0 = clock.perf_counter()
+        clock.sleep(0.5)
+        ctl.observe_save(clock.perf_counter() - t0)
+        assert ctl.interval == 100
+
+    def test_smaller_budget_saves_more_often_and_slower_steps_too(self):
+        a = self._controller(lost_checkpoint_s=10.0)
+        b = self._controller(lost_checkpoint_s=2.0)
+        for ctl in (a, b):
+            for _ in range(5):
+                ctl.observe_step(0.1)
+        assert b.interval < a.interval
+        c = self._controller(lost_checkpoint_s=10.0)
+        for _ in range(5):
+            c.observe_step(0.4)  # slower steps -> fewer steps inside the budget
+        assert c.interval < a.interval
+
+    def test_expensive_saves_stretch_past_an_unaffordable_budget(self):
+        ctl = self._controller(lost_checkpoint_s=10.0, overhead_fraction=0.1)
+        for _ in range(10):
+            ctl.observe_step(0.1)
+        for _ in range(30):
+            ctl.observe_save(5.0)  # 5s saves: the 10s budget is unaffordable
+        # overhead floor 5/(0.1*0.1)=500 beats the 100-step budget cap
+        assert ctl.interval == 500
+
+    def test_fixed_interval_mode_and_validation(self):
+        ctl = self._controller(fixed_interval=7)
+        assert ctl.interval == 7
+        assert ctl.should_save(7) and not ctl.should_save(6)
+        with pytest.raises(ValueError):
+            self._controller(lost_checkpoint_s=0.0)
+        with pytest.raises(ValueError):
+            self._controller(overhead_fraction=1.5)
+        with pytest.raises(ValueError):
+            self._controller(fixed_interval=0)
+
+    def test_ema_tracks_drifting_step_time(self):
+        ctl = self._controller(lost_checkpoint_s=10.0, ema=0.5)
+        for _ in range(10):
+            ctl.observe_step(0.1)
+        fast = ctl.interval
+        for _ in range(10):
+            ctl.observe_step(1.0)  # the run slowed down 10x
+        assert ctl.interval < fast
+
+
+@pytest.mark.checkpoint_async
+def test_accelerator_auto_save_interval_drives_maybe_save_state(tmp_path):
+    """End to end: `Accelerator(save_interval="auto")` saves through
+    `maybe_save_state()` on the controller's cadence and feeds the measured
+    (goodput-ledger) save cost back into it."""
+    import optax
+
+    from accelerate_tpu import Accelerator, SimpleDataLoader
+    from accelerate_tpu.data_loader import BatchSampler
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+    from accelerate_tpu.utils import ProjectConfiguration
+
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True
+        ),
+        save_interval="auto",
+        lost_checkpoint_s=0.001,  # microscopic budget: a save is due immediately
+    )
+    data = [RegressionDataset(length=8, seed=0)[i] for i in range(8)]
+    model, opt, pdl = acc.prepare(
+        RegressionModel(), optax.sgd(0.05), SimpleDataLoader(data, BatchSampler(range(8), 4))
+    )
+    saved = []
+    for _ in range(3):
+        for batch in pdl:
+            acc.backward(model.loss, batch)
+            opt.step()
+            opt.zero_grad()
+        path = acc.maybe_save_state()
+        if path:
+            saved.append(path)
+    ctl = acc.save_controller
+    # the first due boundary saved, and the controller learned the real cost
+    assert saved and ctl.saves_observed == len(saved)
+    assert ctl.avg_save_s is not None and ctl.avg_save_s > 0
+    assert ctl.steps_observed >= 2
+    assert os.path.isdir(saved[0])
+
+
+@pytest.mark.checkpoint_async
+def test_accelerator_fixed_save_interval(tmp_path):
+    import optax
+
+    from accelerate_tpu import Accelerator, SimpleDataLoader
+    from accelerate_tpu.data_loader import BatchSampler
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+    from accelerate_tpu.utils import ProjectConfiguration
+
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True
+        ),
+        save_interval=2,
+    )
+    data = [RegressionDataset(length=8, seed=0)[i] for i in range(8)]
+    model, opt, pdl = acc.prepare(
+        RegressionModel(), optax.sgd(0.05), SimpleDataLoader(data, BatchSampler(range(8), 4))
+    )
+    saves = 0
+    for _ in range(6):
+        for batch in pdl:
+            acc.backward(model.loss, batch)
+            opt.step()
+            opt.zero_grad()
+        if acc.maybe_save_state():
+            saves += 1
+    assert saves == 3  # every 2nd of 6 boundaries
+
+    plain = Accelerator(project_config=ProjectConfiguration(project_dir=str(tmp_path)))
+    with pytest.raises(RuntimeError, match="save_interval"):
+        plain.maybe_save_state()

@@ -360,3 +360,65 @@ def test_launch_cli_max_restarts():
         assert result.returncode == 0, result.stdout + result.stderr
         with open(marker) as f:
             assert f.read() == "2"
+
+
+@pytest.mark.faults
+def test_supervisor_no_forward_progress_crash_loop():
+    """The uptime detector's complement: a child that runs for a while, dies
+    with varying codes, but never advances the progress token (no new
+    published checkpoint) is a livelock — `progress_fn` +
+    `no_progress_threshold` must abort with the `no_forward_progress`
+    diagnostic instead of burning the restart budget."""
+    with tempfile.TemporaryDirectory() as d:
+        marker = os.path.join(d, "n")
+        body = (
+            "import os, sys\n"
+            "n = int(open(sys.argv[1]).read()) if os.path.exists(sys.argv[1]) else 0\n"
+            "open(sys.argv[1], 'w').write(str(n + 1))\n"
+            "raise SystemExit(10 + (n % 2))\n"  # varying codes: uptime detector stays quiet
+        )
+        sup = Supervisor(
+            [sys.executable, "-c", body, marker],
+            max_restarts=50,
+            backoff_seconds=0.01,
+            max_backoff_seconds=0.05,
+            monitor_interval=0.05,
+            crash_loop_min_uptime=0.0,  # disable the fast-exit detector
+            progress_fn=lambda: None,   # nothing ever progresses
+            no_progress_threshold=3,
+        )
+        code = sup.run()
+        assert sup.crash_loop_detected is True
+        assert sup.crash_loop_reason == "no_forward_progress"
+        assert sup.restart_count < 10, "detector must stop well inside the budget"
+
+
+@pytest.mark.faults
+def test_supervisor_progress_resets_no_progress_counter():
+    """A child that DOES advance the progress token on every attempt never
+    trips the detector — the budget path decides as before."""
+    with tempfile.TemporaryDirectory() as d:
+        marker = os.path.join(d, "n")
+        body = (
+            "import os, sys\n"
+            "n = int(open(sys.argv[1]).read()) if os.path.exists(sys.argv[1]) else 0\n"
+            "open(sys.argv[1], 'w').write(str(n + 1))\n"
+            "raise SystemExit(0 if n >= 5 else 9)\n"
+        )
+
+        def progress():
+            return open(marker).read() if os.path.exists(marker) else None
+
+        sup = Supervisor(
+            [sys.executable, "-c", body, marker],
+            max_restarts=10,
+            backoff_seconds=0.01,
+            monitor_interval=0.05,
+            crash_loop_min_uptime=0.0,
+            progress_fn=progress,
+            no_progress_threshold=2,
+        )
+        code = sup.run()
+        assert code == 0
+        assert sup.crash_loop_detected is False
+        assert sup.restart_count == 5

@@ -1,0 +1,16 @@
+"""TPU114 negative: bounded queues and a fleet-wide default deadline."""
+import jax  # noqa: F401
+
+from accelerate_tpu.router import Router
+from accelerate_tpu.serving import ContinuousBatcher
+
+
+def build_engine(model):
+    # sanctioned: overload surfaces as QueueFull backpressure
+    return ContinuousBatcher(model, num_slots=8, chunk_size=16, max_queue=64)
+
+
+def build_fleet(model):
+    # sanctioned: bounded per-replica queues plus a default per-request
+    # deadline, so every request reaches a terminal finish_reason
+    return Router(model, replicas=3, max_queue=64, default_deadline_s=60.0)

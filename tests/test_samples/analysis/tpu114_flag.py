@@ -1,0 +1,11 @@
+"""TPU114 unbounded-serving-queue: a serving engine built without
+backpressure in jit-adjacent code (Router variants are pinned in
+test_analysis_rules.test_tpu114_router_variants)."""
+import jax  # noqa: F401 — the jit-adjacency signal
+
+from accelerate_tpu.serving import ContinuousBatcher
+
+
+def build_engine(model):
+    # hazard: no max_queue — the wait queue grows without bound under overload
+    return ContinuousBatcher(model, num_slots=8, chunk_size=16)
