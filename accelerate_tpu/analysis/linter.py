@@ -669,6 +669,7 @@ class _ModuleChecker:
         self._check_static_argnums_and_donation()
         self._check_closure_capture()
         self._check_serving_construction()
+        self._check_kernel_fallback()
         return self.findings
 
     # -- serving-engine construction (TPU114) -----------------------------------
@@ -717,6 +718,88 @@ class _ModuleChecker:
                         "Router(...) without default_deadline_s lets a request wait "
                         "forever on a stalled replica — give the fleet a default "
                         "per-request deadline",
+                    )
+
+    # -- kernel-path fallback (TPU115) -------------------------------------------
+    #: Pallas attention kernel entry points whose `interpret=` knob is a
+    #: CPU-test shim, never a production setting.
+    _PALLAS_KERNEL_FUNCS = {
+        "paged_decode_attention",
+        "paged_verify_attention",
+        "flash_attention",
+    }
+    #: Constructors/seams that accept an attention implementation flag.
+    _ATTENTION_IMPL_KWARGS = {"attention_impl", "decode_attention_impl"}
+    #: Call targets where paging is the DEFAULT (absent page kwargs still mean
+    #: a paged engine). Everywhere else — the seam functions, config
+    #: constructors — page_size defaults to 0, so an "xla" pin without page
+    #: kwargs is the contiguous layout's only legal impl, not a fallback.
+    _PAGED_BY_DEFAULT_CTORS = {"ContinuousBatcher", "Router"}
+
+    @staticmethod
+    def _call_name(func: ast.AST) -> Optional[str]:
+        if isinstance(func, ast.Name):
+            return func.id
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        return None
+
+    def _check_kernel_fallback(self):
+        """TPU115: the Pallas paged-decode/block-verify kernels are the serving
+        hot path; the XLA gather materializes the whole logical cache per
+        dispatch and exists as the parity oracle. Flags (a) a serving
+        decode/verify construction pinned to the oracle by a LITERAL
+        attention_impl="xla" where the paged kernel applies (the call doesn't
+        also opt out of paging), and (b) a kernel call forced into interpret
+        mode with a literal interpret=True — the CPU-test shim; production
+        call sites use interpret=None so the kernel compiles on TPU. Both are
+        one explicit keyword away from silently serving off the kernel path."""
+        if not self.index.imports_jax:
+            return
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._call_name(node.func)
+            kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            impl = next(
+                (kwargs[k] for k in self._ATTENTION_IMPL_KWARGS if k in kwargs), None
+            )
+            if (
+                impl is not None
+                and isinstance(impl, ast.Constant)
+                and impl.value == "xla"
+            ):
+                paged = kwargs.get("paged")
+                page_size = kwargs.get("page_size") or kwargs.get("decode_page_size")
+                opted_out = (
+                    isinstance(paged, ast.Constant) and paged.value is False
+                ) or (isinstance(page_size, ast.Constant) and page_size.value in (0, None))
+                if name in self._PAGED_BY_DEFAULT_CTORS:
+                    paged_applies = not opted_out
+                else:
+                    # Seam/config spellings default to page_size=0: paging only
+                    # applies when the call really threads page geometry (and
+                    # doesn't zero it out).
+                    paged_applies = page_size is not None and not opted_out
+                if paged_applies:
+                    self.emit(
+                        node,
+                        "TPU115",
+                        'attention_impl="xla" pins this decode/verify program to the '
+                        "gather oracle (a full materialized cache copy per dispatch) "
+                        'where the Pallas paged kernel applies — pass "pallas_paged", '
+                        "or suppress where the oracle is deliberate",
+                    )
+            if name in self._PALLAS_KERNEL_FUNCS:
+                interp = kwargs.get("interpret")
+                if isinstance(interp, ast.Constant) and interp.value is True:
+                    self.emit(
+                        node,
+                        "TPU115",
+                        f"{name}(interpret=True) forces the Pallas interpreter — the "
+                        "CPU-test shim — onto this call site; use interpret=None so "
+                        "the kernel compiles on TPU (tests belong under tests/, "
+                        "which the self-lint roots exclude)",
                     )
 
     def _check_jit_placement(self):

@@ -171,6 +171,20 @@ RULES = (
         "Router a default_deadline_s=<seconds> so every request reaches a "
         "terminal finish_reason even when a replica stalls",
     ),
+    Rule(
+        id="TPU115",
+        slug="kernel-fallback",
+        severity="warn",
+        summary='serving decode/verify programs pinned to attention_impl="xla" '
+        "where the Pallas paged kernel applies, or a Pallas attention kernel "
+        "forced into interpret mode outside test code",
+        fixit='pass attention_impl="pallas_paged" for paged serving engines (the '
+        "XLA gather path materializes the whole logical cache per decode "
+        "dispatch and exists as the parity oracle, not the hot path) — or "
+        "suppress where the oracle is deliberate; interpret=True is the "
+        "CPU-test shim, production call sites must let the kernel compile "
+        "(interpret=None auto-selects)",
+    ),
 )
 
 RULES_BY_ID = {r.id: r for r in RULES}

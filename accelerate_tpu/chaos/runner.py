@@ -687,6 +687,7 @@ class ChaosRunner:
         max_cycles: int = 200,
         paged: bool = True,
         speculative: bool = False,
+        attention_impl: str = "xla",
     ) -> InvariantReport:
         """Serving workload: a tiny llama `ContinuousBatcher` fed one request
         per cycle (plus scripted queue bursts), driven to drain under injected
@@ -694,7 +695,10 @@ class ChaosRunner:
         the report's snapshot carries both. `speculative=True` runs the same
         sweeps through the draft/verify chunk (draft window in every admission,
         history mirror in every blast-radius rebuild), so recovery is proven to
-        reconstruct the speculative state too."""
+        reconstruct the speculative state too. `attention_impl="pallas_paged"`
+        drives the sweeps through the fused page-walk kernels
+        (ops/paged_attention): blast-radius recovery must rebuild the
+        kernel-path executables identically — same invariants, no retrace."""
         from ..models.llama import LlamaConfig, create_llama_model
         from ..serving import FINISH_REASONS, ContinuousBatcher, QueueFull, Request
 
@@ -714,6 +718,7 @@ class ChaosRunner:
             max_queue=max_queue, registry=self.session.registry,
             tracer=self.tracer, paged=paged, page_size=4,
             speculative=speculative, draft_tokens=3,
+            attention_impl=attention_impl,
         )
         ServingInjector(self.session).arm(engine)
         rng = np.random.default_rng(self.plan.seed)

@@ -178,7 +178,12 @@ def make_causal_programs(
     the module's `attention_mask`: the PAGED slot cache reads it as the
     [B, pages_per_slot] int32 page table (a traced operand — the one decode
     executable survives every admission), since slot decode never carries a
-    boolean mask of its own.
+    boolean mask of its own. The module config's `decode_attention_impl`
+    decides what the step/verify programs DO with that table: "xla" gathers
+    the pages into a logical buffer (parity oracle), "pallas_paged" hands the
+    table to the fused `ops/paged_attention` kernels — either way the program
+    signatures here are identical, so serving's compiled-once discipline and
+    the traced-operand page tables are implementation-agnostic.
 
     `verify_block=True` appends the speculative-decode seam to the tuple:
     `verify(params, cache, tokens, positions[, mask])` scores a [B, s] token

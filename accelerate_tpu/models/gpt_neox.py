@@ -18,7 +18,11 @@ import jax
 import jax.numpy as jnp
 
 from ..modeling import Model
-from ..ops.attention import dot_product_attention, update_decode_cache, update_slot_cache
+from ..ops.attention import (
+    dot_product_attention,
+    slot_cache_attention,
+    update_decode_cache,
+)
 from ..parallel.sharding import constrain_activation
 from ..ops.remat import maybe_remat
 from .llama import causal_lm_loss
@@ -53,6 +57,9 @@ class GPTNeoXConfig:
     # LlamaConfig for the full semantics).
     decode_page_size: int = 0
     decode_num_pages: int = 0
+    # Serving-decode attention implementation (see LlamaConfig): "xla" gather
+    # oracle or the "pallas_paged" fused page-walk kernels.
+    decode_attention_impl: str = "xla"
     param_dtype: str = "float32"
 
     @property
@@ -100,16 +107,19 @@ class GPTNeoXAttention(nn.Module):
             if cfg.decode_slot_cache:
                 # Continuous-batching decode: per-row scatter writes at each
                 # slot's own position (serving.ContinuousBatcher). Paged mode
-                # reads `mask` as the [B, pages_per_slot] int32 page table.
-                k_all, v_all, decode_mask = update_slot_cache(
-                    self, k, v, L, positions,
+                # reads `mask` as the [B, pages_per_slot] int32 page table;
+                # decode_attention_impl picks the gather oracle or the fused
+                # Pallas page-walk kernels.
+                out = slot_cache_attention(
+                    self, q, k, v, L, positions,
                     page_table=mask if cfg.decode_page_size else None,
                     page_size=cfg.decode_page_size,
                     num_pages=cfg.decode_num_pages,
+                    attention_impl=cfg.decode_attention_impl,
                 )
             else:
                 k_all, v_all, decode_mask = update_decode_cache(self, k, v, L, pad_mask=mask)
-            out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
+                out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
         else:
             out = dot_product_attention(q, k, v, mask=mask, causal=True)
         return nn.Dense(cfg.hidden_size, param_dtype=cfg._pdtype, name="wo")(out.reshape(b, s, h * d))

@@ -19,7 +19,11 @@ import jax
 import jax.numpy as jnp
 
 from ..modeling import Model
-from ..ops.attention import dot_product_attention, update_decode_cache, update_slot_cache
+from ..ops.attention import (
+    dot_product_attention,
+    slot_cache_attention,
+    update_decode_cache,
+)
 
 from ..parallel.sharding import constrain_activation
 from ..ops.remat import maybe_remat
@@ -61,6 +65,12 @@ class LlamaConfig:
     # boolean mask, so the seam is free). 0 = contiguous per-slot rows.
     decode_page_size: int = 0
     decode_num_pages: int = 0
+    # Serving-decode attention implementation (paged slot cache only):
+    # "xla" = gather the slot's pages into a logical buffer then attend (the
+    # parity oracle); "pallas_paged" = the ops/paged_attention kernels, which
+    # walk the page table inside the kernel and never materialize the gather.
+    # Threaded from serving.ContinuousBatcher(attention_impl=...).
+    decode_attention_impl: str = "xla"
 
     @property
     def head_dim(self) -> int:
@@ -109,18 +119,21 @@ class LlamaAttention(nn.Module):
                 # Continuous-batching decode: each slot row writes at its OWN
                 # position (per-row scatter) and attends its written prefix
                 # only. Paged mode reads `mask` as the slot page table ([B,
-                # pages_per_slot] int32) mapping positions onto pool pages.
-                k_all, v_all, decode_mask = update_slot_cache(
-                    self, k, v, cfg.decode_cache_length, positions,
+                # pages_per_slot] int32) mapping positions onto pool pages;
+                # decode_attention_impl picks the XLA gather oracle or the
+                # fused Pallas page-walk kernels.
+                out = slot_cache_attention(
+                    self, q, k, v, cfg.decode_cache_length, positions,
                     page_table=mask if cfg.decode_page_size else None,
                     page_size=cfg.decode_page_size,
                     num_pages=cfg.decode_num_pages,
+                    attention_impl=cfg.decode_attention_impl,
                 )
             else:
                 # Incremental decoding through the shared flax-cache write path
                 # (ops/attention.update_decode_cache).
                 k_all, v_all, decode_mask = update_decode_cache(self, k, v, cfg.decode_cache_length, pad_mask=mask)
-            out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
+                out = dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
         else:
             out = dot_product_attention(q, k, v, mask=mask, causal=True)
         return nn.Dense(cfg.hidden_size, use_bias=False, name="wo")(out.reshape(b, s, hq * d))

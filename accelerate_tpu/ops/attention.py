@@ -7,6 +7,12 @@
   - ring attention across the "seq" mesh axis (parallel/ring_attention.py) when
     activations are sequence-sharded.
 
+`slot_cache_attention` is the SERVING twin: the fused cache-write + attend seam
+for slot-batched decode, with its own `attention_impl` dispatch — the XLA
+gather oracle, or the Pallas paged-decode / block-verify kernels
+(ops/paged_attention.py) that walk the page table without materializing the
+gathered cache.
+
 Shapes follow the [batch, seq, heads, head_dim] convention (BSHD) throughout.
 """
 
@@ -17,10 +23,13 @@ from typing import Optional
 import numpy as np
 
 # Trace-time record of the implementation the last dispatch chose ("xla" | "flash"
-# | "ring" | "allgather"). Benchmarks read it to PROVE the kernel they claim to
-# measure actually ran (round-2 verdict weak #5: flash was dead code on every
-# benchmarked path and nothing would have noticed).
+# | "ring" | "allgather" | "pallas_paged"). Benchmarks read it to PROVE the kernel
+# they claim to measure actually ran (round-2 verdict weak #5: flash was dead code
+# on every benchmarked path and nothing would have noticed).
 LAST_DISPATCH: Optional[str] = None
+
+#: The serving-decode attention implementations `slot_cache_attention` accepts.
+SLOT_ATTENTION_IMPLS = ("xla", "pallas_paged")
 
 # Once-per-reason guard for the SP-bypass warning (see below).
 _SP_BYPASS_WARNED: set = set()
@@ -151,27 +160,17 @@ def update_slot_cache(
             "update_decode_cache on a batch-1 cache (tree_scatter_rows)"
         )
     if page_size:
-        if page_table is None:
-            raise ValueError("paged slot cache needs a [B, pages_per_slot] page_table operand")
-        pages_per_slot = page_table.shape[-1]
+        pool_k, pool_v, pos, table = _write_slot_pool(
+            module, k, v, positions, page_table, page_size, num_pages
+        )
+        pages_per_slot = table.shape[-1]
         L = pages_per_slot * page_size
-        pool_k = module.variable(
-            "cache", "cached_key", jnp.zeros, (num_pages, page_size, h, d), k.dtype
-        )
-        pool_v = module.variable(
-            "cache", "cached_value", jnp.zeros, (num_pages, page_size, h, d), v.dtype
-        )
-        pos = jnp.clip(positions, 0, L - 1).astype(jnp.int32)  # [B, s]
-        table = jnp.asarray(page_table, jnp.int32)
-        page_slot = jnp.clip(pos // page_size, 0, pages_per_slot - 1)
-        pid = jnp.take_along_axis(table, page_slot, axis=1)  # [B, s]
-        off = pos % page_size
-        pool_k.value = pool_k.value.at[pid, off].set(k)
-        pool_v.value = pool_v.value.at[pid, off].set(v)
         # Logical-order read: [B, P, ps, h, d] -> [B, P*ps, h, d]. Same masked
-        # attention as the contiguous layout — pool order never leaks.
-        k_full = jnp.take(pool_k.value, table, axis=0).reshape(b, L, h, d)
-        v_full = jnp.take(pool_v.value, table, axis=0).reshape(b, L, h, d)
+        # attention as the contiguous layout — pool order never leaks. This
+        # materialized gather is the HBM cost `slot_cache_attention`'s
+        # "pallas_paged" path exists to remove; it stays as the parity oracle.
+        k_full = jnp.take(pool_k, table, axis=0).reshape(b, L, h, d)
+        v_full = jnp.take(pool_v, table, axis=0).reshape(b, L, h, d)
         cols = jnp.arange(L)[None, None, :]
         decode_mask = (cols <= pos[:, :, None])[:, None, :, :]  # [B, 1, s, L]
         return k_full, v_full, decode_mask
@@ -185,6 +184,83 @@ def update_slot_cache(
     cols = jnp.arange(L)[None, None, :]
     decode_mask = (cols <= pos[:, :, None])[:, None, :, :]  # [B, 1, s, L]
     return cached_k.value, cached_v.value, decode_mask
+
+
+def _write_slot_pool(module, k, v, positions, page_table, page_size: int, num_pages: int):
+    """The paged slot cache's WRITE half: scatter this dispatch's [B, s] K/V
+    into the page pool through the slot page tables, and return the updated
+    pools plus the clipped positions/table. Shared by the XLA gather path
+    (`update_slot_cache`) and the fused kernel path (`slot_cache_attention`)
+    so the two implementations can never disagree about where K/V lives."""
+    import jax.numpy as jnp
+
+    if page_table is None:
+        raise ValueError("paged slot cache needs a [B, pages_per_slot] page_table operand")
+    b, s, h, d = k.shape
+    pages_per_slot = page_table.shape[-1]
+    L = pages_per_slot * page_size
+    pool_k = module.variable(
+        "cache", "cached_key", jnp.zeros, (num_pages, page_size, h, d), k.dtype
+    )
+    pool_v = module.variable(
+        "cache", "cached_value", jnp.zeros, (num_pages, page_size, h, d), v.dtype
+    )
+    pos = jnp.clip(positions, 0, L - 1).astype(jnp.int32)  # [B, s]
+    table = jnp.asarray(page_table, jnp.int32)
+    page_slot = jnp.clip(pos // page_size, 0, pages_per_slot - 1)
+    pid = jnp.take_along_axis(table, page_slot, axis=1)  # [B, s]
+    off = pos % page_size
+    pool_k.value = pool_k.value.at[pid, off].set(k)
+    pool_v.value = pool_v.value.at[pid, off].set(v)
+    return pool_k.value, pool_v.value, pos, table
+
+
+def slot_cache_attention(
+    module, q, k, v, cache_length: int, positions, page_table=None,
+    page_size: int = 0, num_pages: int = 0, attention_impl: str = "xla",
+):
+    """Write this dispatch's K/V into the slot cache AND attend — the fused
+    serving-decode seam every slot-cache model family calls (llama, gpt_neox).
+    One function covers decode steps (s == 1) and speculative verify blocks
+    (s == draft_tokens + 1); `attention_impl` picks the read-side engine:
+
+      - ``"xla"`` (default, and the only option for the contiguous layout):
+        `update_slot_cache`'s gather-then-mask read + `dot_product_attention`.
+        Paged mode pays a full materialized copy of the logical cache per
+        dispatch — this path is the PARITY ORACLE the kernels are pinned
+        against, not the serving hot path.
+      - ``"pallas_paged"`` (paged mode only): the pool write plus the
+        `ops/paged_attention` kernels, which walk each slot's page table
+        directly and never materialize the gathered cache. Greedy decode is
+        token-identical to the oracle (`tests/test_paged_kernel.py`).
+
+    Args and cache semantics match `update_slot_cache`; returns the attention
+    output [B, s, Hq, D]."""
+    global LAST_DISPATCH
+    if attention_impl not in SLOT_ATTENTION_IMPLS:
+        raise ValueError(
+            f"unknown attention_impl {attention_impl!r}; expected one of {SLOT_ATTENTION_IMPLS}"
+        )
+    if attention_impl == "pallas_paged":
+        if not page_size:
+            raise ValueError(
+                "attention_impl='pallas_paged' requires the paged slot cache "
+                "(page_size > 0); the contiguous layout has no page table to walk"
+            )
+        from .paged_attention import paged_decode_attention, paged_verify_attention
+
+        pool_k, pool_v, pos, table = _write_slot_pool(
+            module, k, v, positions, page_table, page_size, num_pages
+        )
+        LAST_DISPATCH = "pallas_paged"
+        if q.shape[1] == 1:
+            return paged_decode_attention(q, pool_k, pool_v, table, pos)
+        return paged_verify_attention(q, pool_k, pool_v, table, pos)
+    k_all, v_all, decode_mask = update_slot_cache(
+        module, k, v, cache_length, positions,
+        page_table=page_table, page_size=page_size, num_pages=num_pages,
+    )
+    return dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
 
 
 def _auto_sequence_parallel(batch: int, seq_len: int):

@@ -21,12 +21,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-NEG_INF = -1e30
-# Trailing lane dim for the per-row lse/delta stats: Mosaic's minimum tile is
-# (8, 128) on the last two dims, so [BH, S]-shaped stats can't be blocked per
-# (bh, q-block); they ride a broadcast 128-lane axis instead (same layout as
-# jax's in-tree TPU flash kernel's l/m buffers).
-LANE = 128
+# The online-softmax accumulator math is shared with the serving paged
+# kernels (ops/paged_attention.py) — one implementation, one parity contract.
+from .flash_common import (
+    LANE,
+    NEG_INF,
+    finalize_softmax,
+    init_softmax_state,
+    online_softmax_update,
+)
 
 
 def _causal_block_visible(iq, ik, block_q: int, block_k: int, offset: int) -> "jnp.ndarray":
@@ -56,9 +59,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale
 
     @pl.when(ik == 0)
     def _init():
-        acc[:] = jnp.zeros_like(acc)
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
+        init_softmax_state(acc, m_scr, l_scr)
 
     run = _causal_block_visible(iq, ik, block_q, block_k, offset) if causal else True
 
@@ -72,28 +73,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, scale
         ) * scale  # [Bq, Bk]
         if causal:
             s = jnp.where(_block_mask(iq, ik, block_q, block_k, offset), s, NEG_INF)
-        m_prev = m_scr[:, 0:1]  # [Bq, 1]
-        l_prev = l_scr[:, 0:1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)  # [Bq, Bk]
-        correction = jnp.exp(m_prev - m_new)  # [Bq, 1]
-        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc[:] = acc[:] * correction + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        online_softmax_update(s, v, acc, m_scr, l_scr)
 
     @pl.when(ik == n_k - 1)
     def _finish():
-        l = l_scr[:, 0:1]
-        safe_l = jnp.maximum(l, 1e-30)
-        o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        out, lse = finalize_softmax(acc, m_scr, l_scr)
+        o_ref[0] = out.astype(o_ref.dtype)
         # lse carries a broadcast 128-lane trailing dim: Mosaic requires the last
         # two block dims to be (8k, 128k) or match the array, so a [BH, S] layout
         # cannot be blocked (1, block_q). Same workaround as jax's in-tree TPU
         # flash kernel (l/m stored [B, H, S, MIN_BLOCK_SIZE]).
-        lse = (m_scr[:, 0:1] + jnp.log(safe_l)).astype(jnp.float32)
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 

@@ -1,0 +1,262 @@
+"""Pallas TPU kernels for serving decode: paged single-query attention and the
+speculative block-verify variant, with the page-table gather FUSED into the
+attention walk.
+
+The XLA paged path (`ops/attention.update_slot_cache`) gathers every slot's
+pages back into a logical ``[B, L, h, d]`` K/V buffer before attending — a
+full materialized copy of the cache per decode dispatch, which is exactly the
+HBM traffic that bounds decode throughput. These kernels never materialize
+that buffer: the grid walks each slot's ``page_table`` directly (the table
+rides as a SCALAR-PREFETCH operand, so the BlockSpec index maps pick which
+pool page to stream into VMEM for each grid step) and folds every page into
+the shared online-softmax accumulator (`ops/flash_common.py`). HBM traffic
+per dispatch drops from "the whole logical cache, written then read" to "each
+live page, read once".
+
+Page-walk contract (mirrors the engine's host-side conventions, paging.py):
+
+  - ``page_table`` entries past a slot's reservation point at the scratch
+    page (page 0). Consecutive grid steps that map to the SAME pool page skip
+    the re-fetch (Pallas pipelines dedupe identical block indices), so the
+    tail of a short slot's walk costs one scratch-page read, not P of them.
+  - Masking is positional, not structural: query j of row i attends exactly
+    ``cols <= positions[i, j]``, the same per-query mask the XLA oracle
+    builds — scratch-page rows sit above every live position and contribute
+    exact zeros, so prefix-shared pages, ragged lengths, and freed slots all
+    come out token-identical to the gather path.
+  - Rows whose every lane is masked normalize against a tiny floor
+    (`finalize_softmax`), never NaN — inactive slots ride the same dispatch.
+
+Both kernels are single-program-multiple-rows: grid ``(B, Hkv, pages)``, GQA
+handled by grouping the ``G = Hq // Hkv`` query heads of each KV head into the
+kernel's row axis (the pool is shared per KV head; repeating it like the XLA
+path does would multiply the very HBM traffic this kernel exists to remove).
+
+Interpret mode (`interpret=None` auto-enables off-TPU) runs the same kernels
+on CPU for the tier-1 parity sweeps (`tests/test_paged_kernel.py`), the
+`ring_attention.py` testing pattern. All accumulation is fp32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .flash_common import (
+    LANE,
+    NEG_INF,
+    finalize_softmax,
+    init_softmax_state,
+    online_softmax_update,
+)
+
+
+def _decode_kernel(
+    tbl_ref, q_ref, k_ref, v_ref, pos_ref, len_ref, o_ref, acc, m_scr, l_scr,
+    *, scale, page_size,
+):
+    """Single-query paged decode: one [G, D] query group per (batch, kv head),
+    streaming that row's pages through the online-softmax accumulator."""
+    from jax.experimental import pallas as pl
+
+    pi = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        init_softmax_state(acc, m_scr, l_scr)
+
+    length = len_ref[0, 0]  # row's valid cache length (pos + 1)
+    base = pi * page_size
+
+    @pl.when(base < length)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [page_size, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [G, page_size]
+        cols = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < length, s, NEG_INF)
+        online_softmax_update(s, v, acc, m_scr, l_scr)
+
+    @pl.when(pi == n_pages - 1)
+    def _finish():
+        out, _ = finalize_softmax(acc, m_scr, l_scr)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _verify_kernel(
+    tbl_ref, q_ref, k_ref, v_ref, pos_ref, len_ref, o_ref, acc, m_scr, l_scr,
+    *, scale, page_size, s_block, gsize,
+):
+    """Block-verify paged attention: the [B, s] multi-token twin. Rows are the
+    s*G (query position, GQA group) pairs of one (batch, kv head); query j
+    attends ``cols <= positions[b, j]`` — the accepted prefix plus the block
+    tokens at or before it, exactly the per-query mask of the XLA verify
+    path, so the speculative accept loop sees identical greedy tokens."""
+    from jax.experimental import pallas as pl
+
+    pi = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        init_softmax_state(acc, m_scr, l_scr)
+
+    length = len_ref[0, 0]  # max block position + 1: pages past it hold no query's keys
+    base = pi * page_size
+
+    @pl.when(base < length)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)  # [s*G, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [page_size, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [s*G, page_size]
+        pos = pos_ref[0]  # [s] int32 per-query attend limits
+        s3 = s.reshape(s_block, gsize, page_size)
+        cols = base + jax.lax.broadcasted_iota(jnp.int32, s3.shape, 2)
+        s3 = jnp.where(cols <= pos[:, None, None], s3, NEG_INF)
+        online_softmax_update(s3.reshape(s_block * gsize, page_size), v, acc, m_scr, l_scr)
+
+    @pl.when(pi == n_pages - 1)
+    def _finish():
+        out, _ = finalize_softmax(acc, m_scr, l_scr)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _paged_call(q, k_pool, v_pool, page_table, positions, scale, interpret, kernel_for):
+    """Shared wrapper: layout transforms, prefetch grid spec, pallas_call."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, hq, d = q.shape
+    n_pages_pool, page_size, hkv, _ = k_pool.shape
+    if hq % hkv:
+        raise ValueError(f"GQA requires Hq % Hkv == 0, got {hq}, {hkv}")
+    gsize = hq // hkv
+    rows = s * gsize
+    pages_per_slot = page_table.shape[-1]
+
+    # [B, s, Hq, D] -> [B, Hkv, s*G, D]: query head h*G+g rides kv head h's
+    # walk (the row ordering the kernels' reshape masks assume).
+    qt = (
+        q.reshape(b, s, hkv, gsize, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, hkv, rows, d)
+    )
+    table = jnp.clip(jnp.asarray(page_table, jnp.int32), 0, n_pages_pool - 1)
+    pos = jnp.asarray(positions, jnp.int32).reshape(b, s)
+    # Scalar page-skip bound per row, SMEM-friendly [B, 1].
+    lengths = (jnp.max(pos, axis=1, keepdims=True) + 1).astype(jnp.int32)
+
+    kernel = kernel_for(scale=float(scale), page_size=page_size, s_block=s, gsize=gsize)
+    in_specs = [
+        pl.BlockSpec((1, 1, rows, d), lambda bi, hi, pi, tbl: (bi, hi, 0, 0)),  # q
+        # THE fused page-table gather: grid step (b, h, p) streams pool page
+        # table[b, p] for kv head h. Table entries past a slot's reservation
+        # are the scratch page — identical consecutive block indices, which
+        # the Pallas pipeline fetches once, not P times.
+        pl.BlockSpec((1, page_size, 1, d), lambda bi, hi, pi, tbl: (tbl[bi, pi], 0, hi, 0)),
+        pl.BlockSpec((1, page_size, 1, d), lambda bi, hi, pi, tbl: (tbl[bi, pi], 0, hi, 0)),
+        pl.BlockSpec((1, s), lambda bi, hi, pi, tbl: (bi, 0)),  # per-query limits
+        pl.BlockSpec((1, 1), lambda bi, hi, pi, tbl: (bi, 0), memory_space=pltpu.SMEM),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv, pages_per_slot),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rows, d), lambda bi, hi, pi, tbl: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, LANE), jnp.float32),
+            pltpu.VMEM((rows, LANE), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(table, qt, k_pool, v_pool, pos, lengths)
+    return (
+        out.reshape(b, hkv, s, gsize, d).transpose(0, 2, 1, 3, 4).reshape(b, s, hq, d)
+    )
+
+
+def _auto_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
+
+
+def paged_decode_attention(
+    q, k_pool, v_pool, page_table, positions, *, scale=None, interpret=None
+):
+    """Single-query paged decode attention over a pool-resident KV cache.
+
+    Args:
+        q: [B, 1, Hq, D] this step's queries (one per slot).
+        k_pool / v_pool: [num_pages, page_size, Hkv, D] page pools, ALREADY
+            holding this dispatch's K/V writes (the caller scatters first —
+            query i attends its own new row via ``cols <= positions[i]``).
+        page_table: [B, pages_per_slot] int32 pool-page ids (traced operand);
+            unused entries point at the scratch page.
+        positions: [B, 1] (or [B]) int32 — row i attends ``cols <= positions[i]``.
+        scale: defaults to 1/sqrt(D).
+        interpret: None = auto (Pallas interpreter off-TPU, compiled on TPU).
+
+    Returns [B, 1, Hq, D], token-identical to the XLA gather oracle.
+    """
+    b = q.shape[0]
+    if q.ndim != 4 or q.shape[1] != 1:
+        raise ValueError(f"paged_decode_attention takes [B, 1, Hq, D] queries, got {q.shape}")
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    pos = jnp.asarray(positions, jnp.int32).reshape(b, 1)
+
+    def kernel_for(scale, page_size, s_block, gsize):
+        return functools.partial(_decode_kernel, scale=scale, page_size=page_size)
+
+    return _paged_call(
+        q, k_pool, v_pool, page_table, pos, scale, _auto_interpret(interpret), kernel_for
+    )
+
+
+def paged_verify_attention(
+    q, k_pool, v_pool, page_table, positions, *, scale=None, interpret=None
+):
+    """Block-verify paged attention: the [B, s] multi-token variant used by
+    speculative decoding's verify step (s = draft_tokens + 1).
+
+    Args:
+        q: [B, s, Hq, D] the block's queries.
+        k_pool / v_pool / page_table: as `paged_decode_attention` — the pools
+            already hold the block's K/V writes.
+        positions: [B, s] int32 — query j of row i attends
+            ``cols <= positions[i, j]`` (its accepted prefix plus the block
+            tokens at or before it, all written by this same dispatch).
+
+    Returns [B, s, Hq, D].
+    """
+    if q.ndim != 4:
+        raise ValueError(f"paged_verify_attention takes [B, s, Hq, D] queries, got {q.shape}")
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+
+    def kernel_for(scale, page_size, s_block, gsize):
+        return functools.partial(
+            _verify_kernel, scale=scale, page_size=page_size, s_block=s_block, gsize=gsize
+        )
+
+    return _paged_call(
+        q, k_pool, v_pool, page_table, positions, scale, _auto_interpret(interpret), kernel_for
+    )

@@ -197,6 +197,7 @@ class ContinuousBatcher:
         speculative: bool = False,
         draft_tokens: int = DEFAULT_DRAFT_TOKENS,
         draft_ngram: int = DEFAULT_DRAFT_NGRAM,
+        attention_impl: str = "xla",
     ):
         if getattr(model, "module", None) is None or not hasattr(model.module, "config"):
             raise ValueError("ContinuousBatcher needs a Model bundle built from an in-tree flax module")
@@ -241,6 +242,24 @@ class ContinuousBatcher:
                     "(the presence update is order-dependent across a verified "
                     "block); disable one of the two"
                 )
+        # Decode/verify attention implementation: "xla" keeps the gather-then-
+        # attend oracle; "pallas_paged" fuses the page-table walk into the
+        # ops/paged_attention kernels (paged engines only). Either way the ONE
+        # decode executable and the traced-operand page tables are unchanged —
+        # the impl only swaps the attention read inside the compiled program.
+        from .ops.attention import SLOT_ATTENTION_IMPLS
+
+        self.attention_impl = str(attention_impl)
+        if self.attention_impl not in SLOT_ATTENTION_IMPLS:
+            raise ValueError(
+                f"unknown attention_impl {attention_impl!r}; expected one of "
+                f"{SLOT_ATTENTION_IMPLS}"
+            )
+        if self.attention_impl == "pallas_paged" and not paged:
+            raise ValueError(
+                "attention_impl='pallas_paged' requires the paged KV cache "
+                "(paged=True); the contiguous layout has no page table to walk"
+            )
         self.paged = bool(paged)
         self.page_size = int(page_size)
         if self.paged:
@@ -284,6 +303,7 @@ class ContinuousBatcher:
             step_cfg = dataclasses.replace(
                 base, decode_cache_length=cache_len, decode_slot_cache=True,
                 decode_page_size=self.page_size, decode_num_pages=self.num_pages,
+                decode_attention_impl=self.attention_impl,
             )
         else:
             step_cfg = dataclasses.replace(
@@ -880,6 +900,7 @@ class ContinuousBatcher:
         source of truth since the telemetry PR). Same keys and meanings as the
         old ad-hoc dict; mutate nothing here — it is rebuilt per access."""
         view: Dict[str, Any] = {
+            "attention_impl": self.attention_impl,
             "inserts": int(self._m_inserts.value),
             "chunks": int(self._m_chunks.value),
             "decode_steps": int(self._m_decode_steps.value),

@@ -153,6 +153,7 @@ def run_router_workload(model, args, cfg, max_length, rng, tracer=None):
         max_queue=args.requests + 16, default_deadline_s=600.0,
         paged=not args.no_paged, page_size=args.page_size, tracer=tracer,
         rejoin_cooldown_s=0.2, probation_steps=1, stall_degrade_s=None,
+        attention_impl=args.attention_impl,
     )
 
     def run_traffic(kill_fraction=None):
@@ -342,6 +343,121 @@ def run_spec_workload(model, args, cfg, max_length, rng, tracer=None):
     return result
 
 
+def estimate_decode_hbm_bytes(num_slots, pages_per_slot, page_size, model_cfg, dtype_bytes):
+    """Estimated HBM bytes the attention CACHE READ moves per decode step,
+    derived from pool geometry (worst case: every slot's full page window),
+    per implementation:
+
+      - ``xla``: `update_slot_cache` gathers the pool into a logical
+        [S, L, hkv, d] K/V buffer — the pool pages are read, the gathered
+        buffer is written, then the masked attention reads it back: ~3 passes
+        over the logical cache, for K and V, every layer.
+      - ``pallas_paged``: the kernel streams each table page into VMEM once —
+        1 pass, no materialized buffer.
+
+    An estimate, not a measurement (XLA may fuse or spill differently): its
+    job is to size the bandwidth claim a real-hardware run should verify."""
+    L = pages_per_slot * page_size
+    hkv = getattr(model_cfg, "num_key_value_heads", model_cfg.num_attention_heads)
+    logical = num_slots * L * hkv * model_cfg.head_dim * dtype_bytes * 2  # K + V
+    per_layer = {"xla": 3 * logical, "pallas_paged": logical}
+    return {
+        impl: val * model_cfg.num_hidden_layers for impl, val in per_layer.items()
+    }
+
+
+def run_attention_workload(model, args, cfg, max_length, workload, tracer=None):
+    """The kernel-vs-XLA A/B: the SAME mixed workload served through two
+    otherwise-identical paged engines, attention_impl "xla" (gather oracle)
+    vs "pallas_paged" (fused page-walk kernels). Each engine's timed pass
+    runs under an armed TraceGuard with the hard 0-recompile /
+    0-host-transfer gate — the kernel path must hold the compiled-once
+    discipline, not just match tokens — and the block records the impl each
+    decode executable ACTUALLY traced (`ops.attention.LAST_DISPATCH`), the
+    decode tokens/sec, the mean per-dispatch / per-decode-step chunk seconds,
+    and the pool-geometry HBM estimate, so the MFU/bandwidth claim is a
+    recorded artifact for the next real-hardware run."""
+    from accelerate_tpu.analysis import TraceGuard
+    from accelerate_tpu.ops import attention as attention_ops
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    import jax
+
+    prompts, budgets, arrivals = workload
+    # The KV pool inherits the params' storage dtype (bf16 on accelerators).
+    dtype_bytes = np.dtype(jax.tree_util.tree_leaves(model.params)[0].dtype).itemsize
+    # Off-TPU, pallas_paged runs the Pallas INTERPRETER (the CPU-test shim):
+    # parity and the 0-recompile discipline are real, the timing is not — the
+    # block records it so a CPU-smoke ratio can never pass as TPU behavior.
+    interpreted = jax.default_backend() != "tpu"
+    if interpreted:
+        log(
+            "attention A/B off-TPU: pallas_paged runs the Pallas interpreter — "
+            "parity/discipline are meaningful, tokens/sec ratios are NOT "
+            "(interpreted=true is recorded in the block)"
+        )
+    result = {"backend": jax.default_backend()}
+    for impl in ("xla", "pallas_paged"):
+        engine = ContinuousBatcher(
+            model, num_slots=args.num_slots, max_length=max_length,
+            chunk_size=args.chunk_size, paged=True, page_size=args.page_size,
+            tracer=tracer, max_queue=args.requests, attention_impl=impl,
+        )
+        log(f"attention workload ({impl}): warmup...")
+        engine.warm_inserts()
+        run_continuous(engine, prompts, budgets, arrivals)
+        # The chunk executable traced during the pass above; LAST_DISPATCH is
+        # a trace-time record, so it still names the impl that program chose.
+        dispatch_impl = attention_ops.LAST_DISPATCH
+        run_continuous(engine, prompts, budgets, arrivals)
+        registry = engine.metrics
+        chunk_hist = registry.get("serving_chunk_seconds")
+        count0, sum0 = chunk_hist.count, chunk_hist.sum
+        guard = TraceGuard(
+            transfer_guard="disallow", on_violation="record",
+            name=f"serving-bench-attention-{impl}",
+        )
+        engine.trace_guard = guard
+        with guard:
+            tps, ttfts, iters, span = run_continuous(engine, prompts, budgets, arrivals)
+        if guard.total_recompiles or guard.host_transfers:
+            log(f"TRACE-GUARD VIOLATIONS in attention workload ({impl}): {guard.report().summary()}")
+        # The kernel-path discipline pin: pallas_paged must hold the same
+        # steady state as the oracle — one decode executable, page tables as
+        # traced operands, zero host syncs.
+        assert guard.total_recompiles == 0 and guard.host_transfers == 0, (
+            f"attention workload ({impl}) regressed the 0-recompile / "
+            f"0-host-transfer discipline: {guard.report().summary()}"
+        )
+        chunks = chunk_hist.count - count0
+        chunk_s = (chunk_hist.sum - sum0) / max(chunks, 1)
+        hbm = estimate_decode_hbm_bytes(
+            args.num_slots, engine.pages_per_slot, args.page_size, cfg, dtype_bytes
+        )
+        result[impl] = {
+            "dispatch_impl": dispatch_impl,
+            "interpreted": interpreted and impl == "pallas_paged",
+            "tokens_per_sec": round(tps, 2),
+            "decode_iterations": iters,
+            "ttft_p50_ms": round(pct(ttfts, 50) * 1000, 2),
+            "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 2),
+            "makespan_s": round(span, 3),
+            "decode_chunk_mean_s": round(chunk_s, 6),
+            "decode_attention_s_per_dispatch": round(chunk_s / args.chunk_size, 6),
+            "est_hbm_bytes_per_decode_step": hbm[impl],
+            "recompiles": guard.total_recompiles,
+            "host_transfers": guard.host_transfers,
+        }
+    result["tokens_per_sec_ratio_pallas_over_xla"] = round(
+        result["pallas_paged"]["tokens_per_sec"] / max(result["xla"]["tokens_per_sec"], 1e-9), 3
+    )
+    result["est_hbm_bytes_ratio_xla_over_pallas"] = round(
+        result["xla"]["est_hbm_bytes_per_decode_step"]
+        / max(result["pallas_paged"]["est_hbm_bytes_per_decode_step"], 1), 3
+    )
+    return result
+
+
 def run_prefix_workload(model, args, cfg, max_length, rng, tracer=None):
     """The prefix-heavy serving workload: every request opens with the SAME
     `--prefix-tokens`-long system prompt followed by a random tail. Served
@@ -435,6 +551,12 @@ def main(argv=None):
                         help="draft tokens per verify step in the speculative workload")
     parser.add_argument("--draft-ngram", type=int, default=2,
                         help="n-gram length the speculative drafter matches on")
+    parser.add_argument("--attention-impl", default="xla", choices=["xla", "pallas_paged"],
+                        help="decode/verify attention implementation for the main engine and "
+                        "the --replicas fleet: the XLA gather oracle or the fused Pallas "
+                        "page-walk kernels (paged cache only)")
+    parser.add_argument("--no-attention-ab", action="store_true",
+                        help="skip the kernel-vs-XLA attention A/B workload")
     parser.add_argument("--replicas", type=int, default=1,
                         help="run the replicated-router workload over N engines with a "
                         "kill-one-replica A/B (throughput dip + recovery time); 1 disables")
@@ -497,10 +619,12 @@ def main(argv=None):
     trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="serving_bench_trace_")
     tracer = Tracer(recorder=FlightRecorder(log_dir=trace_dir), category="serve")
 
+    if args.attention_impl == "pallas_paged" and args.no_paged:
+        parser.error("--attention-impl pallas_paged requires the paged cache (drop --no-paged)")
     engine = ContinuousBatcher(
         model, num_slots=args.num_slots, max_length=max_length, chunk_size=args.chunk_size,
         paged=not args.no_paged, page_size=args.page_size, tracer=tracer,
-        max_queue=args.requests,
+        max_queue=args.requests, attention_impl=args.attention_impl,
     )
     static_gen = Generator(model, max_new_tokens=max(budgets), max_length=max_length)
 
@@ -518,6 +642,13 @@ def main(argv=None):
     run_static(static_gen, prompts, budgets, arrivals, args.num_slots, max_length)
     log(f"insert buckets warmed: {engine.warm_inserts()}")
     run_continuous(engine, prompts, budgets, arrivals)
+    # Impl provenance: the decode chunk traced during the pass above (after
+    # every insert bucket), and LAST_DISPATCH is a trace-time record — it
+    # still names the attention implementation the MAIN engine's one decode
+    # executable actually chose, which the JSON pins next to the flag.
+    from accelerate_tpu.ops import attention as attention_ops
+
+    main_dispatch_impl = attention_ops.LAST_DISPATCH
     run_continuous(engine, prompts, budgets, arrivals)
     log(f"warmup done in {time.perf_counter() - t0:.1f}s; timed runs...")
 
@@ -574,6 +705,16 @@ def main(argv=None):
                 f"(accepted_tokens_per_step={spec_block['accepted_tokens_per_step']}) "
                 "— output is still token-identical, but check drafter knobs"
             )
+
+    # Kernel-vs-XLA attention A/B: the SAME workload as the headline timed
+    # passes through two otherwise-identical paged engines, so the JSON
+    # records both impls' decode tokens/sec plus the pool-geometry HBM
+    # estimate — the bandwidth claim as an artifact.
+    attention_ab = None
+    if not args.no_paged and not args.no_attention_ab:
+        attention_ab = run_attention_workload(
+            model, args, cfg, max_length, (prompts, budgets, arrivals), tracer=tracer
+        )
 
     # Replicated-router A/B: the same workload behind a health-routed fleet,
     # with one replica chaos-killed mid-traffic (dip + recovery measured).
@@ -663,6 +804,22 @@ def main(argv=None):
             "queue_peak": engine.stats["queue_peak"],
             "finish_reasons": dict(engine.stats["finish_reasons"]),
             "telemetry": telemetry_block,
+            # Attention-impl provenance + the kernel-vs-XLA A/B: which
+            # implementation the main engine's decode executable traced, and
+            # both impls' decode tokens/sec / per-dispatch seconds / estimated
+            # HBM bytes from the same workload (docs/observability.md).
+            "attention": {
+                "impl": args.attention_impl,
+                "dispatch_impl": main_dispatch_impl,
+                # pallas_paged off-TPU = the Pallas INTERPRETER (the kernels'
+                # interpret=None auto-select): parity and the 0/0 discipline
+                # hold, the timing is not kernel timing.
+                "interpreted": (
+                    args.attention_impl == "pallas_paged"
+                    and jax.default_backend() != "tpu"
+                ),
+                "ab": attention_ab,
+            },
             # Paged-KV state of the MAIN engine plus the shared-system-prompt
             # A/B (prefix cache on/off); prefill_tokens_saved > 0 with TTFT no
             # worse than the uncached run is the prefix-cache acceptance gate.

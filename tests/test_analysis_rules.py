@@ -164,6 +164,70 @@ def test_tpu114_router_variants():
     assert not analyze_source(no_jax)
 
 
+def test_tpu115_interpret_variant():
+    """The kernel-call half of TPU115 (the flag fixture carries the
+    attention_impl pin — one finding per fixture): a literal interpret=True on
+    a Pallas attention kernel flags (the CPU-test shim on a production call
+    site), interpret=None / omitted is clean, a threaded variable is clean,
+    and a jax-free module is out of scope."""
+    hazard = (
+        "import jax\n"
+        "from accelerate_tpu.ops.paged_attention import paged_decode_attention\n"
+        "def attend(q, pk, pv, tbl, pos):\n"
+        "    return paged_decode_attention(q, pk, pv, tbl, pos, interpret=True)\n"
+    )
+    findings = analyze_source(hazard)
+    assert [f.rule_id for f in findings] == ["TPU115"]
+    assert not analyze_source(hazard.replace("interpret=True", "interpret=None"))
+    assert not analyze_source(hazard.replace("interpret=True", "interpret=interp"))
+    assert not analyze_source(hazard.replace(", interpret=True", ""))
+    assert not analyze_source(hazard.replace("import jax\n", ""))
+
+
+def test_tpu115_impl_pin_variants():
+    """attention_impl="xla" flags only where the paged kernel applies: an
+    explicit paged=False or page_size=0 opt-out is clean (no page table to
+    walk), as is threading the impl as a variable (A/B harnesses)."""
+    hazard = (
+        "import jax\n"
+        "from accelerate_tpu.serving import ContinuousBatcher\n"
+        "def engine(model):\n"
+        '    return ContinuousBatcher(model, max_queue=8, attention_impl="xla")\n'
+    )
+    assert [f.rule_id for f in analyze_source(hazard)] == ["TPU115"]
+    assert not analyze_source(
+        hazard.replace('attention_impl="xla"', 'paged=False, attention_impl="xla"')
+    )
+    assert not analyze_source(
+        hazard.replace('attention_impl="xla"', "attention_impl=impl")
+    )
+    # The config-field spelling (dataclasses.replace / model configs) flags too.
+    cfg = (
+        "import jax\n"
+        "import dataclasses\n"
+        "def step_cfg(base):\n"
+        '    return dataclasses.replace(base, decode_page_size=4, decode_attention_impl="xla")\n'
+    )
+    assert [f.rule_id for f in analyze_source(cfg)] == ["TPU115"]
+    assert not analyze_source(
+        cfg.replace("decode_page_size=4", "decode_page_size=0")
+    )
+    # A seam call relying on its own page_size=0 default (the contiguous
+    # layout, where "xla" is the ONLY legal impl) must not flag — only calls
+    # that really thread page geometry, or the paged-by-default constructors.
+    seam = (
+        "import jax\n"
+        "from accelerate_tpu.ops.attention import slot_cache_attention\n"
+        "def attend(module, q, k, v, pos):\n"
+        '    return slot_cache_attention(module, q, k, v, 32, pos, attention_impl="xla")\n'
+    )
+    assert not analyze_source(seam)
+    paged_seam = seam.replace(
+        'attention_impl="xla"', 'page_size=ps, attention_impl="xla"'
+    )
+    assert [f.rule_id for f in analyze_source(paged_seam)] == ["TPU115"]
+
+
 def test_analyze_paths_walks_the_tree():
     findings, scanned = analyze_paths([str(SAMPLES)])
     assert scanned >= 2 * len(RULES) + 1  # flag + clean per rule + suppressed.py

@@ -104,6 +104,30 @@ def test_router_is_warn_clean():
     )
 
 
+def test_kernel_serving_path_is_warn_clean_at_15_rules():
+    """The Pallas kernel path pin: `ops/` (the kernels + the dispatch seams)
+    and the kernel-touching serving/generation files stay warn-clean under the
+    FULL 15-rule registry — including TPU115, so nothing in the shipped tree
+    pins a paged decode program to the gather oracle or forces interpret mode
+    outside tests. The rule-count assert keeps this test honest: if the
+    registry grows, this pin re-evaluates the kernel path under the new rule
+    instead of silently gating against a stale set."""
+    from accelerate_tpu.analysis import RULES
+
+    assert len(RULES) == 15, "rule registry changed — re-audit the kernel-path pin"
+    roots = [
+        REPO / "accelerate_tpu" / "ops",
+        REPO / "accelerate_tpu" / "serving.py",
+        REPO / "accelerate_tpu" / "generation.py",
+    ]
+    findings, scanned = analyze_paths([str(r) for r in roots])
+    assert scanned >= 8, f"kernel-path files missing? scanned {scanned}"
+    flagged = [f for f in findings if severity_at_least(f.severity, "warn")]
+    assert not flagged, "warn+ TPU hazards on the kernel path:\n" + "\n".join(
+        f"  {f.file}:{f.line}: {f.rule_id} {f.message}" for f in flagged
+    )
+
+
 def test_telemetry_subsystem_is_warn_clean():
     """The observability layer rides the serving/train hot paths — it must be
     completely clean at WARN level, not just error-free: a host-sync or

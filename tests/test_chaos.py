@@ -434,6 +434,77 @@ def test_consumed_donation_recovers_on_the_contiguous_layout_too():
     assert ledger.details.get("note") == "contiguous engine (no pool)"
 
 
+# ------------------------------------------------------- kernel-path serving chaos
+@pytest.mark.kernels
+def test_smoke_serve_sweep_on_the_kernel_path():
+    """The smoke-serve acceptance sweep (stall + queue burst + dispatch
+    failure) with `attention_impl="pallas_paged"`: the fused page-walk kernels
+    ride inside the one decode executable, so every serving invariant —
+    terminal finish_reasons, bounded queue, post-failure recovery — must hold
+    unchanged with the kernel on the hot path."""
+    plan = builtin_plans()["smoke-serve"]
+    report = ChaosRunner(plan).run_serve(
+        num_requests=6, max_queue=3, attention_impl="pallas_paged"
+    )
+    assert report.ok, report.render_text()
+    by_name = {c.name: c for c in report.checks}
+    assert by_name["terminal_finish_reasons"].details["accepted"] >= 6
+    assert by_name["queue_bounded"].details["queue_peak"] <= 3
+    assert by_name["engine_recovered"].details.get("requests_after_error", 0) >= 2
+
+
+@pytest.mark.kernels
+def test_consumed_donation_recovers_on_the_kernel_path():
+    """Blast-radius recovery rebuilds the KERNEL-path executables identically:
+    an injected chunk failure deletes the donated pool buffers mid-flight, the
+    engine rebuilds the page pool from zeros, and post-recovery requests must
+    complete through the same compiled pallas_paged decode program — page
+    ledger closed, no retrace (the rebuilt operands have identical shapes, so
+    the warm executable serves them)."""
+    plan = FaultPlan(
+        name="chunk-consumes-donation-kernel",
+        events=[FaultEvent(kind="serve.dispatch_error", at_call=3,
+                           args={"consume_donated": True})],
+    )
+    report = ChaosRunner(plan).run_serve(
+        num_requests=8, max_queue=6, attention_impl="pallas_paged"
+    )
+    assert report.ok, report.render_text()
+    recovered = next(c for c in report.checks if c.name == "engine_recovered")
+    assert recovered.details["requests_after_error"] >= 2
+    ledger = next(c for c in report.checks if c.name == "page_ledger")
+    assert ledger.details["pages_in_use_after_drain"] == 0
+    assert ledger.details["consistency_problems"] == []
+    assert ledger.details["pages_total"] > 0
+
+
+@pytest.mark.kernels
+@pytest.mark.speculative
+def test_consumed_donation_recovers_with_speculation_on_the_kernel_path():
+    """The speculative sweep with the block-verify KERNEL on the verify seam:
+    consumed-donation recovery must rebuild the draft/verify state (history
+    reseeded, window pages released) and drive post-recovery traffic through
+    the same compiled kernel-path verify executable."""
+    plan = FaultPlan(
+        name="chunk-consumes-donation-speculative-kernel",
+        events=[FaultEvent(kind="serve.dispatch_error", at_call=3,
+                           args={"consume_donated": True})],
+    )
+    report = ChaosRunner(plan).run_serve(
+        num_requests=8, max_queue=6, speculative=True, attention_impl="pallas_paged"
+    )
+    assert report.ok, report.render_text()
+    recovered = next(c for c in report.checks if c.name == "engine_recovered")
+    assert recovered.details["requests_after_error"] >= 2
+    ledger = next(c for c in report.checks if c.name == "page_ledger")
+    assert ledger.details["pages_in_use_after_drain"] == 0
+    assert ledger.details["consistency_problems"] == []
+    steps = next(
+        m for m in report.metrics if m["name"] == "serving_spec_verify_steps_total"
+    )
+    assert steps["value"] > 0
+
+
 def test_insert_failure_releases_reserved_pages():
     """An isolated insert failure (no donation consumed) must return the pages
     it reserved for the doomed request — a leak here exhausts the pool after

@@ -1,0 +1,386 @@
+"""Pallas paged-decode & block-verify kernel pins (`ops/paged_attention.py`).
+
+CPU tier-1 coverage via Pallas interpret mode at tiny shapes (the
+`ring_attention.py` pattern): the kernels that fuse the page-table gather into
+the serving hot loop are pinned against the XLA gather oracle —
+kernel==oracle numerics per dtype (f32 tight, bf16 tolerance-bounded), greedy
+token parity through `serving.ContinuousBatcher` across page sizes / ragged
+cache lengths / prefix-shared pages / speculative draft blocks, scratch-page
+rows contributing exact zeros, and the decode-compiled-once discipline with
+the kernel on the decode path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+from accelerate_tpu.ops.paged_attention import (
+    paged_decode_attention,
+    paged_verify_attention,
+)
+from accelerate_tpu.serving import ContinuousBatcher, Request
+
+pytestmark = pytest.mark.kernels
+
+
+# ----------------------------------------------------------------- kernel-level
+def _random_pool(rng, num_pages, page_size, hkv, d, dtype=np.float32):
+    k = rng.normal(size=(num_pages, page_size, hkv, d)).astype(dtype)
+    v = rng.normal(size=(num_pages, page_size, hkv, d)).astype(dtype)
+    return k, v
+
+
+def _oracle(q, pool_k, pool_v, table, positions):
+    """The XLA gather path, re-derived in numpy/f64-free f32: gather the
+    slot's pages into logical order, repeat KV heads for GQA, mask
+    ``cols <= positions[i, j]``, exact two-pass softmax."""
+    b, s, hq, d = q.shape
+    ps = pool_k.shape[1]
+    hkv = pool_k.shape[2]
+    L = table.shape[1] * ps
+    kf = pool_k[table].reshape(b, L, hkv, d).astype(np.float32)
+    vf = pool_v[table].reshape(b, L, hkv, d).astype(np.float32)
+    reps = hq // hkv
+    kf, vf = np.repeat(kf, reps, axis=2), np.repeat(vf, reps, axis=2)
+    scores = np.einsum("bqhd,bkhd->bhqk", q.astype(np.float32), kf) / np.sqrt(d)
+    cols = np.arange(L)[None, None, None, :]
+    scores = np.where(cols <= positions[:, None, :, None], scores, -1e30)
+    scores -= scores.max(-1, keepdims=True)
+    probs = np.exp(scores)
+    probs /= probs.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", probs, vf)
+
+
+@pytest.mark.parametrize("page_size", [4, 8, 16])
+def test_decode_kernel_matches_oracle_f32(page_size):
+    """Single-query paged decode vs the gather oracle across page sizes and
+    ragged cache lengths (first position, page boundaries, full window)."""
+    rng = np.random.default_rng(0)
+    B, Hq, Hkv, D, P = 4, 4, 2, 8, 3
+    N = B * P + 1
+    pool_k, pool_v = _random_pool(rng, N, page_size, Hkv, D)
+    table = np.arange(1, N).reshape(B, P).astype(np.int32)
+    L = P * page_size
+    # Ragged lengths: pos 0 (one valid cell), a page-boundary-1, mid, full.
+    pos = np.array([[0], [page_size - 1], [L // 2], [L - 1]], np.int32)
+    q = rng.normal(size=(B, 1, Hq, D)).astype(np.float32)
+    out = np.asarray(
+        paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(table), jnp.asarray(pos),
+        )
+    )
+    np.testing.assert_allclose(out, _oracle(q, pool_k, pool_v, table, pos), atol=2e-5)
+
+
+def test_decode_kernel_bf16_within_tolerance():
+    rng = np.random.default_rng(1)
+    B, Hq, Hkv, D, P, page_size = 3, 4, 2, 8, 3, 4
+    N = B * P + 1
+    pool_k, pool_v = _random_pool(rng, N, page_size, Hkv, D)
+    table = np.arange(1, N).reshape(B, P).astype(np.int32)
+    pos = np.array([[3], [7], [11]], np.int32)
+    q = rng.normal(size=(B, 1, Hq, D)).astype(np.float32)
+    out = np.asarray(
+        paged_decode_attention(
+            jnp.asarray(q, jnp.bfloat16),
+            jnp.asarray(pool_k, jnp.bfloat16),
+            jnp.asarray(pool_v, jnp.bfloat16),
+            jnp.asarray(table), jnp.asarray(pos),
+        ).astype(jnp.float32)
+    )
+    expect = _oracle(q, pool_k, pool_v, table, pos)
+    # bf16 inputs: ~7 bits of mantissa on the operands; accumulation is f32.
+    np.testing.assert_allclose(out, expect, atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize("s", [2, 4, 5])
+def test_verify_kernel_matches_oracle(s):
+    """Block-verify (the speculative [B, s] variant): per-query
+    ``cols <= positions[i, j]`` masks across draft-block widths."""
+    rng = np.random.default_rng(2)
+    B, Hq, Hkv, D, P, page_size = 3, 4, 2, 8, 4, 4
+    N = B * P + 1
+    pool_k, pool_v = _random_pool(rng, N, page_size, Hkv, D)
+    table = np.arange(1, N).reshape(B, P).astype(np.int32)
+    base = np.array([0, 5, 9], np.int32)
+    pos = base[:, None] + np.arange(s)[None, :].astype(np.int32)
+    q = rng.normal(size=(B, s, Hq, D)).astype(np.float32)
+    out = np.asarray(
+        paged_verify_attention(
+            jnp.asarray(q), jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(table), jnp.asarray(pos),
+        )
+    )
+    np.testing.assert_allclose(out, _oracle(q, pool_k, pool_v, table, pos), atol=2e-5)
+
+
+def test_mha_shape_no_gqa_grouping():
+    """Hq == Hkv (the gpt_neox shape, G = 1) walks the same kernel."""
+    rng = np.random.default_rng(3)
+    B, H, D, P, page_size = 2, 4, 8, 2, 4
+    N = B * P + 1
+    pool_k, pool_v = _random_pool(rng, N, page_size, H, D)
+    table = np.arange(1, N).reshape(B, P).astype(np.int32)
+    pos = np.array([[2], [6]], np.int32)
+    q = rng.normal(size=(B, 1, H, D)).astype(np.float32)
+    out = np.asarray(
+        paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(table), jnp.asarray(pos),
+        )
+    )
+    np.testing.assert_allclose(out, _oracle(q, pool_k, pool_v, table, pos), atol=2e-5)
+
+
+def test_scratch_page_rows_contribute_zero():
+    """Poison the scratch page (page 0) with huge values: outputs must not
+    move — table entries past a slot's reservation point at page 0, and the
+    positional mask keeps every scratch cell invisible."""
+    rng = np.random.default_rng(4)
+    B, Hq, Hkv, D, P, page_size = 2, 4, 2, 8, 4, 4
+    N = 6
+    pool_k, pool_v = _random_pool(rng, N, page_size, Hkv, D)
+    # Short slots: trailing table entries at the scratch page.
+    table = np.array([[1, 2, 0, 0], [3, 0, 0, 0]], np.int32)
+    pos = np.array([[6], [2]], np.int32)
+    q = rng.normal(size=(B, 1, Hq, D)).astype(np.float32)
+
+    def run(pk, pv):
+        return np.asarray(
+            paged_decode_attention(
+                jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+                jnp.asarray(table), jnp.asarray(pos),
+            )
+        )
+
+    clean = run(pool_k, pool_v)
+    poisoned_k, poisoned_v = pool_k.copy(), pool_v.copy()
+    poisoned_k[0] = 1e4
+    poisoned_v[0] = 1e4
+    np.testing.assert_array_equal(clean, run(poisoned_k, poisoned_v))
+
+
+def test_prefix_shared_pages_read_identically():
+    """Two slots whose tables share the same head pages (the prefix cache's
+    layout) must each read the shared content exactly as if it were private."""
+    rng = np.random.default_rng(5)
+    Hq, Hkv, D, P, page_size = 4, 2, 8, 3, 4
+    N = 8
+    pool_k, pool_v = _random_pool(rng, N, page_size, Hkv, D)
+    # Rows share pages 1-2 (a cached system prompt), then diverge.
+    table = np.array([[1, 2, 3], [1, 2, 4]], np.int32)
+    pos = np.array([[10], [11]], np.int32)
+    q = rng.normal(size=(2, 1, Hq, D)).astype(np.float32)
+    out = np.asarray(
+        paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(table), jnp.asarray(pos),
+        )
+    )
+    np.testing.assert_allclose(out, _oracle(q, pool_k, pool_v, table, pos), atol=2e-5)
+
+
+# -------------------------------------------------------------- program-level
+def _tiny_config(**overrides):
+    base = dict(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=10000.0,
+    )
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
+def test_verify_program_kernel_matches_xla():
+    """`make_causal_programs(verify_block=True)` built over two module
+    variants that differ ONLY in `decode_attention_impl`: scoring the same
+    token block through the same page tables must produce matching [B, s, V]
+    logits (and identical argmax — the token the accept loop consumes)."""
+    import dataclasses
+
+    from accelerate_tpu.generation import make_causal_programs
+
+    model = create_llama_model(_tiny_config(), seq_len=16)
+    num_pages = 9
+    step_cfg = dataclasses.replace(
+        model.module.config, decode_cache_length=16, decode_slot_cache=True,
+        decode_page_size=4, decode_num_pages=num_pages,
+    )
+    rng = np.random.default_rng(6)
+    B, s = 2, 3
+    tokens = jnp.asarray(rng.integers(1, 128, (B, s)), jnp.int32)
+    positions = jnp.asarray(np.broadcast_to(np.arange(s), (B, s)), jnp.int32)
+    table = jnp.asarray(np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.int32))
+    params = model.params if "params" in model.params else {"params": model.params}
+    logits = {}
+    for impl in ("xla", "pallas_paged"):
+        module = type(model.module)(
+            dataclasses.replace(step_cfg, decode_attention_impl=impl)
+        )
+        _, _, verify = make_causal_programs(
+            module, lambda p: p, step_mask_operand=True, verify_block=True
+        )
+        cache = jax.tree_util.tree_map(
+            lambda leaf: jnp.zeros(leaf.shape, leaf.dtype),
+            jax.eval_shape(
+                lambda p: module.apply(
+                    p, tokens, table, positions, mutable=["cache"]
+                )[1]["cache"],
+                params,
+            ),
+        )
+        out, _cache = jax.jit(verify)(params, cache, tokens, positions, table)
+        logits[impl] = np.asarray(out)
+    np.testing.assert_allclose(logits["xla"], logits["pallas_paged"], atol=2e-4)
+    np.testing.assert_array_equal(
+        logits["xla"].argmax(-1), logits["pallas_paged"].argmax(-1)
+    )
+
+
+# --------------------------------------------------------------- engine-level
+def _mixed_requests(rng, n, vocab=128, prompt_lo=3, prompt_hi=20, new_lo=2, new_hi=10):
+    return [
+        Request(
+            i,
+            rng.integers(1, vocab, (int(rng.integers(prompt_lo, prompt_hi)),)).astype(np.int32),
+            max_new_tokens=int(rng.integers(new_lo, new_hi)),
+        )
+        for i in range(n)
+    ]
+
+
+def _run_engine(model, requests, **kwargs):
+    engine = ContinuousBatcher(model, max_queue=len(requests) + 2, **kwargs)
+    results = engine.run(
+        [Request(r.request_id, r.input_ids, max_new_tokens=r.max_new_tokens) for r in requests]
+    )
+    return engine, {rid: list(map(int, toks)) for rid, toks in results.items()}
+
+
+@pytest.mark.parametrize("page_size", [4, 8, 16])
+def test_engine_greedy_token_parity_across_page_sizes(page_size):
+    """The serving pin: greedy outputs through `ContinuousBatcher` are
+    token-IDENTICAL (f32) between the kernel path and the XLA oracle, across
+    page sizes and ragged prompt/budget mixes — and the kernel-path decode
+    still compiles exactly once across mixed admissions."""
+    model = create_llama_model(_tiny_config(), seq_len=32)
+    rng = np.random.default_rng(7)
+    requests = _mixed_requests(rng, 6)
+    common = dict(num_slots=2, max_length=64, chunk_size=4, page_size=page_size)
+    _, xla_tokens = _run_engine(model, requests, attention_impl="xla", **common)
+    engine, kernel_tokens = _run_engine(
+        model, requests, attention_impl="pallas_paged", **common
+    )
+    assert kernel_tokens == xla_tokens
+    assert engine.trace_counts["decode_chunk"] == 1
+    assert engine.attention_impl == "pallas_paged"
+    assert engine.stats["attention_impl"] == "pallas_paged"
+
+
+def test_engine_parity_with_prefix_cache_hits():
+    """Prefix-shared pages on the kernel path: the second wave of requests
+    reuses the first wave's registered system-prompt pages (prefix hits > 0)
+    and still matches the oracle token-for-token."""
+    model = create_llama_model(_tiny_config(), seq_len=32)
+    rng = np.random.default_rng(8)
+    system = rng.integers(1, 128, (9,)).astype(np.int32)
+    # Two waves over the same shared system prompt: wave 1 registers its
+    # pages, wave 2 hits them. Prompts fixed up front so both impls serve
+    # byte-identical traffic.
+    waves = [
+        [
+            np.concatenate([system, rng.integers(1, 128, (3 + i,)).astype(np.int32)])
+            for i in range(4)
+        ]
+        for _ in range(2)
+    ]
+    tokens = {}
+    engines = {}
+    for impl in ("xla", "pallas_paged"):
+        engine = ContinuousBatcher(
+            model, num_slots=2, max_length=64, chunk_size=4, page_size=4,
+            attention_impl=impl, max_queue=16,
+        )
+        out = {}
+        for w, prompts in enumerate(waves):
+            out.update(
+                engine.run(
+                    [Request(w * 4 + i, p, max_new_tokens=5) for i, p in enumerate(prompts)]
+                )
+            )
+        tokens[impl] = {k: list(map(int, v)) for k, v in out.items()}
+        engines[impl] = engine
+    assert tokens["pallas_paged"] == tokens["xla"]
+    stats = engines["pallas_paged"].stats
+    assert stats["prefix_cache"]["hits"] > 0, "prefix path never exercised"
+    assert engines["pallas_paged"].trace_counts["decode_chunk"] == 1
+
+
+def test_engine_parity_speculative_draft_blocks():
+    """Speculative decoding through the block-verify KERNEL: spec-on kernel
+    == spec-on oracle == spec-off kernel, token for token (the accept loop's
+    greedy property survives the kernel swap), with drafts really accepted."""
+    model = create_llama_model(_tiny_config(), seq_len=32)
+    rng = np.random.default_rng(9)
+    motif = rng.integers(1, 128, (5,))
+    prompts = [
+        np.tile(motif, 4).astype(np.int32)[: int(rng.integers(8, 16))] for _ in range(4)
+    ]
+    reqs = lambda: [Request(i, p, max_new_tokens=8) for i, p in enumerate(prompts)]
+    runs = {}
+    for label, kwargs in {
+        "spec_kernel": dict(speculative=True, draft_tokens=3, attention_impl="pallas_paged"),
+        "spec_xla": dict(speculative=True, draft_tokens=3, attention_impl="xla"),
+        "plain_kernel": dict(attention_impl="pallas_paged"),
+    }.items():
+        engine = ContinuousBatcher(
+            model, num_slots=2, max_length=64, chunk_size=3, page_size=4,
+            max_queue=8, **kwargs,
+        )
+        runs[label] = {
+            rid: list(map(int, toks)) for rid, toks in engine.run(reqs()).items()
+        }
+        if label == "spec_kernel":
+            spec = engine.stats["speculative"]
+            assert spec["verify_steps"] > 0
+            assert engine.trace_counts["decode_chunk"] == 1
+    assert runs["spec_kernel"] == runs["spec_xla"] == runs["plain_kernel"]
+
+
+def test_engine_parity_gpt_neox():
+    """The second slot-cache family (Hq == Hkv, partial rotary) through the
+    kernel path: greedy token parity with its own oracle."""
+    from accelerate_tpu.models.gpt_neox import GPTNeoXConfig, create_gpt_neox_model
+
+    cfg = GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, rotary_pct=0.5, max_position_embeddings=64,
+    )
+    model = create_gpt_neox_model(cfg, seq_len=16)
+    rng = np.random.default_rng(10)
+    requests = _mixed_requests(rng, 4, prompt_hi=12, new_hi=6)
+    common = dict(num_slots=2, max_length=32, chunk_size=4, page_size=4)
+    _, xla_tokens = _run_engine(model, requests, attention_impl="xla", **common)
+    engine, kernel_tokens = _run_engine(
+        model, requests, attention_impl="pallas_paged", **common
+    )
+    assert kernel_tokens == xla_tokens
+    assert engine.trace_counts["decode_chunk"] == 1
+
+
+# ------------------------------------------------------------------ guardrails
+def test_pallas_paged_requires_paged_cache():
+    model = create_llama_model(_tiny_config(), seq_len=16)
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(
+            model, num_slots=2, max_length=32, paged=False,
+            attention_impl="pallas_paged", max_queue=4,
+        )
+    with pytest.raises(ValueError, match="attention_impl"):
+        ContinuousBatcher(
+            model, num_slots=2, max_length=32, attention_impl="mosaic", max_queue=4
+        )

@@ -1,0 +1,15 @@
+"""TPU115 flag fixture: a paged serving engine pinned to the XLA gather oracle
+by a literal attention_impl="xla" — one keyword away from silently serving off
+the kernel path. (The interpret=True kernel-call variant is unit-tested in
+test_analysis_rules.test_tpu115_interpret_variant; the tree-walk contract
+allows exactly one finding per flag fixture.)"""
+
+import jax.numpy as jnp
+
+from accelerate_tpu.serving import ContinuousBatcher
+
+
+def build_engine(model):
+    # FLAG: paged engine (paged defaults True) explicitly pinned to the
+    # gather oracle — the Pallas paged kernel applies to this configuration.
+    return ContinuousBatcher(model, max_queue=8, attention_impl="xla")
