@@ -670,7 +670,54 @@ class _ModuleChecker:
         self._check_closure_capture()
         self._check_serving_construction()
         self._check_kernel_fallback()
+        self._check_worker_loop()
         return self.findings
+
+    # -- subprocess worker loops (TPU116) ----------------------------------------
+    #: Worker-loop entry points whose heartbeat deadline is the orphan guard.
+    _WORKER_LOOP_FUNCS = {"serve_worker", "WorkerLoop"}
+    #: IPC receive calls that must carry a timeout when called from a loop.
+    _IPC_RECV_FUNCS = {"recv_frame", "recv_message"}
+
+    def _check_worker_loop(self):
+        """TPU116: an out-of-process serving worker is supervised through
+        TIMEOUTS — the controller's step deadline detects a hung worker, the
+        worker's heartbeat deadline detects a dead controller. A worker loop
+        built without a heartbeat deadline leaks an orphaned process (and its
+        device memory) when the controller dies; an IPC recv with no timeout
+        inside a loop turns a hung peer into a hung caller, invisible to the
+        health machine that exists to catch exactly that."""
+        if not self.index.imports_jax:
+            return
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._call_name(node.func)
+            kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            if name in self._WORKER_LOOP_FUNCS:
+                deadline = kwargs.get("heartbeat_deadline_s")
+                if "heartbeat_deadline_s" not in kwargs or (
+                    isinstance(deadline, ast.Constant) and deadline.value is None
+                ):
+                    self.emit(
+                        node,
+                        "TPU116",
+                        f"{name}(...) without heartbeat_deadline_s never notices a "
+                        "dead controller — the worker process (and its device "
+                        "memory) leaks as an orphan; pass a deadline in seconds",
+                    )
+            if name in self._IPC_RECV_FUNCS and _enclosing_loop(node) is not None:
+                timeout = kwargs.get("timeout_s")
+                if "timeout_s" not in kwargs or (
+                    isinstance(timeout, ast.Constant) and timeout.value is None
+                ):
+                    self.emit(
+                        node,
+                        "TPU116",
+                        f"{name}(...) inside a loop with no timeout_s blocks forever "
+                        "on a hung peer — bound every looped IPC recv so the "
+                        "heartbeat machinery can observe the hang",
+                    )
 
     # -- serving-engine construction (TPU114) -----------------------------------
     #: Serving front-end constructors whose robustness knobs this rule audits.

@@ -185,6 +185,18 @@ RULES = (
         "CPU-test shim, production call sites must let the kernel compile "
         "(interpret=None auto-selects)",
     ),
+    Rule(
+        id="TPU116",
+        slug="worker-loop-no-heartbeat",
+        severity="warn",
+        summary="subprocess worker loop without a heartbeat deadline, or an IPC "
+        "recv with no timeout inside a loop",
+        fixit="pass heartbeat_deadline_s=<seconds> to serve_worker/WorkerLoop (an "
+        "orphaned worker must exit, not leak a process + device memory) and give "
+        "every looped recv_frame/recv_message a timeout_s=<seconds> — an unbounded "
+        "IPC read turns a hung peer into a hung fleet controller, invisible to the "
+        "health machine that exists to catch it",
+    ),
 )
 
 RULES_BY_ID = {r.id: r for r in RULES}

@@ -134,6 +134,46 @@ class ChaosSession:
             out[entry["kind"]] = out.get(entry["kind"], 0) + 1
         return out
 
+    def preconsume(self, kind: str, count: int, path: Optional[str] = None):
+        """Mark `count` prior firings of `kind` (matching `path` when the event
+        is path-targeted) as already consumed — WITHOUT journaling or counting
+        them again. The restart half of a per-process env-propagated plan: a
+        respawned worker re-arms the same plan, reads its own past firings back
+        from the shared journal, and pre-consumes them so a `times`-bounded
+        kill cannot re-fire forever (the PR 9 at_step-SIGKILL livelock, closed
+        at the session layer). Events with ``times=0`` (unlimited) cannot be
+        pre-consumed past their cap — they have none."""
+        with self._lock:
+            remaining = int(count)
+            for i, ev in enumerate(self.plan.events):
+                if remaining <= 0:
+                    break
+                if ev.kind != kind:
+                    continue
+                if ev.path_pattern is not None and (
+                    path is None or not _path_matches(path, ev.path_pattern)
+                ):
+                    continue
+                state = self._state[i]
+                take = remaining if ev.times == 0 else min(
+                    remaining, max(ev.times - state["fired"], 0)
+                )
+                state["fired"] += take
+                # at_call is an EXACT call-count match: advancing `calls` to it
+                # would disarm the trigger forever. Only park the counter past
+                # the trigger once the event's budget is fully consumed — an
+                # event with firings left (times > fired, or times=0 unlimited)
+                # must keep counting fresh calls in the new process so its
+                # remaining firings can still trigger.
+                if (
+                    ev.at_call is not None
+                    and take
+                    and ev.times
+                    and state["fired"] >= ev.times
+                ):
+                    state["calls"] = max(state["calls"], ev.at_call)
+                remaining -= take
+
     def event_fire_counts(self) -> List[int]:
         """Per-event fired totals, aligned with `plan.events` (how invariant
         checks attribute injected delays to the specific event that caused

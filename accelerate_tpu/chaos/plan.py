@@ -85,6 +85,20 @@ FAULT_KINDS: Dict[str, str] = {
         "blast radius: its in-flight requests error, the replica survives and the router's "
         "failure counters observe it); target via path_pattern 'replica_N'"
     ),
+    "fleet.worker_kill": (
+        "deliver a REAL SIGKILL to a subprocess engine worker at a matching step op "
+        "(worker-side, via the env-propagated plan): the controller's recv sees EOF, the "
+        "router ejects the replica, re-dispatches never-streamed work, and the factory "
+        "respawns a warm worker. Target via path_pattern 'worker_N' (at_call counts that "
+        "worker's step ops); firings are journaled to ACCELERATE_TPU_CHAOS_JOURNAL before "
+        "the kill and pre-consumed on restart so a respawned worker cannot re-kill itself"
+    ),
+    "fleet.worker_stall": (
+        "sleep args.delay_s (default 1.0) inside a worker before handling a matching step "
+        "op — stall PAST the controller's step timeout and the hang surfaces exactly like "
+        "a death (heartbeat expiry -> kill -> eject -> respawn); target via path_pattern "
+        "'worker_N'"
+    ),
     "harness.disable_verification": (
         "seeded-regression fixture: neuter checkpoint digest verification so torn checkpoints "
         "resolve — the invariant report MUST go red (proves the harness detects regressions)"
@@ -129,7 +143,7 @@ class FaultEvent:
 
 #: Workloads a plan may declare as its intended harness (`ChaosRunner` entry
 #: points; the CLI's default when `--workload` is omitted).
-PLAN_WORKLOADS = ("train", "async-train", "serve", "supervised-train", "router")
+PLAN_WORKLOADS = ("train", "async-train", "serve", "supervised-train", "router", "fleet")
 
 
 @dataclass
@@ -282,6 +296,24 @@ def builtin_plans() -> Dict[str, FaultPlan]:
                            args={"delay_s": 0.02}),
                 FaultEvent(kind="router.replica_poison", path_pattern="replica_2", at_call=2),
                 FaultEvent(kind="router.replica_kill", path_pattern="replica_0", at_call=4),
+            ],
+        ),
+        "smoke-fleet": FaultPlan(
+            name="smoke-fleet",
+            seed=0,
+            workload="fleet",
+            notes="out-of-process fleet degradation chain over REAL worker processes: a "
+            "queue burst spreads load, one worker stalls past the controller's step "
+            "timeout (heartbeat-expiry kill -> respawn), another takes a real SIGKILL "
+            "mid-traffic (eject -> re-dispatch/replica_lost -> warm respawn) — every "
+            "request must reach a terminal finish_reason, no token stream may duplicate, "
+            "restarted workers must rejoin warm, and the ledger must reconcile the "
+            "worker-side journal against observed process deaths",
+            events=[
+                FaultEvent(kind="serve.queue_burst", at_step=1, args={"count": 6}),
+                FaultEvent(kind="fleet.worker_kill", path_pattern="worker_0", at_call=4),
+                FaultEvent(kind="fleet.worker_stall", path_pattern="worker_1", at_call=6,
+                           args={"delay_s": 30.0}),
             ],
         ),
         "seeded-regression": FaultPlan(

@@ -974,6 +974,374 @@ class ChaosRunner:
         ]
         return self._report("router", checks)
 
+    # ---------------------------------------------------------------- fleet
+    def run_fleet(
+        self,
+        num_requests: int = 10,
+        replicas: int = 2,
+        num_slots: int = 2,
+        chunk_size: int = 4,
+        max_queue: int = 8,
+        max_new_tokens: int = 4,
+        max_cycles: int = 2000,
+        autoscale: bool = True,
+        step_timeout_s: float = 15.0,
+        workdir: Optional[str] = None,
+    ) -> InvariantReport:
+        """Out-of-process fleet workload: a `Router` over REAL subprocess
+        engine workers (`worker.SubprocessEngine` via `make_subprocess_factory`)
+        driven to drain while the env-propagated plan SIGKILLs and stalls the
+        worker PROCESSES themselves mid-traffic. The PR 10 router invariants
+        are re-checked against true process fault domains, plus two new ones:
+
+          - **worker_restart_rejoins_warm** — every observed worker death (pid
+            change on a replica) was followed by a respawned process whose
+            ready handshake reports a pre-warmed insert ladder, and the fleet
+            ends with every non-retired replica routable;
+          - **autoscaler_converges** (``autoscale=True``) — the queue-burst
+            pressure scales the fleet up past its floor, and after the traffic
+            drains the autoscaler retires the extra workers back to the floor.
+
+        Worker-side injections are journaled (append+fsync, BEFORE the kill
+        lands) to a shared journal the ledger invariant reconciles against
+        observed process deaths — and that restarted workers read back so a
+        re-armed plan cannot livelock by re-killing at the same trigger."""
+        import tempfile
+
+        from ..models.llama import LlamaConfig, create_llama_model
+        from ..router import ROUTER_FINISH_REASONS, Router
+        from ..serving import QueueFull, Request
+        from ..worker import CHAOS_JOURNAL_ENV, make_subprocess_factory
+        from .plan import FAULT_PLAN_ENV
+
+        cfg = LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+            rope_theta=10000.0,
+        )
+        model = create_llama_model(cfg, seq_len=32)
+        workdir = workdir or tempfile.mkdtemp(prefix="accelerate_tpu_chaos_fleet_")
+        journal_path = os.path.join(workdir, "fleet_chaos_journal.jsonl")
+        worker_env = dict(os.environ)
+        worker_env[FAULT_PLAN_ENV] = self.plan.to_json(indent=None)
+        worker_env[CHAOS_JOURNAL_ENV] = journal_path
+        if self.trace_dir:
+            worker_env["ACCELERATE_TPU_TRACE_DIR"] = self.trace_dir
+        factory = make_subprocess_factory(
+            model,
+            engine_kwargs=dict(
+                num_slots=num_slots, max_length=64, chunk_size=chunk_size,
+                max_queue=max_queue, paged=True, page_size=4,
+            ),
+            workdir=workdir, env=worker_env, step_timeout_s=step_timeout_s,
+        )
+        router = Router(
+            model, replicas=replicas, max_queue=max_queue, default_deadline_s=120.0,
+            registry=self.session.registry, tracer=self.tracer,
+            engine_factory=factory,
+            rejoin_cooldown_s=0.05, probation_steps=2, stall_degrade_s=None,
+            heartbeat_timeout_s=None,  # hang detection is the client step timeout
+            **(dict(
+                min_replicas=replicas, max_replicas=replicas + 1,
+                autoscale_queue_high=1.5, autoscale_cooldown_s=0.0,
+                idle_retire_s=0.05,
+            ) if autoscale else {}),
+        )
+        rng = np.random.default_rng(self.plan.seed)
+
+        next_id = 0
+        rejected = 0
+        accepted: List[int] = []
+        streamed: Dict[int, List[int]] = {}
+        first_id_after_fault: Optional[int] = None
+        #: replica index -> [(pid, warm_handshake)] in observation order.
+        pids_seen: Dict[int, List[tuple]] = {}
+        peak_active = router.active_replicas
+
+        def observe_fleet():
+            nonlocal peak_active
+            peak_active = max(peak_active, router.active_replicas)
+            for replica in router.replica_set.replicas:
+                if replica.dead or replica.state == "retired":
+                    continue
+                engine = replica.engine
+                pid = getattr(engine, "pid", None)
+                seen = pids_seen.setdefault(replica.index, [])
+                if pid is not None and (not seen or seen[-1][0] != pid):
+                    ready = getattr(engine, "ready_info", {}) or {}
+                    seen.append((pid, bool(ready.get("warm"))))
+
+        def submit_one() -> bool:
+            nonlocal next_id, rejected
+            prompt = rng.integers(1, cfg.vocab_size, (int(rng.integers(2, 9)),)).astype(np.int32)
+            request = Request(next_id, prompt, max_new_tokens=max_new_tokens)
+            next_id += 1
+            try:
+                router.submit(request)
+            except QueueFull:
+                rejected += 1
+                return False
+            accepted.append(request.request_id)
+            streamed[request.request_id] = []
+            return True
+
+        fleet_kinds = ("fleet.worker_kill", "fleet.worker_stall")
+        planned_faults = sum(
+            max(ev.times, 1) for ev in self.plan.events if ev.kind in fleet_kinds
+        )
+        fault_planned = planned_faults > 0
+        recovery_probes = 3 if fault_planned else 0
+        #: Worker faults fire IN the workers (env-propagated plan, their own
+        #: step-op call counts) and are journaled BEFORE the damage lands, so
+        #: the journal — not a controller-side proxy like ejection counts,
+        #: which a flapping rejoin could inflate — is the ground truth for
+        #: "every planned fault actually fired". Traffic keeps flowing
+        #: (bounded) until it says so; a sweep that never exercised its
+        #: faults must go red, not green.
+        hard_cap = max(num_requests * 8, num_requests + 32)
+
+        def faults_landed() -> int:
+            return sum(
+                1 for e in self._read_fleet_journal(journal_path)
+                if e.get("kind") in fleet_kinds
+            )
+
+        probes_sent = 0
+        faults_before = 0
+        cycles = 0
+        stalled = False
+        observe_fleet()
+        while (
+            len(accepted) < num_requests
+            or router.pending
+            or (fault_planned and faults_landed() < planned_faults
+                and len(accepted) < hard_cap)
+            or (first_id_after_fault is not None and probes_sent < recovery_probes)
+        ):
+            if cycles >= max_cycles:
+                stalled = True
+                break
+            if len(accepted) < num_requests:
+                submit_one()
+            elif (
+                fault_planned and faults_landed() < planned_faults
+                and len(accepted) < hard_cap
+            ):
+                submit_one()  # sustain pressure until every planned fault lands
+            elif first_id_after_fault is not None and probes_sent < recovery_probes:
+                if submit_one():
+                    probes_sent += 1
+            for ev in self.session.fire("serve.queue_burst", step=cycles):
+                for _ in range(int(ev.args.get("count", 8))):
+                    submit_one()
+            for rid, toks in router.step():
+                if rid in streamed:
+                    streamed[rid].extend(toks)
+            observe_fleet()
+            landed = faults_landed()
+            if landed > faults_before and first_id_after_fault is None:
+                first_id_after_fault = next_id
+            faults_before = landed
+            cycles += 1
+        results = dict(router.drain())
+        # Recovery phase: cycle until every ejected replica rejoined (the
+        # respawn path), then until the autoscaler converged back to its floor.
+        while (
+            any(s == "ejected" for s in router.replica_states.values())
+            and cycles < max_cycles
+        ):
+            self.session.clock.sleep(0.01)
+            router.step()
+            observe_fleet()
+            cycles += 1
+        for _ in range(router.replica_set.probation_steps + 1):
+            router.step()
+        while (
+            autoscale
+            and router.active_replicas > router.min_replicas
+            and cycles < max_cycles
+        ):
+            self.session.clock.sleep(0.01)
+            router.step()
+            cycles += 1
+        observe_fleet()
+        final_states = dict(router.replica_states)
+        final_active = router.active_replicas
+        scale_ups = int(router.stats.get("autoscale", {}).get("scale_ups", 0))
+        scale_downs = int(router.stats.get("autoscale", {}).get("scale_downs", 0))
+        routing_log = list(router.routing_log)
+        state_log = list(router.replica_set.state_log)
+        retries_counter = int(router.stats["retries"])
+        router.close()
+
+        journal = self._read_fleet_journal(journal_path)
+        finish_reasons = {
+            rid: results[rid].finish_reason if rid in results else None for rid in accepted
+        }
+        non_terminal = {
+            rid: reason for rid, reason in finish_reasons.items()
+            if reason not in ROUTER_FINISH_REASONS
+        }
+        duplicate_streams = {
+            rid: {"streamed": streamed[rid], "result": list(results[rid].tokens)}
+            for rid in accepted
+            if rid in results and streamed[rid] != list(results[rid].tokens)
+        }
+        # `fleet_recovered` must ignore retired replicas: the autoscaler
+        # retiring its extra worker after the ramp is convergence, not failure.
+        recovery_states = {i: s for i, s in final_states.items() if s != "retired"}
+        checks = [
+            InvariantCheck(
+                "terminal_finish_reasons",
+                passed=not non_terminal and not stalled,
+                details={
+                    "accepted": len(accepted), "rejected_queue_full": rejected,
+                    "non_terminal": non_terminal, "stalled": stalled, "cycles": cycles,
+                    "reasons": _reason_counts(finish_reasons),
+                },
+            ),
+            InvariantCheck(
+                "no_duplicate_streams",
+                passed=not duplicate_streams,
+                details={"mismatched": duplicate_streams},
+            ),
+            self._check_fleet_recovered(
+                finish_reasons, first_id_after_fault, recovery_states, fault_planned
+            ),
+            self._check_no_route_to_ejected(routing_log, state_log),
+            self._check_worker_restart_warm(pids_seen, journal, fault_planned),
+            self._check_fleet_ledger(
+                journal, pids_seen, routing_log, retries_counter, accepted,
+                finish_reasons, planned_faults,
+            ),
+        ]
+        if autoscale:
+            checks.append(InvariantCheck(
+                "autoscaler_converges",
+                passed=scale_ups >= 1 and peak_active > router.min_replicas
+                and final_active == router.min_replicas and scale_downs >= 1,
+                details={
+                    "scale_ups": scale_ups, "scale_downs": scale_downs,
+                    "peak_active": peak_active, "final_active": final_active,
+                    "min_replicas": router.min_replicas,
+                    "max_replicas": router.max_replicas,
+                },
+            ))
+        return self._report("fleet", checks)
+
+    @staticmethod
+    def _read_fleet_journal(path: str) -> List[dict]:
+        if not os.path.exists(path):
+            return []
+        entries = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entries.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail of a SIGKILLed writer
+        return entries
+
+    @staticmethod
+    def _check_worker_restart_warm(
+        pids_seen: Dict[int, List[tuple]],
+        journal: List[dict],
+        fault_planned: bool,
+    ) -> InvariantCheck:
+        """Every worker death must be followed by a respawn whose ready
+        handshake reports a pre-warmed engine — a restarted worker rejoins the
+        fleet WARM, never paying a compile on the serving path."""
+        deaths = sum(max(len(v) - 1, 0) for v in pids_seen.values())
+        cold_rejoins = {
+            index: [pid for pid, warm in seen[1:] if not warm]
+            for index, seen in pids_seen.items()
+            if any(not warm for _pid, warm in seen[1:])
+        }
+        if not fault_planned:
+            return InvariantCheck(
+                "worker_restart_rejoins_warm",
+                passed=not cold_rejoins,
+                details={"note": "no fleet fault in plan", "deaths": deaths},
+            )
+        return InvariantCheck(
+            "worker_restart_rejoins_warm",
+            passed=deaths >= 1 and not cold_rejoins,
+            details={
+                "observed_deaths": deaths,
+                "cold_rejoins": cold_rejoins,
+                "pids_per_replica": {
+                    i: [pid for pid, _warm in seen] for i, seen in pids_seen.items()
+                },
+                "journaled_faults": len(journal),
+            },
+        )
+
+    def _check_fleet_ledger(
+        self,
+        journal: List[dict],
+        pids_seen: Dict[int, List[tuple]],
+        routing_log: List[dict],
+        retries_counter: int,
+        accepted: List[int],
+        finish_reasons: Dict[int, Optional[str]],
+        planned_faults: int = 0,
+    ) -> InvariantCheck:
+        """Reconcile three independent records: the controller-side injection
+        counters, the worker-side chaos journal (written before each fault
+        landed), and the observed process deaths. Every journaled kill must
+        correspond to a real death of that worker's process, and the retry
+        counter must match the routing journal exactly."""
+        counts = self.session.counts()
+        registry_ok = all(
+            self.session.registry.value("chaos_injected_total", {"kind": kind}) == count
+            for kind, count in counts.items()
+        )
+        journaled_kills: Dict[str, int] = {}
+        for entry in journal:
+            if entry.get("kind") == "fleet.worker_kill":
+                worker = entry.get("worker", "?")
+                journaled_kills[worker] = journaled_kills.get(worker, 0) + 1
+        deaths_by_worker = {
+            f"worker_{index}": max(len(seen) - 1, 0) for index, seen in pids_seen.items()
+        }
+        kills_unaccounted = {
+            worker: count for worker, count in journaled_kills.items()
+            if deaths_by_worker.get(worker, 0) < count
+        }
+        journal_retries = sum(1 for e in routing_log if e["kind"] == "retry")
+        finished_total = sum(1 for r in finish_reasons.values() if r is not None)
+        # Every PLANNED worker fault must actually have fired (journaled by the
+        # worker before its damage): a sweep whose triggers never armed — the
+        # workload drained too fast, a path_pattern matched nothing — must go
+        # red, not silently pass with unexercised faults.
+        fleet_fired = sum(
+            1 for e in journal
+            if e.get("kind") in ("fleet.worker_kill", "fleet.worker_stall")
+        )
+        return InvariantCheck(
+            "ledger_reconciles",
+            passed=registry_ok and not kills_unaccounted
+            and journal_retries == retries_counter
+            and finished_total == len(accepted)
+            and fleet_fired >= planned_faults,
+            details={
+                "planned_worker_faults": planned_faults,
+                "journaled_worker_faults": fleet_fired,
+                "controller_injected": counts,
+                "registry_matches_journal": registry_ok,
+                "worker_journal_kills": journaled_kills,
+                "observed_deaths": deaths_by_worker,
+                "kills_without_observed_death": kills_unaccounted,
+                "router_retries_total": retries_counter,
+                "journal_retries": journal_retries,
+                "finished_total": finished_total,
+                "accepted": len(accepted),
+            },
+        )
+
     def _check_fleet_recovered(
         self,
         finish_reasons: Dict[int, Optional[str]],
@@ -1015,7 +1383,7 @@ class ChaosRunner:
         """Audit every routing decision against the health history: the router
         journals the replica's state at decision time, and the state log lets
         us independently reconstruct ejected/draining windows."""
-        bad = [e for e in routing_log if e.get("state") in ("ejected", "draining")]
+        bad = [e for e in routing_log if e.get("state") in ("ejected", "draining", "retired")]
         # Independent reconstruction: walk the state log and verify no routing
         # timestamp lands inside an (ejected -> rejoining) window.
         windows: Dict[int, List[List[float]]] = {}

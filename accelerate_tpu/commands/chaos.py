@@ -12,7 +12,7 @@ Subcommands (exit codes mirror `analyze`'s CI contract):
     does.
 
 ``--plan`` takes a JSON plan file or a builtin name (``smoke-train``,
-``smoke-serve``, ``seeded-regression``). The seeded-regression fixture MUST
+``smoke-serve``, ``smoke-router``, ``smoke-fleet``, ``seeded-regression``). The seeded-regression fixture MUST
 exit non-zero: it scripts a broken digest layer, and a green report there means
 the harness can no longer detect regressions.
 """
@@ -42,10 +42,12 @@ def register_subcommand(subparsers):
     run.add_argument(
         "--workload",
         default=None,
-        choices=(None, "train", "async-train", "serve", "supervised-train", "router"),
+        choices=(None, "train", "async-train", "serve", "supervised-train", "router",
+                 "fleet"),
         help="Workload to drive (default: the plan's own `workload` field, else inferred "
         "from its fault kinds; `async-train` saves through the background committer; "
-        "`router` drives a replicated serving fleet under per-replica faults)",
+        "`router` drives a replicated serving fleet under per-replica faults; `fleet` "
+        "drives an OUT-OF-PROCESS fleet — real subprocess workers, real SIGKILLs)",
     )
     run.add_argument("--base-dir", default=None, help="Checkpoint/journal dir (default: a temp dir)")
     run.add_argument(
@@ -57,7 +59,9 @@ def register_subcommand(subparsers):
     )
     run.add_argument("--steps", type=int, default=6, help="Train steps (train workloads)")
     run.add_argument("--requests", type=int, default=8, help="Requests (serve/router workloads)")
-    run.add_argument("--replicas", type=int, default=3, help="Fleet size (router workload)")
+    run.add_argument("--replicas", type=int, default=None,
+                     help="Fleet size (default: 3 for the router workload, 2 subprocess "
+                     "workers for the fleet workload)")
     run.add_argument("--json", action="store_true", dest="as_json", help="Emit the report as JSON")
     run.add_argument("--report-out", default=None, help="Also save the report JSON to this path")
     run.set_defaults(func=chaos_run_command)
@@ -97,6 +101,8 @@ def _load_plan(spec: str):
 def _infer_workload(plan) -> str:
     if getattr(plan, "workload", None):
         return plan.workload
+    if any(ev.kind.startswith("fleet.") for ev in plan.events):
+        return "fleet"
     if any(ev.kind.startswith("router.") for ev in plan.events):
         return "router"
     return "serve" if any(ev.kind.startswith("serve.") for ev in plan.events) else "train"
@@ -114,7 +120,13 @@ def chaos_run_command(args):
     if workload == "serve":
         report = runner.run_serve(num_requests=args.requests)
     elif workload == "router":
-        report = runner.run_router(num_requests=args.requests, replicas=args.replicas)
+        report = runner.run_router(
+            num_requests=args.requests, replicas=args.replicas or 3
+        )
+    elif workload == "fleet":
+        report = runner.run_fleet(
+            num_requests=args.requests, replicas=args.replicas or 2
+        )
     else:
         # Default scratch dirs are cleaned up after the report is assembled
         # (checkpoint trees add up across CI runs); an explicit --base-dir is

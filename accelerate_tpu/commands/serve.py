@@ -18,6 +18,12 @@ a normal terminal reason, 1 when any finished `error`/`replica_lost`/`timeout`.
 `--replicas` defaults to the launch env protocol
 (``ACCELERATE_TPU_SERVE_REPLICAS``, exported by ``accelerate-tpu launch
 --replicas N``), so a supervised serving job sizes its fleet from the launcher.
+
+``--out-of-process`` runs each replica as a real subprocess engine worker
+(`accelerate_tpu.worker`) — process-level fault domains with warm
+restart/rejoin; ``--min-replicas``/``--max-replicas`` arm the queue/TTFT
+autoscaler, and ``--hedge-quantile`` derives the hedge threshold from the
+live TTFT histogram (docs/serving.md "Out-of-process workers").
 """
 
 from __future__ import annotations
@@ -52,6 +58,27 @@ def register_subcommand(subparsers):
         "--hedge-after-s", type=float, default=None,
         help="TTFT hedging: duplicate a still-queued request onto a second replica "
         "after this many seconds (default: disabled)",
+    )
+    parser.add_argument(
+        "--hedge-quantile", type=float, default=None,
+        help="derive the hedge threshold from the live TTFT histogram at this "
+        "quantile instead of a static --hedge-after-s (enabled once enough "
+        "samples exist; mutually exclusive with --hedge-after-s)",
+    )
+    parser.add_argument(
+        "--out-of-process", action="store_true",
+        help="run each replica as a REAL subprocess engine worker "
+        "(accelerate_tpu.worker IPC): process-level fault domains — a worker "
+        "SIGKILL/hang ejects one replica, never the fleet",
+    )
+    parser.add_argument(
+        "--min-replicas", type=int, default=None,
+        help="autoscaler floor (with --max-replicas): the fleet never shrinks below this",
+    )
+    parser.add_argument(
+        "--max-replicas", type=int, default=None,
+        help="autoscaler ceiling: enables traffic-adaptive scaling between "
+        "--min-replicas (default: --replicas) and this on queue-depth/TTFT pressure",
     )
     parser.add_argument("--requests", type=int, default=8, help="Synthetic request count")
     parser.add_argument("--max-new", type=int, default=32, help="max_new_tokens per request")
@@ -119,10 +146,15 @@ def serve_command(args):
         max_queue=args.max_queue,
         default_deadline_s=args.deadline_s,
         hedge_after_s=args.hedge_after_s,
+        hedge_quantile=args.hedge_quantile,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        out_of_process=args.out_of_process,
         paged=not args.no_paged,
     )
     print(
-        f"[serve] model {args.model} | {router.num_replicas} replica(s) x "
+        f"[serve] model {args.model} | "
+        f"{'out-of-process, ' if args.out_of_process else ''}{router.num_replicas} replica(s) x "
         f"{args.num_slots} slots, chunk {args.chunk_size}, cache {max_length} | "
         f"{len(requests)} request(s)",
         file=sys.stderr, flush=True,

@@ -30,16 +30,42 @@ splits the fleet from the engine:
       * a request that already emitted tokens is finished with
         ``finish_reason="replica_lost"`` — partial tokens kept, never a
         silently duplicated stream;
-      * optional **TTFT hedging**: a request still queued (zero tokens) after
-        `hedge_after_s` is duplicated onto a second replica; the first copy to
-        stream wins, the loser is cancelled, and only the winner's tokens are
-        ever forwarded.
+      * optional **TTFT hedging**: a request still queued (zero tokens) past
+        the hedge threshold is duplicated onto a second replica; the first
+        copy to stream wins, the loser is cancelled, and only the winner's
+        tokens are ever forwarded. The threshold is a static `hedge_after_s`
+        OR a live `hedge_quantile` of the router's own `serving_ttft_seconds`
+        histogram (disabled below `hedge_min_samples` observations — no
+        hedging off a cold histogram, no stale hand-tuned constant).
 
   - `swap_weights(params)` is the zero-downtime rolling deploy: one replica at
     a time is drained (unroutable, finishes its own work while the rest keep
     serving), its params are replaced in place (same pytree structure — no
     recompile; params are per-dispatch operands), and it rejoins before the
     next replica drains. The fleet never drops below N-1 serving capacity.
+
+  - **Out-of-process workers** (`out_of_process=True`, or any
+    `engine_factory` returning `worker.SubprocessEngine`s): each replica is a
+    real OS process hosting one engine behind the length-prefixed JSON IPC in
+    `accelerate_tpu.worker`. The health machine's existing eject/rebuild path
+    becomes true process supervision — a SIGKILLed or hung worker surfaces as
+    `WorkerGone` from `step()`, is ejected, and the factory respawns a fresh
+    process that pre-warms its executables before taking traffic (rejoins
+    WARM). The in-process default stays the fast path and the parity oracle.
+
+  - **Autoscaling** (`min_replicas`/`max_replicas`): the fleet floats on the
+    signals the health machine already computes — scale up on fleet queue
+    depth per routable replica (or the TTFT histogram's p99 against
+    `autoscale_ttft_target_s`), retire the newest idle replica after
+    `idle_retire_s` of a fully idle fleet, one action per
+    `autoscale_cooldown_s`, every transition journaled.
+
+  - **Admission control** (`tenant_queue_limit`): with the fleet saturated,
+    requests queue at the ROUTER in per-tenant bounded queues drained in
+    priority-then-fair-share order (strict `Request.priority` first,
+    round-robin across tenants at equal priority) — one tenant's burst
+    degrades into bounded queueing + `QueueFull` for THAT tenant, not a
+    fleet-wide rejection of everyone.
 
 Everything here is host-side bookkeeping on host scalars — the device-facing
 work stays inside each engine, and the router adds zero device syncs (the same
@@ -89,7 +115,9 @@ ROUTER_FINISH_REASONS = FINISH_REASONS + ("replica_lost",)
 
 #: Health states, in escalation order. `draining` is the rolling-swap state —
 #: unroutable like `ejected`, but healthy and finishing its own work.
-REPLICA_STATES = ("live", "degraded", "ejected", "rejoining", "draining")
+#: `retired` is terminal: an autoscaler-removed replica — engine closed (a
+#: subprocess worker's process exits), never rejoins, never routed.
+REPLICA_STATES = ("live", "degraded", "ejected", "rejoining", "draining", "retired")
 _STATE_CODE = {s: i for i, s in enumerate(REPLICA_STATES)}
 
 
@@ -193,28 +221,61 @@ class ReplicaSet:
         self._g_live = self.registry.gauge(
             "router_replicas_live", help="replicas currently in the live state"
         )
-        self._g_state = {
-            i: self.registry.gauge(
-                "router_replica_state",
-                help="health state code (0=live 1=degraded 2=ejected 3=rejoining 4=draining)",
-                labels={"replica": str(i)},
-            )
-            for i in range(replicas)
-        }
-        self._g_load = {
-            i: self.registry.gauge(
-                "router_replica_load",
-                help="queued + in-flight requests on this replica",
-                labels={"replica": str(i)},
-            )
-            for i in range(replicas)
-        }
+        self._g_state: Dict[int, Any] = {}
+        self._g_load: Dict[int, Any] = {}
         self.replicas: List[Replica] = []
-        now = self._clock()
-        for i in range(replicas):
-            replica = Replica(index=i, engine=self._build_engine(i), last_ok=now)
-            self.replicas.append(replica)
+        for _ in range(replicas):
+            self.add_replica(why="initial fleet")
         self._refresh_gauges()
+
+    def _ensure_gauges(self, index: int):
+        if index in self._g_state:
+            return
+        self._g_state[index] = self.registry.gauge(
+            "router_replica_state",
+            help="health state code (0=live 1=degraded 2=ejected 3=rejoining "
+            "4=draining 5=retired)",
+            labels={"replica": str(index)},
+        )
+        self._g_load[index] = self.registry.gauge(
+            "router_replica_load",
+            help="queued + in-flight requests on this replica",
+            labels={"replica": str(index)},
+        )
+
+    # ------------------------------------------------------------------ fleet size
+    def add_replica(self, why: str = "scale up") -> Replica:
+        """Grow the fleet by one replica (a new index, never a reused one —
+        journals and chaos targeting stay unambiguous). The engine is built —
+        and, for subprocess factories, spawned + warmed — before the replica
+        becomes routable, so scale-up traffic never pays a compile."""
+        index = len(self.replicas)
+        self._ensure_gauges(index)
+        replica = Replica(index=index, engine=self._build_engine(index), last_ok=self._clock())
+        self.replicas.append(replica)
+        self.state_log.append(
+            {"t": self._clock(), "replica": index, "from": "new", "to": "live", "why": why}
+        )
+        self.tracer.event("router.replica_added", category="router", replica=index, why=why)
+        logger.info("router: replica %d added (%s)", index, why)
+        self._refresh_gauges()
+        return replica
+
+    def retire_replica(self, index: int, why: str = "scale down") -> Replica:
+        """Remove one replica permanently: its engine closes (a subprocess
+        worker exits), the state machine records terminal `retired`, and the
+        index is never routed or rejoined again."""
+        replica = self.replicas[index]
+        if replica.state == "retired":
+            return replica
+        if not replica.dead:
+            try:
+                replica.engine.close()
+            except Exception:  # noqa: BLE001 — a dying engine must not block retirement
+                logger.warning("router: replica %d engine close failed on retire", index)
+        replica.dead = True
+        self.set_state(replica, "retired", why)
+        return replica
 
     # ------------------------------------------------------------------ build
     def _build_engine(self, index: int) -> ContinuousBatcher:
@@ -321,7 +382,12 @@ class ReplicaSet:
 
     def poll(self):
         """Cooldown sweep: ejected replicas whose cooldown elapsed re-enter as
-        `rejoining` (rebuilding the engine first when it died with the fault)."""
+        `rejoining` (rebuilding the engine first when it died with the fault).
+        A FAILED rebuild (a subprocess respawn that never reaches its ready
+        handshake, an OOM during engine construction) must not escape into the
+        router's step loop — that would crash the whole fleet over one
+        replica, the exact blast radius this layer exists to remove. The
+        replica stays ejected and retries after another full cooldown."""
         now = self._clock()
         for replica in self.replicas:
             if replica.state != "ejected" or replica.ejected_at is None:
@@ -329,7 +395,17 @@ class ReplicaSet:
             if now - replica.ejected_at < self.rejoin_cooldown_s:
                 continue
             if replica.dead:
-                replica.engine = self._build_engine(replica.index)
+                try:
+                    replica.engine = self._build_engine(replica.index)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as exc:  # noqa: BLE001 — rebuild failure stays per-replica
+                    logger.warning(
+                        "router: replica %d rebuild failed (%r); retrying after cooldown",
+                        replica.index, exc,
+                    )
+                    replica.ejected_at = now
+                    continue
                 replica.dead = False
             self.set_state(replica, "rejoining", "cooldown elapsed")
         self._refresh_gauges()
@@ -367,8 +443,19 @@ class Router:
         max_queue: Optional[int] = 64,
         default_deadline_s: Optional[float] = None,
         hedge_after_s: Optional[float] = None,
+        hedge_quantile: Optional[float] = None,
+        hedge_min_samples: int = 20,
         max_retries: int = 1,
         retry_window_s: float = 5.0,
+        tenant_queue_limit: Optional[int] = None,
+        min_replicas: Optional[int] = None,
+        max_replicas: Optional[int] = None,
+        autoscale_queue_high: float = 2.0,
+        autoscale_ttft_target_s: Optional[float] = None,
+        autoscale_cooldown_s: float = 5.0,
+        idle_retire_s: float = 30.0,
+        out_of_process: bool = False,
+        worker_kwargs: Optional[Dict[str, Any]] = None,
         registry: Optional[MetricsRegistry] = None,
         tracer=None,
         clock: Callable[[], float] = time.perf_counter,
@@ -381,17 +468,62 @@ class Router:
         heartbeat_timeout_s: Optional[float] = 30.0,
         **engine_kwargs,
     ):
-        n = default_replicas() if replicas is None else int(replicas)
+        if replicas is not None:
+            n = int(replicas)
+        elif min_replicas is not None:
+            n = int(min_replicas)
+        else:
+            n = default_replicas()
         self._clock = clock
         self.metrics = registry if registry is not None else MetricsRegistry()
         self.tracer = tracer if tracer is not None else default_tracer()
         self.max_queue = None if max_queue is None else int(max_queue)
         self.default_deadline_s = default_deadline_s
+        if hedge_after_s is not None and hedge_quantile is not None:
+            raise ValueError(
+                "pass hedge_after_s (static threshold) OR hedge_quantile "
+                "(derived from the live TTFT histogram), not both"
+            )
+        if hedge_quantile is not None and not 0.0 < hedge_quantile < 1.0:
+            raise ValueError("hedge_quantile must be in (0, 1)")
         self.hedge_after_s = hedge_after_s
+        self.hedge_quantile = hedge_quantile
+        self.hedge_min_samples = int(hedge_min_samples)
         self.max_retries = int(max_retries)
         self.retry_window_s = float(retry_window_s)
+        # Admission control (fair-share, per-tenant): None keeps the legacy
+        # fleet-wide QueueFull contract; an int bounds EACH tenant's
+        # router-level wait queue so one tenant's burst degrades into bounded
+        # queueing for that tenant while the rest keep admitting.
+        self.tenant_queue_limit = (
+            None if tenant_queue_limit is None else int(tenant_queue_limit)
+        )
+        if self.tenant_queue_limit is not None and self.tenant_queue_limit < 1:
+            raise ValueError("tenant_queue_limit must be >= 1 (or None to disable)")
+        self._admission: Dict[str, deque] = {}
+        self._admission_rr: List[str] = []  # round-robin order across tenants
+        # Autoscaling: enabled when max_replicas is set; the fleet floats in
+        # [min_replicas, max_replicas] on queue-depth / TTFT pressure.
+        self.min_replicas = n if min_replicas is None else int(min_replicas)
+        self.max_replicas = None if max_replicas is None else int(max_replicas)
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas is not None and self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self.autoscale_queue_high = float(autoscale_queue_high)
+        self.autoscale_ttft_target_s = autoscale_ttft_target_s
+        self.autoscale_cooldown_s = float(autoscale_cooldown_s)
+        self.idle_retire_s = float(idle_retire_s)
+        self._last_scale_t: Optional[float] = None
+        self._idle_since: Optional[float] = None
         engine_kwargs = dict(engine_kwargs)
         engine_kwargs.setdefault("max_queue", self.max_queue)
+        if out_of_process and engine_factory is None:
+            from .worker import make_subprocess_factory
+
+            engine_factory = make_subprocess_factory(
+                model, engine_kwargs=engine_kwargs, **(worker_kwargs or {})
+            )
         self.replica_set = ReplicaSet(
             model,
             n,
@@ -447,6 +579,29 @@ class Router:
             )
             for reason in ROUTER_FINISH_REASONS
         }
+        # Router-level TTFT: submit() -> first forwarded token, fleet-wide.
+        # This is the histogram hedge_quantile and the autoscaler's TTFT signal
+        # read — it works identically for in-process and subprocess fleets
+        # (engine-side serving_ttft histograms live in each engine's registry).
+        self._m_ttft = self.metrics.histogram(
+            "serving_ttft_seconds",
+            help="router submit() -> first streamed token (host wall clock)",
+        )
+        self._m_scale_up = self.metrics.counter(
+            "router_scale_up_total", help="autoscaler replica additions"
+        )
+        self._m_scale_down = self.metrics.counter(
+            "router_scale_down_total", help="autoscaler replica retirements"
+        )
+        self._g_replicas = self.metrics.gauge(
+            "router_replicas_total", help="replicas not retired (fleet size)"
+        )
+        self._g_admission = self.metrics.gauge(
+            "router_admission_queue_depth",
+            help="requests waiting in router-level tenant admission queues",
+        )
+        self._m_admission_rejected: Dict[str, Any] = {}
+        self._g_replicas.set(self.num_replicas)
 
     # ------------------------------------------------------------------ views
     @property
@@ -466,18 +621,25 @@ class Router:
         return self._swap is not None
 
     @property
+    def active_replicas(self) -> int:
+        """Replicas that are part of the fleet (not autoscaler-retired)."""
+        return sum(r.state != "retired" for r in self.replica_set.replicas)
+
+    @property
     def replica_states(self) -> Dict[int, str]:
         return {r.index: r.state for r in self.replica_set.replicas}
 
     @property
     def stats(self) -> Dict[str, Any]:
-        return {
+        view = {
             "replicas": self.num_replicas,
+            "active_replicas": self.active_replicas,
             "replica_states": self.replica_states,
             "retries": int(self._m_retries.value),
             "ejected": int(self.replica_set._m_ejected.value),
             "hedges": int(self._m_hedges.value),
             "hedge_wins": int(self._m_hedge_wins.value),
+            "hedge_threshold_s": self.hedge_threshold(),
             "finish_reasons": {
                 reason: int(counter.value) for reason, counter in self._m_finish.items()
             },
@@ -485,6 +647,22 @@ class Router:
                 None if r.dead else r.engine.stats for r in self.replica_set.replicas
             ],
         }
+        if self.max_replicas is not None:
+            view["autoscale"] = {
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "scale_ups": int(self._m_scale_up.value),
+                "scale_downs": int(self._m_scale_down.value),
+            }
+        if self.tenant_queue_limit is not None:
+            view["admission"] = {
+                "tenant_queue_limit": self.tenant_queue_limit,
+                "queued": {t: len(q) for t, q in self._admission.items() if q},
+                "rejected": {
+                    t: int(c.value) for t, c in self._m_admission_rejected.items()
+                },
+            }
+        return view
 
     def warm_inserts(self) -> Dict[int, List[int]]:
         """Precompile every replica's insert-bucket ladder (the bench's
@@ -528,20 +706,101 @@ class Router:
             request_id=int(request.request_id), replicas=self.num_replicas,
         )
         tracked["span"] = span
+        # With admission control armed, a new request may not jump ahead of
+        # tenants already queued at the router: it enqueues behind them and the
+        # sweep dispatches in priority/fair-share order.
+        queued_behind = self.tenant_queue_limit is not None and any(
+            self._admission.values()
+        )
         try:
-            attempt = self._dispatch(tracked, kind="submit")
+            attempt = None if queued_behind else self._dispatch(tracked, kind="submit")
         except ValueError:
             span.annotate(error="invalid_request").end()
             raise
         if attempt is None:
-            span.annotate(error="queue_full").end()
-            raise QueueFull(
-                "every routable replica's queue is at capacity; shed load or retry later"
-            )
+            if self.tenant_queue_limit is None:
+                span.annotate(error="queue_full").end()
+                raise QueueFull(
+                    "every routable replica's queue is at capacity; shed load or retry later"
+                )
+            # Admission control: the fleet is saturated — queue at the ROUTER
+            # in this tenant's bounded fair-share queue instead of failing the
+            # whole fleet closed. Only this tenant's own bound rejects.
+            tenant = request.tenant or "default"
+            queue = self._admission.get(tenant)
+            if queue is None:
+                queue = self._admission[tenant] = deque()
+                self._admission_rr.append(tenant)
+            if len(queue) >= self.tenant_queue_limit:
+                self._admission_rejected(tenant).inc()
+                span.annotate(error="queue_full", tenant=tenant).end()
+                raise QueueFull(
+                    f"tenant {tenant!r} admission queue is at "
+                    f"tenant_queue_limit={self.tenant_queue_limit}; shed load or retry later"
+                )
+            queue.append(request.request_id)
+            span.event("admission_queued", tenant=tenant, depth=len(queue))
+            self._g_admission.set(sum(len(q) for q in self._admission.values()))
         self.results[request.request_id] = tracked["result"]
         self._tracked[request.request_id] = tracked
         self._m_requests.inc()
         return request.request_id
+
+    def _admission_rejected(self, tenant: str):
+        counter = self._m_admission_rejected.get(tenant)
+        if counter is None:
+            counter = self._m_admission_rejected[tenant] = self.metrics.counter(
+                "router_admission_rejected_total",
+                help="requests rejected at a tenant's bounded admission queue",
+                labels={"tenant": tenant},
+            )
+        return counter
+
+    def _admission_sweep(self):
+        """Drain the per-tenant admission queues into replica capacity:
+        strict priority first (a tenant whose head request carries a higher
+        `priority` dispatches before lower ones), round-robin across tenants
+        at equal priority (fair share — no tenant starves another at its own
+        priority level). Expired queued requests finish `timeout`."""
+        if not self._admission:
+            return
+        progressed = True
+        while progressed:
+            progressed = False
+            heads: List[Tuple[int, int, str]] = []
+            for rr_pos, tenant in enumerate(self._admission_rr):
+                queue = self._admission.get(tenant)
+                while queue:
+                    tracked = self._tracked.get(queue[0])
+                    if tracked is None or tracked["result"].finished:
+                        queue.popleft()  # cancelled / finished while queued
+                        continue
+                    now = self._clock()
+                    deadline_at = tracked["deadline_at"]
+                    if deadline_at is not None and now >= deadline_at:
+                        self._finish(tracked, "timeout")
+                        queue.popleft()
+                        continue
+                    heads.append((-int(tracked["request"].priority), rr_pos, tenant))
+                    break
+            for _neg_priority, _rr_pos, tenant in sorted(heads):
+                queue = self._admission[tenant]
+                if not queue:
+                    continue
+                tracked = self._tracked.get(queue[0])
+                if tracked is None:
+                    queue.popleft()
+                    continue
+                attempt = self._dispatch(tracked, kind="admit")
+                if attempt is None:
+                    continue  # no capacity for this one; try other tenants
+                queue.popleft()
+                # Fair share: a tenant that just dispatched goes to the back
+                # of the round-robin order.
+                self._admission_rr.remove(tenant)
+                self._admission_rr.append(tenant)
+                progressed = True
+        self._g_admission.set(sum(len(q) for q in self._admission.values()))
 
     def _dispatch(self, tracked: Dict[str, Any], kind: str) -> Optional[Dict[str, Any]]:
         """Place one attempt of `tracked` on the best routable replica (skipping
@@ -686,19 +945,48 @@ class Router:
             tracked = self._tracked.get(rid)
             if tracked is not None:
                 self._handle_attempt_failure(tracked, attempt, error=f"replica {index} {reason}")
+        if dead and not replica.dead:
+            # The engine is being written off for a rebuild: tear the old one
+            # down NOW. An out-of-process worker that failed via error replies
+            # still has a live process — left to the garbage collector it
+            # would linger holding device memory next to its replacement.
+            terminate = getattr(replica.engine, "terminate", None)
+            try:
+                if terminate is not None:
+                    terminate()
+                else:
+                    replica.engine.close()
+            except Exception:  # noqa: BLE001 — teardown of a failed engine is best-effort
+                logger.warning("router: replica %d engine teardown failed on eject", index)
         replica.dead = replica.dead or bool(dead)
         self.replica_set.set_state(replica, "ejected", reason)
 
     # ------------------------------------------------------------------ hedging
+    def hedge_threshold(self) -> Optional[float]:
+        """The live hedge trigger in seconds, or None when hedging is off.
+        Static `hedge_after_s` wins when set; otherwise `hedge_quantile` reads
+        the router's own `serving_ttft_seconds` histogram — hedging stays
+        DISABLED until `hedge_min_samples` observations exist, so a cold fleet
+        never hedges off noise (and a stale hand-tuned constant never fires
+        at yesterday's latency)."""
+        if self.hedge_after_s is not None:
+            return self.hedge_after_s
+        if self.hedge_quantile is None:
+            return None
+        if self._m_ttft.count < self.hedge_min_samples:
+            return None
+        return self._m_ttft.quantile(self.hedge_quantile)
+
     def _hedge_sweep(self):
-        if self.hedge_after_s is None:
+        threshold = self.hedge_threshold()
+        if threshold is None:
             return
         now = self._clock()
         for tracked in self._tracked.values():
             result = tracked["result"]
             if result.finished or result.tokens or tracked["hedged"]:
                 continue
-            if now - tracked["submit_t"] < self.hedge_after_s:
+            if now - tracked["submit_t"] < threshold:
                 continue
             if sum(not a["done"] for a in tracked["attempts"]) != 1:
                 continue
@@ -743,6 +1031,88 @@ class Router:
                         self._finish(tracked, "error", error="no routable replica")
         else:
             self._no_capacity_since = None
+
+    # ------------------------------------------------------------------ autoscaling
+    def _fleet_queue_depth(self) -> int:
+        depth = len(self._retry_queue) + sum(len(q) for q in self._admission.values())
+        for replica in self.replica_set.replicas:
+            if not replica.dead and replica.state != "retired":
+                depth += replica.engine.queue_depth
+        return depth
+
+    def _autoscale_sweep(self):
+        """Traffic-adaptive fleet sizing inside [min_replicas, max_replicas]:
+        scale UP on queue-depth pressure (fleet queue depth per routable
+        replica >= `autoscale_queue_high`) or — when `autoscale_ttft_target_s`
+        is set — on the live TTFT histogram's p99 exceeding the target; scale
+        DOWN by retiring one replica after the fleet has been fully idle for
+        `idle_retire_s`. One action per `autoscale_cooldown_s`, journaled on
+        the state log like every other transition."""
+        if self.max_replicas is None:
+            return
+        now = self._clock()
+        active = [r for r in self.replica_set.replicas if r.state != "retired"]
+        routable = [r for r in active if r.routable and not r.dead]
+        queue_depth = self._fleet_queue_depth()
+        pressure = queue_depth >= self.autoscale_queue_high * max(len(routable), 1)
+        if not pressure and self.autoscale_ttft_target_s is not None:
+            if self._m_ttft.count >= self.hedge_min_samples:
+                p99 = self._m_ttft.quantile(0.99)
+                pressure = p99 is not None and p99 > self.autoscale_ttft_target_s
+        cooled = (
+            self._last_scale_t is None
+            or now - self._last_scale_t >= self.autoscale_cooldown_s
+        )
+        if pressure:
+            self._idle_since = None
+            if len(active) < self.max_replicas and cooled:
+                # NOTE: the build is synchronous — an out-of-process spawn
+                # blocks this step for the worker's cold start (it comes up
+                # WARM in exchange). The cooldown bounds how often that cost
+                # can recur; a failed spawn backs off the same way instead of
+                # crashing the serving loop.
+                try:
+                    self.replica_set.add_replica(
+                        why=f"autoscale up: fleet queue depth {queue_depth}"
+                    )
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as exc:  # noqa: BLE001 — spawn failure must not kill serving
+                    logger.warning("router: autoscale spawn failed (%r); backing off", exc)
+                    self._last_scale_t = now
+                    return
+                self._last_scale_t = now
+                self._m_scale_up.inc()
+                self._g_replicas.set(self.active_replicas)
+            return
+        load = sum(
+            r.engine.load for r in active if not r.dead
+        )
+        if queue_depth == 0 and load == 0:
+            if self._idle_since is None:
+                self._idle_since = now
+            elif (
+                now - self._idle_since >= self.idle_retire_s
+                and len(active) > self.min_replicas
+                and cooled
+            ):
+                # Retire the NEWEST idle live replica: scale-down unwinds
+                # scale-up, and the original fleet keeps its indices.
+                victim = next(
+                    (r for r in reversed(active)
+                     if r.state == "live" and not r.engine.pending),
+                    None,
+                )
+                if victim is not None:
+                    self.replica_set.retire_replica(
+                        victim.index, why="autoscale down: fleet idle"
+                    )
+                    self._last_scale_t = now
+                    self._idle_since = now  # next retirement waits a full window
+                    self._m_scale_down.inc()
+                    self._g_replicas.set(self.active_replicas)
+        else:
+            self._idle_since = None
 
     # ------------------------------------------------------------------ swap
     def swap_weights(self, params_or_model, wait: bool = True) -> List[Tuple[int, List[int]]]:
@@ -818,11 +1188,13 @@ class Router:
             return []
         self.replica_set.poll()
         self._advance_swap()
+        self._autoscale_sweep()
+        self._admission_sweep()
         self._retry_sweep()
         self._hedge_sweep()
         events: List[Tuple[int, List[int]]] = []
         for replica in self.replica_set.replicas:
-            if replica.dead or replica.state == "ejected":
+            if replica.dead or replica.state in ("ejected", "retired"):
                 continue
             if not replica.engine.pending and replica.state not in ("rejoining", "degraded"):
                 replica.last_ok = self._clock()
@@ -877,7 +1249,10 @@ class Router:
                 continue  # a losing copy raced a token out before its cancel
             tracked["result"].tokens.extend(toks)
             if tracked["result"].first_token_time is None:
-                tracked["result"].first_token_time = self._clock()
+                now = self._clock()
+                tracked["result"].first_token_time = now
+                # The live TTFT signal hedge_quantile and the autoscaler read.
+                self._m_ttft.observe(max(now - tracked["submit_t"], 0.0))
             out.append((rid, list(toks)))
         return out
 

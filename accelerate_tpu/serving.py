@@ -132,7 +132,12 @@ class Request:
 
     `deadline_s` is a wall-clock budget in seconds measured from `submit()`;
     enforced at step boundaries, so a request can overrun by at most one chunk
-    before finishing with `finish_reason="timeout"` (partial tokens kept)."""
+    before finishing with `finish_reason="timeout"` (partial tokens kept).
+
+    `tenant` and `priority` are ROUTER-level admission-control fields
+    (`router.Router(tenant_queue_limit=...)`): the engine itself ignores them —
+    a single engine is one queue — but carries them so requests survive
+    `dataclasses.replace` round trips through the fleet layers."""
 
     request_id: int
     input_ids: Any  # [prompt_len] int sequence
@@ -142,6 +147,8 @@ class Request:
     eos_token_id: Optional[int] = None
     arrival_time: float = 0.0  # caller-defined clock, echoed into the result
     deadline_s: Optional[float] = None  # wall-clock budget from submit; None = no deadline
+    tenant: Optional[str] = None  # admission-control class (router fair share)
+    priority: int = 0  # higher dispatches first across tenant queues (router)
 
 
 @dataclass

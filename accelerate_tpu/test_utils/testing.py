@@ -126,6 +126,52 @@ def execute_subprocess(cmd, env=None, timeout: int = 600) -> subprocess.Complete
     return result
 
 
+_COLLECTIVE_TIMEOUT_FLAG = "--xla_cpu_collective_call_terminate_timeout_seconds=600"
+_collective_flag_supported = None  # process-level memo over the on-disk probe cache
+
+
+def _supports_collective_timeout_flag() -> bool:
+    """Whether this jaxlib's XLA accepts the collective-timeout flag. Unknown
+    XLA_FLAGS are a FATAL abort at backend init, so support must be probed in a
+    throwaway child, never assumed. The verdict is cached per jaxlib version in
+    the temp dir (one ~2s probe per container, not per pytest process)."""
+    global _collective_flag_supported
+    if _collective_flag_supported is not None:
+        return _collective_flag_supported
+    import tempfile
+
+    try:
+        import jaxlib
+
+        version = getattr(jaxlib, "__version__", "unknown")
+    except ImportError:
+        version = "unknown"
+    cache = os.path.join(
+        tempfile.gettempdir(), f"accelerate_tpu_xla_flag_probe_{version}"
+    )
+    try:
+        with open(cache) as f:
+            _collective_flag_supported = f.read().strip() == "1"
+            return _collective_flag_supported
+    except OSError:
+        pass
+    env = os.environ.copy()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = _COLLECTIVE_TIMEOUT_FLAG
+    probe = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.local_devices()"],
+        env=env, capture_output=True, timeout=120,
+    )
+    _collective_flag_supported = probe.returncode == 0
+    try:
+        with open(cache, "w") as f:
+            f.write("1" if _collective_flag_supported else "0")
+    except OSError:
+        pass
+    return _collective_flag_supported
+
+
 def cpu_mesh_env(num_devices: int = 8) -> dict:
     """Env for a child process running on the N-device virtual CPU mesh (the
     debug_launcher-adjacent single-process harness)."""
@@ -152,8 +198,13 @@ def cpu_mesh_env(num_devices: int = 8) -> dict:
     # rendezvous; FusedTrainStep closes that one by fencing per call on the CPU
     # platform. Shrinking the thread pool likewise DEADLOCKS the first
     # cross-module collective (participants must run concurrently).
+    # ... but only when the installed XLA build KNOWS the flag: parse_flags_from_env
+    # aborts (SIGABRT at backend init) on unknown XLA_FLAGS entries, which turned
+    # this de-flake into a deterministic child crash on older jaxlibs. Probed once
+    # per jaxlib version (cached on disk) instead of guessed from version numbers.
     if "collective_call_terminate_timeout" not in env["XLA_FLAGS"]:
-        env["XLA_FLAGS"] += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+        if _supports_collective_timeout_flag():
+            env["XLA_FLAGS"] += f" {_COLLECTIVE_TIMEOUT_FLAG}"
     # Children must resolve the package even when it's driven from a source checkout.
     pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")

@@ -1,0 +1,994 @@
+"""Out-of-process serving workers: one engine per OS process, coordinated over
+an explicit IPC protocol.
+
+PR 10's `router.Router` made the serving fleet replicated, but every replica
+still shared one Python interpreter: a segfault, a GIL stall, or an OOM in any
+engine took down ALL of them. This module moves the engine into a real process
+fault domain — the serving analogue of the multi-controller discipline MPMD
+training systems use: independent workers, an explicit wire protocol, and a
+controller that can lose any worker without losing its own state.
+
+Three layers, bottom up:
+
+  - **Framing** (`send_frame` / `recv_frame`): length-prefixed JSON over a pair
+    of pipe/socket file descriptors. A frame is a 4-byte big-endian payload
+    length followed by UTF-8 JSON. `recv_frame` always takes a deadline — an
+    IPC read with no timeout turns a hung peer into a hung caller, which is
+    exactly the failure isolation this module exists to remove (analysis rule
+    TPU116 lints that discipline). Torn frames (EOF mid-payload) raise
+    `WorkerGone`; oversized or undecodable frames raise `FrameError`.
+
+  - **Worker side** (`python -m accelerate_tpu.worker`): builds a model from a
+    JSON spec (a named registry model, or a family+config dict with the params
+    loaded from an `.npz` the controller saved — so worker params are
+    bit-identical to the controller's, never re-derived), hosts ONE
+    `ContinuousBatcher` behind `EngineHost`, optionally pre-warms the insert
+    ladder before reporting ready (a restarted worker rejoins WARM: the fleet
+    never pays a compile on the serving path), and runs `serve_worker` — a
+    recv/dispatch/reply loop with a heartbeat deadline: a controller that goes
+    silent past the deadline means the worker is orphaned and exits instead of
+    leaking. Fault plans ride the PR 5 env protocol (`ACCELERATE_TPU_FAULT_PLAN`)
+    and trace context rides the PR 7 one (`ACCELERATE_TPU_TRACE_DIR`), so chaos
+    can SIGKILL a real worker mid-dispatch and the evidence survives.
+
+  - **Controller side** (`SubprocessEngine`): a client proxy exposing the
+    engine's EXACT surface (`submit`/`cancel`/`release`/`step`/`run`/`drain`/
+    `close`, `results`/`pending`/`load`/`queue_depth`/`stats`/`warm_inserts`,
+    assignable `params`), so `router.Router` routes over subprocess workers
+    with ZERO routing changes — `make_subprocess_factory` plugs into
+    `ReplicaSet.engine_factory` and the health machine's existing
+    eject/rebuild/rejoin path becomes real process supervision: a SIGKILLed
+    worker surfaces as `WorkerGone` from `step()`, the router ejects it, and
+    the rebuild spawns a fresh warm process.
+
+Everything on the wire is host scalars and token ids; params move by file
+handoff (`save_pytree` -> path -> worker `load_pytree`), never through frames.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import select
+import signal
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+#: Env var carrying the worker's fleet index to the subprocess (the chaos
+#: `path_pattern: "worker_N"` targeting token derives from it).
+WORKER_ID_ENV = "ACCELERATE_TPU_WORKER_ID"
+#: Env var naming the shared append-only chaos journal file workers record
+#: injections into BEFORE the damage lands (a SIGKILL must not erase the
+#: evidence that it fired) — and read back on restart so a per-process
+#: re-armed plan cannot livelock by re-killing at the same trigger.
+CHAOS_JOURNAL_ENV = "ACCELERATE_TPU_CHAOS_JOURNAL"
+
+#: Hard ceiling on one frame's payload. Tokens and host scalars only — params
+#: move by file handoff — so anything near this is a protocol violation, not a
+#: big message.
+MAX_FRAME_BYTES = 64 << 20
+
+#: Default worker-side heartbeat: a controller silent for this long means the
+#: worker is orphaned (controller crashed without close()) and exits.
+DEFAULT_HEARTBEAT_S = 120.0
+
+#: Exit code a worker uses when it terminates itself (orphaned / torn pipe),
+#: distinguishing self-termination from a crash in supervision logs.
+ORPHANED_EXIT_CODE = 17
+
+
+class FrameError(RuntimeError):
+    """A malformed frame: oversized length prefix or undecodable payload (a
+    protocol bug or corrupted stream, NOT a dead peer)."""
+
+
+class FrameTimeout(RuntimeError):
+    """No complete frame arrived inside the deadline: the peer is hung (or
+    stalled past its budget) — the caller decides whether that is fatal."""
+
+
+class WorkerGone(RuntimeError):
+    """The peer's stream ended (EOF / broken pipe), cleanly or mid-frame: the
+    process on the other side is dead. Escapes `SubprocessEngine.step()` so the
+    router's replica-death handling (eject -> rebuild -> rejoin) takes over."""
+
+
+def _fileno(stream) -> int:
+    return stream if isinstance(stream, int) else stream.fileno()
+
+
+def _read_exact(fd: int, n: int, deadline: Optional[float], what: str) -> bytes:
+    """Read exactly `n` bytes from `fd`, honoring an absolute monotonic
+    deadline. EOF before `n` bytes is a dead peer (`WorkerGone`) — torn frames
+    included; a deadline miss is `FrameTimeout`."""
+    chunks: List[bytes] = []
+    got = 0
+    while got < n:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise FrameTimeout(f"timed out waiting for {what} ({got}/{n} bytes)")
+            ready, _, _ = select.select([fd], [], [], remaining)
+            if not ready:
+                raise FrameTimeout(f"timed out waiting for {what} ({got}/{n} bytes)")
+        chunk = os.read(fd, n - got)
+        if not chunk:
+            raise WorkerGone(
+                f"peer closed the stream mid-{what} ({got}/{n} bytes)"
+                if got else "peer closed the stream"
+            )
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def send_frame(stream, obj: Dict[str, Any]) -> None:
+    """Write one length-prefixed JSON frame. Raises `WorkerGone` when the peer
+    end of the pipe is closed, `FrameError` for oversized payloads."""
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_FRAME_BYTES:
+        raise FrameError(f"frame of {len(payload)} bytes exceeds MAX_FRAME_BYTES")
+    data = struct.pack(">I", len(payload)) + payload
+    fd = _fileno(stream)
+    view = memoryview(data)
+    while view:
+        try:
+            written = os.write(fd, view)
+        except (BrokenPipeError, OSError) as exc:
+            raise WorkerGone(f"peer pipe closed during send: {exc!r}") from exc
+        view = view[written:]
+
+
+def recv_frame(stream, timeout_s: Optional[float]) -> Dict[str, Any]:
+    """Read one frame. `timeout_s` is the heartbeat deadline for the WHOLE
+    frame — pass the peer's liveness budget, never None in a long-lived loop
+    (TPU116). Raises `FrameTimeout` / `WorkerGone` / `FrameError`."""
+    fd = _fileno(stream)
+    deadline = None if timeout_s is None else time.monotonic() + float(timeout_s)
+    header = _read_exact(fd, 4, deadline, "frame header")
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME_BYTES:
+        raise FrameError(f"frame length {length} exceeds MAX_FRAME_BYTES")
+    payload = _read_exact(fd, length, deadline, "frame payload")
+    try:
+        return json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise FrameError(f"undecodable frame payload: {exc}") from exc
+
+
+# ------------------------------------------------------------------ wire codecs
+def request_to_wire(request) -> Dict[str, Any]:
+    return {
+        "request_id": int(request.request_id),
+        "input_ids": [int(t) for t in np.asarray(request.input_ids).reshape(-1)],
+        "max_new_tokens": int(request.max_new_tokens),
+        "temperature": float(request.temperature),
+        "repetition_penalty": float(request.repetition_penalty),
+        "eos_token_id": None if request.eos_token_id is None else int(request.eos_token_id),
+        "arrival_time": float(request.arrival_time),
+        "deadline_s": None if request.deadline_s is None else float(request.deadline_s),
+        "tenant": getattr(request, "tenant", None),
+        "priority": int(getattr(request, "priority", 0)),
+    }
+
+
+def request_from_wire(data: Dict[str, Any]):
+    from .serving import Request
+
+    return Request(
+        request_id=int(data["request_id"]),
+        input_ids=np.asarray(data["input_ids"], np.int32),
+        max_new_tokens=int(data["max_new_tokens"]),
+        temperature=float(data.get("temperature", 1.0)),
+        repetition_penalty=float(data.get("repetition_penalty", 1.0)),
+        eos_token_id=data.get("eos_token_id"),
+        arrival_time=float(data.get("arrival_time", 0.0)),
+        deadline_s=data.get("deadline_s"),
+        tenant=data.get("tenant"),
+        priority=int(data.get("priority", 0)),
+    )
+
+
+def result_to_wire(result) -> Dict[str, Any]:
+    return {
+        "request_id": int(result.request_id),
+        "tokens": [int(t) for t in result.tokens],
+        "finished": bool(result.finished),
+        "finish_reason": result.finish_reason,
+        "error": result.error,
+    }
+
+
+#: Engine exception -> wire kind; the client re-raises the same type, so the
+#: router's QueueFull/EngineClosed handling works unchanged out of process.
+_ERROR_KINDS = ("queue_full", "engine_closed", "value_error", "key_error", "runtime_error")
+
+
+def _error_reply(exc: BaseException) -> Dict[str, Any]:
+    from .serving import EngineClosed, QueueFull
+
+    if isinstance(exc, QueueFull):
+        kind = "queue_full"
+    elif isinstance(exc, EngineClosed):
+        kind = "engine_closed"
+    elif isinstance(exc, ValueError):
+        kind = "value_error"
+    elif isinstance(exc, KeyError):
+        kind = "key_error"
+    else:
+        kind = "runtime_error"
+    return {"ok": False, "kind": kind, "error": str(exc) or repr(exc)}
+
+
+def _raise_from_reply(reply: Dict[str, Any]):
+    from .serving import EngineClosed, QueueFull
+
+    kind = reply.get("kind", "runtime_error")
+    message = reply.get("error", "worker error")
+    if kind == "queue_full":
+        raise QueueFull(message)
+    if kind == "engine_closed":
+        raise EngineClosed(message)
+    if kind == "value_error":
+        raise ValueError(message)
+    if kind == "key_error":
+        raise KeyError(message)
+    raise RuntimeError(message)
+
+
+# ------------------------------------------------------------------ model specs
+#: Flax module class name -> model-family key (`models.CREATE_BY_FAMILY`).
+#: Serving needs `decode_slot_cache`, so only the slot-cache families appear.
+_FAMILY_BY_MODULE = {
+    "LlamaForCausalLM": "llama",
+    "GPTNeoXForCausalLM": "gpt_neox",
+}
+
+
+def spec_for_model(model, params_path: Optional[str] = None) -> Dict[str, Any]:
+    """Serialize a live Model bundle into a worker-buildable JSON spec: the
+    family + config dataclass fields, plus the path of a `save_pytree`'d params
+    file. Params ALWAYS move by file — a worker must serve the controller's
+    exact weights (token parity), never a re-derived init."""
+    family = _FAMILY_BY_MODULE.get(type(model.module).__name__)
+    if family is None:
+        raise ValueError(
+            f"{type(model.module).__name__} has no subprocess-worker family mapping; "
+            f"known: {sorted(_FAMILY_BY_MODULE)}"
+        )
+    return {
+        "family": family,
+        "config": dataclasses.asdict(model.module.config),
+        "params_path": params_path,
+    }
+
+
+def build_model_from_spec(spec: Dict[str, Any]):
+    """Worker-side model construction. Accepts either a named registry model
+    (`{"name": "llama-tiny"}`) or a family+config spec from `spec_for_model`;
+    a `params_path` (when present) replaces the init params wholesale."""
+    from . import models
+
+    if "name" in spec:
+        model = models.create_named_model(spec["name"], seq_len=int(spec.get("seq_len", 8)))
+    else:
+        family = spec["family"]
+        create = models.CREATE_BY_FAMILY.get(family)
+        if create is None:
+            raise ValueError(f"unknown model family {family!r} in worker spec")
+        config_cls = type(models.MODEL_REGISTRY[f"{family.replace('_', '-')}-tiny"][1]())
+        config = config_cls(**spec["config"])
+        # Tiny init seq_len: the real params arrive via params_path below, so
+        # the throwaway init should cost as little as possible.
+        seq_len = int(spec.get("seq_len", 8))
+        model = create(config, seq_len=seq_len)
+    params_path = spec.get("params_path")
+    if params_path:
+        model.params = _load_params_on_device(params_path)
+    return model
+
+
+def _load_params_on_device(path: str):
+    """`load_pytree` returns numpy leaves "placed by the caller" — place them
+    NOW: params left as numpy would ride every dispatch as an implicit
+    host-to-device transfer (a per-step re-upload the worker's own armed
+    TraceGuard rightly rejects)."""
+    import jax
+
+    from .checkpointing import load_pytree
+
+    return jax.tree_util.tree_map(jax.device_put, load_pytree(path))
+
+
+# ------------------------------------------------------------------ worker side
+class EngineHost:
+    """Executes protocol ops against one `ContinuousBatcher`. Pure translation:
+    every engine exception maps to a typed error reply, every reply carries the
+    load/queue-depth scalars the controller mirrors for routing."""
+
+    def __init__(self, engine, worker_id: int = 0, guard=None):
+        self.engine = engine
+        self.worker_id = int(worker_id)
+        self.guard = guard
+        #: Result ids already shipped in a `finished` list (step/drain replies
+        #: carry only the delta; release forgets).
+        self._reported: set = set()
+
+    # ---- op implementations ----
+    def _load_view(self) -> Dict[str, Any]:
+        return {
+            "load": int(self.engine.load),
+            "queue_depth": int(self.engine.queue_depth),
+            "pending": bool(self.engine.pending),
+        }
+
+    def _finished_delta(self) -> List[Dict[str, Any]]:
+        out = []
+        for rid, result in self.engine.results.items():
+            if result.finished and rid not in self._reported:
+                self._reported.add(rid)
+                out.append(result_to_wire(result))
+        return out
+
+    def _worker_stats(self) -> Dict[str, Any]:
+        stats = dict(self.engine.stats)
+        stats["worker"] = {
+            "pid": os.getpid(),
+            "worker_id": self.worker_id,
+            "trace_counts": dict(self.engine.trace_counts),
+            "guard": None if self.guard is None else {
+                "recompiles": int(self.guard.total_recompiles),
+                "host_transfers": int(self.guard.host_transfers),
+            },
+        }
+        return stats
+
+    def handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        op = msg.get("op")
+        try:
+            if op == "ping":
+                return {"ok": True, "pid": os.getpid(), **self._load_view()}
+            if op == "submit":
+                request = request_from_wire(msg["request"])
+                self.engine.submit(request)
+                return {"ok": True, **self._load_view()}
+            if op == "cancel":
+                rid = int(msg["request_id"])
+                cancelled = self.engine.cancel(rid)
+                return {
+                    "ok": True,
+                    "cancelled": bool(cancelled),
+                    "result": result_to_wire(self.engine.results[rid]),
+                    **self._load_view(),
+                }
+            if op == "release":
+                rid = int(msg["request_id"])
+                result = self.engine.release(rid)
+                self._reported.discard(rid)
+                return {"ok": True, "result": result_to_wire(result)}
+            if op == "step":
+                events = self.engine.step()
+                return {
+                    "ok": True,
+                    "events": [[int(rid), [int(t) for t in toks]] for rid, toks in events],
+                    "finished": self._finished_delta(),
+                    **self._load_view(),
+                }
+            if op == "drain":
+                self.engine.drain()
+                return {"ok": True, "finished": self._finished_delta(), **self._load_view()}
+            if op == "warm":
+                # Warmup pushes throwaway donated operands host->device by
+                # design — suspend the armed guard (the 0/0 gate covers the
+                # SERVING path, warm windows are excluded exactly like the
+                # in-process benches arm after warm_inserts()).
+                if self.guard is not None:
+                    self.guard.__exit__(None, None, None)
+                try:
+                    buckets = self.engine.warm_inserts()
+                finally:
+                    if self.guard is not None:
+                        self.guard.__enter__()
+                return {"ok": True, "buckets": [int(b) for b in buckets]}
+            if op == "stats":
+                return {"ok": True, "stats": self._worker_stats(), **self._load_view()}
+            if op == "guard_reset":
+                # Benches warm the serving path first, then zero the guard so
+                # the timed window's 0-recompile/0-transfer gate is exact.
+                if self.guard is not None:
+                    self.guard.reset()
+                return {"ok": True, "armed": self.guard is not None}
+            if op == "set_params":
+                self.engine.params = _load_params_on_device(msg["path"])
+                return {"ok": True}
+            if op == "close":
+                self.engine.close()
+                return {"ok": True, "finished": self._finished_delta()}
+            return {"ok": False, "kind": "value_error", "error": f"unknown op {op!r}"}
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as exc:  # noqa: BLE001 — typed error replies, worker stays up
+            return _error_reply(exc)
+
+
+class WorkerChaos:
+    """Worker-side fault injection (the env-propagated half of the fleet
+    sweeps): `fleet.worker_kill` delivers a REAL ``SIGKILL`` to this process at
+    a matching step op, `fleet.worker_stall` sleeps past the controller's step
+    timeout so the heartbeat machinery — not cooperation — detects the hang.
+
+    Every firing is journaled (append + fsync) to the shared
+    ``ACCELERATE_TPU_CHAOS_JOURNAL`` file BEFORE the damage lands, and the
+    journal is read back at startup to pre-consume already-fired events — a
+    restarted worker re-arms the same plan from env but must not re-kill
+    itself at the same trigger (the PR 9 livelock lesson)."""
+
+    def __init__(self, plan, worker_id: int, journal_path: Optional[str] = None,
+                 tracer=None):
+        from .chaos.injectors import ChaosSession
+
+        self.session = ChaosSession(plan, tracer=tracer)
+        self.token = f"worker_{int(worker_id)}"
+        self.journal_path = journal_path
+        if journal_path and os.path.exists(journal_path):
+            for kind, count in self._journaled_counts(journal_path).items():
+                self.session.preconsume(kind, count, path=self.token)
+        if journal_path:
+            self.session.on_inject = self._journal
+
+    def _journaled_counts(self, path: str) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail of a killed writer
+                if entry.get("worker") == self.token:
+                    counts[entry["kind"]] = counts.get(entry["kind"], 0) + 1
+        return counts
+
+    def _journal(self, entry: Dict[str, Any]):
+        record = json.dumps({**entry, "worker": self.token, "pid": os.getpid()})
+        # O_APPEND single-write + fsync: atomic against concurrent workers,
+        # durable against the SIGKILL that may follow immediately.
+        fd = os.open(self.journal_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, (record + "\n").encode())
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def arm(self, engine):
+        from .chaos.injectors import ServingInjector
+
+        ServingInjector(self.session).arm(engine)
+        return self
+
+    def poll(self, op: str):
+        if op != "step":
+            return
+        for ev in self.session.fire("fleet.worker_stall", path=self.token):
+            self.session.clock.sleep(float(ev.args.get("delay_s", 1.0)))
+        for _ev in self.session.fire("fleet.worker_kill", path=self.token):
+            os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(5)  # unreachable — SIGKILL is unmaskable; belt for exotic platforms
+
+
+def serve_worker(host: EngineHost, rstream, wstream, *,
+                 heartbeat_deadline_s: Optional[float] = DEFAULT_HEARTBEAT_S,
+                 chaos: Optional[WorkerChaos] = None) -> int:
+    """The worker main loop: recv one frame, dispatch, reply. The heartbeat
+    deadline bounds EVERY recv — a controller silent past it means this worker
+    is orphaned (controller crashed without `close`), and the worker exits
+    rather than leaking a process + device memory (analysis rule TPU116 flags
+    loops built without this bound). Returns the process exit code."""
+    while True:
+        try:
+            msg = recv_frame(rstream, timeout_s=heartbeat_deadline_s)
+        except FrameTimeout:
+            logger.warning(
+                "worker %d: controller silent for %.1fs — exiting as orphaned",
+                host.worker_id, heartbeat_deadline_s,
+            )
+            return ORPHANED_EXIT_CODE
+        except (WorkerGone, FrameError) as exc:
+            logger.warning("worker %d: control stream died: %r", host.worker_id, exc)
+            return ORPHANED_EXIT_CODE
+        if chaos is not None:
+            chaos.poll(msg.get("op"))
+        reply = host.handle(msg)
+        try:
+            send_frame(wstream, reply)
+        except WorkerGone:
+            return ORPHANED_EXIT_CODE
+        if msg.get("op") == "close":
+            return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser("accelerate-tpu serving worker")
+    parser.add_argument("--spec-json", required=True,
+                        help="model spec JSON (spec_for_model / {'name': ...})")
+    parser.add_argument("--engine-json", default="{}",
+                        help="ContinuousBatcher kwargs as JSON")
+    parser.add_argument("--worker-id", type=int,
+                        default=int(os.environ.get(WORKER_ID_ENV, "0")))
+    parser.add_argument("--heartbeat-deadline-s", type=float, default=DEFAULT_HEARTBEAT_S)
+    parser.add_argument("--no-warm", action="store_true",
+                        help="skip pre-warming the insert ladder before reporting ready")
+    parser.add_argument("--guard", action="store_true",
+                        help="arm a record-mode TraceGuard post-warmup and report its "
+                        "recompile/host-transfer counters in stats")
+    args = parser.parse_args(argv)
+
+    # fd 1 belongs to the PROTOCOL: anything else printing to stdout (jax
+    # warnings, user prints) would corrupt frames. Keep a private dup for
+    # frames and point fd 1 (and sys.stdout) at stderr.
+    ipc_out = os.dup(1)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+    ipc_in = 0
+
+    from .serving import ContinuousBatcher
+    from .telemetry.tracing import default_tracer
+
+    tracer = default_tracer()
+    spec = json.loads(args.spec_json)
+    engine_kwargs = json.loads(args.engine_json)
+    span = tracer.start_span(
+        "worker.lifetime", category="worker",
+        worker_id=args.worker_id, pid=os.getpid(),
+    )
+    model = build_model_from_spec(spec)
+    # The controller always threads its own max_queue through engine_kwargs;
+    # a hand-launched worker still gets a bounded queue (TPU114 discipline).
+    max_queue = engine_kwargs.pop("max_queue", 64)
+    engine = ContinuousBatcher(model, tracer=tracer, max_queue=max_queue, **engine_kwargs)
+
+    chaos = None
+    from .chaos.plan import FaultPlan
+
+    plan = FaultPlan.from_env()
+    if plan is not None:
+        chaos = WorkerChaos(
+            plan, args.worker_id,
+            journal_path=os.environ.get(CHAOS_JOURNAL_ENV), tracer=tracer,
+        )
+        chaos.arm(engine)
+
+    warmed: List[int] = []
+    if not args.no_warm:
+        warmed = [int(b) for b in engine.warm_inserts()]
+
+    guard = None
+    if args.guard:
+        from .analysis import TraceGuard
+
+        guard = TraceGuard(
+            transfer_guard="disallow", on_violation="record",
+            name=f"worker-{args.worker_id}",
+        )
+        guard.__enter__()
+
+    host = EngineHost(engine, worker_id=args.worker_id, guard=guard)
+    send_frame(ipc_out, {
+        "ok": True, "ready": True, "pid": os.getpid(),
+        "worker_id": args.worker_id, "warm": not args.no_warm, "warmed": warmed,
+    })
+    span.event("ready", warmed_buckets=len(warmed))
+    code = serve_worker(
+        host, ipc_in, ipc_out,
+        heartbeat_deadline_s=args.heartbeat_deadline_s, chaos=chaos,
+    )
+    if guard is not None:
+        guard.__exit__(None, None, None)
+    span.annotate(exit_code=code).end()
+    return code
+
+
+# ------------------------------------------------------------------ controller side
+class _PipeTransport:
+    """The real transport: a spawned worker process with frame streams over
+    its stdin/stdout pipes. Tests substitute a duck-typed fake."""
+
+    def __init__(self, cmd: List[str], env: Dict[str, str], stderr=None):
+        self.proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=stderr, env=env, bufsize=0,
+        )
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def send(self, obj: Dict[str, Any]):
+        send_frame(self.proc.stdin, obj)
+
+    def recv(self, timeout_s: Optional[float]) -> Dict[str, Any]:
+        return recv_frame(self.proc.stdout, timeout_s=timeout_s)
+
+    def kill(self):
+        if self.alive():
+            self.proc.kill()
+
+    def close(self, timeout_s: float = 10.0):
+        try:
+            self.proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+        try:
+            self.proc.stdout.close()
+        except OSError:
+            pass
+
+
+class SubprocessEngine:
+    """Client proxy for one out-of-process engine worker, exposing the exact
+    `ContinuousBatcher` surface so `Router` needs no routing changes.
+
+    The proxy mirrors request results locally (`results` holds real
+    `RequestResult`s updated from step replies), mirrors the worker's
+    load/queue-depth scalars for least-loaded routing, and converts transport
+    death into the router's existing failure language: a dead/hung worker makes
+    `step()` raise `WorkerGone` (-> `fail_replica` -> factory rebuild -> warm
+    rejoin) and `submit()` raise `EngineClosed` (-> the router tries the next
+    candidate replica)."""
+
+    def __init__(
+        self,
+        spec: Dict[str, Any],
+        engine_kwargs: Optional[Dict[str, Any]] = None,
+        worker_id: int = 0,
+        *,
+        warm: bool = True,
+        guard: bool = False,
+        heartbeat_deadline_s: float = DEFAULT_HEARTBEAT_S,
+        step_timeout_s: float = 120.0,
+        start_timeout_s: float = 600.0,
+        env: Optional[Dict[str, str]] = None,
+        stderr=None,
+        python: Optional[str] = None,
+        _transport=None,
+    ):
+        from .serving import RequestResult  # noqa: F401 — re-exported surface
+
+        self.spec = dict(spec)
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.worker_id = int(worker_id)
+        self.max_queue = self.engine_kwargs.get("max_queue")
+        self.step_timeout_s = float(step_timeout_s)
+        self.results: Dict[int, Any] = {}
+        self.trace_guard = None  # surface parity; guards run worker-side
+        self._dead = False
+        self._closed = False
+        self._load = 0
+        self._queue_depth = 0
+        self._worker_pending = False
+        self._stats_cache: Dict[str, Any] = {}
+        self._params_dir: Optional[str] = None
+        self._params_seq = 0
+        if _transport is not None:
+            self.transport = _transport
+        else:
+            run_env = dict(os.environ if env is None else env)
+            run_env[WORKER_ID_ENV] = str(self.worker_id)
+            pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            run_env["PYTHONPATH"] = pkg_parent + os.pathsep + run_env.get("PYTHONPATH", "")
+            cmd = [
+                python or sys.executable, "-m", "accelerate_tpu.worker",
+                "--spec-json", json.dumps(self.spec),
+                "--engine-json", json.dumps(self.engine_kwargs),
+                "--worker-id", str(self.worker_id),
+                "--heartbeat-deadline-s", str(heartbeat_deadline_s),
+            ]
+            if not warm:
+                cmd.append("--no-warm")
+            if guard:
+                cmd.append("--guard")
+            self.transport = _PipeTransport(cmd, env=run_env, stderr=stderr)
+        try:
+            self.ready_info = self.transport.recv(timeout_s=start_timeout_s)
+        except (WorkerGone, FrameTimeout, FrameError) as exc:
+            self._mark_dead()
+            raise WorkerGone(f"worker {self.worker_id} never became ready: {exc}") from exc
+        if not self.ready_info.get("ready"):
+            self._mark_dead()
+            raise WorkerGone(f"worker {self.worker_id} handshake failed: {self.ready_info}")
+
+    # ---- transport plumbing ----
+    @property
+    def pid(self) -> Optional[int]:
+        return getattr(self.transport, "pid", None)
+
+    def _mark_dead(self):
+        self._dead = True
+        kill = getattr(self.transport, "kill", None)
+        if kill is not None:
+            try:
+                kill()
+            except OSError:
+                pass
+
+    def _call(self, msg: Dict[str, Any], timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        if self._dead:
+            raise WorkerGone(f"worker {self.worker_id} process is gone")
+        try:
+            self.transport.send(msg)
+            reply = self.transport.recv(
+                timeout_s=self.step_timeout_s if timeout_s is None else timeout_s
+            )
+        except FrameTimeout as exc:
+            # A hung worker is indistinguishable from a dead one from the
+            # controller's side — kill it so the rebuild path can take over.
+            self._mark_dead()
+            raise WorkerGone(
+                f"worker {self.worker_id} missed its step deadline: {exc}"
+            ) from exc
+        except (WorkerGone, FrameError) as exc:
+            self._mark_dead()
+            raise WorkerGone(f"worker {self.worker_id} died: {exc}") from exc
+        if not reply.get("ok"):
+            _raise_from_reply(reply)
+        self._load = int(reply.get("load", self._load))
+        self._queue_depth = int(reply.get("queue_depth", self._queue_depth))
+        self._worker_pending = bool(reply.get("pending", self._worker_pending))
+        return reply
+
+    # ---- mirror maintenance ----
+    def _apply_finished(self, records: List[Dict[str, Any]]):
+        for record in records:
+            result = self.results.get(int(record["request_id"]))
+            if result is None or result.finished:
+                continue
+            result.tokens[:] = [int(t) for t in record["tokens"]]
+            result.finished = True
+            result.finish_reason = record.get("finish_reason")
+            result.error = record.get("error")
+            result.finish_time = time.perf_counter()
+
+    # ---- engine surface ----
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def pending(self) -> bool:
+        # A dead worker with unfinished mirrors must look pending: the router
+        # only discovers replica death by stepping it.
+        unfinished = any(not r.finished for r in self.results.values())
+        return unfinished or (self._worker_pending and not self._dead)
+
+    @property
+    def load(self) -> int:
+        return self._load
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue_depth
+
+    @property
+    def stats(self) -> Dict[str, Any]:
+        if not self._dead and not self._closed:
+            try:
+                self._stats_cache = self._call({"op": "stats"})["stats"]
+            except (WorkerGone, RuntimeError):
+                pass
+        return self._stats_cache
+
+    @property
+    def params(self):
+        return None  # live params stay worker-side; the setter ships new ones
+
+    @params.setter
+    def params(self, value):
+        if value is None:
+            return
+        from .checkpointing import save_pytree
+
+        if self._params_dir is None:
+            self._params_dir = tempfile.mkdtemp(prefix="accelerate_tpu_worker_params_")
+        self._params_seq += 1
+        path = os.path.join(self._params_dir, f"params_{self._params_seq}.npz")
+        save_pytree(value, path)
+        self._call({"op": "set_params", "path": path})
+
+    def submit(self, request) -> int:
+        from .serving import EngineClosed, RequestResult
+
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        if self._dead:
+            raise EngineClosed(f"worker {self.worker_id} process is gone")
+        try:
+            self._call({"op": "submit", "request": request_to_wire(request)})
+        except WorkerGone as exc:
+            # The router's dispatch loop treats EngineClosed as "try the next
+            # replica"; the death itself surfaces from the next step().
+            raise EngineClosed(str(exc)) from exc
+        self.results[request.request_id] = RequestResult(
+            request.request_id, arrival_time=request.arrival_time
+        )
+        return request.request_id
+
+    def cancel(self, request_id: int) -> bool:
+        result = self.results[request_id]  # KeyError for unknown ids, like the engine
+        if result.finished:
+            return False
+        try:
+            reply = self._call({"op": "cancel", "request_id": int(request_id)})
+        except WorkerGone:
+            # Worker died under the cancel: the mirror finishes cancelled
+            # locally (partial tokens kept) — nothing can stream anymore.
+            result.finished = True
+            result.finish_reason = "cancelled"
+            result.finish_time = time.perf_counter()
+            return True
+        # `cancelled: false` means the worker finished it first (a terminal
+        # token raced our cancel out): adopt the worker's record verbatim.
+        self._apply_finished([reply["result"]])
+        return bool(reply["cancelled"])
+
+    def release(self, request_id: int):
+        result = self.results[request_id]
+        if not result.finished:
+            raise ValueError(f"request {request_id} is still in flight")
+        if not self._dead and not self._closed:
+            try:
+                self._call({"op": "release", "request_id": int(request_id)})
+            except (WorkerGone, KeyError, ValueError):
+                pass
+        del self.results[request_id]
+        return result
+
+    def step(self) -> List[Tuple[int, List[int]]]:
+        if self._closed:
+            return []
+        reply = self._call({"op": "step"})
+        events: List[Tuple[int, List[int]]] = []
+        for rid, toks in reply.get("events", ()):
+            rid = int(rid)
+            toks = [int(t) for t in toks]
+            result = self.results.get(rid)
+            if result is not None and not result.finished:
+                result.tokens.extend(toks)
+                if result.first_token_time is None:
+                    result.first_token_time = time.perf_counter()
+            events.append((rid, toks))
+        self._apply_finished(reply.get("finished", ()))
+        return events
+
+    def run(self, requests=None) -> Dict[int, np.ndarray]:
+        for request in requests or ():
+            self.submit(request)
+        while self.pending:
+            self.step()
+        return {rid: np.asarray(r.tokens, np.int32) for rid, r in self.results.items()}
+
+    def drain(self) -> Dict[int, Any]:
+        reply = self._call({"op": "drain"}, timeout_s=self.step_timeout_s * 10)
+        self._apply_finished(reply.get("finished", ()))
+        return self.results
+
+    def warm_inserts(self) -> List[int]:
+        return [int(b) for b in self._call({"op": "warm"})["buckets"]]
+
+    def reset_guard(self) -> bool:
+        """Zero the worker-side TraceGuard counters (spawned with guard=True):
+        benches call this after warmup so the timed window's 0/0 gate is
+        exact. Returns whether a guard is armed at all."""
+        return bool(self._call({"op": "guard_reset"})["armed"])
+
+    def terminate(self):
+        """Hard shutdown for a replica being ejected: kill the worker process
+        and reap it WITHOUT the cooperative close RPC (the worker may be the
+        reason we are here — hung, or erroring every dispatch). The router's
+        eject path calls this so a worker that failed via error replies (its
+        transport still alive) can never linger as an orphan next to its
+        replacement, holding device memory."""
+        self._mark_dead()
+        close = getattr(self.transport, "close", None)
+        if close is not None:
+            close()
+
+    def close(self) -> Dict[int, Any]:
+        if self._closed:
+            return self.results
+        if not self._dead:
+            try:
+                reply = self._call({"op": "close"})
+                self._apply_finished(reply.get("finished", ()))
+            except (WorkerGone, RuntimeError):
+                pass
+        for result in self.results.values():
+            if not result.finished:
+                result.finished = True
+                result.finish_reason = "cancelled"
+                result.finish_time = time.perf_counter()
+        close = getattr(self.transport, "close", None)
+        if close is not None:
+            close()
+        self._closed = True
+        return self.results
+
+
+def make_subprocess_factory(
+    model=None,
+    spec: Optional[Dict[str, Any]] = None,
+    engine_kwargs: Optional[Dict[str, Any]] = None,
+    *,
+    workdir: Optional[str] = None,
+    warm: bool = True,
+    guard: bool = False,
+    env: Optional[Dict[str, str]] = None,
+    heartbeat_deadline_s: float = DEFAULT_HEARTBEAT_S,
+    step_timeout_s: float = 120.0,
+    start_timeout_s: float = 600.0,
+    stderr_dir: Optional[str] = None,
+) -> Callable[[int], SubprocessEngine]:
+    """Build a `ReplicaSet.engine_factory` that spawns one warm subprocess
+    worker per replica index. When a live `model` is given, its params are
+    saved ONCE to `<workdir>/params.npz` and every worker (including restarts)
+    loads that exact file — subprocess fleets are token-identical to in-process
+    ones by construction. `stderr_dir` (default: the workdir) collects one
+    append-mode `worker_<i>.stderr.log` per index, so restarted workers extend
+    their predecessor's log instead of interleaving on the controller's tty."""
+    if (model is None) == (spec is None):
+        raise ValueError("pass exactly one of model= or spec=")
+    workdir = workdir or tempfile.mkdtemp(prefix="accelerate_tpu_fleet_")
+    os.makedirs(workdir, exist_ok=True)
+    if model is not None:
+        from .checkpointing import save_pytree
+
+        params_path = os.path.join(workdir, "params.npz")
+        save_pytree(model.params, params_path)
+        spec = spec_for_model(model, params_path=params_path)
+    engine_kwargs = dict(engine_kwargs or {})
+    stderr_dir = stderr_dir or workdir
+
+    def factory(index: int) -> SubprocessEngine:
+        log_path = os.path.join(stderr_dir, f"worker_{index}.stderr.log")
+        stderr = open(log_path, "ab")
+        try:
+            return SubprocessEngine(
+                spec, engine_kwargs, worker_id=index,
+                warm=warm, guard=guard,
+                heartbeat_deadline_s=heartbeat_deadline_s,
+                step_timeout_s=step_timeout_s,
+                start_timeout_s=start_timeout_s,
+                env=env, stderr=stderr,
+            )
+        finally:
+            stderr.close()  # the child holds its own copy of the fd
+
+    factory.workdir = workdir
+    factory.spec = spec
+    return factory
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -528,8 +528,191 @@ def run_prefix_workload(model, args, cfg, max_length, rng, tracer=None):
     return result
 
 
+def run_ramp_workload(model, args, cfg, max_length, rng, tracer=None):
+    """The open-loop capacity ramp (`--workload ramp`): requests arrive at a
+    FIXED offered rate regardless of completions (open loop — the arrival
+    process never slows down for a saturated server, unlike the closed-loop
+    workloads above), swept over geometrically increasing rates. Each level
+    records p99 TTFT against offered load; the **knee point** — the highest
+    offered rate whose p99 TTFT stays within `--ramp-knee-factor` of the
+    unloaded level — is the fleet's capacity number, emitted in the JSON.
+
+    Runs against the in-process fleet by default and against REAL subprocess
+    engine workers with `--out-of-process`: same workload, same knee
+    definition, so the two topologies' capacity numbers are comparable. The
+    0-recompile / 0-host-transfer discipline is enforced per engine — a
+    process-wide TraceGuard in-process, the workers' own guards (reset after
+    warmup, read back through stats) out of process."""
+    from accelerate_tpu.analysis import TraceGuard
+    from accelerate_tpu.router import Router
+    from accelerate_tpu.serving import QueueFull, Request
+
+    n = args.ramp_requests
+    prompts = [
+        rng.integers(1, cfg.vocab_size, (int(rng.integers(args.prompt_min, args.prompt_max + 1)),)).astype(np.int32)
+        for _ in range(n)
+    ]
+    budgets = [int(rng.integers(args.max_new_min, args.max_new_max + 1)) for _ in range(n)]
+    replicas = max(args.replicas, 1)
+    router = Router(
+        model,
+        replicas=replicas,
+        num_slots=args.num_slots,
+        max_length=max_length,
+        chunk_size=args.chunk_size,
+        # Open loop: overload must surface as TTFT blow-up (the knee), not as
+        # rejected arrivals — the queue bound is sized above one full level.
+        max_queue=max(4 * n, 64),
+        default_deadline_s=600.0,
+        paged=not args.no_paged,
+        page_size=args.page_size,
+        tracer=tracer,
+        out_of_process=args.out_of_process,
+        worker_kwargs=dict(guard=True) if args.out_of_process else None,
+        stall_degrade_s=None,
+    )
+    next_id = 0
+
+    def run_level(rate):
+        """One offered-load level on the shared virtual clock (real step
+        durations, virtual arrivals at `rate` req/s). Returns per-request
+        TTFTs and the rejected count."""
+        nonlocal next_id
+        base = next_id
+        arrivals = {base + i: i / rate for i in range(n)}
+        clock = 0.0
+        submitted = 0
+        rejected = 0
+        first_seen = {}
+        while submitted < n or router.pending:
+            while submitted < n and arrivals[base + submitted] <= clock:
+                rid = base + submitted
+                try:
+                    router.submit(Request(
+                        rid, prompts[submitted], max_new_tokens=budgets[submitted]
+                    ))
+                except QueueFull:
+                    rejected += 1
+                submitted += 1
+            if not router.pending and submitted < n:
+                clock = max(clock, arrivals[base + submitted])
+                continue
+            t0 = time.perf_counter()
+            events = router.step()
+            clock += time.perf_counter() - t0
+            for rid, _toks in events:
+                first_seen.setdefault(rid, clock)
+        next_id = base + n
+        ttfts = [first_seen[rid] - arrivals[rid] for rid in sorted(first_seen)]
+        for rid in list(router.results):
+            router.release(rid)
+        return ttfts, rejected
+
+    rates = [args.ramp_base_rate * (2.0 ** k) for k in range(args.ramp_levels)]
+    log(f"ramp workload ({'out-of-process' if args.out_of_process else 'in-process'}, "
+        f"{replicas} replica(s)): warmup...")
+    warmed = router.warm_inserts()
+    log(f"ramp insert buckets warmed: {sorted(set(sum(warmed.values(), [])))}")
+    run_level(rates[0])  # decode executables + prefix floors warm
+
+    guard = None
+    if args.out_of_process:
+        for replica in router.replica_set.replicas:
+            assert replica.engine.reset_guard(), "worker spawned without --guard"
+    else:
+        guard = TraceGuard(
+            transfer_guard="disallow", on_violation="record", name="serving-bench-ramp"
+        )
+        guard.__enter__()
+
+    levels = []
+    for rate in rates:
+        ttfts, rejected = run_level(rate)
+        completed = len(ttfts)
+        levels.append({
+            "offered_rps": round(rate, 3),
+            "offered_tokens_per_sec": round(rate * float(np.mean(budgets)), 2),
+            "completed": completed,
+            "rejected": rejected,
+            "ttft_p50_ms": round(pct(ttfts, 50) * 1000, 2) if ttfts else None,
+            "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 2) if ttfts else None,
+        })
+        log(f"ramp level {rate:.1f} req/s: p99 TTFT {levels[-1]['ttft_p99_ms']}ms, "
+            f"{completed}/{n} completed, {rejected} rejected")
+
+    worker_guards = None
+    if guard is not None:
+        guard.__exit__(None, None, None)
+        assert guard.total_recompiles == 0 and guard.host_transfers == 0, (
+            "ramp workload regressed the 0-recompile / 0-host-transfer discipline: "
+            f"{guard.report().summary()}"
+        )
+        recompiles, host_transfers = guard.total_recompiles, guard.host_transfers
+    else:
+        # Per-worker discipline: every subprocess engine's own guard must have
+        # stayed at zero across every timed level.
+        worker_guards = {}
+        recompiles = host_transfers = 0
+        for replica in router.replica_set.replicas:
+            stats = replica.engine.stats
+            info = (stats.get("worker") or {}).get("guard") or {}
+            worker_guards[replica.index] = info
+            recompiles += int(info.get("recompiles", 0))
+            host_transfers += int(info.get("host_transfers", 0))
+        assert recompiles == 0 and host_transfers == 0, (
+            "a subprocess worker regressed the 0-recompile / 0-host-transfer "
+            f"discipline under the ramp: {worker_guards}"
+        )
+
+    # The knee: the highest offered rate whose p99 TTFT is still within
+    # ramp_knee_factor of the unloaded (first) level — the capacity number.
+    base_p99 = levels[0]["ttft_p99_ms"] or 1e-9
+    knee = levels[0]
+    for level in levels:
+        if level["ttft_p99_ms"] is not None and (
+            level["ttft_p99_ms"] <= args.ramp_knee_factor * base_p99
+        ) and level["rejected"] == 0:
+            knee = level
+    saturated = knee is not levels[-1]
+    router.close()
+    return {
+        "out_of_process": args.out_of_process,
+        "replicas": replicas,
+        "requests_per_level": n,
+        "levels": levels,
+        "knee": {
+            "offered_rps": knee["offered_rps"],
+            "offered_tokens_per_sec": knee["offered_tokens_per_sec"],
+            "ttft_p99_ms": knee["ttft_p99_ms"],
+            "knee_factor": args.ramp_knee_factor,
+            # False means every level stayed under the knee: the ramp never
+            # reached saturation and capacity is a lower bound.
+            "saturated": saturated,
+        },
+        "recompiles": recompiles,
+        "host_transfers": host_transfers,
+        "worker_guards": worker_guards,
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--workload", default="standard", choices=["standard", "ramp"],
+                        help="standard: the static-vs-continuous A/B suite; ramp: the "
+                        "open-loop arrival ramp (p99 TTFT vs offered load + knee-point "
+                        "capacity), against an in-process or --out-of-process fleet")
+    parser.add_argument("--out-of-process", action="store_true",
+                        help="ramp workload: serve through REAL subprocess engine workers "
+                        "(accelerate_tpu.worker) instead of in-process engines")
+    parser.add_argument("--ramp-levels", type=int, default=5,
+                        help="offered-load levels in the ramp (each doubles the rate)")
+    parser.add_argument("--ramp-base-rate", type=float, default=4.0,
+                        help="ramp starting offered load in requests per virtual second")
+    parser.add_argument("--ramp-requests", type=int, default=None,
+                        help="requests per ramp level (default: --requests)")
+    parser.add_argument("--ramp-knee-factor", type=float, default=3.0,
+                        help="knee = highest rate with p99 TTFT within this factor of "
+                        "the unloaded level")
     parser.add_argument("--model", default=None, help="named model (accelerate_tpu.models); default llama-1b on accelerators, llama-tiny on CPU")
     parser.add_argument("--requests", type=int, default=None)
     parser.add_argument("--num-slots", type=int, default=4)
@@ -579,6 +762,8 @@ def main(argv=None):
         args.prefix_tokens = 64 if on_accel else 24
     if args.max_new_max is None:
         args.max_new_max = 128 if on_accel else 32
+    if args.ramp_requests is None:
+        args.ramp_requests = args.requests
     if args.prompt_min > args.prompt_max:
         parser.error(f"--prompt-min {args.prompt_min} > --prompt-max {args.prompt_max}")
     if args.max_new_min > args.max_new_max:
@@ -618,6 +803,28 @@ def main(argv=None):
     # this instrumentation costs 0 recompiles / 0 host transfers.
     trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="serving_bench_trace_")
     tracer = Tracer(recorder=FlightRecorder(log_dir=trace_dir), category="serve")
+
+    if args.workload == "ramp":
+        ramp = run_ramp_workload(model, args, cfg, max_length, rng, tracer=tracer)
+        prefix = "" if on_accel else "cpu-smoke "
+        topo = ", out-of-process" if args.out_of_process else ""
+        result = {
+            "metric": f"{prefix}serving capacity knee (open-loop ramp, {model_name}, "
+            f"{ramp['replicas']} replica(s){topo})",
+            "value": ramp["knee"]["offered_tokens_per_sec"],
+            "unit": "offered tokens/sec at the p99-TTFT knee",
+            "extra": {
+                "device_kind": jax.devices()[0].device_kind,
+                "ramp_workload": ramp,
+                "num_slots": args.num_slots,
+                "chunk_size": args.chunk_size,
+                "prompt_range": [args.prompt_min, args.prompt_max],
+                "max_new_range": [args.max_new_min, args.max_new_max],
+                "seed": args.seed,
+            },
+        }
+        print(json.dumps(result))
+        return 0
 
     if args.attention_impl == "pallas_paged" and args.no_paged:
         parser.error("--attention-impl pallas_paged requires the paged cache (drop --no-paged)")

@@ -228,6 +228,43 @@ def test_tpu115_impl_pin_variants():
     assert [f.rule_id for f in analyze_source(paged_seam)] == ["TPU115"]
 
 
+def test_tpu116_worker_loop_variants():
+    """The looped-recv half of TPU116 (the flag fixture carries the
+    serve_worker pin — one finding per fixture): an unbounded recv_frame
+    INSIDE a loop flags, a bounded one is clean, a one-shot recv outside any
+    loop is clean (handshakes may use their own start timeout), an explicit
+    heartbeat_deadline_s=None flags, and a jax-free module is out of scope."""
+    hazard = (
+        "import jax\n"
+        "from accelerate_tpu.worker import recv_frame\n"
+        "def pump(stream):\n"
+        "    while True:\n"
+        "        frame = recv_frame(stream)\n"
+    )
+    assert [f.rule_id for f in analyze_source(hazard)] == ["TPU116"]
+    assert not analyze_source(
+        hazard.replace("recv_frame(stream)", "recv_frame(stream, timeout_s=30.0)")
+    )
+    assert [f.rule_id for f in analyze_source(
+        hazard.replace("recv_frame(stream)", "recv_frame(stream, timeout_s=None)")
+    )] == ["TPU116"]
+    one_shot = (
+        "import jax\n"
+        "from accelerate_tpu.worker import recv_frame\n"
+        "def handshake(stream):\n"
+        "    return recv_frame(stream, timeout_s=600.0)\n"
+    )
+    assert not analyze_source(one_shot)
+    explicit_none = (
+        "import jax\n"
+        "from accelerate_tpu.worker import serve_worker\n"
+        "def run(host, r, w):\n"
+        "    return serve_worker(host, r, w, heartbeat_deadline_s=None)\n"
+    )
+    assert [f.rule_id for f in analyze_source(explicit_none)] == ["TPU116"]
+    assert not analyze_source(hazard.replace("import jax\n", ""))
+
+
 def test_analyze_paths_walks_the_tree():
     findings, scanned = analyze_paths([str(SAMPLES)])
     assert scanned >= 2 * len(RULES) + 1  # flag + clean per rule + suppressed.py

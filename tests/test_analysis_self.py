@@ -104,17 +104,32 @@ def test_router_is_warn_clean():
     )
 
 
-def test_kernel_serving_path_is_warn_clean_at_15_rules():
+def test_worker_module_is_warn_clean():
+    """The out-of-process worker pin: accelerate_tpu/worker.py — the IPC
+    framing, the worker loop, and the SubprocessEngine proxy — stays
+    warn-clean under the full registry INCLUDING its own rule (TPU116): the
+    module that defines the heartbeat/timeout discipline must itself pass it
+    (every looped recv bounded, serve_worker called with an explicit
+    heartbeat deadline)."""
+    findings, scanned = analyze_paths([str(REPO / "accelerate_tpu" / "worker.py")])
+    assert scanned == 1, f"worker module missing? scanned {scanned}"
+    flagged = [f for f in findings if severity_at_least(f.severity, "warn")]
+    assert not flagged, "warn+ TPU hazards in worker:\n" + "\n".join(
+        f"  {f.file}:{f.line}: {f.rule_id} {f.message}" for f in flagged
+    )
+
+
+def test_kernel_serving_path_is_warn_clean_at_16_rules():
     """The Pallas kernel path pin: `ops/` (the kernels + the dispatch seams)
     and the kernel-touching serving/generation files stay warn-clean under the
-    FULL 15-rule registry — including TPU115, so nothing in the shipped tree
+    FULL 16-rule registry — including TPU115, so nothing in the shipped tree
     pins a paged decode program to the gather oracle or forces interpret mode
     outside tests. The rule-count assert keeps this test honest: if the
     registry grows, this pin re-evaluates the kernel path under the new rule
     instead of silently gating against a stale set."""
     from accelerate_tpu.analysis import RULES
 
-    assert len(RULES) == 15, "rule registry changed — re-audit the kernel-path pin"
+    assert len(RULES) == 16, "rule registry changed — re-audit the kernel-path pin"
     roots = [
         REPO / "accelerate_tpu" / "ops",
         REPO / "accelerate_tpu" / "serving.py",

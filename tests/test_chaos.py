@@ -822,6 +822,114 @@ def test_cli_run_router_workload(capsys, tmp_path):
     assert InvariantReport.load(str(report_path)).ok
 
 
+# ------------------------------------------------------------------ fleet sweeps
+@pytest.mark.fleet
+def test_fleet_real_sigkill_mid_traffic_sweep(tmp_path):
+    """THE out-of-process acceptance sweep: a real worker PROCESS takes a real
+    SIGKILL mid-traffic (worker-side, via the env-propagated plan). Every
+    request reaches a terminal reason, no stream duplicates, the respawned
+    worker rejoins WARM and serves post-fault traffic, the autoscaler
+    converges back to its floor after the burst, and the worker-side journal
+    reconciles against the observed process death."""
+    plan = FaultPlan(
+        name="fleet-kill", seed=1, workload="fleet",
+        events=[
+            FaultEvent(kind="serve.queue_burst", at_step=1, args={"count": 6}),
+            FaultEvent(kind="fleet.worker_kill", path_pattern="worker_0", at_call=3),
+        ],
+    )
+    report = ChaosRunner(plan).run_fleet(
+        num_requests=8, replicas=2, workdir=str(tmp_path)
+    )
+    assert report.ok, report.render_text()
+    names = {c.name for c in report.checks}
+    assert {"terminal_finish_reasons", "no_duplicate_streams", "fleet_recovered",
+            "no_route_to_ejected", "worker_restart_rejoins_warm",
+            "ledger_reconciles", "autoscaler_converges"} <= names
+    restart = next(c for c in report.checks if c.name == "worker_restart_rejoins_warm")
+    assert restart.details["observed_deaths"] >= 1
+    ledger = next(c for c in report.checks if c.name == "ledger_reconciles")
+    assert ledger.details["worker_journal_kills"] == {"worker_0": 1}
+    # The journal entry was durably written BEFORE the SIGKILL landed.
+    journal = [json.loads(l) for l in open(tmp_path / "fleet_chaos_journal.jsonl")]
+    assert any(e["kind"] == "fleet.worker_kill" and e["worker"] == "worker_0"
+               for e in journal)
+
+
+@pytest.mark.fleet
+def test_fleet_worker_stall_surfaces_as_heartbeat_death(tmp_path):
+    """A worker stalled past the controller's step timeout is
+    indistinguishable from a dead one: the client kills it, the router ejects
+    and respawns it warm, and the invariants hold — hang detection by
+    TIMEOUT, not cooperation."""
+    plan = FaultPlan(
+        name="fleet-stall", seed=2, workload="fleet",
+        events=[
+            # The burst spreads load across the fleet: least-loaded routing
+            # with drip-fed traffic would otherwise keep worker_1 idle and the
+            # stall trigger (counting ITS OWN step ops) would never arm.
+            FaultEvent(kind="serve.queue_burst", at_step=1, args={"count": 6}),
+            FaultEvent(kind="fleet.worker_stall", path_pattern="worker_1", at_call=2,
+                       args={"delay_s": 30.0}),
+        ],
+    )
+    report = ChaosRunner(plan).run_fleet(
+        num_requests=6, replicas=2, autoscale=False, step_timeout_s=3.0,
+        workdir=str(tmp_path),
+    )
+    assert report.ok, report.render_text()
+    assert "autoscaler_converges" not in {c.name for c in report.checks}
+    ledger = next(c for c in report.checks if c.name == "ledger_reconciles")
+    assert ledger.details["observed_deaths"].get("worker_1", 0) >= 1
+
+
+@pytest.mark.fleet
+def test_smoke_fleet_plan_and_workload_inference():
+    """The builtin plan round-trips, the CLI infers the fleet workload from
+    fleet.* kinds, and the catalog documents the new fault kinds."""
+    from accelerate_tpu.chaos.injectors import catalog
+    from accelerate_tpu.commands.chaos import _infer_workload
+
+    plan = builtin_plans()["smoke-fleet"]
+    assert plan.workload == "fleet"
+    assert FaultPlan.from_json(plan.to_json()).to_dict() == plan.to_dict()
+    bare = FaultPlan(name="x", events=[
+        FaultEvent(kind="fleet.worker_kill", path_pattern="worker_0", at_call=1),
+    ])
+    assert _infer_workload(bare) == "fleet"
+    assert {"fleet.worker_kill", "fleet.worker_stall"} <= set(catalog())
+
+
+def test_session_preconsume_blocks_refire_but_not_other_events():
+    """`ChaosSession.preconsume` (the worker-restart livelock guard at the
+    session layer): consumed firings count against `times`, at_call counters
+    advance to the trigger, and path-mismatched or other-kind events are
+    untouched."""
+    plan = FaultPlan(name="p", events=[
+        FaultEvent(kind="fleet.worker_kill", path_pattern="worker_0", at_call=2),
+        FaultEvent(kind="fleet.worker_stall", path_pattern="worker_1", at_call=1),
+    ])
+    session = ChaosSession(plan)
+    session.preconsume("fleet.worker_kill", 1, path="worker_0")
+    for _ in range(4):
+        assert session.fire("fleet.worker_kill", path="worker_0") == []
+    # the OTHER worker's stall still fires normally
+    assert len(session.fire("fleet.worker_stall", path="worker_1")) == 1
+    # a preconsume that matches nothing is a no-op, not an error
+    session.preconsume("fleet.worker_kill", 3, path="worker_9")
+    # An event with firings LEFT (times=2, one consumed) must keep counting
+    # fresh calls: the restarted process's at_call trigger still arms for the
+    # remaining firing instead of being disarmed forever.
+    plan2 = FaultPlan(name="p2", events=[
+        FaultEvent(kind="fleet.worker_kill", path_pattern="worker_0", at_call=2, times=2),
+    ])
+    session2 = ChaosSession(plan2)
+    session2.preconsume("fleet.worker_kill", 1, path="worker_0")
+    assert session2.fire("fleet.worker_kill", path="worker_0") == []  # call 1
+    assert len(session2.fire("fleet.worker_kill", path="worker_0")) == 1  # call 2: 2nd firing
+    assert session2.fire("fleet.worker_kill", path="worker_0") == []  # budget exhausted
+
+
 # ------------------------------------------------------------------ crash-loop livelock
 def test_async_at_step_kill_livelock_surfaces_crash_loop(tmp_path):
     """The PR-9 livelock regression (at_step SIGKILL + async saves, re-armed

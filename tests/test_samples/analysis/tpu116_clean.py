@@ -1,0 +1,19 @@
+"""TPU116 negative: a heartbeat-bounded worker loop and timeout-bounded IPC
+reads — a hung peer surfaces as a timeout the supervision machinery can act
+on, never as a silently hung process."""
+import jax  # noqa: F401
+
+from accelerate_tpu.worker import recv_frame, serve_worker
+
+
+def run_worker(host, rstream, wstream):
+    # sanctioned: the worker exits when the controller goes silent
+    return serve_worker(host, rstream, wstream, heartbeat_deadline_s=120.0)
+
+
+def pump(stream):
+    frames = []
+    for _ in range(4):
+        # sanctioned: every looped IPC read is bounded
+        frames.append(recv_frame(stream, timeout_s=30.0))
+    return frames

@@ -1,0 +1,12 @@
+"""TPU116 worker-loop-no-heartbeat: a subprocess engine worker loop started
+without a heartbeat deadline (the looped-recv variant is pinned in
+test_analysis_rules.test_tpu116_worker_loop_variants)."""
+import jax  # noqa: F401 — the jit-adjacency signal
+
+from accelerate_tpu.worker import serve_worker
+
+
+def run_worker(host, rstream, wstream):
+    # hazard: no heartbeat_deadline_s — a dead controller leaves this worker
+    # (and its device memory) orphaned forever
+    return serve_worker(host, rstream, wstream)

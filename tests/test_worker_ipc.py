@@ -1,0 +1,440 @@
+"""Out-of-process worker IPC tests — the protocol layer in ISOLATION.
+
+Everything here runs tier-1/CPU-fast with fake peers (pipes, scripted
+transports, an in-process `EngineHost`): no real subprocess is ever spawned.
+What is pinned:
+
+  1. framing: length-prefixed JSON round trips; torn/short frames surface as
+     `WorkerGone` (dead peer), oversized/undecodable ones as `FrameError`
+     (protocol bug), and a silent peer as `FrameTimeout` — three distinct
+     failures because the caller handles them differently;
+  2. `EngineHost` op dispatch against a real in-process engine: the typed
+     error replies (QueueFull/EngineClosed/ValueError/KeyError) that let the
+     client re-raise the engine's exact exception types;
+  3. `SubprocessEngine` mirror semantics over a scripted fake transport:
+     worker-dies-mid-stream escalates to `WorkerGone` from step() (the
+     router's replica-death language), heartbeat expiry kills the worker,
+     submit() after death raises `EngineClosed` (try-next-replica), and a
+     cancel racing a final token adopts the worker's terminal record instead
+     of double-finishing;
+  4. `WorkerChaos` journal pre-consumption: a respawned worker re-arming the
+     same env plan must NOT re-kill itself at the same trigger.
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.worker import (
+    FrameError,
+    FrameTimeout,
+    SubprocessEngine,
+    WorkerGone,
+    recv_frame,
+    request_from_wire,
+    request_to_wire,
+    result_to_wire,
+    send_frame,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+# ------------------------------------------------------------------ framing
+def _pipe():
+    r, w = os.pipe()
+    return r, w
+
+
+def test_frame_round_trip_and_multiple_frames():
+    r, w = _pipe()
+    try:
+        payloads = [{"op": "ping"}, {"op": "step", "events": [[1, [2, 3]]], "n": 0}]
+        for p in payloads:
+            send_frame(w, p)
+        for p in payloads:
+            assert recv_frame(r, timeout_s=5.0) == p
+    finally:
+        os.close(r), os.close(w)
+
+
+def test_torn_frame_mid_payload_is_worker_gone():
+    r, w = _pipe()
+    try:
+        # Header promises 100 bytes; the peer dies after 3.
+        os.write(w, struct.pack(">I", 100) + b"abc")
+        os.close(w)
+        with pytest.raises(WorkerGone, match="mid-frame payload"):
+            recv_frame(r, timeout_s=5.0)
+    finally:
+        os.close(r)
+
+
+def test_eof_at_frame_boundary_is_worker_gone():
+    r, w = _pipe()
+    os.close(w)
+    try:
+        with pytest.raises(WorkerGone, match="closed the stream"):
+            recv_frame(r, timeout_s=5.0)
+    finally:
+        os.close(r)
+
+
+def test_short_header_is_worker_gone():
+    r, w = _pipe()
+    try:
+        os.write(w, b"\x00\x00")  # 2 of 4 header bytes, then death
+        os.close(w)
+        with pytest.raises(WorkerGone, match="mid-frame header"):
+            recv_frame(r, timeout_s=5.0)
+    finally:
+        os.close(r)
+
+
+def test_oversized_and_undecodable_frames_are_frame_errors():
+    r, w = _pipe()
+    try:
+        os.write(w, struct.pack(">I", (64 << 20) + 1))
+        with pytest.raises(FrameError, match="exceeds"):
+            recv_frame(r, timeout_s=5.0)
+        bad = b"\xff\xfe not json"
+        os.write(w, struct.pack(">I", len(bad)) + bad)
+        with pytest.raises(FrameError, match="undecodable"):
+            recv_frame(r, timeout_s=5.0)
+        with pytest.raises(FrameError, match="exceeds"):
+            send_frame(w, {"blob": "x" * (64 << 20)})
+    finally:
+        os.close(r), os.close(w)
+
+
+def test_silent_peer_is_frame_timeout():
+    r, w = _pipe()
+    try:
+        with pytest.raises(FrameTimeout):
+            recv_frame(r, timeout_s=0.05)
+        # ... and a timeout mid-frame (header arrived, payload never does).
+        os.write(w, struct.pack(">I", 10) + b"abc")
+        with pytest.raises(FrameTimeout, match="payload"):
+            recv_frame(r, timeout_s=0.05)
+    finally:
+        os.close(r), os.close(w)
+
+
+def test_request_and_result_wire_codecs_round_trip():
+    from accelerate_tpu.serving import Request, RequestResult
+
+    req = Request(
+        7, np.asarray([3, 1, 4], np.int32), max_new_tokens=5, temperature=0.5,
+        repetition_penalty=1.1, eos_token_id=2, deadline_s=3.5,
+        tenant="team-a", priority=4,
+    )
+    back = request_from_wire(json.loads(json.dumps(request_to_wire(req))))
+    assert back.request_id == 7 and back.max_new_tokens == 5
+    np.testing.assert_array_equal(back.input_ids, [3, 1, 4])
+    assert back.temperature == 0.5 and back.eos_token_id == 2
+    assert back.deadline_s == 3.5 and back.tenant == "team-a" and back.priority == 4
+
+    res = RequestResult(7, tokens=[1, 2], finished=True, finish_reason="eos")
+    wire = result_to_wire(res)
+    assert wire == {
+        "request_id": 7, "tokens": [1, 2], "finished": True,
+        "finish_reason": "eos", "error": None,
+    }
+
+
+# ------------------------------------------------------------------ EngineHost
+def _tiny_engine(**overrides):
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=10000.0,
+    )
+    model = create_llama_model(cfg, seq_len=32)
+    kwargs = dict(num_slots=2, max_length=64, chunk_size=4, max_queue=2,
+                  paged=True, page_size=4)
+    kwargs.update(overrides)
+    return ContinuousBatcher(model, **kwargs)
+
+
+def test_engine_host_op_round_trip_without_subprocess():
+    """The worker side of the protocol against a REAL engine, no process: ops
+    map 1:1 to the engine surface and error replies carry typed kinds."""
+    from accelerate_tpu.worker import EngineHost
+
+    host = EngineHost(_tiny_engine(), worker_id=3)
+    rng = np.random.default_rng(0)
+    req = {"op": "submit", "request": request_to_wire(
+        __import__("accelerate_tpu.serving", fromlist=["Request"]).Request(
+            0, rng.integers(1, 128, (5,)).astype(np.int32), max_new_tokens=4
+        )
+    )}
+    assert host.handle({"op": "ping"})["ok"]
+    assert host.handle(req)["ok"]
+    # duplicate id -> typed value_error, engine untouched
+    dup = host.handle(req)
+    assert not dup["ok"] and dup["kind"] == "value_error"
+    # queue-full backpressure maps to its own kind (max_queue=2: id 1 fits,
+    # ids 2 and 3 overflow the bounded wait queue before any step admits)
+    for i in (1, 2, 3):
+        reply = host.handle({"op": "submit", "request": {**req["request"], "request_id": i}})
+    assert not reply["ok"] and reply["kind"] == "queue_full"
+    events, finished = [], []
+    while host.engine.pending:
+        step = host.handle({"op": "step"})
+        assert step["ok"]
+        events.extend(step["events"])
+        finished.extend(step["finished"])
+    assert {f["request_id"] for f in finished} == {0, 1}
+    assert all(f["finish_reason"] == "length" for f in finished)
+    # the finished list is a DELTA: a second step reports nothing new
+    assert host.handle({"op": "step"})["finished"] == []
+    streamed = {}
+    for rid, toks in events:
+        streamed.setdefault(rid, []).extend(toks)
+    for f in finished:
+        assert streamed[f["request_id"]] == f["tokens"]
+    stats = host.handle({"op": "stats"})["stats"]
+    assert stats["worker"]["worker_id"] == 3 and stats["worker"]["pid"] == os.getpid()
+    released = host.handle({"op": "release", "request_id": 0})
+    assert released["ok"] and released["result"]["finish_reason"] == "length"
+    missing = host.handle({"op": "release", "request_id": 0})
+    assert not missing["ok"] and missing["kind"] == "key_error"
+    unknown = host.handle({"op": "frobnicate"})
+    assert not unknown["ok"] and unknown["kind"] == "value_error"
+    closed = host.handle({"op": "close"})
+    assert closed["ok"]
+    after = host.handle({"op": "submit", "request": {**req["request"], "request_id": 9}})
+    assert not after["ok"] and after["kind"] == "engine_closed"
+
+
+# ------------------------------------------------------------------ fake transport
+class FakeTransport:
+    """Scripted worker: a queue of canned replies (or callables computing one
+    from the sent message), plus a journal of everything sent."""
+
+    def __init__(self, replies):
+        self.replies = list(replies)
+        self.sent = []
+        self.pid = 4242
+        self.killed = False
+        self.closed = False
+
+    def send(self, obj):
+        if self.killed:
+            raise WorkerGone("fake worker killed")
+        self.sent.append(obj)
+
+    def recv(self, timeout_s):
+        if not self.replies:
+            raise WorkerGone("fake worker script exhausted")
+        reply = self.replies.pop(0)
+        if callable(reply):
+            reply = reply(self.sent[-1] if self.sent else None)
+        if isinstance(reply, BaseException):
+            raise reply
+        return reply
+
+    def alive(self):
+        return not self.killed
+
+    def kill(self):
+        self.killed = True
+
+    def close(self, timeout_s=10.0):
+        self.closed = True
+
+
+READY = {"ok": True, "ready": True, "pid": 4242, "worker_id": 0, "warm": True, "warmed": [1, 2]}
+
+
+def _fake_engine(*replies, **kwargs):
+    return SubprocessEngine(
+        {"name": "fake"}, {"max_queue": 4}, _transport=FakeTransport([READY, *replies]),
+        **kwargs,
+    )
+
+
+def _ok_submit(msg):
+    return {"ok": True, "load": 1, "queue_depth": 0, "pending": True}
+
+
+def test_fake_worker_submit_step_release_mirror():
+    from accelerate_tpu.serving import Request
+
+    eng = _fake_engine(
+        _ok_submit,
+        {"ok": True, "events": [[5, [10, 11]]], "finished": [],
+         "load": 1, "queue_depth": 0, "pending": True},
+        {"ok": True, "events": [[5, [12]]],
+         "finished": [{"request_id": 5, "tokens": [10, 11, 12], "finished": True,
+                       "finish_reason": "length", "error": None}],
+         "load": 0, "queue_depth": 0, "pending": False},
+        {"ok": True, "result": {"request_id": 5, "tokens": [10, 11, 12], "finished": True,
+                                "finish_reason": "length", "error": None}},
+    )
+    assert eng.ready_info["warmed"] == [1, 2]
+    eng.submit(Request(5, np.asarray([1, 2], np.int32), max_new_tokens=3))
+    assert eng.load == 1 and eng.pending
+    assert eng.step() == [(5, [10, 11])]
+    assert eng.results[5].tokens == [10, 11]
+    assert eng.step() == [(5, [12])]
+    result = eng.results[5]
+    assert result.finished and result.finish_reason == "length"
+    assert result.tokens == [10, 11, 12]
+    assert not eng.pending
+    released = eng.release(5)
+    assert released is result and 5 not in eng.results
+
+
+def test_fake_worker_error_kinds_reraise_engine_types():
+    from accelerate_tpu.serving import EngineClosed, QueueFull, Request
+
+    eng = _fake_engine(
+        {"ok": False, "kind": "queue_full", "error": "full"},
+        {"ok": False, "kind": "value_error", "error": "empty prompt"},
+        {"ok": False, "kind": "engine_closed", "error": "closed"},
+    )
+    req = Request(1, np.asarray([1], np.int32), max_new_tokens=2)
+    with pytest.raises(QueueFull):
+        eng.submit(req)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit(req)
+    with pytest.raises(EngineClosed):
+        eng.submit(req)
+    assert not eng.results  # no mirror is created for a rejected submit
+
+
+def test_worker_dies_mid_stream_escalates_to_worker_gone():
+    """EOF mid-conversation: the step raises WorkerGone (the router's replica
+    -death signal), the engine stays pending (so the router WILL step it and
+    observe the death), and submit() refuses with EngineClosed so the router
+    tries the next replica."""
+    from accelerate_tpu.serving import EngineClosed, Request
+
+    eng = _fake_engine(
+        _ok_submit,
+        {"ok": True, "events": [[1, [7]]], "finished": [],
+         "load": 1, "queue_depth": 0, "pending": True},
+        WorkerGone("peer closed the stream mid-frame payload (3/100 bytes)"),
+    )
+    eng.submit(Request(1, np.asarray([1, 2], np.int32), max_new_tokens=4))
+    assert eng.step() == [(1, [7])]
+    with pytest.raises(WorkerGone):
+        eng.step()
+    assert eng.transport.killed  # the dead process is reaped, not leaked
+    assert eng.pending  # unfinished mirror keeps the replica steppable
+    with pytest.raises(EngineClosed):
+        eng.submit(Request(2, np.asarray([3], np.int32), max_new_tokens=2))
+    with pytest.raises(WorkerGone):
+        eng.step()  # dead stays dead: every later step re-raises
+
+
+def test_heartbeat_expiry_kills_hung_worker():
+    """A worker that stops answering inside step_timeout_s is killed and
+    surfaced as WorkerGone — a hang and a death are the same failure to the
+    fleet."""
+    from accelerate_tpu.serving import Request
+
+    eng = _fake_engine(
+        _ok_submit,
+        FrameTimeout("timed out waiting for frame header (0/4 bytes)"),
+        step_timeout_s=0.01,
+    )
+    eng.submit(Request(1, np.asarray([1], np.int32), max_new_tokens=2))
+    with pytest.raises(WorkerGone, match="missed its step deadline"):
+        eng.step()
+    assert eng.transport.killed
+
+
+def test_cancel_racing_final_token_adopts_worker_record():
+    """cancel() arriving after the worker already finished the request must
+    adopt the worker's terminal record (reason + full tokens), return False
+    like the engine, and never double-finish."""
+    from accelerate_tpu.serving import Request
+
+    eng = _fake_engine(
+        _ok_submit,
+        {"ok": True, "cancelled": False,
+         "result": {"request_id": 1, "tokens": [4, 5, 2], "finished": True,
+                    "finish_reason": "eos", "error": None},
+         "load": 0, "queue_depth": 0, "pending": False},
+    )
+    eng.submit(Request(1, np.asarray([1], np.int32), max_new_tokens=8))
+    assert eng.cancel(1) is False
+    result = eng.results[1]
+    assert result.finish_reason == "eos" and result.tokens == [4, 5, 2]
+    # and the true-cancel path:
+    eng2 = _fake_engine(
+        _ok_submit,
+        {"ok": True, "cancelled": True,
+         "result": {"request_id": 1, "tokens": [9], "finished": True,
+                    "finish_reason": "cancelled", "error": None},
+         "load": 0, "queue_depth": 0, "pending": False},
+    )
+    eng2.submit(Request(1, np.asarray([1], np.int32), max_new_tokens=8))
+    assert eng2.cancel(1) is True
+    assert eng2.results[1].finish_reason == "cancelled"
+    assert eng2.results[1].tokens == [9]  # partial tokens adopted from the worker
+    with pytest.raises(KeyError):
+        eng2.cancel(99)
+
+
+def test_close_finishes_mirrors_and_closes_transport():
+    from accelerate_tpu.serving import EngineClosed, Request
+
+    eng = _fake_engine(
+        _ok_submit,
+        {"ok": True, "finished": [{"request_id": 1, "tokens": [3], "finished": True,
+                                   "finish_reason": "cancelled", "error": None}]},
+    )
+    eng.submit(Request(1, np.asarray([1], np.int32), max_new_tokens=2))
+    results = eng.close()
+    assert results[1].finish_reason == "cancelled"
+    assert eng.transport.closed and eng.closed
+    with pytest.raises(EngineClosed):
+        eng.submit(Request(2, np.asarray([1], np.int32), max_new_tokens=2))
+    assert eng.step() == []  # closed engine steps to nothing, like the engine
+    assert eng.close() is results  # idempotent
+
+
+# ------------------------------------------------------------------ worker chaos
+def test_worker_chaos_preconsumes_journal_on_restart(tmp_path, monkeypatch):
+    """The livelock guard: a worker that already fired its SIGKILL (journaled
+    before death) and was respawned with the SAME env plan must not fire it
+    again at the same trigger."""
+    from accelerate_tpu import worker as worker_mod
+    from accelerate_tpu.chaos.plan import FaultEvent, FaultPlan
+    from accelerate_tpu.worker import WorkerChaos
+
+    kills = []
+    monkeypatch.setattr(worker_mod.os, "kill", lambda pid, sig: kills.append((pid, sig)))
+    monkeypatch.setattr(worker_mod.time, "sleep", lambda s: None)
+    journal = str(tmp_path / "journal.jsonl")
+    plan = FaultPlan(name="t", events=[
+        FaultEvent(kind="fleet.worker_kill", path_pattern="worker_0", at_call=2),
+    ])
+    first = WorkerChaos(plan, 0, journal_path=journal)
+    first.poll("step")
+    assert not kills
+    first.poll("step")
+    assert len(kills) == 1  # fired at its trigger — and journaled BEFORE the kill
+    entries = [json.loads(l) for l in open(journal)]
+    assert entries and entries[0]["kind"] == "fleet.worker_kill"
+    assert entries[0]["worker"] == "worker_0"
+
+    # The respawn: same plan from env, same journal -> pre-consumed, no re-kill.
+    respawn = WorkerChaos(plan, 0, journal_path=journal)
+    for _ in range(6):
+        respawn.poll("step")
+    assert len(kills) == 1
+    # A DIFFERENT worker's chaos is unaffected by worker_0's history.
+    other = WorkerChaos(plan, 1, journal_path=journal)
+    for _ in range(6):
+        other.poll("step")
+    assert len(kills) == 1  # path_pattern worker_0 never matches worker_1
