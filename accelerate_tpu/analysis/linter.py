@@ -671,7 +671,78 @@ class _ModuleChecker:
         self._check_serving_construction()
         self._check_kernel_fallback()
         self._check_worker_loop()
+        self._check_quantization()
         return self.findings
+
+    # -- quantized serving (TPU117) ----------------------------------------------
+    #: Serving attention/kernel seams whose scale arguments must be traced
+    #: arrays (the pool's parallel scale pools), never Python scalars.
+    _QUANT_SCALE_FUNCS = {
+        "paged_decode_attention",
+        "paged_verify_attention",
+        "slot_cache_attention",
+        "update_slot_cache",
+        "quantized_pool_write",
+        "dequantize_kv",
+        "quantize_kv",
+    }
+    _QUANT_SCALE_KWARGS = {"k_scale", "v_scale"}
+    #: KV cache dtype knobs and their one legal value set
+    #: (ops/quantization.KV_CACHE_DTYPES; duplicated as literals so the
+    #: linter stays stdlib-only with no jax import).
+    _KV_DTYPE_KWARGS = {"kv_cache_dtype", "decode_kv_cache_dtype"}
+    _KV_DTYPES_OK = {"bf16", "int8", "fp8_e4m3"}
+
+    def _check_quantization(self):
+        """TPU117: quantization knobs that silently break the compiled-once
+        discipline or fail late. (a) A scale passed as a Python NUMERIC
+        LITERAL to a serving attention/kernel seam is baked into the
+        executable at trace time — the scale pool exists precisely so scale
+        changes ride as operands; one hard-coded float either pins every page
+        to one scale or retraces per value. (b) A `kv_cache_dtype` /
+        `decode_kv_cache_dtype` string literal off the supported set fails at
+        engine construction at best — flag it where it's written, not where
+        it detonates."""
+        if not self.index.imports_jax:
+            return
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._call_name(node.func)
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                value = kw.value
+                if (
+                    kw.arg in self._QUANT_SCALE_KWARGS
+                    and name in self._QUANT_SCALE_FUNCS
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, (int, float))
+                    and not isinstance(value.value, bool)
+                ):
+                    self.emit(
+                        node,
+                        "TPU117",
+                        f"{name}({kw.arg}={value.value!r}) bakes a quantization "
+                        "scale into the executable at trace time — pass the "
+                        "pool's traced scale array (key_scale/value_scale) so "
+                        "scale updates never retrace the decode program",
+                    )
+                if (
+                    kw.arg in self._KV_DTYPE_KWARGS
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)
+                    and value.value not in self._KV_DTYPES_OK
+                ):
+                    supported = ", ".join(sorted(self._KV_DTYPES_OK))
+                    self.emit(
+                        node,
+                        "TPU117",
+                        f"{kw.arg}={value.value!r} is not a supported KV cache "
+                        f"dtype (expected one of: {supported}) — this fails at "
+                        "engine construction; int4 packing is explicitly out of "
+                        "scope (docs/limitations.md)",
+                    )
 
     # -- subprocess worker loops (TPU116) ----------------------------------------
     #: Worker-loop entry points whose heartbeat deadline is the orphan guard.

@@ -197,6 +197,21 @@ RULES = (
         "IPC read turns a hung peer into a hung fleet controller, invisible to the "
         "health machine that exists to catch it",
     ),
+    Rule(
+        id="TPU117",
+        slug="quant-scale-literal",
+        severity="warn",
+        summary="a quantization scale passed as a Python numeric literal to a "
+        "serving attention/kernel seam, or a kv_cache_dtype literal off the "
+        "supported set",
+        fixit="thread scales as traced ARRAY operands (the pool's parallel "
+        "key_scale/value_scale arrays) — a Python scalar bakes the scale into "
+        "the executable at trace time, so every scale change retraces the "
+        'decode program; kv_cache_dtype must be one of "bf16" | "int8" | '
+        '"fp8_e4m3" (static config, ops/quantization.KV_CACHE_DTYPES) — an '
+        "off-set literal fails at engine construction, or worse, silently "
+        "selects nothing",
+    ),
 )
 
 RULES_BY_ID = {r.id: r for r in RULES}

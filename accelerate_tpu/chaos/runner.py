@@ -688,6 +688,7 @@ class ChaosRunner:
         paged: bool = True,
         speculative: bool = False,
         attention_impl: str = "xla",
+        kv_cache_dtype: str = "bf16",
     ) -> InvariantReport:
         """Serving workload: a tiny llama `ContinuousBatcher` fed one request
         per cycle (plus scripted queue bursts), driven to drain under injected
@@ -698,7 +699,12 @@ class ChaosRunner:
         reconstruct the speculative state too. `attention_impl="pallas_paged"`
         drives the sweeps through the fused page-walk kernels
         (ops/paged_attention): blast-radius recovery must rebuild the
-        kernel-path executables identically — same invariants, no retrace."""
+        kernel-path executables identically — same invariants, no retrace.
+        `kv_cache_dtype="int8"`/`"fp8_e4m3"` runs the sweeps on the QUANTIZED
+        page pool: the blast-radius rebuild must recreate the quantized pools
+        AND their scale pools from zeros, and the page ledger must still
+        close — fault paths exercise the quantized cache, not just happy
+        decode."""
         from ..models.llama import LlamaConfig, create_llama_model
         from ..serving import FINISH_REASONS, ContinuousBatcher, QueueFull, Request
 
@@ -718,7 +724,7 @@ class ChaosRunner:
             max_queue=max_queue, registry=self.session.registry,
             tracer=self.tracer, paged=paged, page_size=4,
             speculative=speculative, draft_tokens=3,
-            attention_impl=attention_impl,
+            attention_impl=attention_impl, kv_cache_dtype=kv_cache_dtype,
         )
         ServingInjector(self.session).arm(engine)
         rng = np.random.default_rng(self.plan.seed)

@@ -185,6 +185,14 @@ def make_causal_programs(
     signatures here are identical, so serving's compiled-once discipline and
     the traced-operand page tables are implementation-agnostic.
 
+    Weight-only quantization rides the module config's `weight_dtype`
+    ("bf16" default): "int8" wraps every apply below in
+    `ops.quantization.weight_autocast`, so Dense kernels stored as
+    per-output-channel int8 entries (`quantize_params_int8` — the serving
+    engine's params setter) compute through the fused int8-epilogue matmul.
+    The wrap is trace-time only (the interceptor rewrites the bound method
+    during tracing); "bf16" is a no-op context.
+
     `verify_block=True` appends the speculative-decode seam to the tuple:
     `verify(params, cache, tokens, positions[, mask])` scores a [B, s] token
     BLOCK (the pending token plus s-1 draft proposals) in ONE dispatch,
@@ -196,46 +204,55 @@ def make_causal_programs(
     after accepting the first j block tokens — the property the accept loop
     relies on for token-identical output."""
 
+    from .ops.quantization import weight_autocast
+
+    weight_dtype = getattr(getattr(module, "config", None), "weight_dtype", "bf16")
+
     def prefill(params, input_ids, positions, attention_mask=None):
         # attention_mask (left-padded batch prompts): rides into the cached
         # attention as the persistent pad mask (update_decode_cache).
-        logits, mutated = module.apply(
-            resolve(params), input_ids, attention_mask, positions, mutable=["cache"]
-        )
+        with weight_autocast(weight_dtype):
+            logits, mutated = module.apply(
+                resolve(params), input_ids, attention_mask, positions, mutable=["cache"]
+            )
         if full_prefill_logits:
             return logits, mutated["cache"]
         return logits[:, -1, :], mutated["cache"]
 
     def step(params, cache, token, position):
-        logits, mutated = module.apply(
-            {**resolve(params), "cache": cache},
-            token[:, None],
-            None,
-            position[:, None],
-            mutable=["cache"],
-        )
+        with weight_autocast(weight_dtype):
+            logits, mutated = module.apply(
+                {**resolve(params), "cache": cache},
+                token[:, None],
+                None,
+                position[:, None],
+                mutable=["cache"],
+            )
         return logits[:, -1, :], mutated["cache"]
 
     def step_with_mask(params, cache, token, position, mask):
-        logits, mutated = module.apply(
-            {**resolve(params), "cache": cache},
-            token[:, None],
-            mask,
-            position[:, None],
-            mutable=["cache"],
-        )
+        with weight_autocast(weight_dtype):
+            logits, mutated = module.apply(
+                {**resolve(params), "cache": cache},
+                token[:, None],
+                mask,
+                position[:, None],
+                mutable=["cache"],
+            )
         return logits[:, -1, :], mutated["cache"]
 
     def verify(params, cache, tokens, positions):
-        logits, mutated = module.apply(
-            {**resolve(params), "cache": cache}, tokens, None, positions, mutable=["cache"]
-        )
+        with weight_autocast(weight_dtype):
+            logits, mutated = module.apply(
+                {**resolve(params), "cache": cache}, tokens, None, positions, mutable=["cache"]
+            )
         return logits, mutated["cache"]
 
     def verify_with_mask(params, cache, tokens, positions, mask):
-        logits, mutated = module.apply(
-            {**resolve(params), "cache": cache}, tokens, mask, positions, mutable=["cache"]
-        )
+        with weight_autocast(weight_dtype):
+            logits, mutated = module.apply(
+                {**resolve(params), "cache": cache}, tokens, mask, positions, mutable=["cache"]
+            )
         return logits, mutated["cache"]
 
     step_fn = step_with_mask if step_mask_operand else step
@@ -254,14 +271,19 @@ def make_cached_prefill_program(module, resolve):
     model here — the prefill FLOPs a shared system prompt would have cost are
     simply never issued — and the result is scattered back into pool pages."""
 
+    from .ops.quantization import weight_autocast
+
+    weight_dtype = getattr(getattr(module, "config", None), "weight_dtype", "bf16")
+
     def prefill_with_cache(params, cache, input_ids, positions):
-        logits, mutated = module.apply(
-            {**resolve(params), "cache": cache},
-            input_ids,
-            None,
-            positions,
-            mutable=["cache"],
-        )
+        with weight_autocast(weight_dtype):
+            logits, mutated = module.apply(
+                {**resolve(params), "cache": cache},
+                input_ids,
+                None,
+                positions,
+                mutable=["cache"],
+            )
         return logits, mutated["cache"]
 
     return prefill_with_cache

@@ -60,6 +60,10 @@ class GPTNeoXConfig:
     # Serving-decode attention implementation (see LlamaConfig): "xla" gather
     # oracle or the "pallas_paged" fused page-walk kernels.
     decode_attention_impl: str = "xla"
+    # Quantized serving (see LlamaConfig): KV page-pool storage dtype and
+    # weight storage dtype for the serving programs.
+    decode_kv_cache_dtype: str = "bf16"
+    weight_dtype: str = "bf16"
     param_dtype: str = "float32"
 
     @property
@@ -116,6 +120,7 @@ class GPTNeoXAttention(nn.Module):
                     page_size=cfg.decode_page_size,
                     num_pages=cfg.decode_num_pages,
                     attention_impl=cfg.decode_attention_impl,
+                    kv_cache_dtype=cfg.decode_kv_cache_dtype,
                 )
             else:
                 k_all, v_all, decode_mask = update_decode_cache(self, k, v, L, pad_mask=mask)

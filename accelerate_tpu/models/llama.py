@@ -71,6 +71,15 @@ class LlamaConfig:
     # walk the page table inside the kernel and never materialize the gather.
     # Threaded from serving.ContinuousBatcher(attention_impl=...).
     decode_attention_impl: str = "xla"
+    # KV page-pool storage dtype (paged slot cache only): "bf16" keeps the
+    # model compute dtype; "int8"/"fp8_e4m3" store pages quantized with
+    # per-page-per-head scale pools riding in the cache collection
+    # (ops/quantization.py). Threaded from ContinuousBatcher(kv_cache_dtype=).
+    decode_kv_cache_dtype: str = "bf16"
+    # Weight storage dtype for the serving programs: "int8" runs every Dense
+    # whose kernel is a quantized entry (quantize_params_int8) through the
+    # fused int8-epilogue matmul via the weight_autocast interceptor.
+    weight_dtype: str = "bf16"
 
     @property
     def head_dim(self) -> int:
@@ -128,6 +137,7 @@ class LlamaAttention(nn.Module):
                     page_size=cfg.decode_page_size,
                     num_pages=cfg.decode_num_pages,
                     attention_impl=cfg.decode_attention_impl,
+                    kv_cache_dtype=cfg.decode_kv_cache_dtype,
                 )
             else:
                 # Incremental decoding through the shared flax-cache write path

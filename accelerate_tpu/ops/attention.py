@@ -101,7 +101,7 @@ def update_decode_cache(module, k, v, cache_length: int, pad_mask=None):
 
 def update_slot_cache(
     module, k, v, cache_length: int, positions, page_table=None, page_size: int = 0,
-    num_pages: int = 0,
+    num_pages: int = 0, kv_cache_dtype: str = "bf16",
 ):
     """Per-ROW cache writes for slot-based continuous batching (serving.py):
     every batch row is an independent request slot with its OWN running position,
@@ -143,10 +143,21 @@ def update_slot_cache(
     slots' table rows at it, so their (discarded) writes can never land in a
     page owned by a live request or a shared read-only prefix page.
 
+    QUANTIZED pool (`kv_cache_dtype` "int8" / "fp8_e4m3", paged only): pages
+    are stored in the quantized dtype with per-page-per-head scales in
+    parallel `key_scale`/`value_scale` pool arrays ([num_pages, h] f32, same
+    cache collection — traced operands, never Python scalars), maintained by
+    `ops.quantization.quantized_pool_write` (offset-0 scale reset, scatter-max
+    growth, in-dispatch requant of touched pages). This XLA read path
+    dequantizes the gathered pages — the parity oracle the fused-dequant
+    Pallas kernels are pinned against.
+
     Args:
         positions: [B, s] int32 — each token's absolute write/attend position.
         page_table: [B, pages_per_slot] int32 pool-page ids per slot (paged only).
         page_size / num_pages: static pool geometry (paged only).
+        kv_cache_dtype: "bf16" (unquantized, the model compute dtype) |
+            "int8" | "fp8_e4m3" — pool storage dtype (paged only).
 
     Returns `(k_full, v_full, decode_mask)` like `update_decode_cache`.
     """
@@ -160,8 +171,9 @@ def update_slot_cache(
             "update_decode_cache on a batch-1 cache (tree_scatter_rows)"
         )
     if page_size:
-        pool_k, pool_v, pos, table = _write_slot_pool(
-            module, k, v, positions, page_table, page_size, num_pages
+        pool_k, pool_v, pos, table, scales = _write_slot_pool(
+            module, k, v, positions, page_table, page_size, num_pages,
+            kv_cache_dtype=kv_cache_dtype,
         )
         pages_per_slot = table.shape[-1]
         L = pages_per_slot * page_size
@@ -169,11 +181,25 @@ def update_slot_cache(
         # attention as the contiguous layout — pool order never leaks. This
         # materialized gather is the HBM cost `slot_cache_attention`'s
         # "pallas_paged" path exists to remove; it stays as the parity oracle.
-        k_full = jnp.take(pool_k, table, axis=0).reshape(b, L, h, d)
-        v_full = jnp.take(pool_v, table, axis=0).reshape(b, L, h, d)
+        k_pages = jnp.take(pool_k, table, axis=0)  # [B, P, ps, h, d]
+        v_pages = jnp.take(pool_v, table, axis=0)
+        if scales is not None:
+            # Dequantize-on-read: scale[table] broadcasts per page per head.
+            from .quantization import dequantize_kv_pages
+
+            k_scale, v_scale = scales
+            k_pages = dequantize_kv_pages(k_pages, jnp.take(k_scale, table, axis=0), k.dtype)
+            v_pages = dequantize_kv_pages(v_pages, jnp.take(v_scale, table, axis=0), v.dtype)
+        k_full = k_pages.reshape(b, L, h, d)
+        v_full = v_pages.reshape(b, L, h, d)
         cols = jnp.arange(L)[None, None, :]
         decode_mask = (cols <= pos[:, :, None])[:, None, :, :]  # [B, 1, s, L]
         return k_full, v_full, decode_mask
+    if kv_cache_dtype != "bf16":
+        raise ValueError(
+            f"kv_cache_dtype={kv_cache_dtype!r} requires the paged slot cache "
+            "(page_size > 0); the contiguous layout has no page-scale pool"
+        )
     L = cache_length
     cached_k = module.variable("cache", "cached_key", jnp.zeros, (b, L, h, d), k.dtype)
     cached_v = module.variable("cache", "cached_value", jnp.zeros, (b, L, h, d), v.dtype)
@@ -186,38 +212,58 @@ def update_slot_cache(
     return cached_k.value, cached_v.value, decode_mask
 
 
-def _write_slot_pool(module, k, v, positions, page_table, page_size: int, num_pages: int):
+def _write_slot_pool(
+    module, k, v, positions, page_table, page_size: int, num_pages: int,
+    kv_cache_dtype: str = "bf16",
+):
     """The paged slot cache's WRITE half: scatter this dispatch's [B, s] K/V
     into the page pool through the slot page tables, and return the updated
-    pools plus the clipped positions/table. Shared by the XLA gather path
-    (`update_slot_cache`) and the fused kernel path (`slot_cache_attention`)
-    so the two implementations can never disagree about where K/V lives."""
+    pools plus the clipped positions/table and (quantized pools only) the
+    `(key_scale, value_scale)` parallel scale pools. Shared by the XLA gather
+    path (`update_slot_cache`) and the fused kernel path
+    (`slot_cache_attention`) so the two implementations can never disagree
+    about where K/V lives — or what scale it was stored under."""
     import jax.numpy as jnp
+
+    from .quantization import kv_quant_spec, quantized_pool_write
 
     if page_table is None:
         raise ValueError("paged slot cache needs a [B, pages_per_slot] page_table operand")
     b, s, h, d = k.shape
     pages_per_slot = page_table.shape[-1]
     L = pages_per_slot * page_size
+    spec = kv_quant_spec(kv_cache_dtype)
+    pool_dtype = k.dtype if spec is None else spec[0]
     pool_k = module.variable(
-        "cache", "cached_key", jnp.zeros, (num_pages, page_size, h, d), k.dtype
+        "cache", "cached_key", jnp.zeros, (num_pages, page_size, h, d), pool_dtype
     )
     pool_v = module.variable(
-        "cache", "cached_value", jnp.zeros, (num_pages, page_size, h, d), v.dtype
+        "cache", "cached_value", jnp.zeros, (num_pages, page_size, h, d), pool_dtype
     )
     pos = jnp.clip(positions, 0, L - 1).astype(jnp.int32)  # [B, s]
     table = jnp.asarray(page_table, jnp.int32)
     page_slot = jnp.clip(pos // page_size, 0, pages_per_slot - 1)
     pid = jnp.take_along_axis(table, page_slot, axis=1)  # [B, s]
     off = pos % page_size
-    pool_k.value = pool_k.value.at[pid, off].set(k)
-    pool_v.value = pool_v.value.at[pid, off].set(v)
-    return pool_k.value, pool_v.value, pos, table
+    if spec is None:
+        pool_k.value = pool_k.value.at[pid, off].set(k)
+        pool_v.value = pool_v.value.at[pid, off].set(v)
+        return pool_k.value, pool_v.value, pos, table, None
+    k_scale = module.variable("cache", "key_scale", jnp.zeros, (num_pages, h), jnp.float32)
+    v_scale = module.variable("cache", "value_scale", jnp.zeros, (num_pages, h), jnp.float32)
+    pool_k.value, k_scale.value = quantized_pool_write(
+        pool_k.value, k_scale.value, k, pid, off, spec
+    )
+    pool_v.value, v_scale.value = quantized_pool_write(
+        pool_v.value, v_scale.value, v, pid, off, spec
+    )
+    return pool_k.value, pool_v.value, pos, table, (k_scale.value, v_scale.value)
 
 
 def slot_cache_attention(
     module, q, k, v, cache_length: int, positions, page_table=None,
     page_size: int = 0, num_pages: int = 0, attention_impl: str = "xla",
+    kv_cache_dtype: str = "bf16",
 ):
     """Write this dispatch's K/V into the slot cache AND attend — the fused
     serving-decode seam every slot-cache model family calls (llama, gpt_neox).
@@ -234,6 +280,11 @@ def slot_cache_attention(
         directly and never materialize the gathered cache. Greedy decode is
         token-identical to the oracle (`tests/test_paged_kernel.py`).
 
+    `kv_cache_dtype` "int8"/"fp8_e4m3" stores the pool quantized with
+    per-page-per-head scale pools (see `update_slot_cache`); the kernels
+    receive the scale pools as operands and fuse the dequant into the
+    page-streaming loop, so quantized decode moves int8/fp8 bytes.
+
     Args and cache semantics match `update_slot_cache`; returns the attention
     output [B, s, Hq, D]."""
     global LAST_DISPATCH
@@ -249,16 +300,23 @@ def slot_cache_attention(
             )
         from .paged_attention import paged_decode_attention, paged_verify_attention
 
-        pool_k, pool_v, pos, table = _write_slot_pool(
-            module, k, v, positions, page_table, page_size, num_pages
+        pool_k, pool_v, pos, table, scales = _write_slot_pool(
+            module, k, v, positions, page_table, page_size, num_pages,
+            kv_cache_dtype=kv_cache_dtype,
         )
+        k_scale, v_scale = scales if scales is not None else (None, None)
         LAST_DISPATCH = "pallas_paged"
         if q.shape[1] == 1:
-            return paged_decode_attention(q, pool_k, pool_v, table, pos)
-        return paged_verify_attention(q, pool_k, pool_v, table, pos)
+            return paged_decode_attention(
+                q, pool_k, pool_v, table, pos, k_scale=k_scale, v_scale=v_scale
+            )
+        return paged_verify_attention(
+            q, pool_k, pool_v, table, pos, k_scale=k_scale, v_scale=v_scale
+        )
     k_all, v_all, decode_mask = update_slot_cache(
         module, k, v, cache_length, positions,
         page_table=page_table, page_size=page_size, num_pages=num_pages,
+        kv_cache_dtype=kv_cache_dtype,
     )
     return dot_product_attention(q, k_all, v_all, mask=decode_mask, causal=False)
 

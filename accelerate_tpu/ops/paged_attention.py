@@ -32,6 +32,14 @@ handled by grouping the ``G = Hq // Hkv`` query heads of each KV head into the
 kernel's row axis (the pool is shared per KV head; repeating it like the XLA
 path does would multiply the very HBM traffic this kernel exists to remove).
 
+QUANTIZED pools (``k_scale``/``v_scale`` operands, `ops/quantization.py`):
+int8/fp8 pages stream through the same BlockSpec walk at 1 byte/value, their
+per-page-per-head scales ride (1, 1) SMEM blocks picked by the SAME
+``tbl[b, p]`` index map, and the dequant is one fused multiply on the
+VMEM-resident block before the score dot — the cache crosses HBM quantized,
+fp32 exists only inside the accumulator. Token-identical to the XLA
+dequantize-on-read oracle (`tests/test_quantization.py`).
+
 Interpret mode (`interpret=None` auto-enables off-TPU) runs the same kernels
 on CPU for the tier-1 parity sweeps (`tests/test_paged_kernel.py`), the
 `ring_attention.py` testing pattern. All accumulation is fp32.
@@ -57,12 +65,23 @@ from .flash_common import (
 
 
 def _decode_kernel(
-    tbl_ref, q_ref, k_ref, v_ref, pos_ref, len_ref, o_ref, acc, m_scr, l_scr,
-    *, scale, page_size,
+    tbl_ref, q_ref, k_ref, v_ref, *rest,
+    scale, page_size, quantized,
 ):
     """Single-query paged decode: one [G, D] query group per (batch, kv head),
-    streaming that row's pages through the online-softmax accumulator."""
+    streaming that row's pages through the online-softmax accumulator.
+
+    Quantized pools (`quantized=True`) thread two extra refs — the page's
+    per-head K/V scales ((1, 1) SMEM scalars picked by the same
+    ``tbl[b, p]`` index map that streams the page) — and the dequant is one
+    fused multiply on the VMEM-resident block: the page crosses HBM at
+    int8/fp8 width, fp32 exists only inside the accumulator."""
     from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, pos_ref, len_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        pos_ref, len_ref, o_ref, acc, m_scr, l_scr = rest
 
     pi = pl.program_id(2)
     n_pages = pl.num_programs(2)
@@ -79,6 +98,9 @@ def _decode_kernel(
         q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
         k = k_ref[0, :, 0, :].astype(jnp.float32)  # [page_size, D]
         v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [G, page_size]
@@ -93,15 +115,22 @@ def _decode_kernel(
 
 
 def _verify_kernel(
-    tbl_ref, q_ref, k_ref, v_ref, pos_ref, len_ref, o_ref, acc, m_scr, l_scr,
-    *, scale, page_size, s_block, gsize,
+    tbl_ref, q_ref, k_ref, v_ref, *rest,
+    scale, page_size, s_block, gsize, quantized,
 ):
     """Block-verify paged attention: the [B, s] multi-token twin. Rows are the
     s*G (query position, GQA group) pairs of one (batch, kv head); query j
     attends ``cols <= positions[b, j]`` — the accepted prefix plus the block
     tokens at or before it, exactly the per-query mask of the XLA verify
-    path, so the speculative accept loop sees identical greedy tokens."""
+    path, so the speculative accept loop sees identical greedy tokens.
+    Quantized pools dequant the streamed page in VMEM exactly like
+    `_decode_kernel`."""
     from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, pos_ref, len_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        pos_ref, len_ref, o_ref, acc, m_scr, l_scr = rest
 
     pi = pl.program_id(2)
     n_pages = pl.num_programs(2)
@@ -118,6 +147,9 @@ def _verify_kernel(
         q = q_ref[0, 0].astype(jnp.float32)  # [s*G, D]
         k = k_ref[0, :, 0, :].astype(jnp.float32)  # [page_size, D]
         v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [s*G, page_size]
@@ -133,8 +165,13 @@ def _verify_kernel(
         o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
-def _paged_call(q, k_pool, v_pool, page_table, positions, scale, interpret, kernel_for):
-    """Shared wrapper: layout transforms, prefetch grid spec, pallas_call."""
+def _paged_call(
+    q, k_pool, v_pool, page_table, positions, scale, interpret, kernel_for,
+    k_scale=None, v_scale=None,
+):
+    """Shared wrapper: layout transforms, prefetch grid spec, pallas_call.
+    `k_scale`/`v_scale` ([num_pages, Hkv] f32 traced operands, never Python
+    scalars — TPU117) switch the kernels into fused-dequant mode."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -142,6 +179,16 @@ def _paged_call(q, k_pool, v_pool, page_table, positions, scale, interpret, kern
     n_pages_pool, page_size, hkv, _ = k_pool.shape
     if hq % hkv:
         raise ValueError(f"GQA requires Hq % Hkv == 0, got {hq}, {hkv}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("quantized pools need BOTH k_scale and v_scale (or neither)")
+    quantized = k_scale is not None
+    if quantized:
+        for name, sc in (("k_scale", k_scale), ("v_scale", v_scale)):
+            if sc.shape != (n_pages_pool, hkv):
+                raise ValueError(
+                    f"per-page-per-head {name} must be [num_pages, Hkv] = "
+                    f"{(n_pages_pool, hkv)}, got {sc.shape}"
+                )
     gsize = hq // hkv
     rows = s * gsize
     pages_per_slot = page_table.shape[-1]
@@ -158,7 +205,10 @@ def _paged_call(q, k_pool, v_pool, page_table, positions, scale, interpret, kern
     # Scalar page-skip bound per row, SMEM-friendly [B, 1].
     lengths = (jnp.max(pos, axis=1, keepdims=True) + 1).astype(jnp.int32)
 
-    kernel = kernel_for(scale=float(scale), page_size=page_size, s_block=s, gsize=gsize)
+    kernel = kernel_for(
+        scale=float(scale), page_size=page_size, s_block=s, gsize=gsize,
+        quantized=quantized,
+    )
     in_specs = [
         pl.BlockSpec((1, 1, rows, d), lambda bi, hi, pi, tbl: (bi, hi, 0, 0)),  # q
         # THE fused page-table gather: grid step (b, h, p) streams pool page
@@ -167,9 +217,21 @@ def _paged_call(q, k_pool, v_pool, page_table, positions, scale, interpret, kern
         # the Pallas pipeline fetches once, not P times.
         pl.BlockSpec((1, page_size, 1, d), lambda bi, hi, pi, tbl: (tbl[bi, pi], 0, hi, 0)),
         pl.BlockSpec((1, page_size, 1, d), lambda bi, hi, pi, tbl: (tbl[bi, pi], 0, hi, 0)),
+    ]
+    operands = [qt, k_pool, v_pool]
+    if quantized:
+        # The streamed page's per-head scales ride the SAME tbl[b, p] walk as
+        # the page itself — the dequant is fused, not a second gather.
+        scale_spec = pl.BlockSpec(
+            (1, 1), lambda bi, hi, pi, tbl: (tbl[bi, pi], hi), memory_space=pltpu.SMEM
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+    in_specs += [
         pl.BlockSpec((1, s), lambda bi, hi, pi, tbl: (bi, 0)),  # per-query limits
         pl.BlockSpec((1, 1), lambda bi, hi, pi, tbl: (bi, 0), memory_space=pltpu.SMEM),
     ]
+    operands += [pos, lengths]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, hkv, pages_per_slot),
@@ -186,7 +248,7 @@ def _paged_call(q, k_pool, v_pool, page_table, positions, scale, interpret, kern
         out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(table, qt, k_pool, v_pool, pos, lengths)
+    )(table, *operands)
     return (
         out.reshape(b, hkv, s, gsize, d).transpose(0, 2, 1, 3, 4).reshape(b, s, hq, d)
     )
@@ -199,7 +261,8 @@ def _auto_interpret(interpret: Optional[bool]) -> bool:
 
 
 def paged_decode_attention(
-    q, k_pool, v_pool, page_table, positions, *, scale=None, interpret=None
+    q, k_pool, v_pool, page_table, positions, *, scale=None, interpret=None,
+    k_scale=None, v_scale=None,
 ):
     """Single-query paged decode attention over a pool-resident KV cache.
 
@@ -213,8 +276,13 @@ def paged_decode_attention(
         positions: [B, 1] (or [B]) int32 — row i attends ``cols <= positions[i]``.
         scale: defaults to 1/sqrt(D).
         interpret: None = auto (Pallas interpreter off-TPU, compiled on TPU).
+        k_scale / v_scale: [num_pages, Hkv] f32 per-page-per-head scale pools
+            for int8/fp8 page pools (traced operands, never Python scalars —
+            TPU117); the dequant fuses into the page-streaming loop. Both or
+            neither.
 
-    Returns [B, 1, Hq, D], token-identical to the XLA gather oracle.
+    Returns [B, 1, Hq, D], token-identical to the XLA gather oracle
+    (dequantize-on-read for quantized pools).
     """
     b = q.shape[0]
     if q.ndim != 4 or q.shape[1] != 1:
@@ -223,16 +291,20 @@ def paged_decode_attention(
         scale = 1.0 / np.sqrt(q.shape[-1])
     pos = jnp.asarray(positions, jnp.int32).reshape(b, 1)
 
-    def kernel_for(scale, page_size, s_block, gsize):
-        return functools.partial(_decode_kernel, scale=scale, page_size=page_size)
+    def kernel_for(scale, page_size, s_block, gsize, quantized):
+        return functools.partial(
+            _decode_kernel, scale=scale, page_size=page_size, quantized=quantized
+        )
 
     return _paged_call(
-        q, k_pool, v_pool, page_table, pos, scale, _auto_interpret(interpret), kernel_for
+        q, k_pool, v_pool, page_table, pos, scale, _auto_interpret(interpret), kernel_for,
+        k_scale=k_scale, v_scale=v_scale,
     )
 
 
 def paged_verify_attention(
-    q, k_pool, v_pool, page_table, positions, *, scale=None, interpret=None
+    q, k_pool, v_pool, page_table, positions, *, scale=None, interpret=None,
+    k_scale=None, v_scale=None,
 ):
     """Block-verify paged attention: the [B, s] multi-token variant used by
     speculative decoding's verify step (s = draft_tokens + 1).
@@ -244,6 +316,7 @@ def paged_verify_attention(
         positions: [B, s] int32 — query j of row i attends
             ``cols <= positions[i, j]`` (its accepted prefix plus the block
             tokens at or before it, all written by this same dispatch).
+        k_scale / v_scale: as `paged_decode_attention` (quantized pools).
 
     Returns [B, s, Hq, D].
     """
@@ -252,11 +325,13 @@ def paged_verify_attention(
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
 
-    def kernel_for(scale, page_size, s_block, gsize):
+    def kernel_for(scale, page_size, s_block, gsize, quantized):
         return functools.partial(
-            _verify_kernel, scale=scale, page_size=page_size, s_block=s_block, gsize=gsize
+            _verify_kernel, scale=scale, page_size=page_size, s_block=s_block,
+            gsize=gsize, quantized=quantized,
         )
 
     return _paged_call(
-        q, k_pool, v_pool, page_table, positions, scale, _auto_interpret(interpret), kernel_for
+        q, k_pool, v_pool, page_table, positions, scale, _auto_interpret(interpret), kernel_for,
+        k_scale=k_scale, v_scale=v_scale,
     )

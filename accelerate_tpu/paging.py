@@ -35,12 +35,19 @@ between dispatches and must never touch the device.
 from __future__ import annotations
 
 import hashlib
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 #: Pool page 0 — never allocated; absorbs writes the engine wants discarded.
 SCRATCH_PAGE = 0
+
+#: KV page storage dtypes the device pool supports ("bf16" = unquantized, the
+#: model compute dtype; mirrors ops/quantization.KV_CACHE_DTYPES without the
+#: jax import — this module stays pure host Python). Bytes-per-value is never
+#: tabulated here: the live pool leaf's itemsize
+#: (`ContinuousBatcher.kv_pool_itemsize`) is the one source of truth.
+KV_CACHE_DTYPES = ("bf16", "int8", "fp8_e4m3")
 
 
 def pages_for(num_tokens: int, page_size: int) -> int:
@@ -89,13 +96,22 @@ class PagePool:
         num_pages: int,
         page_size: int,
         on_evict: Optional[Callable[[int], None]] = None,
+        kv_cache_dtype: str = "bf16",
     ):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the reserved scratch page)")
         if page_size < 1:
             raise ValueError("page_size must be >= 1")
+        if kv_cache_dtype not in KV_CACHE_DTYPES:
+            raise ValueError(
+                f"unknown kv_cache_dtype {kv_cache_dtype!r}; expected one of {KV_CACHE_DTYPES}"
+            )
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
+        #: Device-pool storage dtype this allocator fronts. Pure bookkeeping
+        #: host-side (allocation is dtype-blind), but carried here so capacity
+        #: math / stats / the bench derive bytes from ONE source of truth.
+        self.kv_cache_dtype = str(kv_cache_dtype)
         self.on_evict = on_evict
         self.evictions = 0
         self._init_state()
@@ -256,8 +272,9 @@ class PagePool:
         (`evictions`) survive; they are telemetry, not state."""
         self._init_state()
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, Any]:
         return {
+            "kv_cache_dtype": self.kv_cache_dtype,
             "pages_total": self.pages_total,
             "pages_in_use": self.pages_in_use,
             "pages_free": self.pages_free,

@@ -285,6 +285,15 @@ class ReplicaSet:
             engine = ContinuousBatcher(self.model, tracer=self.tracer, **self.engine_kwargs)
         if self.current_params is not None:
             engine.params = self.current_params
+        # Share ONE params tree across the fleet: a weight_dtype="int8"
+        # engine's setter quantizes, and without this rebind every replica
+        # would quantize the same raw tree into its OWN int8+scale copy
+        # (N x the weight HBM). Adopting the first engine's (possibly
+        # quantized) tree makes later setter calls pass-throughs — the
+        # setter is idempotent. Subprocess engines keep params worker-side
+        # (their getter returns None), so the controller copy stays as-is.
+        if getattr(engine, "params", None) is not None:
+            self.current_params = engine.params
         for hook in self.on_engine_built:
             hook(index, engine)
         return engine
@@ -1124,7 +1133,13 @@ class Router:
         `wait=True` (default) drives `step()` until the swap completes and
         returns the stream events those steps produced (nothing is dropped);
         `wait=False` just arms the swap — the caller's own `step()` loop
-        advances it."""
+        advances it.
+
+        Pass RAW (unquantized) params for any fleet: engines built with
+        `weight_dtype="int8"` (riding `engine_kwargs`) re-quantize in their
+        `params` setter — per-output-channel scales are recomputed at swap
+        time, exactly as at load time (subprocess workers do the same in
+        their `set_params` op after the file handoff)."""
         if self._closed:
             raise EngineClosed("router is closed")
         if self._swap is not None:
@@ -1170,6 +1185,12 @@ class Router:
             return self._advance_swap()
         if not replica.engine.pending:
             replica.engine.params = swap["params"]
+            # One quantize per swap, not per replica: adopt the first
+            # swapped engine's (possibly quantized) tree so the remaining
+            # replicas' setters share it by reference (idempotent setter;
+            # subprocess engines expose no params and keep the raw tree).
+            if getattr(replica.engine, "params", None) is not None:
+                swap["params"] = replica.engine.params
             self.replica_set.set_state(replica, "live", "weights swapped")
             self.tracer.event(
                 "router.replica_swapped", category="router", replica=replica.index

@@ -105,7 +105,12 @@ from .speculative import (
 )
 from .telemetry import MetricsRegistry
 from .telemetry.tracing import default_tracer
-from .utils.operations import tree_gather_pages, tree_scatter_pages, tree_scatter_rows
+from .utils.operations import (
+    tree_gather_pages,
+    tree_scatter_pages,
+    tree_scatter_rows,
+    tree_zero_cache_tail,
+)
 
 logger = get_logger(__name__)
 
@@ -205,6 +210,8 @@ class ContinuousBatcher:
         draft_tokens: int = DEFAULT_DRAFT_TOKENS,
         draft_ngram: int = DEFAULT_DRAFT_NGRAM,
         attention_impl: str = "xla",
+        weight_dtype: str = "bf16",
+        kv_cache_dtype: str = "bf16",
     ):
         if getattr(model, "module", None) is None or not hasattr(model.module, "config"):
             raise ValueError("ContinuousBatcher needs a Model bundle built from an in-tree flax module")
@@ -221,6 +228,29 @@ class ContinuousBatcher:
                 "pass paged=False for the contiguous per-slot layout"
             )
         self.base_config = base
+        # Quantized serving (ops/quantization.py): `weight_dtype="int8"`
+        # quantizes the params ONCE at load/swap time (the `params` setter
+        # below) and routes every Dense through the int8-epilogue matmul;
+        # `kv_cache_dtype` picks the paged pool's storage dtype, with
+        # per-page-per-head scales riding the cache collection as traced
+        # operands. Both are static config — dtypes never retrace.
+        from .ops.quantization import KV_CACHE_DTYPES, WEIGHT_DTYPES
+
+        self.weight_dtype = str(weight_dtype)
+        if self.weight_dtype not in WEIGHT_DTYPES:
+            raise ValueError(
+                f"unknown weight_dtype {weight_dtype!r}; expected one of {WEIGHT_DTYPES}"
+            )
+        self.kv_cache_dtype = str(kv_cache_dtype)
+        if self.kv_cache_dtype not in KV_CACHE_DTYPES:
+            raise ValueError(
+                f"unknown kv_cache_dtype {kv_cache_dtype!r}; expected one of {KV_CACHE_DTYPES}"
+            )
+        if self.kv_cache_dtype != "bf16" and not paged:
+            raise ValueError(
+                "a quantized KV cache requires the paged layout (paged=True): "
+                "the per-page-per-head scale pools have no contiguous twin"
+            )
         self.params = model.params if "params" in model.params else {"params": model.params}
         self.num_slots = int(num_slots)
         self.max_length = int(max_length or base.max_position_embeddings)
@@ -305,16 +335,33 @@ class ContinuousBatcher:
         # logical cache capacity so the prefilled rows line up for the scatter —
         # into slot rows (contiguous) or pool pages (paged).
         cache_len = self._padded_length
-        prefill_cfg = dataclasses.replace(base, decode_cache_length=cache_len)
+        quant_cfg = {}
+        if self.weight_dtype != "bf16":
+            if not hasattr(base, "weight_dtype"):
+                raise ValueError(
+                    f"{type(model.module).__name__}'s config has no `weight_dtype` "
+                    "field — this model family doesn't support int8 weight-only "
+                    "serving yet"
+                )
+            quant_cfg["weight_dtype"] = self.weight_dtype
+        prefill_cfg = dataclasses.replace(base, decode_cache_length=cache_len, **quant_cfg)
         if self.paged:
+            if self.kv_cache_dtype != "bf16":
+                if not hasattr(base, "decode_kv_cache_dtype"):
+                    raise ValueError(
+                        f"{type(model.module).__name__}'s config has no "
+                        "`decode_kv_cache_dtype` field — this model family doesn't "
+                        "support the quantized KV page pool yet"
+                    )
+                quant_cfg["decode_kv_cache_dtype"] = self.kv_cache_dtype
             step_cfg = dataclasses.replace(
                 base, decode_cache_length=cache_len, decode_slot_cache=True,
                 decode_page_size=self.page_size, decode_num_pages=self.num_pages,
-                decode_attention_impl=self.attention_impl,
+                decode_attention_impl=self.attention_impl, **quant_cfg,
             )
         else:
             step_cfg = dataclasses.replace(
-                base, decode_cache_length=cache_len, decode_slot_cache=True
+                base, decode_cache_length=cache_len, decode_slot_cache=True, **quant_cfg
             )
         prefill_module = type(model.module)(prefill_cfg)
         step_module = type(model.module)(step_cfg)
@@ -327,13 +374,18 @@ class ContinuousBatcher:
         if self.paged:
             self._cached_prefill_raw = make_cached_prefill_program(prefill_module, resolve)
             # The dense batch-1 cache STRUCTURE the paged insert materializes by
-            # gathering pool pages (zero compute/compile: eval_shape only).
+            # gathering pool pages (zero compute/compile: eval_shape only). The
+            # weight_autocast wrap matters even for eval_shape: int8 engines
+            # hold quantized kernel entries the raw Dense can't consume.
+            from .ops.quantization import weight_autocast
+
             dummy = jnp.zeros((1, 1), jnp.int32)
             dpos = jnp.zeros((1, 1), jnp.int32)
-            self._dense_cache_struct = jax.eval_shape(
-                lambda p: prefill_module.apply(resolve(p), dummy, None, dpos, mutable=["cache"])[1]["cache"],
-                self.params,
-            )
+            with weight_autocast(self.weight_dtype):
+                self._dense_cache_struct = jax.eval_shape(
+                    lambda p: prefill_module.apply(resolve(p), dummy, None, dpos, mutable=["cache"])[1]["cache"],
+                    self.params,
+                )
 
         self._sample_config = GenerationConfig(do_sample=do_sample, top_k=top_k, top_p=top_p)
         # Python-side effects run at TRACE time: these count compiles, and the
@@ -475,6 +527,7 @@ class ContinuousBatcher:
             self.pool = PagePool(
                 self.num_pages, self.page_size,
                 on_evict=self._m_prefix_evictions.inc,
+                kv_cache_dtype=self.kv_cache_dtype,
             )
             self._m_pages_total.set(self.pool.pages_total)
 
@@ -507,22 +560,45 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------------ programs
 
+    @property
+    def params(self):
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        """The weight-load seam: construction, the router's rolling
+        `swap_weights`, the ReplicaSet rebuild path, and the worker's
+        `set_params` op all assign here. int8 engines quantize per-output-
+        channel scales ONCE per assignment (`quantize_params_int8` —
+        idempotent, so an already-quantized tree passes through), which is
+        exactly the "scales computed at weight-load/swap time" contract: the
+        compiled programs only ever see int8 kernels + scale operands."""
+        if self.weight_dtype == "int8":
+            from .ops.quantization import quantize_params_int8
+
+            value = quantize_params_int8(value)
+        self._params = value
+
     def _init_cache(self):
         """Create the slot cache — dense [num_slots, max_length] rows, or the
-        [num_pages, page_size] pool when paged: `eval_shape` the slot-mode
+        [num_pages, page_size] pool when paged (quantized dtypes add the
+        per-page-per-head scale pools): `eval_shape` the slot-mode
         module's cache variables (zero compute, zero compile — no throwaway
         executable at engine construction) and materialize them as zeros.
         Correct because every slot's rows/pages are overwritten by insert
         before they're ever attended."""
+        from .ops.quantization import weight_autocast
+
         S = self.num_slots
         module, resolve = self._step_module, self._resolve
         dummy = jnp.zeros((S, 1), jnp.int32)
         pos = jnp.zeros((S, 1), jnp.int32)
         mask = jnp.zeros((S, self.pages_per_slot), jnp.int32) if self.paged else None
-        shapes = jax.eval_shape(
-            lambda p: module.apply(resolve(p), dummy, mask, pos, mutable=["cache"])[1]["cache"],
-            self.params,
-        )
+        with weight_autocast(self.weight_dtype):
+            shapes = jax.eval_shape(
+                lambda p: module.apply(resolve(p), dummy, mask, pos, mutable=["cache"])[1]["cache"],
+                self.params,
+            )
         return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
     @staticmethod
@@ -681,6 +757,11 @@ class ContinuousBatcher:
             dense = tree_gather_pages(pool_cache, dense_struct, page_row, matched_len)
             positions = matched_len + jnp.broadcast_to(jnp.arange(bucket)[None, :], (1, bucket))
             logits, dense = cached_prefill(params, dense, suffix_ids, positions)
+            # Zero rows past the prompt before the write-back: the gather
+            # resurrects a recycled page's stale content (never attended, but
+            # a QUANTIZED scatter folds it into the boundary page's amax
+            # scale, coarsening the real rows; tree_zero_cache_tail).
+            dense = tree_zero_cache_tail(dense, matched_len + real_len)
             write_row = jnp.where(
                 jnp.arange(P) < matched_pages, jnp.int32(SCRATCH_PAGE), page_row
             )
@@ -902,12 +983,34 @@ class ContinuousBatcher:
         return self._closed
 
     @property
+    def kv_pool_itemsize(self) -> int:
+        """Stored bytes per cached K/V VALUE in the live cache (pool leaf
+        itemsize) — the honest dtype figure for HBM-traffic estimates, which
+        used to be (wrongly, under quantization) read off the params dtype."""
+        for path, leaf in jax.tree_util.tree_flatten_with_path(self._cache)[0]:
+            name = str(getattr(path[-1], "key", getattr(path[-1], "name", path[-1])))
+            if name == "cached_key":
+                return int(np.dtype(leaf.dtype).itemsize)
+        return int(np.dtype(np.float32).itemsize)
+
+    @property
+    def kv_cache_nbytes(self) -> int:
+        """Actual stored bytes of the whole slot cache (pools + scale pools
+        for quantized dtypes) — the capacity half of the quantization story."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self._cache):
+            total += int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
+        return total
+
+    @property
     def stats(self) -> Dict[str, Any]:
         """Back-compat health view, computed from the metrics registry (the
         source of truth since the telemetry PR). Same keys and meanings as the
         old ad-hoc dict; mutate nothing here — it is rebuilt per access."""
         view: Dict[str, Any] = {
             "attention_impl": self.attention_impl,
+            "weight_dtype": self.weight_dtype,
+            "kv_cache_dtype": self.kv_cache_dtype,
             "inserts": int(self._m_inserts.value),
             "chunks": int(self._m_chunks.value),
             "decode_steps": int(self._m_decode_steps.value),

@@ -521,6 +521,15 @@ def tree_gather_rows(tree, index):
 # layers ([layers, num_pages, page_size, h, d]).
 _PAGE_AXIS_FROM_BACK = {"cached_key": 4, "cached_value": 4}
 
+# Per-page-per-head scale pools of a QUANTIZED paged cache
+# (ops/quantization.py): [..., num_pages, heads] f32, page axis 2 from the
+# back. `_SCALE_OF` maps a K/V pool leaf to its sibling scale leaf; the
+# gather/scatter below dequantize/quantize through it so the insert path and
+# the decode write path can never disagree about a page's scale.
+_SCALE_AXIS_FROM_BACK = {"key_scale": 2, "value_scale": 2}
+_SCALE_OF = {"cached_key": "key_scale", "cached_value": "value_scale"}
+_KV_OF = {v: k for k, v in _SCALE_OF.items()}
+
 
 def _path_names(path):
     return tuple(_key_name(p) for p in path)
@@ -561,6 +570,18 @@ def tree_gather_pages(pool, dense_struct, page_ids, cache_index):
             raise ValueError(f"pool cache has no leaf at {'/'.join(names)}")
         axis = leaf.ndim - axis_back
         pages = jnp.take(leaf, jnp.asarray(page_ids, jnp.int32), axis=axis)
+        scale_leaf = pool_leaves.get(names[:-1] + (_SCALE_OF.get(names[-1], ""),))
+        if scale_leaf is not None:
+            # Quantized pool: dequantize the gathered pages with their
+            # per-page-per-head scales so the dense prefill sees real values.
+            scale_axis = scale_leaf.ndim - _SCALE_AXIS_FROM_BACK[_SCALE_OF[names[-1]]]
+            pages_scale = jnp.take(
+                scale_leaf, jnp.asarray(page_ids, jnp.int32), axis=scale_axis
+            )
+            # Insert the page_size axis after the page axis and the head_dim
+            # axis at the end, then broadcast-multiply in fp32.
+            scale_b = jnp.expand_dims(pages_scale, axis + 1)[..., None]
+            pages = pages.astype(jnp.float32) * scale_b
         merged = pages.reshape(
             pages.shape[:axis]
             + (pages.shape[axis] * pages.shape[axis + 1],)
@@ -578,6 +599,35 @@ def tree_gather_pages(pool, dense_struct, page_ids, cache_index):
     return jax.tree_util.tree_map_with_path(_build, dense_struct)
 
 
+def tree_zero_cache_tail(dense, valid_len):
+    """Zero every `cached_key`/`cached_value` row of a dense cache at
+    positions >= `valid_len` (a traced scalar). The paged insert runs this
+    before `tree_scatter_pages`: the gathered dense cache carries STALE
+    dequantized content from each private page's previous occupant beyond the
+    prompt, and while the position mask keeps it unattended, a QUANTIZED
+    scatter would fold it into the boundary page's amax scale — a prior
+    occupant with larger K/V magnitudes would silently coarsen the new
+    request's real rows past the half-step round-trip bound (and decode's
+    scatter-max would keep the inflated scale alive). Zeros contribute
+    nothing to amax, restoring the bound; on unquantized pools this is pure
+    hygiene."""
+    import jax
+    import jax.numpy as jnp
+
+    def _zero(path, leaf):
+        name = _leaf_name(path)
+        if name not in _PAGE_AXIS_FROM_BACK:  # cached_key / cached_value only
+            return leaf
+        seq_axis = leaf.ndim - 3  # [..., batch, L, heads, head_dim]
+        cols = jnp.arange(leaf.shape[seq_axis])
+        keep = (cols < jnp.asarray(valid_len, jnp.int32)).reshape(
+            (leaf.shape[seq_axis],) + (1,) * (leaf.ndim - seq_axis - 1)
+        )
+        return jnp.where(keep, leaf, jnp.zeros((), leaf.dtype))
+
+    return jax.tree_util.tree_map_with_path(_zero, dense)
+
+
 def tree_scatter_pages(pool, dense, page_ids):
     """Write a batch-1 dense cache back into pool pages (the inverse of
     `tree_gather_pages`): every `cached_key`/`cached_value` leaf is split into
@@ -588,29 +638,71 @@ def tree_scatter_pages(pool, dense, page_ids):
     Callers that must not rewrite shared read-only prefix pages redirect those
     entries of `page_ids` to the reserved scratch page before calling (the
     serving engine's insert does exactly that), so a registered prefix page is
-    written exactly once — at creation — for its whole lifetime."""
+    written exactly once — at creation — for its whole lifetime.
+
+    QUANTIZED pools (int8/fp8 K/V leaves with sibling `key_scale`/
+    `value_scale` pool arrays): the dense float blocks are quantized whole-page
+    (per-page-per-head amax scales, `ops.quantization.quantize_kv_pages`) and
+    the scale leaves are scattered at the same `page_ids` — so an
+    insert-written page round-trips within half a quantization step and the
+    decode write path (`quantized_pool_write`) can grow its scale from there."""
     import jax
     import jax.numpy as jnp
+
+    from ..ops.quantization import kv_spec_for_dtype, quantize_kv_pages
 
     dense_leaves = {
         _path_names(path): leaf
         for path, leaf in jax.tree_util.tree_flatten_with_path(dense)[0]
     }
+    pool_leaves = {
+        _path_names(path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(pool)[0]
+    }
     ids = jnp.asarray(page_ids, jnp.int32)
+
+    def _kv_blocks_front(names, kv_leaf):
+        """Dense K/V leaf -> page blocks with the page axis at the FRONT
+        ([P, ..., page_size, h, head_dim]), or None when absent in `dense`."""
+        d = dense_leaves.get(names)
+        if d is None:
+            return None
+        axis = kv_leaf.ndim - _PAGE_AXIS_FROM_BACK[names[-1]]
+        d = jnp.squeeze(d, axis=axis)  # drop the batch-1 slot axis
+        page_size = kv_leaf.shape[axis + 1]
+        num = ids.shape[0]
+        blocks = d.reshape(d.shape[:axis] + (num, page_size) + d.shape[axis + 1 :])
+        return jnp.moveaxis(blocks, axis, 0)
 
     def _scatter(path, leaf):
         names = _path_names(path)
-        axis_back = _PAGE_AXIS_FROM_BACK.get(names[-1])
-        d = dense_leaves.get(names)
-        if axis_back is None or d is None:
+        name = names[-1]
+        if name in _SCALE_AXIS_FROM_BACK:
+            # Scale pool leaf: recompute the written pages' per-head scales
+            # from the dense sibling K/V and scatter them alongside.
+            kv_names = names[:-1] + (_KV_OF[name],)
+            kv_leaf = pool_leaves.get(kv_names)
+            spec = kv_spec_for_dtype(kv_leaf.dtype) if kv_leaf is not None else None
+            blocks = _kv_blocks_front(kv_names, kv_leaf) if spec is not None else None
+            if blocks is None:
+                return leaf
+            _, scales = quantize_kv_pages(blocks, spec)
+            axis = leaf.ndim - _SCALE_AXIS_FROM_BACK[name]
+            front = jnp.moveaxis(leaf, axis, 0)
+            return jnp.moveaxis(front.at[ids].set(scales.astype(leaf.dtype)), 0, axis)
+        axis_back = _PAGE_AXIS_FROM_BACK.get(name)
+        if axis_back is None or names not in dense_leaves:
             return leaf
         axis = leaf.ndim - axis_back
-        d = jnp.squeeze(d, axis=axis)  # drop the batch-1 slot axis
-        page_size = leaf.shape[axis + 1]
-        num = ids.shape[0]
-        blocks = d.reshape(d.shape[:axis] + (num, page_size) + d.shape[axis + 1 :])
+        blocks_front = _kv_blocks_front(names, leaf)
+        spec = (
+            kv_spec_for_dtype(leaf.dtype)
+            if names[:-1] + (_SCALE_OF[name],) in pool_leaves
+            else None
+        )
+        if spec is not None:
+            blocks_front, _ = quantize_kv_pages(blocks_front, spec)
         pool_front = jnp.moveaxis(leaf, axis, 0)
-        blocks_front = jnp.moveaxis(blocks, axis, 0)
         out = pool_front.at[ids].set(blocks_front.astype(leaf.dtype))
         return jnp.moveaxis(out, 0, axis)
 

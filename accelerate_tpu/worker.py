@@ -410,6 +410,9 @@ class EngineHost:
                     self.guard.reset()
                 return {"ok": True, "armed": self.guard is not None}
             if op == "set_params":
+                # The file handoff always carries RAW params; a quantized
+                # engine (weight_dtype="int8" via engine_kwargs) re-quantizes
+                # in its params setter — same seam as an in-process swap.
                 self.engine.params = _load_params_on_device(msg["path"])
                 return {"ok": True}
             if op == "close":

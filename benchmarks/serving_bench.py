@@ -92,11 +92,13 @@ def run_static(gen, prompts, budgets, arrivals, num_slots, max_length):
     return useful / max(makespan, 1e-9), ttfts, decode_iterations, makespan
 
 
-def run_continuous(engine, prompts, budgets, arrivals):
+def run_continuous(engine, prompts, budgets, arrivals, collect_tokens=None):
     """The same workload through the slot engine; arrival-gated submission on
     the virtual clock. Returns (tokens_per_sec, ttfts, decode_iterations,
     makespan). Finished requests are `release()`d at the end, so the engine is
-    reusable across warmup and timed passes with the same request ids."""
+    reusable across warmup and timed passes with the same request ids.
+    `collect_tokens` (a dict) captures each request's generated tokens before
+    release — the quant A/B compares token streams across engines with it."""
     from accelerate_tpu.serving import Request
 
     clock = 0.0
@@ -120,6 +122,8 @@ def run_continuous(engine, prompts, budgets, arrivals):
     useful = sum(budgets)
     makespan = clock - float(arrivals[0])
     for i in range(n):
+        if collect_tokens is not None:
+            collect_tokens[i] = [int(t) for t in engine.results[i].tokens]
         engine.release(i)
     return (
         useful / max(makespan, 1e-9),
@@ -154,6 +158,7 @@ def run_router_workload(model, args, cfg, max_length, rng, tracer=None):
         paged=not args.no_paged, page_size=args.page_size, tracer=tracer,
         rejoin_cooldown_s=0.2, probation_steps=1, stall_degrade_s=None,
         attention_impl=args.attention_impl,
+        weight_dtype=args.weight_dtype, kv_cache_dtype=args.kv_cache_dtype,
     )
 
     def run_traffic(kill_fraction=None):
@@ -343,24 +348,37 @@ def run_spec_workload(model, args, cfg, max_length, rng, tracer=None):
     return result
 
 
-def estimate_decode_hbm_bytes(num_slots, pages_per_slot, page_size, model_cfg, dtype_bytes):
+def estimate_decode_hbm_bytes(
+    num_slots, pages_per_slot, page_size, model_cfg, pool_dtype_bytes,
+    compute_dtype_bytes=None,
+):
     """Estimated HBM bytes the attention CACHE READ moves per decode step,
-    derived from pool geometry (worst case: every slot's full page window),
-    per implementation:
+    derived from pool geometry (worst case: every slot's full page window)
+    and PER-PASS dtypes — `pool_dtype_bytes` from the live engine's pool
+    leaves (`engine.kv_pool_itemsize`), never the params dtype, and
+    `compute_dtype_bytes` for the buffers XLA materializes in the compute
+    dtype. Per implementation:
 
-      - ``xla``: `update_slot_cache` gathers the pool into a logical
-        [S, L, hkv, d] K/V buffer — the pool pages are read, the gathered
-        buffer is written, then the masked attention reads it back: ~3 passes
-        over the logical cache, for K and V, every layer.
+      - ``xla``: `update_slot_cache` reads the pool pages (POOL dtype — the
+        only quantized pass), dequantizes into a logical [S, L, hkv, d] K/V
+        buffer it writes, then the masked attention reads that buffer back —
+        the gather write + re-read move COMPUTE-dtype bytes even on a
+        quantized pool, which is exactly why the oracle is the parity path
+        and dequant must fuse into the kernel to bank the bandwidth.
       - ``pallas_paged``: the kernel streams each table page into VMEM once —
-        1 pass, no materialized buffer.
+        1 pass at POOL dtype, no materialized buffer.
 
     An estimate, not a measurement (XLA may fuse or spill differently): its
     job is to size the bandwidth claim a real-hardware run should verify."""
+    if compute_dtype_bytes is None:
+        compute_dtype_bytes = pool_dtype_bytes
     L = pages_per_slot * page_size
     hkv = getattr(model_cfg, "num_key_value_heads", model_cfg.num_attention_heads)
-    logical = num_slots * L * hkv * model_cfg.head_dim * dtype_bytes * 2  # K + V
-    per_layer = {"xla": 3 * logical, "pallas_paged": logical}
+    values = num_slots * L * hkv * model_cfg.head_dim * 2  # K + V
+    per_layer = {
+        "xla": values * (pool_dtype_bytes + 2 * compute_dtype_bytes),
+        "pallas_paged": values * pool_dtype_bytes,
+    }
     return {
         impl: val * model_cfg.num_hidden_layers for impl, val in per_layer.items()
     }
@@ -384,8 +402,6 @@ def run_attention_workload(model, args, cfg, max_length, workload, tracer=None):
     import jax
 
     prompts, budgets, arrivals = workload
-    # The KV pool inherits the params' storage dtype (bf16 on accelerators).
-    dtype_bytes = np.dtype(jax.tree_util.tree_leaves(model.params)[0].dtype).itemsize
     # Off-TPU, pallas_paged runs the Pallas INTERPRETER (the CPU-test shim):
     # parity and the 0-recompile discipline are real, the timing is not — the
     # block records it so a CPU-smoke ratio can never pass as TPU behavior.
@@ -402,7 +418,15 @@ def run_attention_workload(model, args, cfg, max_length, workload, tracer=None):
             model, num_slots=args.num_slots, max_length=max_length,
             chunk_size=args.chunk_size, paged=True, page_size=args.page_size,
             tracer=tracer, max_queue=args.requests, attention_impl=impl,
+            weight_dtype=args.weight_dtype, kv_cache_dtype=args.kv_cache_dtype,
         )
+        # Honest dtype accounting: pool passes at the LIVE pool leaf dtype
+        # (int8/fp8 pools move 1 byte/value), XLA's materialized gather at
+        # the compute dtype — never a single params-derived figure.
+        pool_bytes = engine.kv_pool_itemsize
+        compute_bytes = np.dtype(
+            jax.tree_util.tree_leaves(model.params)[0].dtype
+        ).itemsize
         log(f"attention workload ({impl}): warmup...")
         engine.warm_inserts()
         run_continuous(engine, prompts, budgets, arrivals)
@@ -432,7 +456,8 @@ def run_attention_workload(model, args, cfg, max_length, workload, tracer=None):
         chunks = chunk_hist.count - count0
         chunk_s = (chunk_hist.sum - sum0) / max(chunks, 1)
         hbm = estimate_decode_hbm_bytes(
-            args.num_slots, engine.pages_per_slot, args.page_size, cfg, dtype_bytes
+            args.num_slots, engine.pages_per_slot, args.page_size, cfg,
+            pool_bytes, compute_bytes,
         )
         result[impl] = {
             "dispatch_impl": dispatch_impl,
@@ -454,6 +479,155 @@ def run_attention_workload(model, args, cfg, max_length, workload, tracer=None):
     result["est_hbm_bytes_ratio_xla_over_pallas"] = round(
         result["xla"]["est_hbm_bytes_per_decode_step"]
         / max(result["pallas_paged"]["est_hbm_bytes_per_decode_step"], 1), 3
+    )
+    return result
+
+
+def run_quant_workload(model, args, cfg, max_length, workload, tracer=None):
+    """The quantization A/B: the SAME mixed workload served through
+    otherwise-identical paged engines — bf16 baseline, int8 weights + int8 KV
+    pool, int8 weights + fp8_e4m3 KV pool — each timed pass under the hard
+    0-recompile / 0-host-transfer gate (dtypes are static config, scales are
+    traced operands: quantization must not cost the compiled-once
+    discipline). Per row the block records decode tokens/sec, per-dispatch
+    attention seconds, the ACTUAL pool bytes (`engine.kv_cache_nbytes`,
+    scales included) and weight bytes, the pool-geometry HBM estimate off the
+    live pool dtype, token agreement against the bf16 row's streams, the max
+    logit error of the quantized-weight forward vs dense on a probe batch,
+    and interpreter provenance. Asserts the headline acceptance number: int8
+    KV cuts estimated cache-read bytes >= 2x vs bf16 at identical geometry."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.analysis import TraceGuard
+    from accelerate_tpu.ops.quantization import params_nbytes, quantize_params_int8, weight_autocast
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    prompts, budgets, arrivals = workload
+    interpreted = (
+        args.attention_impl == "pallas_paged" and jax.default_backend() != "tpu"
+    )
+
+    # Max logit error of the int8-weight forward vs dense, one probe batch —
+    # the weight-quantization accuracy budget as a recorded artifact. Probe
+    # width is the shortest sampled prompt, so ragged --prompt-min/-max
+    # settings below 8 tokens still stack.
+    width = min(8, min(p.size for p in prompts[:4]))
+    probe = jnp.asarray(np.stack([p[:width] for p in prompts[:4]]).astype(np.int32))
+    dense_logits = np.asarray(model.apply_fn(model.params, probe), np.float32)
+    qparams = quantize_params_int8(
+        model.params if "params" in model.params else {"params": model.params}
+    )
+    with weight_autocast("int8"):
+        int8_logits = np.asarray(jax.jit(model.apply_fn)(qparams, probe), np.float32)
+    weight_max_logit_err = float(np.abs(int8_logits - dense_logits).max())
+
+    rows = (
+        ("bf16", "bf16", "bf16"),
+        ("int8", "int8", "int8"),
+        ("fp8_e4m3", "int8", "fp8_e4m3"),
+    )
+    result = {
+        "backend": jax.default_backend(),
+        "attention_impl": args.attention_impl,
+        "weight_int8_max_logit_error_vs_bf16": round(weight_max_logit_err, 6),
+    }
+    baseline_tokens = None
+    for label, weight_dtype, kv_dtype in rows:
+        engine = ContinuousBatcher(
+            model, num_slots=args.num_slots, max_length=max_length,
+            chunk_size=args.chunk_size, paged=True, page_size=args.page_size,
+            tracer=tracer, max_queue=args.requests,
+            attention_impl=args.attention_impl,
+            weight_dtype=weight_dtype, kv_cache_dtype=kv_dtype,
+        )
+        log(f"quantization workload ({label}): warmup...")
+        engine.warm_inserts()
+        run_continuous(engine, prompts, budgets, arrivals)
+        run_continuous(engine, prompts, budgets, arrivals)
+        registry = engine.metrics
+        chunk_hist = registry.get("serving_chunk_seconds")
+        count0, sum0 = chunk_hist.count, chunk_hist.sum
+        guard = TraceGuard(
+            transfer_guard="disallow", on_violation="record",
+            name=f"serving-bench-quant-{label}",
+        )
+        engine.trace_guard = guard
+        tokens = {}
+        with guard:
+            tps, ttfts, iters, span = run_continuous(
+                engine, prompts, budgets, arrivals, collect_tokens=tokens
+            )
+        if guard.total_recompiles or guard.host_transfers:
+            log(f"TRACE-GUARD VIOLATIONS in quantization workload ({label}): {guard.report().summary()}")
+        # The quantization-discipline pin: static dtypes + traced scale
+        # operands must keep the one-executable / zero-sync steady state.
+        assert guard.total_recompiles == 0 and guard.host_transfers == 0, (
+            f"quantization workload ({label}) regressed the 0-recompile / "
+            f"0-host-transfer discipline: {guard.report().summary()}"
+        )
+        if baseline_tokens is None:
+            baseline_tokens = tokens
+            agreement = 1.0
+        else:
+            pairs = [
+                (x, y)
+                for i in baseline_tokens
+                for x, y in zip(baseline_tokens[i], tokens.get(i, []))
+            ]
+            agreement = (
+                sum(x == y for x, y in pairs) / len(pairs) if pairs else None
+            )
+        chunks = chunk_hist.count - count0
+        chunk_s = (chunk_hist.sum - sum0) / max(chunks, 1)
+        compute_bytes = np.dtype(
+            jax.tree_util.tree_leaves(model.params)[0].dtype
+        ).itemsize
+        hbm = estimate_decode_hbm_bytes(
+            args.num_slots, engine.pages_per_slot, args.page_size, cfg,
+            engine.kv_pool_itemsize, compute_bytes,
+        )
+        result[label] = {
+            "weight_dtype": weight_dtype,
+            "kv_cache_dtype": kv_dtype,
+            "interpreted": interpreted,
+            "tokens_per_sec": round(tps, 2),
+            "ttft_p50_ms": round(pct(ttfts, 50) * 1000, 2),
+            "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 2),
+            "makespan_s": round(span, 3),
+            "decode_iterations": iters,
+            "decode_chunk_mean_s": round(chunk_s, 6),
+            "decode_attention_s_per_dispatch": round(chunk_s / args.chunk_size, 6),
+            "kv_pool_bytes": engine.kv_cache_nbytes,
+            "kv_pool_itemsize": engine.kv_pool_itemsize,
+            "weight_bytes": params_nbytes(engine.params),
+            # Both impls' estimates ride every row: the serving impl's number
+            # is what THIS engine moved; the pallas one is the fused-dequant
+            # hot-path claim (the XLA oracle re-materializes the gather in
+            # the compute dtype, so its quantized saving is structurally
+            # smaller — that is the point of fusing).
+            "est_hbm_bytes_per_decode_step": hbm[args.attention_impl],
+            "est_hbm_bytes_per_decode_step_pallas": hbm["pallas_paged"],
+            "token_agreement_vs_bf16": round(agreement, 4) if agreement is not None else None,
+            "recompiles": guard.total_recompiles,
+            "host_transfers": guard.host_transfers,
+        }
+    ratio = result["bf16"]["est_hbm_bytes_per_decode_step_pallas"] / max(
+        result["int8"]["est_hbm_bytes_per_decode_step_pallas"], 1
+    )
+    result["est_cache_hbm_ratio_bf16_over_int8"] = round(ratio, 3)
+    # The acceptance headline, evaluated on the fused-kernel path (one pool
+    # pass — where the pool dtype IS the traffic): int8 KV at identical pool
+    # geometry must at least halve the estimated cache-read bytes per step.
+    assert ratio >= 2.0, (
+        f"int8 KV cache only cut estimated cache-read HBM bytes by {ratio:.2f}x "
+        "(expected >= 2x at identical pool geometry) — dtype accounting is off"
+    )
+    result["kv_pool_bytes_ratio_bf16_over_int8"] = round(
+        result["bf16"]["kv_pool_bytes"] / max(result["int8"]["kv_pool_bytes"], 1), 3
+    )
+    result["weight_bytes_ratio_bf16_over_int8"] = round(
+        result["bf16"]["weight_bytes"] / max(result["int8"]["weight_bytes"], 1), 3
     )
     return result
 
@@ -570,6 +744,7 @@ def run_ramp_workload(model, args, cfg, max_length, rng, tracer=None):
         out_of_process=args.out_of_process,
         worker_kwargs=dict(guard=True) if args.out_of_process else None,
         stall_degrade_s=None,
+        weight_dtype=args.weight_dtype, kv_cache_dtype=args.kv_cache_dtype,
     )
     next_id = 0
 
@@ -740,6 +915,17 @@ def main(argv=None):
                         "page-walk kernels (paged cache only)")
     parser.add_argument("--no-attention-ab", action="store_true",
                         help="skip the kernel-vs-XLA attention A/B workload")
+    parser.add_argument("--weight-dtype", default="bf16", choices=["bf16", "int8"],
+                        help="weight storage dtype for the main engine, the attention A/B "
+                        "and the fleet workloads: int8 = per-output-channel weight-only "
+                        "quantization with the fused epilogue matmul (ops/quantization.py)")
+    parser.add_argument("--kv-cache-dtype", default="bf16", choices=["bf16", "int8", "fp8_e4m3"],
+                        help="KV page-pool storage dtype for the same engines: int8/fp8_e4m3 "
+                        "store pages quantized with per-page-per-head scale pools, with "
+                        "dequant fused into the Pallas decode kernels (paged cache only)")
+    parser.add_argument("--no-quant-ab", action="store_true",
+                        help="skip the quantization A/B workload (bf16 vs int8 weights + "
+                        "int8/fp8 KV cache on the same workload)")
     parser.add_argument("--replicas", type=int, default=1,
                         help="run the replicated-router workload over N engines with a "
                         "kill-one-replica A/B (throughput dip + recovery time); 1 disables")
@@ -828,10 +1014,13 @@ def main(argv=None):
 
     if args.attention_impl == "pallas_paged" and args.no_paged:
         parser.error("--attention-impl pallas_paged requires the paged cache (drop --no-paged)")
+    if args.kv_cache_dtype != "bf16" and args.no_paged:
+        parser.error("--kv-cache-dtype requires the paged cache (drop --no-paged)")
     engine = ContinuousBatcher(
         model, num_slots=args.num_slots, max_length=max_length, chunk_size=args.chunk_size,
         paged=not args.no_paged, page_size=args.page_size, tracer=tracer,
         max_queue=args.requests, attention_impl=args.attention_impl,
+        weight_dtype=args.weight_dtype, kv_cache_dtype=args.kv_cache_dtype,
     )
     static_gen = Generator(model, max_new_tokens=max(budgets), max_length=max_length)
 
@@ -923,6 +1112,15 @@ def main(argv=None):
             model, args, cfg, max_length, (prompts, budgets, arrivals), tracer=tracer
         )
 
+    # Quantization A/B: bf16 vs int8-weights+int8-KV vs int8-weights+fp8-KV on
+    # the same workload — tokens/sec, per-dispatch attention seconds, actual
+    # pool/weight bytes, token agreement and the >= 2x cache-byte drop gate.
+    quant_block = None
+    if not args.no_paged and not args.no_quant_ab:
+        quant_block = run_quant_workload(
+            model, args, cfg, max_length, (prompts, budgets, arrivals), tracer=tracer
+        )
+
     # Replicated-router A/B: the same workload behind a health-routed fleet,
     # with one replica chaos-killed mid-traffic (dip + recovery measured).
     router_block = None
@@ -982,6 +1180,7 @@ def main(argv=None):
         paging_block.update(
             page_size=args.page_size,
             pages_total=engine.stats["pages_total"],
+            kv_cache_dtype=engine.stats["kv_cache_dtype"],
             prefix_cache=engine.stats["prefix_cache"],
         )
     result = {
@@ -1026,6 +1225,17 @@ def main(argv=None):
                     and jax.default_backend() != "tpu"
                 ),
                 "ab": attention_ab,
+            },
+            # Quantization A/B (bf16 vs int8 weights + int8/fp8 KV cache):
+            # the bandwidth/capacity multipliers as artifacts — tokens/sec,
+            # per-dispatch seconds, actual pool + weight bytes, estimated
+            # cache-read HBM drop (>= 2x asserted), token agreement vs bf16,
+            # max logit error of the int8-weight forward, interpreter
+            # provenance. Main-engine dtypes are pinned next to it.
+            "quantization": {
+                "weight_dtype": args.weight_dtype,
+                "kv_cache_dtype": args.kv_cache_dtype,
+                "ab": quant_block,
             },
             # Paged-KV state of the MAIN engine plus the shared-system-prompt
             # A/B (prefix cache on/off); prefill_tokens_saved > 0 with TTFT no

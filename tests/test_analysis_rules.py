@@ -265,6 +265,51 @@ def test_tpu116_worker_loop_variants():
     assert not analyze_source(hazard.replace("import jax\n", ""))
 
 
+def test_tpu117_variants():
+    """The variants beyond the flag fixture's k_scale literal (one finding
+    per fixture): a v_scale literal flags, a threaded array variable is
+    clean, an int literal flags, a scale kwarg on an unrelated function is
+    out of scope (no false positives on generic `k_scale=` spellings),
+    kv_cache_dtype literals off the supported set flag in both the engine and
+    config spellings, supported literals and variables are clean, and a
+    jax-free module is out of scope."""
+    hazard = (
+        "import jax\n"
+        "from accelerate_tpu.ops.paged_attention import paged_verify_attention\n"
+        "def attend(q, pk, pv, tbl, pos, ks):\n"
+        "    return paged_verify_attention(q, pk, pv, tbl, pos, k_scale=ks, v_scale=0.01)\n"
+    )
+    assert [f.rule_id for f in analyze_source(hazard)] == ["TPU117"]
+    assert not analyze_source(hazard.replace("v_scale=0.01", "v_scale=vs"))
+    assert [f.rule_id for f in analyze_source(
+        hazard.replace("v_scale=0.01", "v_scale=1")
+    )] == ["TPU117"]
+    unrelated = (
+        "import jax\n"
+        "def tune(plotter):\n"
+        "    return plotter.draw(k_scale=0.5)\n"
+    )
+    assert not analyze_source(unrelated)
+    engine = (
+        "import jax\n"
+        "from accelerate_tpu.serving import ContinuousBatcher\n"
+        "def build(model):\n"
+        '    return ContinuousBatcher(model, max_queue=8, kv_cache_dtype="int4")\n'
+    )
+    assert [f.rule_id for f in analyze_source(engine)] == ["TPU117"]
+    assert not analyze_source(engine.replace('"int4"', '"fp8_e4m3"'))
+    assert not analyze_source(engine.replace('"int4"', "dtype_flag"))
+    cfg = (
+        "import jax\n"
+        "import dataclasses\n"
+        "def step_cfg(base):\n"
+        '    return dataclasses.replace(base, decode_kv_cache_dtype="fp16")\n'
+    )
+    assert [f.rule_id for f in analyze_source(cfg)] == ["TPU117"]
+    assert not analyze_source(cfg.replace('"fp16"', '"bf16"'))
+    assert not analyze_source(hazard.replace("import jax\n", ""))
+
+
 def test_analyze_paths_walks_the_tree():
     findings, scanned = analyze_paths([str(SAMPLES)])
     assert scanned >= 2 * len(RULES) + 1  # flag + clean per rule + suppressed.py

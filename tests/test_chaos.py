@@ -453,6 +453,50 @@ def test_smoke_serve_sweep_on_the_kernel_path():
     assert by_name["engine_recovered"].details.get("requests_after_error", 0) >= 2
 
 
+def test_smoke_serve_sweep_on_the_quantized_pool():
+    """The smoke-serve acceptance sweep with `kv_cache_dtype="int8"`: fault
+    paths must exercise the QUANTIZED page pool — dispatch stalls, queue
+    bursts, and the blast-radius dispatch failure all land on an engine whose
+    pool pages are int8 with per-page-per-head scale pools, and recovery must
+    rebuild pools AND scales from zeros with the page ledger still closed."""
+    plan = builtin_plans()["smoke-serve"]
+    report = ChaosRunner(plan).run_serve(
+        num_requests=6, max_queue=3, kv_cache_dtype="int8"
+    )
+    assert report.ok, report.render_text()
+    by_name = {c.name: c for c in report.checks}
+    assert by_name["terminal_finish_reasons"].details["accepted"] >= 6
+    assert by_name["queue_bounded"].details["queue_peak"] <= 3
+    assert by_name["engine_recovered"].details.get("requests_after_error", 0) >= 2
+    ledger = by_name["page_ledger"]
+    assert ledger.details["pages_in_use_after_drain"] == 0
+    assert ledger.details["consistency_problems"] == []
+
+
+@pytest.mark.kernels
+def test_consumed_donation_recovers_on_the_quantized_kernel_path():
+    """Blast-radius recovery on the quantized KERNEL path: the injected chunk
+    failure deletes the donated int8 pool (and its scale pools) mid-flight;
+    the rebuild must recreate both from zeros and post-recovery traffic must
+    run through the same compiled fused-dequant decode executable — identical
+    shapes/dtypes, so the warm executable serves the rebuilt operands."""
+    plan = FaultPlan(
+        name="chunk-consumes-donation-quantized-kernel",
+        events=[FaultEvent(kind="serve.dispatch_error", at_call=3,
+                           args={"consume_donated": True})],
+    )
+    report = ChaosRunner(plan).run_serve(
+        num_requests=8, max_queue=6, attention_impl="pallas_paged",
+        kv_cache_dtype="int8",
+    )
+    assert report.ok, report.render_text()
+    recovered = next(c for c in report.checks if c.name == "engine_recovered")
+    assert recovered.details["requests_after_error"] >= 2
+    ledger = next(c for c in report.checks if c.name == "page_ledger")
+    assert ledger.details["pages_in_use_after_drain"] == 0
+    assert ledger.details["consistency_problems"] == []
+
+
 @pytest.mark.kernels
 def test_consumed_donation_recovers_on_the_kernel_path():
     """Blast-radius recovery rebuilds the KERNEL-path executables identically:

@@ -1,0 +1,32 @@
+"""TPU117 clean fixture: the sanctioned quantization spellings — scales as
+traced arrays from the pool's parallel scale pools, kv cache dtypes from the
+supported set (or threaded as variables), and engine dtype flags as static
+config."""
+
+import jax.numpy as jnp
+
+from accelerate_tpu.ops.paged_attention import paged_decode_attention
+from accelerate_tpu.serving import ContinuousBatcher
+
+
+def attend(q, k_pool, v_pool, table, pos, k_scale, v_scale):
+    # Scales ride as traced arrays: updates never retrace the program.
+    return paged_decode_attention(
+        q, k_pool, v_pool, table, pos, k_scale=k_scale, v_scale=v_scale
+    )
+
+
+def build_engine(model):
+    # Supported dtype literals are static config, not hazards.
+    return ContinuousBatcher(
+        model, max_queue=8, weight_dtype="int8", kv_cache_dtype="int8"
+    )
+
+
+def build_fp8_engine(model):
+    return ContinuousBatcher(model, max_queue=8, kv_cache_dtype="fp8_e4m3")
+
+
+def build_ab_engine(model, kv_dtype):
+    # A/B harnesses thread the dtype as a variable; only off-set LITERALS flag.
+    return ContinuousBatcher(model, max_queue=8, kv_cache_dtype=kv_dtype)

@@ -1,0 +1,18 @@
+"""TPU117 flag fixture: a quantization scale passed as a Python float literal
+to the paged decode kernel — baked into the executable at trace time, so the
+one scale ever honored is whatever this line said when the program traced.
+(The kv_cache_dtype-off-the-set and v_scale variants are unit-tested in
+test_analysis_rules.test_tpu117_variants; the tree-walk contract allows
+exactly one finding per flag fixture.)"""
+
+import jax.numpy as jnp
+
+from accelerate_tpu.ops.paged_attention import paged_decode_attention
+
+
+def attend(q, k_pool, v_pool, table, pos, v_scale):
+    # FLAG: k_scale as a Python literal — the pool's parallel scale array is
+    # the traced operand this seam exists for.
+    return paged_decode_attention(
+        q, k_pool, v_pool, table, pos, k_scale=0.05, v_scale=v_scale
+    )
