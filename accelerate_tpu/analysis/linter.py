@@ -670,6 +670,7 @@ class _ModuleChecker:
         self._check_closure_capture()
         self._check_serving_construction()
         self._check_kernel_fallback()
+        self._check_tp_replicated_operand()
         self._check_worker_loop()
         self._check_quantization()
         return self.findings
@@ -919,6 +920,85 @@ class _ModuleChecker:
                         "the kernel compiles on TPU (tests belong under tests/, "
                         "which the self-lint roots exclude)",
                     )
+
+    # -- tensor-parallel replicated placement (TPU118) ---------------------------
+    @classmethod
+    def _mentions_model_axis(cls, node: ast.AST) -> bool:
+        return any(
+            isinstance(sub, ast.Constant) and sub.value == "model"
+            for sub in ast.walk(node)
+        )
+
+    def _module_spans_mesh(self) -> bool:
+        """True when this module builds a tensor-parallel serving mesh: a
+        `serving_tp_mesh(...)` call, or a `Mesh(...)` whose axis names include
+        "model" — the context in which an unsharded placement is a silent
+        full replication rather than ordinary single-device code."""
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._call_name(node.func)
+            if name == "serving_tp_mesh":
+                return True
+            if name == "Mesh" and any(
+                self._mentions_model_axis(arg)
+                for arg in list(node.args) + [kw.value for kw in node.keywords]
+            ):
+                return True
+        return False
+
+    @classmethod
+    def _placement_is_devicey(cls, node: ast.AST) -> bool:
+        """A placement expression that is a raw DEVICE (not a sharding):
+        `jax.devices()[...]` / `jax.local_devices()[...]` subscripts or calls,
+        or a name that spells a device. Unknown names get the benefit of the
+        doubt — a precomputed shardings pytree is the sanctioned pattern."""
+        if isinstance(node, ast.Subscript):
+            return cls._placement_is_devicey(node.value)
+        if isinstance(node, ast.Call):
+            return cls._call_name(node.func) in {"devices", "local_devices"}
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            label = node.id if isinstance(node, ast.Name) else node.attr
+            return label.lower() in {"device", "dev"}
+        return False
+
+    def _check_tp_replicated_operand(self):
+        """TPU118: in a module that spans a serving mesh, `device_put` with no
+        sharding argument (or a raw device) lands the params/pool tree on ONE
+        device — every sharded executable that consumes it then replicates the
+        full tree to every chip, serving token-identically while spending N x
+        the per-chip HBM the mesh exists to save. The sanctioned spellings
+        carry a NamedSharding (pytree): `derive_tp_param_shardings` /
+        `derive_tp_cache_shardings`, or `ContinuousBatcher(tp=N)` doing the
+        placement internally."""
+        if not self.index.imports_jax or not self._module_spans_mesh():
+            return
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._call_name(node.func) != "device_put":
+                continue
+            placement = None
+            if len(node.args) >= 2:
+                placement = node.args[1]
+            else:
+                for kw in node.keywords:
+                    if kw.arg in ("device", "shardings", "sharding"):
+                        placement = kw.value
+                        break
+            missing = placement is None or (
+                isinstance(placement, ast.Constant) and placement.value is None
+            )
+            if missing or self._placement_is_devicey(placement):
+                self.emit(
+                    node,
+                    "TPU118",
+                    "device_put without a NamedSharding in a mesh-spanning serving "
+                    "module places the tree on one device and lets jit replicate it "
+                    "to every chip — derive shardings from the model family's rules "
+                    "(derive_tp_param_shardings / derive_tp_cache_shardings) or let "
+                    "ContinuousBatcher(tp=N) place it",
+                )
 
     def _check_jit_placement(self):
         for call in self.index.jit_calls:

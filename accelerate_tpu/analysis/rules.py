@@ -212,6 +212,22 @@ RULES = (
         "off-set literal fails at engine construction, or worse, silently "
         "selects nothing",
     ),
+    Rule(
+        id="TPU118",
+        slug="tp-replicated-operand",
+        severity="warn",
+        summary="a mesh-spanning serving module places params/pool trees with "
+        "device_put but no NamedSharding — the tree lands on one device and "
+        "jit replicates it to every chip (silent full replication)",
+        fixit="pass a NamedSharding pytree to device_put (derive it with "
+        "parallel.sharding.derive_tp_param_shardings / "
+        "derive_tp_cache_shardings from the model family's Megatron rules) — "
+        "or build the engine with ContinuousBatcher(tp=N), whose params "
+        "setter and cache init place everything sharded; an unsharded "
+        "placement serves token-identically while spending N x the per-chip "
+        "HBM the mesh exists to save (the accidental-fallback analogue of "
+        "TPU115)",
+    ),
 )
 
 RULES_BY_ID = {r.id: r for r in RULES}

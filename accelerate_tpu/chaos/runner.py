@@ -689,6 +689,7 @@ class ChaosRunner:
         speculative: bool = False,
         attention_impl: str = "xla",
         kv_cache_dtype: str = "bf16",
+        tp: int = 1,
     ) -> InvariantReport:
         """Serving workload: a tiny llama `ContinuousBatcher` fed one request
         per cycle (plus scripted queue bursts), driven to drain under injected
@@ -704,7 +705,10 @@ class ChaosRunner:
         page pool: the blast-radius rebuild must recreate the quantized pools
         AND their scale pools from zeros, and the page ledger must still
         close — fault paths exercise the quantized cache, not just happy
-        decode."""
+        decode. `tp=N` spans the engine over an N-device submesh: the same
+        sweeps must leave the rebuilt pools (and scale pools) SHARDED on
+        that submesh — the extra `tp_pool_sharded` invariant fails if a
+        blast-radius recovery quietly rebuilt them replicated."""
         from ..models.llama import LlamaConfig, create_llama_model
         from ..serving import FINISH_REASONS, ContinuousBatcher, QueueFull, Request
 
@@ -725,6 +729,7 @@ class ChaosRunner:
             tracer=self.tracer, paged=paged, page_size=4,
             speculative=speculative, draft_tokens=3,
             attention_impl=attention_impl, kv_cache_dtype=kv_cache_dtype,
+            tp=tp,
         )
         ServingInjector(self.session).arm(engine)
         rng = np.random.default_rng(self.plan.seed)
@@ -818,7 +823,39 @@ class ChaosRunner:
             self._check_page_ledger(engine),
             self._check_serve_trace(accepted),
         ]
+        if tp > 1:
+            checks.append(self._check_tp_pool_sharded(engine, tp))
         return self._report("serve", checks)
+
+    def _check_tp_pool_sharded(self, engine, tp: int) -> InvariantCheck:
+        """Mesh-spanning engines: the LIVE slot cache — including one rebuilt
+        by a blast-radius recovery mid-sweep — must still be sharded over the
+        `tp`-device submesh (K/V pools and quantized scale pools carry the
+        "model" axis; a silently-replicated rebuild would serve correctly
+        while spending N x the HBM, which is exactly the failure chaos is
+        here to catch)."""
+        import jax
+
+        unsharded = []
+        sharded = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(engine._cache)[0]:
+            name = str(getattr(path[-1], "key", getattr(path[-1], "name", path[-1])))
+            if name not in ("cached_key", "cached_value", "key_scale", "value_scale"):
+                continue
+            spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+            if spec is None or "model" not in tuple(spec):
+                unsharded.append("/".join(str(getattr(k, "key", k)) for k in path))
+            else:
+                sharded += 1
+        mesh_ok = engine.mesh is not None and engine.mesh.devices.size == tp
+        return InvariantCheck(
+            "tp_pool_sharded",
+            passed=mesh_ok and sharded > 0 and not unsharded,
+            details={
+                "tp": tp, "mesh_devices": int(engine.mesh.devices.size) if engine.mesh else 0,
+                "sharded_leaves": sharded, "unsharded_leaves": unsharded,
+            },
+        )
 
     # ---------------------------------------------------------------- router
     def run_router(

@@ -103,6 +103,14 @@ def register_subcommand(subparsers):
         "store pages quantized with per-page-per-head scales, cutting "
         "cache-read bytes 2x vs bf16 and multiplying pool capacity",
     )
+    parser.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel degree PER ENGINE: each replica spans its own "
+        "tp-device submesh (weights Megatron-sharded, the KV pool sharded by "
+        "KV head — docs/serving.md \"Tensor-parallel engines\"); replicas "
+        "get disjoint device groups when the topology allows, so "
+        "--replicas R --tp N uses R*N chips",
+    )
     parser.set_defaults(func=serve_command)
     return parser
 
@@ -148,6 +156,14 @@ def serve_command(args):
             file=sys.stderr,
         )
         raise SystemExit(2)
+    if args.tp > 1 and args.out_of_process:
+        print(
+            "accelerate-tpu serve: --tp composes with in-process replicas only "
+            "for now — subprocess workers pin their own device view (multi-host "
+            "TP workers are ROADMAP item 2)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
     _fam, cfg = get_model_family(args.model)
     requests = _load_requests(args, cfg.vocab_size)
     if not requests:
@@ -172,12 +188,14 @@ def serve_command(args):
         paged=not args.no_paged,
         weight_dtype=args.weight_dtype,
         kv_cache_dtype=args.kv_cache_dtype,
+        tp=args.tp,
     )
     print(
         f"[serve] model {args.model} | "
         f"{'out-of-process, ' if args.out_of_process else ''}{router.num_replicas} replica(s) x "
-        f"{args.num_slots} slots, chunk {args.chunk_size}, cache {max_length} | "
-        f"{len(requests)} request(s)",
+        f"{args.num_slots} slots, chunk {args.chunk_size}, cache {max_length}"
+        + (f", tp {args.tp}" if args.tp > 1 else "")
+        + f" | {len(requests)} request(s)",
         file=sys.stderr, flush=True,
     )
     # Pace submissions against the fleet's backpressure: a workload larger

@@ -11,7 +11,7 @@ projections; un-biased lm_head (embed_out)."""
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -64,6 +64,9 @@ class GPTNeoXConfig:
     # weight storage dtype for the serving programs.
     decode_kv_cache_dtype: str = "bf16"
     weight_dtype: str = "bf16"
+    # Tensor-parallel decode submesh (see LlamaConfig.decode_tp_mesh): the
+    # 1-axis ("model",) Mesh the Pallas page-walk kernels shard_map over.
+    decode_tp_mesh: Optional[Any] = None
     param_dtype: str = "float32"
 
     @property
@@ -121,6 +124,7 @@ class GPTNeoXAttention(nn.Module):
                     num_pages=cfg.decode_num_pages,
                     attention_impl=cfg.decode_attention_impl,
                     kv_cache_dtype=cfg.decode_kv_cache_dtype,
+                    mesh=cfg.decode_tp_mesh,
                 )
             else:
                 k_all, v_all, decode_mask = update_decode_cache(self, k, v, L, pad_mask=mask)

@@ -10,7 +10,7 @@ rules + FSDP-friendly shapes.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Any, Optional
 
 import numpy as np
 
@@ -80,6 +80,13 @@ class LlamaConfig:
     # whose kernel is a quantized entry (quantize_params_int8) through the
     # fused int8-epilogue matmul via the weight_autocast interceptor.
     weight_dtype: str = "bf16"
+    # Tensor-parallel decode submesh (serving.ContinuousBatcher(tp=N)): the
+    # 1-axis ("model",) jax Mesh the engine's sharded executables span. The
+    # XLA paths need nothing (GSPMD partitions them off the operand
+    # shardings); the Pallas page-walk kernels read this to shard_map over
+    # the KV-head grid, since pallas_call has no GSPMD partitioning rule.
+    # None = single-device serving, byte-for-byte the pre-TP behavior.
+    decode_tp_mesh: Optional[Any] = None
 
     @property
     def head_dim(self) -> int:
@@ -138,6 +145,7 @@ class LlamaAttention(nn.Module):
                     num_pages=cfg.decode_num_pages,
                     attention_impl=cfg.decode_attention_impl,
                     kv_cache_dtype=cfg.decode_kv_cache_dtype,
+                    mesh=cfg.decode_tp_mesh,
                 )
             else:
                 # Incremental decoding through the shared flax-cache write path

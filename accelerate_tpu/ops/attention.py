@@ -260,10 +260,48 @@ def _write_slot_pool(
     return pool_k.value, pool_v.value, pos, table, (k_scale.value, v_scale.value)
 
 
+def _tp_paged_attention(fn, q, pool_k, pool_v, table, positions, k_scale, v_scale, mesh):
+    """`shard_map` the fused page-walk kernels over the "model" axis: each
+    device runs the kernel on its OWN KV-head shard of the pool. `pallas_call`
+    has no GSPMD partitioning rule, so without the manual map the compiler
+    would all-gather the whole pool to every chip per dispatch — exactly the
+    HBM/ICI traffic the kernel exists to remove. GQA grouping survives the
+    split because heads shard in contiguous chunks: device i holds query
+    heads [i*Hq/tp, (i+1)*Hq/tp) and their kv heads [i*Hkv/tp, (i+1)*Hkv/tp),
+    so every local query head's kv head is local too. Page tables, positions
+    and the output's batch dims stay replicated traced operands."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import compat_shard_map
+
+    head = P(None, None, "model", None)  # q/pools: [.., heads, head_dim]
+    repl = P(None, None)  # page tables / positions: replicated operands
+
+    if k_scale is not None:
+        def inner(q_, pk, pv, tbl, pos_, ks, vs):
+            return fn(q_, pk, pv, tbl, pos_, k_scale=ks, v_scale=vs)
+
+        in_specs = (head, head, head, repl, repl, P(None, "model"), P(None, "model"))
+        args = (q, pool_k, pool_v, table, positions, k_scale, v_scale)
+    else:
+        def inner(q_, pk, pv, tbl, pos_):
+            return fn(q_, pk, pv, tbl, pos_)
+
+        in_specs = (head, head, head, repl, repl)
+        args = (q, pool_k, pool_v, table, positions)
+    # Replication checking off: pallas_call can't annotate its outputs (the
+    # same dispensation ring_attention's flash path uses); numerics are
+    # covered by the tp-parity pins.
+    wrapped = compat_shard_map(
+        inner, mesh=mesh, in_specs=in_specs, out_specs=head, check_vma=False
+    )
+    return wrapped(*args)
+
+
 def slot_cache_attention(
     module, q, k, v, cache_length: int, positions, page_table=None,
     page_size: int = 0, num_pages: int = 0, attention_impl: str = "xla",
-    kv_cache_dtype: str = "bf16",
+    kv_cache_dtype: str = "bf16", mesh=None,
 ):
     """Write this dispatch's K/V into the slot cache AND attend — the fused
     serving-decode seam every slot-cache model family calls (llama, gpt_neox).
@@ -284,6 +322,12 @@ def slot_cache_attention(
     per-page-per-head scale pools (see `update_slot_cache`); the kernels
     receive the scale pools as operands and fuse the dequant into the
     page-streaming loop, so quantized decode moves int8/fp8 bytes.
+
+    `mesh` (a 1-axis ("model",) Mesh, threaded from the model config's
+    `decode_tp_mesh` by a tensor-parallel `ContinuousBatcher(tp=N)`) makes
+    the kernel path `shard_map` over the KV-head grid so each device walks
+    only its own pool shard; the XLA paths ignore it — GSPMD partitions them
+    automatically from the sharded pool/param operands.
 
     Args and cache semantics match `update_slot_cache`; returns the attention
     output [B, s, Hq, D]."""
@@ -306,13 +350,12 @@ def slot_cache_attention(
         )
         k_scale, v_scale = scales if scales is not None else (None, None)
         LAST_DISPATCH = "pallas_paged"
-        if q.shape[1] == 1:
-            return paged_decode_attention(
-                q, pool_k, pool_v, table, pos, k_scale=k_scale, v_scale=v_scale
+        fn = paged_decode_attention if q.shape[1] == 1 else paged_verify_attention
+        if mesh is not None and mesh.shape.get("model", 1) > 1:
+            return _tp_paged_attention(
+                fn, q, pool_k, pool_v, table, pos, k_scale, v_scale, mesh
             )
-        return paged_verify_attention(
-            q, pool_k, pool_v, table, pos, k_scale=k_scale, v_scale=v_scale
-        )
+        return fn(q, pool_k, pool_v, table, pos, k_scale=k_scale, v_scale=v_scale)
     k_all, v_all, decode_mask = update_slot_cache(
         module, k, v, cache_length, positions,
         page_table=page_table, page_size=page_size, num_pages=num_pages,

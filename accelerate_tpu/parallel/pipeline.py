@@ -37,9 +37,9 @@ PIPELINE_SHARDING_RULES = [(r"(^|/)(enc_|dec_)?layers(/|$)", ("stage",))]
 
 
 def _shard_map():
-    from jax import shard_map
+    from .sharding import compat_shard_map
 
-    return shard_map
+    return compat_shard_map
 
 
 def stack_layer_params(layers):
@@ -268,7 +268,9 @@ def _build_local_fns(
         out_mb, out_i, valid)` folds the last stage's finished carry into an
         accumulator. The scan carry is (streams_tuple, acc)."""
         prelude_p, tail_p = params["prelude"], params["tail"]
-        S = lax.axis_size("stage")
+        from .ring_attention import _axis_size
+
+        S = _axis_size("stage")
         idx = lax.axis_index("stage")
         mbs = _split_microbatches(batch, M)
         mb0 = _index_mb(mbs, jnp.int32(0))

@@ -24,6 +24,20 @@ from typing import Optional
 import numpy as np
 
 
+def _axis_size(axis_name) -> int:
+    """Static size of a named mesh axis from inside `shard_map`.
+
+    `lax.psum(1, axis)` constant-folds to a Python int (no collective is
+    emitted), which the ring loops need for `range()` unrolling. Newer jax
+    exposes `lax.axis_size`; this works on every version in support."""
+    from jax import lax
+
+    size = getattr(lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def segment_mask(q_seg, kv_seg):
     """Packed-sequence attention mask: [B, Sq] x [B, Skv] ids -> [B, 1, Sq, Skv]
     boolean, True where the ids match. The ONE definition of segment semantics —
@@ -91,7 +105,7 @@ def ring_attention(
     import jax.numpy as jnp
     from jax import lax
 
-    axis_size = lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     axis_index = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     skv = k.shape[1]
@@ -143,7 +157,7 @@ def _ring_flash_fwd_impl(qt, kt, vt, axis_name, causal, scale, block_q, block_k,
 
     from ..ops.flash_attention import LANE, NEG_INF, _fwd_call
 
-    axis_size = lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     BH, S, D = qt.shape
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
@@ -206,7 +220,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k, interpret, r
     from ..ops.flash_attention import LANE, _bwd_call
 
     qt, kt, vt, out, lse = res
-    axis_size = lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     BH, S, D = qt.shape
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
@@ -372,10 +386,7 @@ def sequence_parallel_attention(
     import jax
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from .sharding import compat_shard_map as shard_map
 
     if mesh is None:
         from ..state import AcceleratorState
@@ -425,17 +436,15 @@ def sequence_parallel_attention(
 
     if mode == "ring" and use_flash:
         # Varying-mesh-axes checking off: pallas_call inside shard_map can't
-        # annotate its outputs; correctness is covered by the parity tests. The
-        # kwarg is check_vma on current jax, check_rep on the older experimental
-        # shard_map the import fallback serves.
+        # annotate its outputs; correctness is covered by the parity tests
+        # (compat_shard_map handles the check_vma/check_rep rename).
         inner_flash = functools.partial(
             ring_flash_attention, axis_name=seq_axis, causal=causal, scale=scale
         )
-        smap = dict(mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec)
-        try:
-            fn = shard_map(inner_flash, check_vma=False, **smap)
-        except TypeError:
-            fn = shard_map(inner_flash, check_rep=False, **smap)
+        fn = shard_map(
+            inner_flash, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
+            out_specs=q_spec, check_vma=False,
+        )
         return fn(q, k, v)
 
     inner = ring_attention if mode == "ring" else allgather_attention

@@ -301,6 +301,209 @@ def constrain_activation(x):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, PartitionSpec(*spec)))
 
 
+# --------------------------------------------------------------- serving TP
+# Tensor-parallel DECODE (serving.ContinuousBatcher(tp=N)): one engine spans a
+# submesh whose single "model" axis carries the Megatron column/row-parallel
+# layout the model families' rule tables already describe. Everything here is
+# spec derivation — XLA/GSPMD inserts the collectives once params, KV pools
+# and scale pools are placed with these NamedShardings.
+
+
+def compat_shard_map(fn, **kwargs):
+    """`shard_map` across jax versions — the ONE compat shim (pipeline, ring
+    flash, and the TP paged-attention wrap all route here): current jax
+    exposes `jax.shard_map`, older versions `jax.experimental.shard_map`;
+    the replication-checking kwarg renamed `check_rep` -> `check_vma` along
+    the way. Callers pass the current spelling (`check_vma`); exactly one
+    retry swaps the kwarg on TypeError, so an unrelated TypeError from the
+    wrapped call still propagates."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax keeps it under experimental
+        from jax.experimental.shard_map import shard_map
+
+    if "check_vma" in kwargs:
+        try:
+            return shard_map(fn, **kwargs)
+        except TypeError:
+            kwargs = dict(kwargs)
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return shard_map(fn, **kwargs)
+
+
+def serving_tp_mesh(tp: int, devices=None, group: int = 0):
+    """A 1-axis ("model",) submesh over `tp` devices for a mesh-spanning
+    serving engine. `devices` picks the group explicitly; otherwise `group`
+    selects the g-th disjoint `tp`-device block of `jax.devices()` (the
+    router assigns one group per replica), wrapping around when the topology
+    has fewer than ``(group+1)*tp`` devices — CPU smoke meshes oversubscribe
+    harmlessly."""
+    import jax
+    from jax.sharding import Mesh
+
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError("tp must be >= 1")
+    if devices is None:
+        all_devices = jax.devices()
+        groups = max(len(all_devices) // tp, 1)
+        g = int(group)
+        if g >= groups:
+            # The wrap exists for CPU smoke meshes (oversubscription is
+            # harmless there); on real hardware sharing chips between groups
+            # silently halves their throughput — be loud about it.
+            from ..logging import get_logger
+
+            get_logger(__name__).warning(
+                "serving_tp_mesh: group %d wraps onto device block %d — only "
+                "%d disjoint %d-device group(s) exist across %d visible "
+                "device(s), so this submesh SHARES chips with group %d. Fine "
+                "for CPU smoke meshes; on real hardware shrink replicas or tp.",
+                g, g % groups, groups, tp, len(all_devices), g % groups,
+            )
+        start = (g % groups) * tp
+        devices = all_devices[start : start + tp]
+    devices = list(devices)
+    if len(devices) != tp:
+        raise ValueError(
+            f"tensor-parallel degree {tp} needs exactly {tp} devices, got "
+            f"{len(devices)} (of {len(jax.devices())} visible)"
+        )
+    return Mesh(np.asarray(devices), ("model",))
+
+
+def _check_tp_divisible(path: str, shape, spec, mesh):
+    """A rule-sharded dim must divide by its axis group — silently dropping
+    the axis would be exactly the full-replication fallback TPU118 warns
+    about, so an indivisible rule is a hard error naming the leaf."""
+    for i, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        group = int(np.prod([mesh.shape.get(a, 1) for a in axes]))
+        if group > 1 and shape[i] % group:
+            raise ValueError(
+                f"TP rule shards {path} dim {i} (size {shape[i]}) over axes "
+                f"{axes} (group size {group}), which does not divide — pick a "
+                f"tp that divides the model's head/hidden dims"
+            )
+
+
+def derive_tp_param_shardings(params, mesh, rules):
+    """NamedSharding pytree for a serving params tree: Megatron TP rules only
+    (no fsdp/data axes — decode batches are slot batches, replicated).
+
+    Quantized kernel entries (`ops/quantization.quantize_params_int8`:
+    ``{"q": int8 [K, N], "scale": f32 [N]}`` dict leaves under the kernel
+    path) ride their kernel's rule — ``q`` shards exactly like the kernel it
+    replaced, and the per-output-channel ``scale`` vector follows the
+    kernel's OUTPUT dim (the rule's last entry): column-parallel kernels
+    shard their scales, row-parallel kernels replicate them. Unmatched
+    leaves (norms, biases) replicate."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rules = list(rules or [])
+    flat, treedef = tree_paths_and_leaves(params)
+    out = []
+    for path, leaf in flat:
+        shape = tuple(np.shape(leaf))
+        if path.endswith("kernel/scale") and len(shape) == 1:
+            # The quantized entry's scale vector: align with the kernel's
+            # output (last) dim instead of rule-from-the-front truncation,
+            # which would silently replicate column-parallel scales.
+            axis = None
+            for pattern, rule_spec in rules:
+                if re.search(pattern, path):
+                    rule_spec = tuple(rule_spec)
+                    axis = rule_spec[-1] if rule_spec else None
+                    break
+            spec = PartitionSpec(axis) if axis is not None else PartitionSpec()
+        else:
+            spec = spec_for_param(path, shape, mesh, None, rules)
+        _check_tp_divisible(path, shape, tuple(spec), mesh)
+        out.append(NamedSharding(mesh, spec))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _tp_cache_spec(path: str, ndim: int, axis: str = "model"):
+    """PartitionSpec for one slot-cache leaf, by leaf name: K/V pools/rows
+    ([..., heads, head_dim]) shard their HEADS dim; the quantized pools'
+    per-page-per-head scale arrays ([..., num_pages, heads]) shard their
+    trailing heads dim; everything else (cache_index scalars, pad masks)
+    replicates. Name-based so the dense per-slot rows, the page pools, AND
+    scan-stacked ([layers, ...]) variants all derive the same layout."""
+    from jax.sharding import PartitionSpec
+
+    leaf = path.rsplit("/", 1)[-1]
+    if leaf in ("cached_key", "cached_value") and ndim >= 2:
+        spec = [None] * ndim
+        spec[ndim - 2] = axis
+        return PartitionSpec(*spec)
+    if leaf in ("key_scale", "value_scale") and ndim >= 1:
+        spec = [None] * ndim
+        spec[ndim - 1] = axis
+        return PartitionSpec(*spec)
+    return PartitionSpec()
+
+
+def derive_tp_cache_shardings(cache, mesh, axis: str = "model"):
+    """NamedSharding pytree for a serving slot cache (dense rows or page
+    pools): K/V shard by KV head over `axis`, scale pools by head, scalars
+    replicate. Shapes may be real arrays or ShapeDtypeStructs."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    flat, treedef = tree_paths_and_leaves(cache)
+    out = []
+    for path, leaf in flat:
+        shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        spec = _tp_cache_spec(path, len(shape), axis)
+        _check_tp_divisible(path, shape, tuple(spec), mesh)
+        out.append(NamedSharding(mesh, spec))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def constrain_tp_cache(cache, mesh, axis: str = "model"):
+    """`with_sharding_constraint` every cache leaf to its TP layout — applied
+    INSIDE the serving programs on the returned (donated) cache so the pool
+    round-trips every dispatch with one stable sharding: without the pin,
+    GSPMD is free to pick a different output layout per program, which would
+    (a) silently replicate the pool and (b) change the next dispatch's input
+    signature — a recompile the serving discipline forbids."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    if mesh is None:
+        return cache
+
+    def pin(path, leaf):
+        parts = []
+        for k in path:
+            parts.append(str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k)))))
+        spec = _tp_cache_spec("/".join(parts), getattr(leaf, "ndim", 0), axis)
+        return jax.lax.with_sharding_constraint(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(pin, cache)
+
+
+def tree_device_nbytes(tree, device) -> int:
+    """Stored bytes of `tree` resident on ONE device — the honest per-chip
+    HBM figure for a sharded params/KV tree (a replicated leaf counts its
+    full size, a sharded leaf only its local shard), read off the LIVE
+    arrays' shardings rather than computed from specs."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            total += int(np.size(leaf)) * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+            continue
+        total += sum(int(s.data.nbytes) for s in shards if s.device == device)
+    return total
+
+
 def data_spec(mesh, extra_seq_axis: bool = False):
     """PartitionSpec for input batches: batch over ("data","fsdp"), optionally sequence
     over "seq" (sequence parallelism; the capability gap called out in SURVEY §5)."""

@@ -278,11 +278,28 @@ class ReplicaSet:
         return replica
 
     # ------------------------------------------------------------------ build
+    def _engine_kwargs_for(self, index: int) -> Dict[str, Any]:
+        """Per-replica engine kwargs: a tensor-parallel fleet (`engine_kwargs`
+        ``tp=N``) gives each replica its OWN N-device submesh — replica r
+        spans devices ``[r*N, (r+1)*N)`` when the topology has that many,
+        wrapping around otherwise (`parallel.sharding.serving_tp_mesh`
+        resolves the group; CPU smoke meshes oversubscribe harmlessly). A
+        mesh-spanning engine is just one replica, so replication over TP
+        groups composes with health routing, retries, hedging and rolling
+        swaps for free."""
+        kwargs = dict(self.engine_kwargs)
+        tp = int(kwargs.get("tp", 1) or 1)
+        if tp > 1 and kwargs.get("tp_devices") is None:
+            kwargs.setdefault("tp_group", index)
+        return kwargs
+
     def _build_engine(self, index: int) -> ContinuousBatcher:
         if self._engine_factory is not None:
             engine = self._engine_factory(index)
         else:
-            engine = ContinuousBatcher(self.model, tracer=self.tracer, **self.engine_kwargs)
+            engine = ContinuousBatcher(
+                self.model, tracer=self.tracer, **self._engine_kwargs_for(index)
+            )
         if self.current_params is not None:
             engine.params = self.current_params
         # Share ONE params tree across the fleet: a weight_dtype="int8"
@@ -292,7 +309,10 @@ class ReplicaSet:
         # quantized) tree makes later setter calls pass-throughs — the
         # setter is idempotent. Subprocess engines keep params worker-side
         # (their getter returns None), so the controller copy stays as-is.
-        if getattr(engine, "params", None) is not None:
+        # Mesh-spanning engines are excluded: their setters re-shard onto
+        # their OWN submesh, so adopting one replica's placed tree would
+        # just churn device_put round trips through every other group.
+        if getattr(engine, "params", None) is not None and getattr(engine, "mesh", None) is None:
             self.current_params = engine.params
         for hook in self.on_engine_built:
             hook(index, engine)
@@ -527,6 +547,18 @@ class Router:
         self._idle_since: Optional[float] = None
         engine_kwargs = dict(engine_kwargs)
         engine_kwargs.setdefault("max_queue", self.max_queue)
+        if out_of_process and int(engine_kwargs.get("tp", 1) or 1) > 1:
+            # The subprocess factory bypasses ReplicaSet._engine_kwargs_for,
+            # so every worker would build its submesh at the default
+            # tp_group=0 — all replicas silently sharing one device block.
+            # Refuse rather than degrade (multi-host TP workers are ROADMAP
+            # item 2); the serve CLI carries the same guard.
+            raise ValueError(
+                "tp > 1 composes with in-process replicas only for now: "
+                "subprocess workers pin their own device view, so an "
+                "out-of-process TP fleet would stack every replica on the "
+                "same device block — pass out_of_process=False"
+            )
         if out_of_process and engine_factory is None:
             from .worker import make_subprocess_factory
 
@@ -1188,8 +1220,13 @@ class Router:
             # One quantize per swap, not per replica: adopt the first
             # swapped engine's (possibly quantized) tree so the remaining
             # replicas' setters share it by reference (idempotent setter;
-            # subprocess engines expose no params and keep the raw tree).
-            if getattr(replica.engine, "params", None) is not None:
+            # subprocess engines expose no params and keep the raw tree;
+            # mesh-spanning engines keep the raw tree too — each TP group
+            # re-shards onto its own submesh at its setter).
+            if (
+                getattr(replica.engine, "params", None) is not None
+                and getattr(replica.engine, "mesh", None) is None
+            ):
                 swap["params"] = replica.engine.params
             self.replica_set.set_state(replica, "live", "weights swapped")
             self.tracer.event(

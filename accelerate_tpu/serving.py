@@ -97,6 +97,7 @@ from .generation import (
 )
 from .logging import get_logger
 from .paging import SCRATCH_PAGE, PagePool, chain_hashes, pages_for
+from .parallel.sharding import constrain_tp_cache, tree_device_nbytes
 from .speculative import (
     DEFAULT_DRAFT_NGRAM,
     DEFAULT_DRAFT_TOKENS,
@@ -212,6 +213,9 @@ class ContinuousBatcher:
         attention_impl: str = "xla",
         weight_dtype: str = "bf16",
         kv_cache_dtype: str = "bf16",
+        tp: int = 1,
+        tp_devices=None,
+        tp_group: int = 0,
     ):
         if getattr(model, "module", None) is None or not hasattr(model.module, "config"):
             raise ValueError("ContinuousBatcher needs a Model bundle built from an in-tree flax module")
@@ -251,6 +255,38 @@ class ContinuousBatcher:
                 "a quantized KV cache requires the paged layout (paged=True): "
                 "the per-page-per-head scale pools have no contiguous twin"
             )
+        # Tensor-parallel decode: one engine spanning a `tp`-device submesh
+        # whose single "model" axis carries the model family's Megatron
+        # column/row-parallel rules (parallel/sharding.py). Weights, the KV
+        # pool (by KV head) and the quantized scale pools are placed sharded;
+        # GSPMD inserts the collectives into the SAME one-decode-executable
+        # programs — page tables, sampling scalars and token operands stay
+        # replicated host pushes, so admissions still never recompile. tp=1
+        # is byte-for-byte the single-device engine (mesh is None).
+        self.tp = int(tp)
+        if self.tp < 1:
+            raise ValueError("tp must be >= 1")
+        self.mesh = None
+        self._param_shardings = None
+        self._cache_shardings = None
+        self._tp_rules = list(getattr(model, "sharding_rules", None) or [])
+        if self.tp > 1:
+            from .parallel.sharding import serving_tp_mesh
+
+            if not self._tp_rules:
+                raise ValueError(
+                    f"{type(model.module).__name__}'s Model bundle carries no "
+                    "sharding_rules — this model family has no Megatron TP "
+                    "layout to span a mesh with; pass tp=1"
+                )
+            kv_heads = getattr(base, "num_key_value_heads", base.num_attention_heads)
+            if kv_heads % self.tp:
+                raise ValueError(
+                    f"tp={self.tp} must divide the model's KV head count "
+                    f"({kv_heads}): the KV pool shards by KV head over the "
+                    "\"model\" axis"
+                )
+            self.mesh = serving_tp_mesh(self.tp, devices=tp_devices, group=tp_group)
         self.params = model.params if "params" in model.params else {"params": model.params}
         self.num_slots = int(num_slots)
         self.max_length = int(max_length or base.max_position_embeddings)
@@ -345,6 +381,18 @@ class ContinuousBatcher:
                 )
             quant_cfg["weight_dtype"] = self.weight_dtype
         prefill_cfg = dataclasses.replace(base, decode_cache_length=cache_len, **quant_cfg)
+        if self.mesh is not None:
+            # The slot-decode modules carry the submesh so the Pallas page-walk
+            # kernels can shard_map over the KV-head grid; prefill stays
+            # mesh-free in config (its XLA paths partition off the sharded
+            # operands alone).
+            if not hasattr(base, "decode_tp_mesh"):
+                raise ValueError(
+                    f"{type(model.module).__name__}'s config has no "
+                    "`decode_tp_mesh` field — this model family doesn't "
+                    "support tensor-parallel serving yet"
+                )
+            quant_cfg["decode_tp_mesh"] = self.mesh
         if self.paged:
             if self.kv_cache_dtype != "bf16":
                 if not hasattr(base, "decode_kv_cache_dtype"):
@@ -399,6 +447,18 @@ class ContinuousBatcher:
         self._presence = (
             jnp.zeros((self.num_slots, base.vocab_size), bool) if use_repetition_penalty else None
         )
+        if self.mesh is not None:
+            # Commit the carried device state (rng; presence when penalized)
+            # REPLICATED on the submesh up front: these thread through every
+            # dispatch, and an uncommitted first-call signature followed by a
+            # committed second-call one would recompile the one decode
+            # executable the engine promises never to.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            replicated = NamedSharding(self.mesh, PartitionSpec())
+            self._rng = jax.device_put(self._rng, replicated)
+            if self._presence is not None:
+                self._presence = jax.device_put(self._presence, replicated)
 
         S = self.num_slots
         # Host mirror of the per-slot device operands (small [S] vectors, pushed
@@ -572,11 +632,25 @@ class ContinuousBatcher:
         channel scales ONCE per assignment (`quantize_params_int8` —
         idempotent, so an already-quantized tree passes through), which is
         exactly the "scales computed at weight-load/swap time" contract: the
-        compiled programs only ever see int8 kernels + scale operands."""
+        compiled programs only ever see int8 kernels + scale operands.
+
+        Tensor-parallel engines RE-SHARD here too: the (possibly quantized)
+        tree is `device_put` onto the submesh with the model family's
+        Megatron rules (`derive_tp_param_shardings` — quantized {"q",
+        "scale"} entries ride their kernel's rule), so a rolling
+        `swap_weights` lands already-sharded weights with zero recompiles
+        and an already-placed tree passes through as the same buffers."""
         if self.weight_dtype == "int8":
             from .ops.quantization import quantize_params_int8
 
             value = quantize_params_int8(value)
+        if self.mesh is not None:
+            from .parallel.sharding import derive_tp_param_shardings
+
+            self._param_shardings = derive_tp_param_shardings(
+                value, self.mesh, self._tp_rules
+            )
+            value = jax.device_put(value, self._param_shardings)
         self._params = value
 
     def _init_cache(self):
@@ -599,7 +673,16 @@ class ContinuousBatcher:
                 lambda p: module.apply(resolve(p), dummy, mask, pos, mutable=["cache"])[1]["cache"],
                 self.params,
             )
-        return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        cache = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        if self.mesh is not None:
+            # Place the pools SHARDED by KV head over the submesh (scale
+            # pools by head, scalars replicated) — blast-radius rebuilds come
+            # through here too, so recovery reconstructs the sharded layout.
+            from .parallel.sharding import derive_tp_cache_shardings
+
+            self._cache_shardings = derive_tp_cache_shardings(cache, self.mesh)
+            cache = jax.device_put(cache, self._cache_shardings)
+        return cache
 
     @staticmethod
     def plan_admission_bucket(
@@ -662,6 +745,11 @@ class ContinuousBatcher:
         for bucket in self.insert_bucket_ladder():
             fn = self._insert_fn(bucket)
             dummy_cache = jax.tree_util.tree_map(jnp.zeros_like, self._cache)
+            if self._cache_shardings is not None:
+                # Warm with the REAL sharded signature: an unsharded dummy
+                # would compile a throwaway executable and the first live
+                # admission would still pay the sharded compile.
+                dummy_cache = jax.device_put(dummy_cache, self._cache_shardings)
             dummy_presence = (
                 jax.tree_util.tree_map(jnp.zeros_like, self._presence)
                 if self._presence is not None
@@ -703,12 +791,13 @@ class ContinuousBatcher:
         use_pen = self.use_repetition_penalty
         config = self._sample_config
         V = self.base_config.vocab_size
+        mesh = self.mesh
 
         def insert(params, cache, presence, input_ids, real_len, slot, temperature, penalty, rng):
             self.trace_counts["insert"] += 1
             positions = jnp.broadcast_to(jnp.arange(bucket)[None, :], (1, bucket))
             logits, small = prefill(params, input_ids, positions)
-            cache = tree_scatter_rows(cache, small, slot)
+            cache = constrain_tp_cache(tree_scatter_rows(cache, small, slot), mesh)
             # Logits at the REAL last prompt token (right-bucket pads sit above
             # it and, being causal, never influenced it).
             last = jax.lax.dynamic_slice_in_dim(logits, real_len - 1, 1, axis=1)[:, 0, :]
@@ -748,6 +837,7 @@ class ContinuousBatcher:
         config = self._sample_config
         V = self.base_config.vocab_size
         P = self.pages_per_slot
+        mesh = self.mesh
 
         def insert(
             params, pool_cache, presence, suffix_ids, real_len, matched_len,
@@ -765,7 +855,9 @@ class ContinuousBatcher:
             write_row = jnp.where(
                 jnp.arange(P) < matched_pages, jnp.int32(SCRATCH_PAGE), page_row
             )
-            pool_cache = tree_scatter_pages(pool_cache, dense, write_row)
+            pool_cache = constrain_tp_cache(
+                tree_scatter_pages(pool_cache, dense, write_row), mesh
+            )
             # Logits at the REAL last suffix token (bucket pads sit above it
             # and, being causal, never influenced it).
             last = jax.lax.dynamic_slice_in_dim(logits, real_len - 1, 1, axis=1)[:, 0, :]
@@ -798,6 +890,7 @@ class ContinuousBatcher:
         use_pen = self.use_repetition_penalty
         paged = self.paged
         config = self._sample_config
+        mesh = self.mesh
 
         def decode_chunk(params, cache, presence, token, pos, active, rem, eos_ids, temperature, penalty, page_table, rng):
             self.trace_counts["decode_chunk"] += 1
@@ -827,6 +920,7 @@ class ContinuousBatcher:
             carry = (cache, presence, token, pos, active, rem, rng)
             carry, (toks, valids) = jax.lax.scan(body, carry, None, length=chunk)
             cache, presence, token, pos, active, rem, rng = carry
+            cache = constrain_tp_cache(cache, mesh)
             # Pack the [chunk, S] stream TIME-major so each slot's tokens stay in
             # order, valid entries first: composite sort key = invalid*N + time.
             n = chunk * S
@@ -881,6 +975,7 @@ class ContinuousBatcher:
         verify_inner = self._verify_raw
         paged = self.paged
         k_draft, m_gram = self.draft_tokens, self.draft_ngram
+        mesh = self.mesh
 
         def decode_chunk(params, cache, presence, token, pos, active, rem, eos_ids, temperature, penalty, page_table, rng, history):
             self.trace_counts["decode_chunk"] += 1
@@ -928,6 +1023,7 @@ class ContinuousBatcher:
             carry = (cache, token, pos, active, rem, history)
             carry, (toks, valids, emitted_mat, proposed_mat) = jax.lax.scan(body, carry, None, length=chunk)
             cache, token, pos, active, rem, history = carry
+            cache = constrain_tp_cache(cache, mesh)
             # Pack [chunk, S, k+1] -> (slot, token) stream, time-major per slot
             # (row-major flatten keeps (iteration, block-index) order within a
             # slot), valid entries first — same composite key as the plain chunk.
@@ -1003,6 +1099,45 @@ class ContinuousBatcher:
         return total
 
     @property
+    def _home_device(self):
+        """The per-chip accounting device: the submesh's first device for a
+        mesh-spanning engine, the default device otherwise."""
+        if self.mesh is not None:
+            return self.mesh.devices.flat[0]
+        return jax.devices()[0]
+
+    @property
+    def per_device_weight_nbytes(self) -> int:
+        """Weight bytes resident on ONE chip, read off the LIVE shardings —
+        for a tp=N engine the Megatron-sharded kernels contribute ~1/N each,
+        replicated leaves (norms, biases) their full size."""
+        return tree_device_nbytes(self._params, self._home_device)
+
+    @property
+    def per_device_kv_cache_nbytes(self) -> int:
+        """Slot-cache bytes resident on ONE chip (pools sharded by KV head
+        contribute ~1/N under tp=N; scalars and pad masks replicate)."""
+        return tree_device_nbytes(self._cache, self._home_device)
+
+    def tp_sharding_report(self) -> Dict[str, Dict[str, str]]:
+        """{'params': {path: spec}, 'cache': {path: spec}} from the LIVE
+        arrays — the audit surface the tp tests and the serving bench read to
+        prove nothing fell back to silent full replication (TPU118's runtime
+        complement). Single-device engines report every leaf as
+        'single-device'."""
+        from .parallel.sharding import tree_paths_and_leaves
+
+        def describe(tree):
+            out = {}
+            for path, leaf in tree_paths_and_leaves(tree)[0]:
+                sharding = getattr(leaf, "sharding", None)
+                spec = getattr(sharding, "spec", None)
+                out[path] = str(spec) if spec is not None else "single-device"
+            return out
+
+        return {"params": describe(self._params), "cache": describe(self._cache)}
+
+    @property
     def stats(self) -> Dict[str, Any]:
         """Back-compat health view, computed from the metrics registry (the
         source of truth since the telemetry PR). Same keys and meanings as the
@@ -1011,6 +1146,7 @@ class ContinuousBatcher:
             "attention_impl": self.attention_impl,
             "weight_dtype": self.weight_dtype,
             "kv_cache_dtype": self.kv_cache_dtype,
+            "tp": self.tp,
             "inserts": int(self._m_inserts.value),
             "chunks": int(self._m_chunks.value),
             "decode_steps": int(self._m_decode_steps.value),
@@ -1152,6 +1288,12 @@ class ContinuousBatcher:
         self._cache = self._init_cache()
         if self._presence is not None:
             self._presence = jnp.zeros((self.num_slots, self.base_config.vocab_size), bool)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                self._presence = jax.device_put(
+                    self._presence, NamedSharding(self.mesh, PartitionSpec())
+                )
         if self.speculative:
             # The speculative state dies with the cache: every slot's drafting
             # context belonged to a request that just errored. Admissions

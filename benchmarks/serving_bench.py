@@ -632,6 +632,124 @@ def run_quant_workload(model, args, cfg, max_length, workload, tracer=None):
     return result
 
 
+def run_tensor_parallel_workload(model, args, cfg, max_length, workload, tracer=None):
+    """The tensor-parallel A/B (`--tp N`): the SAME mixed workload served by a
+    single-device engine and by one engine spanning an N-device submesh
+    (weights Megatron-sharded by the model family's rules, the KV pool
+    sharded by KV head, page tables and sampling scalars replicated traced
+    operands). Per row the block records decode tokens/sec, per-dispatch
+    attention seconds, and PER-CHIP weight + KV-pool bytes read off the live
+    shardings (`engine.per_device_*_nbytes`), each timed pass under the hard
+    0-recompile / 0-host-transfer gate. Asserts the two acceptance headlines:
+    greedy token IDENTITY tp=N vs tp=1, and combined per-chip weight+pool
+    bytes dropping to ~1/N (>= 60% of the ideal reduction — replicated
+    norms/biases/scalars keep it off the exact bound)."""
+    import jax
+
+    from accelerate_tpu.analysis import TraceGuard
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    prompts, budgets, arrivals = workload
+    tp_n = int(args.tp)
+    result = {
+        "backend": jax.default_backend(),
+        "attention_impl": args.attention_impl,
+        "kv_cache_dtype": args.kv_cache_dtype,
+        "weight_dtype": args.weight_dtype,
+        "devices_visible": len(jax.devices()),
+    }
+    baseline_tokens = None
+    for tp in (1, tp_n):
+        label = f"tp{tp}"
+        engine = ContinuousBatcher(
+            model, num_slots=args.num_slots, max_length=max_length,
+            chunk_size=args.chunk_size, paged=not args.no_paged,
+            page_size=args.page_size, tracer=tracer, max_queue=args.requests,
+            attention_impl=args.attention_impl,
+            weight_dtype=args.weight_dtype, kv_cache_dtype=args.kv_cache_dtype,
+            tp=tp,
+        )
+        log(f"tensor-parallel workload ({label}): warmup...")
+        engine.warm_inserts()
+        run_continuous(engine, prompts, budgets, arrivals)
+        run_continuous(engine, prompts, budgets, arrivals)
+        registry = engine.metrics
+        chunk_hist = registry.get("serving_chunk_seconds")
+        count0, sum0 = chunk_hist.count, chunk_hist.sum
+        guard = TraceGuard(
+            transfer_guard="disallow", on_violation="record",
+            name=f"serving-bench-tp-{label}",
+        )
+        engine.trace_guard = guard
+        tokens = {}
+        with guard:
+            tps, ttfts, iters, span = run_continuous(
+                engine, prompts, budgets, arrivals, collect_tokens=tokens
+            )
+        if guard.total_recompiles or guard.host_transfers:
+            log(f"TRACE-GUARD VIOLATIONS in tensor-parallel workload ({label}): {guard.report().summary()}")
+        # The sharded-operand discipline pin: collectives inserted by GSPMD
+        # must not cost the one-executable / zero-host-sync steady state.
+        assert guard.total_recompiles == 0 and guard.host_transfers == 0, (
+            f"tensor-parallel workload ({label}) regressed the 0-recompile / "
+            f"0-host-transfer discipline: {guard.report().summary()}"
+        )
+        if baseline_tokens is None:
+            baseline_tokens = tokens
+            agreement = 1.0
+        else:
+            pairs = [
+                (x, y)
+                for i in baseline_tokens
+                for x, y in zip(baseline_tokens[i], tokens.get(i, []))
+            ]
+            agreement = sum(x == y for x, y in pairs) / len(pairs) if pairs else None
+            # GSPMD partitioning is a layout change, not a numerics change:
+            # greedy decode must be token-IDENTICAL across tp degrees.
+            assert agreement == 1.0, (
+                f"tp={tp} diverged from tp=1 greedy tokens "
+                f"(agreement {agreement}) — sharded decode is not token-exact"
+            )
+        chunks = chunk_hist.count - count0
+        chunk_s = (chunk_hist.sum - sum0) / max(chunks, 1)
+        sharded_leaves = sum(
+            1 for spec in engine.tp_sharding_report()["params"].values() if "model" in spec
+        )
+        result[label] = {
+            "tp": tp,
+            "tokens_per_sec": round(tps, 2),
+            "ttft_p50_ms": round(pct(ttfts, 50) * 1000, 2),
+            "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 2),
+            "makespan_s": round(span, 3),
+            "decode_iterations": iters,
+            "decode_chunk_mean_s": round(chunk_s, 6),
+            "decode_attention_s_per_dispatch": round(chunk_s / args.chunk_size, 6),
+            "per_chip_weight_bytes": engine.per_device_weight_nbytes,
+            "per_chip_kv_pool_bytes": engine.per_device_kv_cache_nbytes,
+            "params_leaves_sharded": sharded_leaves,
+            "token_agreement_vs_tp1": round(agreement, 4) if agreement is not None else None,
+            "recompiles": guard.total_recompiles,
+            "host_transfers": guard.host_transfers,
+        }
+    base = result["tp1"]["per_chip_weight_bytes"] + result["tp1"]["per_chip_kv_pool_bytes"]
+    tp_key = f"tp{tp_n}"
+    spanned = result[tp_key]["per_chip_weight_bytes"] + result[tp_key]["per_chip_kv_pool_bytes"]
+    ratio = base / max(spanned, 1)
+    result["per_chip_bytes_ratio_tp1_over_tpN"] = round(ratio, 3)
+    result["tokens_per_sec_ratio_tpN_over_tp1"] = round(
+        result[tp_key]["tokens_per_sec"] / max(result["tp1"]["tokens_per_sec"], 1e-9), 3
+    )
+    # The footprint headline: per-chip weight+pool bytes must approach 1/N.
+    # 60% of ideal leaves room for replicated norms/biases/pad masks at the
+    # tiny CPU-smoke sizes; real model shapes sit much closer to N.
+    assert ratio >= 1.0 + 0.6 * (tp_n - 1), (
+        f"tp={tp_n} only cut per-chip weight+pool bytes {ratio:.2f}x "
+        f"(expected >= {1.0 + 0.6 * (tp_n - 1):.2f}x) — something is "
+        "silently replicated (see engine.tp_sharding_report())"
+    )
+    return result
+
+
 def run_prefix_workload(model, args, cfg, max_length, rng, tracer=None):
     """The prefix-heavy serving workload: every request opens with the SAME
     `--prefix-tokens`-long system prompt followed by a random tail. Served
@@ -926,6 +1044,12 @@ def main(argv=None):
     parser.add_argument("--no-quant-ab", action="store_true",
                         help="skip the quantization A/B workload (bf16 vs int8 weights + "
                         "int8/fp8 KV cache on the same workload)")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel A/B: serve the same workload through a "
+                        "single-device engine and ONE engine spanning a --tp-device "
+                        "submesh (Megatron-sharded weights, KV pool sharded by KV "
+                        "head) — token parity asserted, per-chip bytes recorded in "
+                        "extra.tensor_parallel; 1 disables")
     parser.add_argument("--replicas", type=int, default=1,
                         help="run the replicated-router workload over N engines with a "
                         "kill-one-replica A/B (throughput dip + recovery time); 1 disables")
@@ -1121,6 +1245,15 @@ def main(argv=None):
             model, args, cfg, max_length, (prompts, budgets, arrivals), tracer=tracer
         )
 
+    # Tensor-parallel A/B (--tp N): tp=1 vs one engine spanning N devices on
+    # the same workload — token parity and the ~1/N per-chip footprint drop
+    # asserted, per-chip bytes read off the live shardings.
+    tp_block = None
+    if args.tp > 1:
+        tp_block = run_tensor_parallel_workload(
+            model, args, cfg, max_length, (prompts, budgets, arrivals), tracer=tracer
+        )
+
     # Replicated-router A/B: the same workload behind a health-routed fleet,
     # with one replica chaos-killed mid-traffic (dip + recovery measured).
     router_block = None
@@ -1246,6 +1379,12 @@ def main(argv=None):
             # accepted_tokens_per_step, spec-off vs spec-on, both timed passes
             # TraceGuard-verified at 0 recompiles / 0 host transfers.
             "speculative_workload": spec_block,
+            # Tensor-parallel A/B (--tp N): tp=1 vs one mesh-spanning engine
+            # on the same workload — tokens/sec, per-dispatch attention
+            # seconds, per-chip weight + KV-pool bytes from live shardings
+            # (~1/N asserted), greedy token identity asserted, TraceGuard
+            # 0/0 per row (docs/observability.md).
+            "tensor_parallel": tp_block,
             # Replicated-fleet A/B (--replicas N): baseline vs kill-one-replica
             # throughput, degraded-window tokens/sec, measured recovery
             # seconds, retry/replica_lost accounting — still 0 recompiles /

@@ -310,6 +310,52 @@ def test_tpu117_variants():
     assert not analyze_source(hazard.replace("import jax\n", ""))
 
 
+def test_tpu118_variants():
+    """Beyond the flag fixture's bare device_put (one finding per fixture):
+    a raw-device placement flags, a None placement flags, a NamedSharding /
+    derived-shardings / unknown-name placement is clean (precomputed sharding
+    pytrees get the benefit of the doubt), a module with NO "model"-axis mesh
+    is out of scope however it places things, a Mesh(..., ("model",)) literal
+    counts as mesh-spanning the same as serving_tp_mesh, and a jax-free
+    module is out of scope."""
+    hazard = (
+        "import jax\n"
+        "from accelerate_tpu.parallel.sharding import serving_tp_mesh\n"
+        "def place(params):\n"
+        "    mesh = serving_tp_mesh(4)\n"
+        "    return jax.device_put(params, jax.devices()[0])\n"
+    )
+    assert [f.rule_id for f in analyze_source(hazard)] == ["TPU118"]
+    assert [f.rule_id for f in analyze_source(
+        hazard.replace("jax.devices()[0]", "None")
+    )] == ["TPU118"]
+    assert not analyze_source(
+        hazard.replace("jax.devices()[0]", "NamedSharding(mesh, spec)")
+    )
+    assert not analyze_source(
+        hazard.replace("jax.devices()[0]", "derive_tp_param_shardings(params, mesh, rules)")
+    )
+    assert not analyze_source(hazard.replace("jax.devices()[0]", "shardings"))
+    # No "model"-axis mesh in the module: ordinary single-device placement.
+    no_mesh = (
+        "import jax\n"
+        "def place(params):\n"
+        "    return jax.device_put(params)\n"
+    )
+    assert not analyze_source(no_mesh)
+    # A literal Mesh with a "model" axis counts as mesh-spanning too.
+    literal_mesh = (
+        "import jax\n"
+        "from jax.sharding import Mesh\n"
+        "def place(params, devices):\n"
+        '    mesh = Mesh(devices, ("model",))\n'
+        "    return jax.device_put(params)\n"
+    )
+    assert [f.rule_id for f in analyze_source(literal_mesh)] == ["TPU118"]
+    assert not analyze_source(literal_mesh.replace('("model",)', '("data",)'))
+    assert not analyze_source(hazard.replace("import jax\n", ""))
+
+
 def test_analyze_paths_walks_the_tree():
     findings, scanned = analyze_paths([str(SAMPLES)])
     assert scanned >= 2 * len(RULES) + 1  # flag + clean per rule + suppressed.py

@@ -119,23 +119,26 @@ def test_worker_module_is_warn_clean():
     )
 
 
-def test_kernel_serving_path_is_warn_clean_at_17_rules():
+def test_kernel_serving_path_is_warn_clean_at_18_rules():
     """The Pallas kernel path pin: `ops/` (the kernels + the dispatch seams +
-    the quantization module) and the kernel-touching serving/generation files
-    stay warn-clean under the FULL 17-rule registry — including TPU115, so
-    nothing in the shipped tree pins a paged decode program to the gather
-    oracle or forces interpret mode outside tests, and TPU117, so no shipped
-    quantization seam bakes a scale literal or an off-set kv_cache_dtype into
-    a program. The rule-count assert keeps this test honest: if the registry
-    grows, this pin re-evaluates the kernel path under the new rule instead
-    of silently gating against a stale set."""
+    the quantization module), the kernel-touching serving/generation files,
+    and the TP sharding module stay warn-clean under the FULL 18-rule
+    registry — including TPU115, so nothing in the shipped tree pins a paged
+    decode program to the gather oracle or forces interpret mode outside
+    tests; TPU117, so no shipped quantization seam bakes a scale literal or
+    an off-set kv_cache_dtype into a program; and TPU118, so the
+    mesh-spanning serving engine itself never places a params/pool tree
+    without a NamedSharding. The rule-count assert keeps this test honest:
+    if the registry grows, this pin re-evaluates the kernel path under the
+    new rule instead of silently gating against a stale set."""
     from accelerate_tpu.analysis import RULES
 
-    assert len(RULES) == 17, "rule registry changed — re-audit the kernel-path pin"
+    assert len(RULES) == 18, "rule registry changed — re-audit the kernel-path pin"
     roots = [
         REPO / "accelerate_tpu" / "ops",
         REPO / "accelerate_tpu" / "serving.py",
         REPO / "accelerate_tpu" / "generation.py",
+        REPO / "accelerate_tpu" / "parallel" / "sharding.py",
     ]
     findings, scanned = analyze_paths([str(r) for r in roots])
     assert scanned >= 8, f"kernel-path files missing? scanned {scanned}"

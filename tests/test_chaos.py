@@ -473,6 +473,51 @@ def test_smoke_serve_sweep_on_the_quantized_pool():
     assert ledger.details["consistency_problems"] == []
 
 
+@pytest.mark.tp
+def test_smoke_serve_sweep_on_a_tensor_parallel_engine():
+    """The smoke-serve acceptance sweep with `tp=2`: the engine spans a
+    2-device submesh (Megatron-sharded weights, KV pool sharded by KV head),
+    and every serving invariant holds unchanged — PLUS the new
+    `tp_pool_sharded` check: fault recovery must leave the live pools sharded
+    on the submesh, never silently replicated."""
+    plan = builtin_plans()["smoke-serve"]
+    report = ChaosRunner(plan).run_serve(num_requests=6, max_queue=3, tp=2)
+    assert report.ok, report.render_text()
+    by_name = {c.name: c for c in report.checks}
+    assert by_name["terminal_finish_reasons"].details["accepted"] >= 6
+    assert by_name["engine_recovered"].details.get("requests_after_error", 0) >= 2
+    sharded = by_name["tp_pool_sharded"]
+    assert sharded.details["mesh_devices"] == 2
+    assert sharded.details["sharded_leaves"] > 0
+    assert sharded.details["unsharded_leaves"] == []
+
+
+@pytest.mark.tp
+def test_consumed_donation_recovers_sharded_on_the_tp_submesh():
+    """Blast-radius recovery on a mesh-spanning engine: the injected chunk
+    failure deletes the donated SHARDED pool mid-flight; the rebuild must
+    recreate the pools (and, int8, the scale pools) from zeros ON THE
+    SUBMESH — `tp_pool_sharded` fails on a replicated rebuild — with the
+    page ledger closed and post-recovery traffic served by the same warm
+    sharded executables."""
+    plan = FaultPlan(
+        name="chunk-consumes-donation-tp",
+        events=[FaultEvent(kind="serve.dispatch_error", at_call=3,
+                           args={"consume_donated": True})],
+    )
+    report = ChaosRunner(plan).run_serve(
+        num_requests=8, max_queue=6, tp=2, kv_cache_dtype="int8"
+    )
+    assert report.ok, report.render_text()
+    recovered = next(c for c in report.checks if c.name == "engine_recovered")
+    assert recovered.details["requests_after_error"] >= 2
+    ledger = next(c for c in report.checks if c.name == "page_ledger")
+    assert ledger.details["pages_in_use_after_drain"] == 0
+    assert ledger.details["consistency_problems"] == []
+    sharded = next(c for c in report.checks if c.name == "tp_pool_sharded")
+    assert sharded.passed, sharded.details
+
+
 @pytest.mark.kernels
 def test_consumed_donation_recovers_on_the_quantized_kernel_path():
     """Blast-radius recovery on the quantized KERNEL path: the injected chunk

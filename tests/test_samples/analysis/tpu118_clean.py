@@ -1,0 +1,28 @@
+"""TPU118 clean fixture: the sanctioned mesh-spanning placements — shardings
+derived from the model family's Megatron rules ride every device_put, or the
+engine does the placement internally via ContinuousBatcher(tp=N)."""
+
+import jax
+
+from accelerate_tpu.parallel.sharding import (
+    derive_tp_cache_shardings,
+    derive_tp_param_shardings,
+    serving_tp_mesh,
+)
+from accelerate_tpu.serving import ContinuousBatcher
+
+
+def place_params(params, rules):
+    mesh = serving_tp_mesh(4)
+    shardings = derive_tp_param_shardings(params, mesh, rules)
+    return jax.device_put(params, shardings)
+
+
+def place_cache(cache):
+    mesh = serving_tp_mesh(4)
+    return jax.device_put(cache, derive_tp_cache_shardings(cache, mesh))
+
+
+def build_engine(model):
+    # The engine's params setter and cache init place everything sharded.
+    return ContinuousBatcher(model, max_queue=8, tp=4)

@@ -1,0 +1,19 @@
+"""TPU118 flag fixture: a mesh-spanning serving module that `device_put`s its
+params tree with NO NamedSharding — the tree lands on one device and every
+sharded executable replicates it to all chips, silently spending N x the
+per-chip HBM the mesh exists to save. (The raw-device placement and
+non-mesh-module variants are unit-tested in
+test_analysis_rules.test_tpu118_variants; the tree-walk contract allows
+exactly one finding per flag fixture.)"""
+
+import jax
+
+from accelerate_tpu.parallel.sharding import serving_tp_mesh
+
+
+def build_engine_state(params):
+    mesh = serving_tp_mesh(4)
+    # FLAG: no sharding — params land on one device, jit replicates them to
+    # every chip of the mesh built above.
+    placed = jax.device_put(params)
+    return mesh, placed
