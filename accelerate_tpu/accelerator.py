@@ -543,6 +543,40 @@ class Accelerator:
             from .utils.operations import broadcast
 
             model.params = broadcast(model.params, from_process=0)
+        if isinstance(model.sharding_rules, str):
+            # sharding_rules="auto": the cost-model planner searches the
+            # MODEL-axis (tensor-parallel) layout for this mesh and emits the
+            # rules table every consumer below (param/opt-state derivation)
+            # already reads. The planner owns only the "model" axis here:
+            # "fsdp" sharding stays the deriver's job — the fsdp_plugin is
+            # the user's explicit memory request, spec_for_param extends the
+            # planner's rules with the fsdp dim exactly as it extends the
+            # hand tables (Megatron+ZeRO composition), and overriding that
+            # from a cost model that can't see the real batch would silently
+            # undo a policy the user set on purpose. The resolved table
+            # replaces the sentinel on the bundle so the optimizer's mirrored
+            # derivation sees the same rules, not the string.
+            from .parallel.planner import Workload, resolve_sharding_rules
+
+            if model.sharding_rules == "rules":
+                raise ValueError(
+                    "sharding_rules='rules' is a serving-engine sentinel (it "
+                    "means 'fall back to the Model bundle's family table'); on "
+                    "this seam the bundle's sharding_rules IS that table, and "
+                    "the sentinel just overwrote it — leave the table in place, "
+                    "or pass 'auto' for the planner"
+                )
+            adam_bytes = 8.0  # fp32 moments; the dominant non-param account
+            rules, _plan = resolve_sharding_rules(
+                model.sharding_rules,
+                model.params,
+                mesh,
+                plan_kwargs=dict(
+                    axes=("model",),
+                    workload=Workload(batch=8, seq=512, opt_bytes_per_param=adam_bytes),
+                ),
+            )
+            model.sharding_rules = rules
         param_sharding = derive_param_shardings(
             model.params, mesh, fsdp_plugin=fsdp, rules=model.sharding_rules
         )

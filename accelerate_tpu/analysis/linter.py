@@ -114,6 +114,10 @@ class _ModuleIndex:
         #: fallbacks below don't count): the "jit-adjacent module" signal
         #: rules like TPU114 scope themselves to.
         self.imports_jax = False
+        #: A flax import was seen: the "model module" signal TPU119 scopes
+        #: itself to (sharding-rule tables ship next to the flax modules
+        #: whose parameter paths they must match).
+        self.imports_flax = False
         self.jnp_aliases: Set[str] = set()
         self.np_aliases: Set[str] = set()
         self.lax_aliases: Set[str] = set()
@@ -146,12 +150,16 @@ class _ModuleIndex:
                         self.imports_jax = True
                     elif name in ("numpy",):
                         self.np_aliases.add(bound)
+                    elif name == "flax" or name.startswith("flax."):
+                        self.imports_flax = True
                     elif name == "functools":
                         pass
             elif isinstance(node, ast.ImportFrom):
                 mod = node.module or ""
                 if mod == "jax" or mod.startswith("jax."):
                     self.imports_jax = True
+                if mod == "flax" or mod.startswith("flax."):
+                    self.imports_flax = True
                 for alias in node.names:
                     bound = alias.asname or alias.name
                     if mod == "jax" and alias.name == "numpy":
@@ -673,6 +681,7 @@ class _ModuleChecker:
         self._check_tp_replicated_operand()
         self._check_worker_loop()
         self._check_quantization()
+        self._check_dead_partition_rule()
         return self.findings
 
     # -- quantized serving (TPU117) ----------------------------------------------
@@ -998,6 +1007,131 @@ class _ModuleChecker:
                     "to every chip — derive shardings from the model family's rules "
                     "(derive_tp_param_shardings / derive_tp_cache_shardings) or let "
                     "ContinuousBatcher(tp=N) place it",
+                )
+
+    # -- dead partition rules (TPU119) --------------------------------------------
+    #: Pattern tokens that name STORAGE details every family table shares, not
+    #: module identity — a pattern made only of these can't be judged dead.
+    _RULE_GENERIC_TOKENS = {
+        "kernel",
+        "embedding",
+        "embed",
+        "bias",
+        "scale",
+        "layers",
+        "layer",
+        "params",
+        "weight",
+    }
+
+    @staticmethod
+    def _pattern_tokens(pattern: str) -> List[str]:
+        """Identifier-ish fragments of a path regex ("(wq|wk|wv)/kernel" ->
+        [wq, wk, wv]), generic storage words removed; single letters are too
+        ambiguous to judge."""
+        tokens = re.findall(r"[A-Za-z_][A-Za-z0-9_]+", pattern)
+        return [
+            t
+            for t in tokens
+            if len(t) >= 2 and t.lower() not in _ModuleChecker._RULE_GENERIC_TOKENS
+        ]
+
+    def _sharding_tables(self) -> List[ast.Assign]:
+        tables = []
+        for node in ast.walk(self.index.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("SHARDING_RULES")
+                and isinstance(node.value, (ast.List, ast.Tuple))
+            ):
+                tables.append(node)
+        return tables
+
+    def _name_evidence(self, exclude: List[ast.AST]) -> Set[str]:
+        """Every name-ish string in the module OUTSIDE the rule tables: flax
+        submodule names arrive as `name="wq"` constants or f-string parts,
+        attribute targets, dict keys, identifiers. This is what a live
+        pattern's tokens must connect to."""
+        skip = set()
+        for table in exclude:
+            for sub in ast.walk(table):
+                skip.add(id(sub))
+        evidence: Set[str] = set()
+        for node in ast.walk(self.index.tree):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.Name):
+                evidence.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                evidence.add(node.attr)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                evidence.add(node.name)
+            elif isinstance(node, ast.keyword) and node.arg:
+                evidence.add(node.arg)
+            elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+                # Identifier-like strings only (flax `name="wq"` kwargs,
+                # f-string parts like "layer_"): free-text constants such as
+                # docstrings would vouch for anything they happen to mention.
+                if re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", node.value):
+                    evidence.add(node.value)
+        return {e for e in evidence if len(e) >= 2}
+
+    def _check_dead_partition_rule(self):
+        """TPU119: a sharding-rules entry whose regex names modules the model
+        never defines matches NO parameter path at derivation time — the
+        weight it was written to shard silently replicates, the table-side
+        twin of TPU118's silent-replication placement. Also flagged: a
+        literal string-axis `PartitionSpec(...)` in model code — per-leaf
+        placement decisions scattered outside the one rules table the
+        derivation seam (and the planner's emitted tables) can audit."""
+        if not self.index.imports_jax or not self.index.imports_flax:
+            return
+        tables = self._sharding_tables()
+        evidence = self._name_evidence(exclude=tables) if tables else set()
+        for table in tables:
+            for entry in table.value.elts:
+                if not (isinstance(entry, ast.Tuple) and len(entry.elts) == 2):
+                    continue
+                pattern = entry.elts[0]
+                if not (isinstance(pattern, ast.Constant) and isinstance(pattern.value, str)):
+                    continue
+                tokens = self._pattern_tokens(pattern.value)
+                if not tokens:
+                    continue  # all-generic pattern: can't judge statically
+                alive = any(tok in ev for tok in tokens for ev in evidence)
+                if not alive:
+                    self.emit(
+                        entry,
+                        "TPU119",
+                        f"rule pattern {pattern.value!r} names no module this "
+                        "model defines — the entry matches no parameter path, "
+                        "so the weight it was written to shard silently "
+                        "replicates; fix the regex or delete the entry",
+                    )
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._call_name(node.func) != "PartitionSpec":
+                continue
+            has_axis_literal = any(
+                isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+                for arg in node.args
+            ) or any(
+                isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                for arg in node.args
+                if isinstance(arg, (ast.Tuple, ast.List))
+                for sub in arg.elts
+            )
+            if has_axis_literal:
+                self.emit(
+                    node,
+                    "TPU119",
+                    "literal per-leaf PartitionSpec in model code bypasses the "
+                    "family's sharding-rules table — move the placement into "
+                    "*_SHARDING_RULES (or let sharding_rules=\"auto\" emit it) "
+                    "so the one derivation seam sees every decision",
                 )
 
     def _check_jit_placement(self):

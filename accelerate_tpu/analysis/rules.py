@@ -228,6 +228,22 @@ RULES = (
         "HBM the mesh exists to save (the accidental-fallback analogue of "
         "TPU115)",
     ),
+    Rule(
+        id="TPU119",
+        slug="dead-partition-rule",
+        severity="warn",
+        summary="a (pattern, spec) entry in a sharding-rules table whose regex "
+        "matches no parameter path of the model it ships with, or a literal "
+        "per-leaf PartitionSpec scattered in model code outside the rule "
+        "tables",
+        fixit="delete the dead entry (or fix its regex to name a module the "
+        "model actually defines) — an entry that matches nothing silently "
+        "replicates the weight it was written to shard, the same failure the "
+        "planner's audit would catch; and keep per-leaf PartitionSpecs out of "
+        "model code: route them through the family's *_SHARDING_RULES table "
+        "or let sharding_rules=\"auto\" (parallel.planner) emit the table, so "
+        "every placement decision stays visible to the one derivation seam",
+    ),
 )
 
 RULES_BY_ID = {r.id: r for r in RULES}

@@ -16,7 +16,7 @@ def get_command_parser():
 
     # Subcommand modules are imported lazily so `--help` stays fast and optional deps
     # (yaml, rich) are only touched by the commands that need them.
-    from . import analysis, chaos, config, convert, env, estimate, launch, serve, test, tpu, trace
+    from . import analysis, chaos, config, convert, env, estimate, launch, plan, serve, test, tpu, trace
 
     analysis.register_subcommand(subparsers)
     chaos.register_subcommand(subparsers)
@@ -24,6 +24,7 @@ def get_command_parser():
     env.register_subcommand(subparsers)
     estimate.register_subcommand(subparsers)
     launch.register_subcommand(subparsers)
+    plan.register_subcommand(subparsers)
     serve.register_subcommand(subparsers)
     test.register_subcommand(subparsers)
     tpu.register_subcommand(subparsers)

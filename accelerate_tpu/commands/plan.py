@@ -1,0 +1,189 @@
+"""`accelerate-tpu plan` — the sharding-strategy planner as a command.
+
+Searches the tensor-parallel decode layout for a named in-tree model (the
+cost-model planner behind ``sharding_rules="auto"``, `parallel/planner.py`)
+and prints the chosen plan: per-leaf PartitionSpecs, the emitted
+``(pattern, spec)`` rules table, predicted per-chip HBM bytes and predicted
+collective traffic per dispatch — plus the same cost model priced over the
+family's hand-written table, so the auto-vs-hand comparison is one command.
+
+Planning is pure shape arithmetic: parameter shapes come from
+``jax.eval_shape`` over the module's init where the family allows it (no
+weight materialization — planning a 70B layout works on a laptop), and the
+mesh is abstract (``--tp 64`` needs no devices). Only ``--refine-top-k``
+compiles anything: the top-k candidates' params are placed for real and a
+one-token forward is timed per candidate (cost model proposes, hardware
+disposes), which requires the tp to fit the visible devices."""
+
+import argparse
+import json
+
+
+def register_subcommand(subparsers):
+    parser = subparsers.add_parser(
+        "plan", help="Search + print a sharding plan for a named model"
+    )
+    parser.add_argument(
+        "model", nargs="?", default="llama-tiny",
+        help="Named in-tree model (accelerate_tpu.models registry)",
+    )
+    parser.add_argument("--tp", type=int, default=2, help="Tensor-parallel degree to plan for")
+    parser.add_argument("--num-slots", type=int, default=8, help="Serving slots (decode batch rows)")
+    parser.add_argument("--max-length", type=int, default=None, help="Per-slot cache length (default: model max)")
+    parser.add_argument("--page-size", type=int, default=16, help="KV pool page size (paged cache)")
+    parser.add_argument("--no-paged", action="store_true", help="Price the contiguous per-slot KV layout")
+    parser.add_argument("--kv-cache-dtype", default="bf16", choices=["bf16", "int8", "fp8_e4m3"],
+                        help="KV pool storage dtype the cost model prices")
+    parser.add_argument("--weight-dtype", default="bf16", choices=["bf16", "int8"],
+                        help="Weight storage dtype (int8 prices quantized kernels + scales)")
+    parser.add_argument("--chip", default=None, help="Chip constants (parallel.planner.CHIPS key); default: by backend")
+    parser.add_argument("--beam-width", type=int, default=8, help="Beam width for the strategy search")
+    parser.add_argument("--refine-top-k", type=int, default=0,
+                        help="Compile + time the top-k candidates and pick the measured best "
+                        "(needs tp visible devices)")
+    parser.add_argument("--seq-len", type=int, default=8, help="Init sequence length for shape derivation")
+    parser.add_argument("--json", action="store_true", help="Machine-readable plan JSON")
+    parser.set_defaults(func=plan_command)
+    return parser
+
+
+#: Families whose modules init from a bare [1, seq] int32 token batch — these
+#: plan from `jax.eval_shape` (no weight materialization). Others fall back to
+#: building the real bundle.
+_CAUSAL_FAMILIES = ("llama", "gpt_neox", "gptj", "opt", "mixtral")
+
+
+def _model_shapes(name: str, seq_len: int, materialize: bool):
+    """(params-or-shapes tree, config, hand rules table, apply_fn-or-None,
+    real-params-or-None) for a registry name."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import models as model_zoo
+    from ..models import CREATE_BY_FAMILY, get_model_family
+
+    family, config = get_model_family(name)
+    if materialize or family not in _CAUSAL_FAMILIES:
+        bundle = CREATE_BY_FAMILY[family](config, seq_len=seq_len)
+        return bundle.params, config, list(bundle.sharding_rules or []), bundle.apply_fn, bundle.params
+
+    module_cls = {
+        "llama": model_zoo.LlamaForCausalLM,
+        "gpt_neox": model_zoo.GPTNeoXForCausalLM,
+        "gptj": model_zoo.GPTJForCausalLM,
+        "opt": model_zoo.OPTForCausalLM,
+        "mixtral": model_zoo.MixtralForCausalLM,
+    }[family]
+    module = module_cls(config)
+    sample = jnp.zeros((1, min(seq_len, config.max_position_embeddings)), jnp.int32)
+    shapes = jax.eval_shape(module.init, jax.random.key(0), sample)
+    import importlib
+
+    family_module = importlib.import_module(f"accelerate_tpu.models.{family}")
+    rules = list(getattr(family_module, f"{family.upper()}_SHARDING_RULES", None) or [])
+    return shapes, config, rules, module.apply, None
+
+
+def plan_command(args):
+    import numpy as np
+
+    from ..parallel.planner import (
+        CHIPS,
+        measure_forward_step,
+        plan_serving_sharding,
+        refine_plans,
+        score_rules,
+    )
+
+    chip = CHIPS[args.chip] if args.chip else None
+    refine = max(0, int(args.refine_top_k))
+    params, config, hand_rules, apply_fn, real_params = _model_shapes(
+        args.model, args.seq_len, materialize=refine >= 1
+    )
+    max_length = int(args.max_length or config.max_position_embeddings)
+    paged = not args.no_paged
+    if paged:
+        pages_per_slot = -(-max_length // args.page_size)
+        padded_length = pages_per_slot * args.page_size
+        num_pages = args.num_slots * pages_per_slot + 1
+    else:
+        padded_length = max_length
+        num_pages = 0
+
+    mesh = {"model": int(args.tp)}
+    plan_kwargs = dict(
+        num_slots=args.num_slots,
+        padded_length=padded_length,
+        paged=paged,
+        page_size=args.page_size,
+        num_pages=num_pages,
+        kv_cache_dtype=args.kv_cache_dtype,
+        weight_dtype=args.weight_dtype,
+        chip=chip,
+        beam_width=args.beam_width,
+    )
+    measurements = None
+    if refine >= 1:
+        # Measured selection needs real devices: build the live submesh and
+        # time a one-token forward per candidate (refine-top-k 1 still
+        # measures the single chosen plan).
+        from ..parallel.sharding import serving_tp_mesh
+
+        live_mesh = serving_tp_mesh(args.tp)
+        plans = plan_serving_sharding(params, live_mesh, config, top_k=refine, **plan_kwargs)
+        if not isinstance(plans, list):
+            plans = [plans]
+        plan, measured = refine_plans(
+            plans,
+            lambda p: measure_forward_step(
+                apply_fn, real_params, live_mesh, p.rules, batch=1
+            ),
+        )
+        measurements = [(i, seconds) for i, (_, seconds) in enumerate(measured)]
+    else:
+        plan = plan_serving_sharding(params, mesh, config, **plan_kwargs)
+
+    hand = (
+        score_rules(
+            params, mesh, hand_rules,
+            chip=chip, workload=plan.workload, weight_dtype=args.weight_dtype,
+        )
+        if hand_rules
+        else None
+    )
+
+    if args.json:
+        payload = {"model": args.model, "plan": plan.to_json()}
+        if hand is not None:
+            payload["hand_rules"] = {
+                "rules": [[p, list(s)] for p, s in hand.rules],
+                "predicted": hand.to_json()["predicted"],
+                "modeled_cost": hand.cost.total,
+            }
+            payload["plan"]["modeled_cost"] = plan.cost.total
+            payload["auto_beats_hand"] = plan.cost.total <= hand.cost.total
+        if measurements is not None:
+            payload["refine_measurements_s"] = [s for _, s in measurements]
+        print(json.dumps(payload, indent=2))
+        return payload
+
+    print(f"[plan] {args.model} | tp={args.tp} | slots={args.num_slots} | "
+          f"{'paged' if paged else 'contiguous'} kv={args.kv_cache_dtype} "
+          f"weights={args.weight_dtype}")
+    print()
+    print(plan.describe())
+    if measurements is not None:
+        print()
+        print("measure-and-refine (top-{}):".format(len(measurements)))
+        for i, seconds in measurements:
+            print(f"  candidate {i}: {seconds * 1e6:.1f} us")
+    if hand is not None:
+        print()
+        verdict = "matches or beats" if plan.cost.total <= hand.cost.total else "LOSES TO"
+        print(
+            f"hand-written family table: modeled cost {hand.cost.total:.3e} "
+            f"(per-chip {int(hand.cost.per_chip_total_bytes)} bytes, "
+            f"ici {int(hand.cost.collective_bytes)} B/dispatch) — "
+            f"auto plan ({plan.cost.total:.3e}) {verdict} it"
+        )
+    return plan

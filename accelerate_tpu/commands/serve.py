@@ -111,6 +111,13 @@ def register_subcommand(subparsers):
         "get disjoint device groups when the topology allows, so "
         "--replicas R --tp N uses R*N chips",
     )
+    parser.add_argument(
+        "--sharding", default="rules", choices=["rules", "auto"],
+        help="tensor-parallel partition source: \"rules\" = the model "
+        "family's hand-written table, \"auto\" = the cost-model planner "
+        "searches the layout and emits an equivalent table "
+        "(accelerate-tpu plan shows what it would pick)",
+    )
     parser.set_defaults(func=serve_command)
     return parser
 
@@ -156,6 +163,14 @@ def serve_command(args):
             file=sys.stderr,
         )
         raise SystemExit(2)
+    if args.sharding == "auto" and args.tp <= 1:
+        print(
+            "accelerate-tpu serve: --sharding auto plans a tensor-parallel "
+            "layout — pass --tp N (N > 1); a single-device engine has nothing "
+            "to partition",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
     if args.tp > 1 and args.out_of_process:
         print(
             "accelerate-tpu serve: --tp composes with in-process replicas only "
@@ -189,6 +204,7 @@ def serve_command(args):
         weight_dtype=args.weight_dtype,
         kv_cache_dtype=args.kv_cache_dtype,
         tp=args.tp,
+        sharding_rules=args.sharding,
     )
     print(
         f"[serve] model {args.model} | "

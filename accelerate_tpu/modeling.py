@@ -147,15 +147,16 @@ class PreparedModel:
         self.offload_params = False
         self.param_compute_sharding = param_sharding
         if offload_params and param_sharding is not None:
-            from .parallel.sharding import host_memory_available, with_memory_kind
+            from .parallel.sharding import host_memory_available, host_memory_kind, with_memory_kind
 
             if host_memory_available():
                 self.offload_params = True
-                param_sharding = with_memory_kind(param_sharding, "pinned_host")
+                param_sharding = with_memory_kind(param_sharding, host_memory_kind())
             else:
                 logger.warning(
-                    "offload_params requested but this backend exposes no pinned_host "
-                    "memory space; parameters stay in device memory."
+                    "offload_params requested but this backend exposes no host-tier "
+                    "memory space (pinned_host/unpinned_host); parameters stay in "
+                    "device memory."
                 )
         self.param_sharding = param_sharding
 

@@ -27,6 +27,7 @@ from ..parallel.sharding import constrain_activation
 from ..ops.remat import maybe_remat
 from .llama import causal_lm_loss
 
+# Parity oracle for the sharding planner (see LLAMA_SHARDING_RULES).
 GPT_NEOX_SHARDING_RULES = [
     (r"(wq|wk|wv)/kernel", (None, "model")),
     (r"wo/kernel", ("model", None)),

@@ -28,6 +28,10 @@ from ..ops.attention import (
 from ..parallel.sharding import constrain_activation
 from ..ops.remat import maybe_remat
 
+# The hand-written Megatron layout. Since the sharding planner landed
+# (parallel/planner.py, sharding_rules="auto") this table is the parity
+# ORACLE the planner is tested against, not the required source — the auto
+# plan must match or beat it on modeled cost with identical greedy tokens.
 LLAMA_SHARDING_RULES = [
     (r"(wq|wk|wv)/kernel", (None, "model")),
     (r"wo/kernel", ("model", None)),

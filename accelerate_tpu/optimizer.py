@@ -256,6 +256,7 @@ class AcceleratedOptimizer:
             from .parallel.sharding import (
                 derive_opt_state_shardings,
                 host_memory_available,
+                host_memory_kind,
                 with_memory_kind,
             )
 
@@ -272,7 +273,8 @@ class AcceleratedOptimizer:
                 if want_offload and not host_memory_available():
                     logger.warning(
                         "offload_optimizer_state requested but this backend exposes no "
-                        "pinned_host memory space; optimizer state stays in device memory."
+                        "host-tier memory space (pinned_host/unpinned_host); optimizer "
+                        "state stays in device memory."
                     )
                     want_offload = False
                 if want_disk:
@@ -296,7 +298,9 @@ class AcceleratedOptimizer:
                     # llama-1b against a 16 GB chip).
                     self.offload_opt_state = True
                     self._opt_compute_sharding = self.opt_state_sharding
-                    self.opt_state_sharding = with_memory_kind(self.opt_state_sharding, "pinned_host")
+                    self.opt_state_sharding = with_memory_kind(
+                        self.opt_state_sharding, host_memory_kind()
+                    )
                     self.opt_state = self._chunked_offload_init(model.params, state_shapes)
                 else:
                     self.opt_state = jax.jit(self.tx.init, out_shardings=self.opt_state_sharding)(model.params)

@@ -1,4 +1,13 @@
 from .mesh import build_mesh, get_default_mesh, mesh_axis_size
 from .pipeline import PipelinedModel, prepare_pipeline
 from .expert import EXPERT_SHARDING_RULES, ExpertMLP, MoEBlock, expert_capacity, top_k_routing
+from .planner import (
+    ChipSpec,
+    ShardingPlan,
+    Workload,
+    plan_serving_sharding,
+    plan_sharding,
+    refine_plans,
+    score_rules,
+)
 from .ring_attention import ring_attention

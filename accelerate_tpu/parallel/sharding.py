@@ -95,6 +95,13 @@ def spec_for_param(
     """PartitionSpec for one parameter: TP rules first, then FSDP on a free dim."""
     from jax.sharding import PartitionSpec
 
+    if isinstance(rules, str):
+        raise ValueError(
+            f"rules={rules!r} reached spec derivation unresolved — the 'auto' "
+            "sentinel must be lowered to a table first (parallel.planner."
+            "plan_sharding, or the Accelerator/ContinuousBatcher seams that "
+            "call it)"
+        )
     size = int(np.prod(shape)) if shape else 1
     spec = [None] * len(shape)
     matched = False
@@ -213,14 +220,47 @@ def with_memory_kind(shardings, memory_kind: str):
     )
 
 
-def host_memory_available() -> bool:
-    """Whether the backend exposes a pinned_host memory space."""
+#: Memory kinds that live in host RAM, preferred order. Accelerator backends
+#: expose a distinct "pinned_host" space next to device HBM; CPU backends
+#: (jax >= 0.4.3x) expose only "unpinned_host", which IS their default memory
+#: — offload placement there is a no-op by construction, which keeps the
+#: offload code paths (kind-stamped shardings, streaming device_puts, chunked
+#: group programs) fully exercisable on the CPU test tier.
+HOST_MEMORY_KINDS = ("pinned_host", "unpinned_host")
+
+
+def host_memory_kind() -> Optional[str]:
+    """The memory kind the host-offload tier lowers to on this backend:
+    "pinned_host" where a distinct host space exists, the backend's host-side
+    default ("unpinned_host" on CPU) otherwise, None when the backend exposes
+    no host-addressable space at all."""
     import jax
 
     try:
-        return any(m.kind == "pinned_host" for m in jax.devices()[0].addressable_memories())
+        kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
     except Exception:
-        return False
+        return None
+    for kind in HOST_MEMORY_KINDS:
+        if kind in kinds:
+            return kind
+    return None
+
+
+def device_memory_kind() -> Optional[str]:
+    """The backend's default (compute-tier) memory kind — "device" on
+    TPU/GPU, "unpinned_host" on CPU where the two tiers coincide."""
+    import jax
+
+    try:
+        return jax.devices()[0].default_memory().kind
+    except Exception:
+        return None
+
+
+def host_memory_available() -> bool:
+    """Whether the backend exposes a host-tier memory space the offload
+    machinery can place state into (see `host_memory_kind`)."""
+    return host_memory_kind() is not None
 
 
 def place_params(tree, shardings=None):
@@ -237,7 +277,13 @@ def place_params(tree, shardings=None):
     if shardings is None:
         return jax.jit(lambda t: t)(tree)
     flat = jax.tree_util.tree_leaves(shardings)
-    if any(getattr(s, "memory_kind", None) == "pinned_host" for s in flat):
+    # Host-TIER shardings route through eager device_put. Membership is
+    # "a host kind that is NOT this backend's default": on CPU every
+    # sharding resolves to unpinned_host (the only memory space), so plain
+    # placements must keep the jit path; on accelerators both host kinds
+    # are a distinct tier and take the eager path.
+    host_kinds = {k for k in HOST_MEMORY_KINDS if k != device_memory_kind()}
+    if any(getattr(s, "memory_kind", None) in host_kinds for s in flat):
         # jit out_shardings with memory kinds trips the SPMD partitioner on some
         # backends, so host placement goes through eager device_put. device_put
         # aliases a source already committed to the identical sharding — break the

@@ -216,6 +216,8 @@ class ContinuousBatcher:
         tp: int = 1,
         tp_devices=None,
         tp_group: int = 0,
+        sharding_rules: Any = None,
+        sharding_refine_top_k: int = 0,
     ):
         if getattr(model, "module", None) is None or not hasattr(model.module, "config"):
             raise ValueError("ContinuousBatcher needs a Model bundle built from an in-tree flax module")
@@ -269,15 +271,34 @@ class ContinuousBatcher:
         self.mesh = None
         self._param_shardings = None
         self._cache_shardings = None
-        self._tp_rules = list(getattr(model, "sharding_rules", None) or [])
+        # Sharding-rule source: None / "rules" -> the model family's
+        # hand-written table (the parity oracle); "auto" -> the cost-model
+        # planner (parallel/planner.py) searches the layout from shapes +
+        # mesh topology and emits an equivalent table; an explicit list is a
+        # caller override. The planner call itself happens below, once the
+        # paged-pool geometry it prices is known.
+        self.sharding_mode = "rules" if sharding_rules is None else sharding_rules
+        if isinstance(self.sharding_mode, (list, tuple)):
+            self._tp_rules = list(self.sharding_mode)
+            self.sharding_mode = "explicit"
+        elif self.sharding_mode in ("rules", "auto"):
+            self._tp_rules = list(getattr(model, "sharding_rules", None) or [])
+        else:
+            raise ValueError(
+                f"sharding_rules must be a rules list, None, 'rules' or 'auto'; "
+                f"got {sharding_rules!r}"
+            )
+        self.sharding_plan = None
+        self.sharding_refine_top_k = int(sharding_refine_top_k)
         if self.tp > 1:
             from .parallel.sharding import serving_tp_mesh
 
-            if not self._tp_rules:
+            if not self._tp_rules and self.sharding_mode != "auto":
                 raise ValueError(
                     f"{type(model.module).__name__}'s Model bundle carries no "
                     "sharding_rules — this model family has no Megatron TP "
-                    "layout to span a mesh with; pass tp=1"
+                    "layout to span a mesh with; pass tp=1 or "
+                    "sharding_rules=\"auto\" to let the planner derive one"
                 )
             kv_heads = getattr(base, "num_key_value_heads", base.num_attention_heads)
             if kv_heads % self.tp:
@@ -287,7 +308,6 @@ class ContinuousBatcher:
                     "\"model\" axis"
                 )
             self.mesh = serving_tp_mesh(self.tp, devices=tp_devices, group=tp_group)
-        self.params = model.params if "params" in model.params else {"params": model.params}
         self.num_slots = int(num_slots)
         self.max_length = int(max_length or base.max_position_embeddings)
         self.chunk_size = int(chunk_size)
@@ -365,6 +385,50 @@ class ContinuousBatcher:
                 "presence seeding, which shared-prefix inserts cannot provide"
             )
 
+        params_tree = model.params if "params" in model.params else {"params": model.params}
+        if self.tp > 1 and self.sharding_mode == "auto":
+            # The planner searches the Megatron layout from shapes + mesh
+            # topology, pricing the KV pool at the live cache dtype, and
+            # emits a table the SAME derivation below consumes — swap-in
+            # weights, cache init and the TPU118 audit all behave exactly as
+            # with a hand table. With sharding_refine_top_k > 1, the top-k
+            # candidates are compiled as one-token forwards and the
+            # measured-best wins (cost model proposes, hardware disposes).
+            from .parallel.planner import (
+                measure_forward_step,
+                plan_serving_sharding,
+                refine_plans,
+            )
+
+            top_k = max(1, self.sharding_refine_top_k)
+            planned = plan_serving_sharding(
+                params_tree,
+                self.mesh,
+                base,
+                num_slots=self.num_slots,
+                padded_length=self._padded_length,
+                paged=self.paged,
+                page_size=self.page_size,
+                num_pages=self.num_pages,
+                kv_cache_dtype=self.kv_cache_dtype,
+                weight_dtype=self.weight_dtype,
+                top_k=top_k,
+            )
+            if self.sharding_refine_top_k >= 1:
+                # refine_top_k=1 still measures: the single candidate gets a
+                # real compiled-forward timing stamped on measured_step_s.
+                best, _ = refine_plans(
+                    planned if isinstance(planned, list) else [planned],
+                    lambda plan: measure_forward_step(
+                        model.apply_fn, params_tree, self.mesh, plan.rules, batch=1
+                    ),
+                )
+                self.sharding_plan = best
+            else:
+                self.sharding_plan = planned
+            self._tp_rules = list(self.sharding_plan.rules)
+
+        self.params = params_tree
         resolve = _params_resolver(model)
         # Prefill rides the ORDINARY decode-cache path on a batch-1 cache (shared
         # scalar cache_index); decode steps ride the per-row slot cache. Same

@@ -632,6 +632,89 @@ def run_quant_workload(model, args, cfg, max_length, workload, tracer=None):
     return result
 
 
+def _run_guarded_engine_pass(model, args, cfg, max_length, workload, tracer, label, **engine_kwargs):
+    """One engine through the shared A/B measurement harness: build it, warm
+    the insert ladder, run the workload twice unguarded (compiles + page-pool
+    steady state), then once under an armed TraceGuard collecting tokens.
+    Returns (row, tokens, engine) — `row` carries the timing/footprint fields
+    every A/B block shares, with the 0-recompile / 0-host-transfer gate
+    already asserted."""
+    from accelerate_tpu.analysis import TraceGuard
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    prompts, budgets, arrivals = workload
+    engine = ContinuousBatcher(
+        model, num_slots=args.num_slots, max_length=max_length,
+        chunk_size=args.chunk_size, paged=not args.no_paged,
+        page_size=args.page_size, tracer=tracer, max_queue=args.requests,
+        attention_impl=args.attention_impl,
+        weight_dtype=args.weight_dtype, kv_cache_dtype=args.kv_cache_dtype,
+        **engine_kwargs,
+    )
+    log(f"{label}: warmup...")
+    engine.warm_inserts()
+    run_continuous(engine, prompts, budgets, arrivals)
+    run_continuous(engine, prompts, budgets, arrivals)
+    chunk_hist = engine.metrics.get("serving_chunk_seconds")
+    count0, sum0 = chunk_hist.count, chunk_hist.sum
+    guard = TraceGuard(
+        transfer_guard="disallow", on_violation="record", name=f"serving-bench-{label}",
+    )
+    engine.trace_guard = guard
+    tokens = {}
+    with guard:
+        tps, ttfts, iters, span = run_continuous(
+            engine, prompts, budgets, arrivals, collect_tokens=tokens
+        )
+    if guard.total_recompiles or guard.host_transfers:
+        log(f"TRACE-GUARD VIOLATIONS in {label}: {guard.report().summary()}")
+    # The sharded-operand discipline pin: collectives inserted by GSPMD
+    # must not cost the one-executable / zero-host-sync steady state.
+    assert guard.total_recompiles == 0 and guard.host_transfers == 0, (
+        f"{label} regressed the 0-recompile / 0-host-transfer discipline: "
+        f"{guard.report().summary()}"
+    )
+    chunks = chunk_hist.count - count0
+    chunk_s = (chunk_hist.sum - sum0) / max(chunks, 1)
+    row = {
+        "tokens_per_sec": round(tps, 2),
+        "ttft_p50_ms": round(pct(ttfts, 50) * 1000, 2),
+        "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 2),
+        "makespan_s": round(span, 3),
+        "decode_iterations": iters,
+        "decode_chunk_mean_s": round(chunk_s, 6),
+        "per_chip_weight_bytes": engine.per_device_weight_nbytes,
+        "per_chip_kv_pool_bytes": engine.per_device_kv_cache_nbytes,
+        "params_leaves_sharded": sum(
+            1 for spec in engine.tp_sharding_report()["params"].values() if "model" in spec
+        ),
+        "recompiles": guard.total_recompiles,
+        "host_transfers": guard.host_transfers,
+    }
+    return row, tokens, engine
+
+
+def _token_agreement(baseline_tokens, tokens, what):
+    """Exact greedy-token agreement between two passes of the same workload:
+    identical per-request token COUNTS (a zip would silently forgive a short
+    stream) and identical values. GSPMD partitioning is a layout change, not
+    a numerics change, so anything under 1.0 asserts."""
+    lengths = {i: len(v) for i, v in baseline_tokens.items()}
+    assert lengths == {i: len(v) for i, v in tokens.items()}, (
+        f"{what} emitted a different token COUNT per request"
+    )
+    pairs = [
+        (x, y)
+        for i in baseline_tokens
+        for x, y in zip(baseline_tokens[i], tokens.get(i, []))
+    ]
+    agreement = sum(x == y for x, y in pairs) / len(pairs) if pairs else None
+    assert agreement == 1.0, (
+        f"{what} diverged (agreement {agreement}) — sharded decode is not token-exact"
+    )
+    return agreement
+
+
 def run_tensor_parallel_workload(model, args, cfg, max_length, workload, tracer=None):
     """The tensor-parallel A/B (`--tp N`): the SAME mixed workload served by a
     single-device engine and by one engine spanning an N-device submesh
@@ -646,10 +729,6 @@ def run_tensor_parallel_workload(model, args, cfg, max_length, workload, tracer=
     norms/biases/scalars keep it off the exact bound)."""
     import jax
 
-    from accelerate_tpu.analysis import TraceGuard
-    from accelerate_tpu.serving import ContinuousBatcher
-
-    prompts, budgets, arrivals = workload
     tp_n = int(args.tp)
     result = {
         "backend": jax.default_backend(),
@@ -661,76 +740,24 @@ def run_tensor_parallel_workload(model, args, cfg, max_length, workload, tracer=
     baseline_tokens = None
     for tp in (1, tp_n):
         label = f"tp{tp}"
-        engine = ContinuousBatcher(
-            model, num_slots=args.num_slots, max_length=max_length,
-            chunk_size=args.chunk_size, paged=not args.no_paged,
-            page_size=args.page_size, tracer=tracer, max_queue=args.requests,
-            attention_impl=args.attention_impl,
-            weight_dtype=args.weight_dtype, kv_cache_dtype=args.kv_cache_dtype,
-            tp=tp,
-        )
-        log(f"tensor-parallel workload ({label}): warmup...")
-        engine.warm_inserts()
-        run_continuous(engine, prompts, budgets, arrivals)
-        run_continuous(engine, prompts, budgets, arrivals)
-        registry = engine.metrics
-        chunk_hist = registry.get("serving_chunk_seconds")
-        count0, sum0 = chunk_hist.count, chunk_hist.sum
-        guard = TraceGuard(
-            transfer_guard="disallow", on_violation="record",
-            name=f"serving-bench-tp-{label}",
-        )
-        engine.trace_guard = guard
-        tokens = {}
-        with guard:
-            tps, ttfts, iters, span = run_continuous(
-                engine, prompts, budgets, arrivals, collect_tokens=tokens
-            )
-        if guard.total_recompiles or guard.host_transfers:
-            log(f"TRACE-GUARD VIOLATIONS in tensor-parallel workload ({label}): {guard.report().summary()}")
-        # The sharded-operand discipline pin: collectives inserted by GSPMD
-        # must not cost the one-executable / zero-host-sync steady state.
-        assert guard.total_recompiles == 0 and guard.host_transfers == 0, (
-            f"tensor-parallel workload ({label}) regressed the 0-recompile / "
-            f"0-host-transfer discipline: {guard.report().summary()}"
+        row, tokens, engine = _run_guarded_engine_pass(
+            model, args, cfg, max_length, workload, tracer,
+            f"tensor-parallel workload ({label})",
+            tp=tp, sharding_rules=getattr(args, "sharding", None),
         )
         if baseline_tokens is None:
             baseline_tokens = tokens
             agreement = 1.0
         else:
-            pairs = [
-                (x, y)
-                for i in baseline_tokens
-                for x, y in zip(baseline_tokens[i], tokens.get(i, []))
-            ]
-            agreement = sum(x == y for x, y in pairs) / len(pairs) if pairs else None
-            # GSPMD partitioning is a layout change, not a numerics change:
-            # greedy decode must be token-IDENTICAL across tp degrees.
-            assert agreement == 1.0, (
-                f"tp={tp} diverged from tp=1 greedy tokens "
-                f"(agreement {agreement}) — sharded decode is not token-exact"
+            agreement = _token_agreement(
+                baseline_tokens, tokens, f"tp={tp} vs tp=1 greedy tokens"
             )
-        chunks = chunk_hist.count - count0
-        chunk_s = (chunk_hist.sum - sum0) / max(chunks, 1)
-        sharded_leaves = sum(
-            1 for spec in engine.tp_sharding_report()["params"].values() if "model" in spec
+        row["tp"] = tp
+        row["decode_attention_s_per_dispatch"] = round(
+            row["decode_chunk_mean_s"] / args.chunk_size, 6
         )
-        result[label] = {
-            "tp": tp,
-            "tokens_per_sec": round(tps, 2),
-            "ttft_p50_ms": round(pct(ttfts, 50) * 1000, 2),
-            "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 2),
-            "makespan_s": round(span, 3),
-            "decode_iterations": iters,
-            "decode_chunk_mean_s": round(chunk_s, 6),
-            "decode_attention_s_per_dispatch": round(chunk_s / args.chunk_size, 6),
-            "per_chip_weight_bytes": engine.per_device_weight_nbytes,
-            "per_chip_kv_pool_bytes": engine.per_device_kv_cache_nbytes,
-            "params_leaves_sharded": sharded_leaves,
-            "token_agreement_vs_tp1": round(agreement, 4) if agreement is not None else None,
-            "recompiles": guard.total_recompiles,
-            "host_transfers": guard.host_transfers,
-        }
+        row["token_agreement_vs_tp1"] = round(agreement, 4) if agreement is not None else None
+        result[label] = row
     base = result["tp1"]["per_chip_weight_bytes"] + result["tp1"]["per_chip_kv_pool_bytes"]
     tp_key = f"tp{tp_n}"
     spanned = result[tp_key]["per_chip_weight_bytes"] + result[tp_key]["per_chip_kv_pool_bytes"]
@@ -746,6 +773,89 @@ def run_tensor_parallel_workload(model, args, cfg, max_length, workload, tracer=
         f"tp={tp_n} only cut per-chip weight+pool bytes {ratio:.2f}x "
         f"(expected >= {1.0 + 0.6 * (tp_n - 1):.2f}x) — something is "
         "silently replicated (see engine.tp_sharding_report())"
+    )
+    return result
+
+
+def run_sharding_plan_workload(model, args, cfg, max_length, workload, tracer=None):
+    """The sharding-source A/B (`--tp N` engines, hand `rules` vs planner
+    `auto`): the SAME mixed workload served by two mesh-spanning engines that
+    differ ONLY in where their partition table came from — the model family's
+    hand-written rules, or the cost-model planner's emitted table
+    (`parallel/planner.py`, `sharding_rules="auto"`). Per row: decode
+    tokens/sec, per-chip weight + KV-pool bytes read off the LIVE shardings,
+    and for the auto engine the planner's predictions next to reality — the
+    predicted-vs-live per-chip byte error and the predicted-vs-measured
+    step-time error (the honesty metric behind measure-and-refine). Asserts
+    the acceptance headlines: greedy tokens IDENTICAL auto vs rules, both
+    engines under the 0-recompile / 0-host-transfer gate, and auto per-chip
+    weight+pool bytes at >= 60% of the ideal 1/N reduction off the
+    replicated footprint."""
+    import jax
+
+    tp_n = int(args.tp)
+    result = {
+        "backend": jax.default_backend(),
+        "tp": tp_n,
+        "devices_visible": len(jax.devices()),
+    }
+    baseline_tokens = None
+    for mode in ("rules", "auto"):
+        row, tokens, engine = _run_guarded_engine_pass(
+            model, args, cfg, max_length, workload, tracer,
+            f"sharding-plan workload ({mode})",
+            tp=tp_n, sharding_rules=mode,
+        )
+        if baseline_tokens is None:
+            baseline_tokens = tokens
+            agreement = 1.0
+        else:
+            # The planner emits a table the SAME GSPMD derivation consumes:
+            # a layout change, never a numerics change.
+            agreement = _token_agreement(
+                baseline_tokens, tokens, "sharding_rules='auto' vs the hand rules"
+            )
+        measured_step_s = row["decode_chunk_mean_s"] / args.chunk_size
+        row["sharding"] = mode
+        row["measured_step_s"] = round(measured_step_s, 6)
+        row["token_agreement_vs_rules"] = round(agreement, 4) if agreement is not None else None
+        if engine.sharding_plan is not None:
+            plan = engine.sharding_plan
+            predicted_bytes = plan.cost.per_chip_param_bytes
+            live_bytes = engine.per_device_weight_nbytes
+            predicted_step = plan.cost.step_time_s
+            row["planner"] = {
+                "rules_emitted": len(plan.rules),
+                "predicted_per_chip_param_bytes": int(predicted_bytes),
+                "predicted_per_chip_kv_bytes": int(plan.cost.per_chip_kv_bytes),
+                "predicted_collective_bytes_per_dispatch": int(plan.cost.collective_bytes),
+                "predicted_step_s": round(predicted_step, 9),
+                "predicted_vs_live_bytes_error": round(
+                    abs(predicted_bytes - live_bytes) / max(live_bytes, 1), 4
+                ),
+                "predicted_vs_measured_step_error": round(
+                    abs(predicted_step - measured_step_s) / max(measured_step_s, 1e-12), 4
+                ),
+            }
+        # The footprint headline off the LIVE shardings: per-chip weight+pool
+        # bytes at >= 60% of the ideal 1/N cut from the replicated footprint
+        # (replicated norms/biases/page tables keep it off the exact bound).
+        replicated = sum(
+            int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            for tree in (engine.params, engine._cache)
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+        spanned = row["per_chip_weight_bytes"] + row["per_chip_kv_pool_bytes"]
+        ratio = replicated / max(spanned, 1)
+        row["per_chip_bytes_ratio_vs_replicated"] = round(ratio, 3)
+        assert ratio >= 1.0 + 0.6 * (tp_n - 1), (
+            f"sharding={mode} only cut per-chip weight+pool bytes {ratio:.2f}x "
+            f"(expected >= {1.0 + 0.6 * (tp_n - 1):.2f}x) — something is "
+            "silently replicated (see engine.tp_sharding_report())"
+        )
+        result[mode] = row
+    result["tokens_per_sec_ratio_auto_over_rules"] = round(
+        result["auto"]["tokens_per_sec"] / max(result["rules"]["tokens_per_sec"], 1e-9), 3
     )
     return result
 
@@ -1050,6 +1160,13 @@ def main(argv=None):
                         "submesh (Megatron-sharded weights, KV pool sharded by KV "
                         "head) — token parity asserted, per-chip bytes recorded in "
                         "extra.tensor_parallel; 1 disables")
+    parser.add_argument("--sharding", default="rules", choices=["rules", "auto"],
+                        help="partition source for the --tp engines: the model family's "
+                        "hand-written table, or the cost-model planner's emitted one "
+                        "(parallel/planner.py); the rules-vs-auto A/B in "
+                        "extra.sharding_plan runs either way unless --no-sharding-ab")
+    parser.add_argument("--no-sharding-ab", action="store_true",
+                        help="skip the sharding rules-vs-auto A/B (extra.sharding_plan)")
     parser.add_argument("--replicas", type=int, default=1,
                         help="run the replicated-router workload over N engines with a "
                         "kill-one-replica A/B (throughput dip + recovery time); 1 disables")
@@ -1254,6 +1371,15 @@ def main(argv=None):
             model, args, cfg, max_length, (prompts, budgets, arrivals), tracer=tracer
         )
 
+    # Sharding-source A/B (--tp N): hand rules vs the planner's auto table on
+    # the same mesh — token identity + the >= 60%-of-ideal footprint asserted,
+    # the planner's predicted-vs-measured step time reported.
+    sharding_block = None
+    if args.tp > 1 and not args.no_sharding_ab:
+        sharding_block = run_sharding_plan_workload(
+            model, args, cfg, max_length, (prompts, budgets, arrivals), tracer=tracer
+        )
+
     # Replicated-router A/B: the same workload behind a health-routed fleet,
     # with one replica chaos-killed mid-traffic (dip + recovery measured).
     router_block = None
@@ -1385,6 +1511,9 @@ def main(argv=None):
             # (~1/N asserted), greedy token identity asserted, TraceGuard
             # 0/0 per row (docs/observability.md).
             "tensor_parallel": tp_block,
+            # hand rules vs planner auto on the same mesh: per-chip bytes off
+            # live shardings for BOTH plans + predicted-vs-measured step error
+            "sharding_plan": sharding_block,
             # Replicated-fleet A/B (--replicas N): baseline vs kill-one-replica
             # throughput, degraded-window tokens/sec, measured recovery
             # seconds, retry/replica_lost accounting — still 0 recompiles /

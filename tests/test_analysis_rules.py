@@ -356,6 +356,64 @@ def test_tpu118_variants():
     assert not analyze_source(hazard.replace("import jax\n", ""))
 
 
+def test_tpu119_variants():
+    """Beyond the flag fixture's dead table entry (one finding per fixture):
+    a live entry whose tokens connect to flax submodule names is clean, an
+    f-string name part counts as evidence, an all-generic pattern is skipped
+    (can't be judged statically), a literal string-axis PartitionSpec in a
+    flax model module flags while the empty PartitionSpec() does not, and
+    modules without flax (or without jax) are out of scope however their
+    tables look."""
+    base = (
+        "import jax\n"
+        "import flax.linen as nn\n"
+        "RULES_SHARDING_RULES = [(r\"{pattern}\", (None, \"model\"))]\n"
+        "class Toy(nn.Module):\n"
+        "    @nn.compact\n"
+        "    def __call__(self, x):\n"
+        "        return nn.Dense(4, name=\"wq\")(x)\n"
+    )
+    dead = base.replace("{pattern}", "query_proj/kernel")
+    assert [f.rule_id for f in analyze_source(dead)] == ["TPU119"]
+    assert not analyze_source(base.replace("{pattern}", "wq/kernel"))
+    # f-string submodule names vouch for the pattern's tokens.
+    fstring = (
+        "import jax\n"
+        "import flax.linen as nn\n"
+        "TOY_SHARDING_RULES = [(r\"block_\\d+/kernel\", (None, \"model\"))]\n"
+        "class Toy(nn.Module):\n"
+        "    @nn.compact\n"
+        "    def __call__(self, x):\n"
+        "        for i in range(2):\n"
+        "            x = nn.Dense(4, name=f\"block_{i}\")(x)\n"
+        "        return x\n"
+    )
+    assert not analyze_source(fstring)
+    # All-generic patterns (kernel/embedding/bias...) carry no module identity.
+    assert not analyze_source(base.replace("{pattern}", "kernel$"))
+    # A literal string-axis PartitionSpec outside the table flags; the empty
+    # replicated spec does not.
+    literal = (
+        "import jax\n"
+        "import flax.linen as nn\n"
+        "from jax.sharding import PartitionSpec\n"
+        "def place():\n"
+        "    return PartitionSpec(None, \"model\")\n"
+    )
+    assert [f.rule_id for f in analyze_source(literal)] == ["TPU119"]
+    assert not analyze_source(literal.replace("PartitionSpec(None, \"model\")", "PartitionSpec()"))
+    # Tuple-nested axis literals flag too.
+    assert [f.rule_id for f in analyze_source(
+        literal.replace("PartitionSpec(None, \"model\")", "PartitionSpec((\"data\", \"fsdp\"))")
+    )] == ["TPU119"]
+    # No flax import: not a model module — rule tables and specs are the
+    # derivation layer's business (parallel/sharding.py spells both).
+    assert not analyze_source(dead.replace("import flax.linen as nn\n", ""))
+    assert not analyze_source(literal.replace("import flax.linen as nn\n", ""))
+    # No jax import: out of scope entirely.
+    assert not analyze_source(dead.replace("import jax\n", ""))
+
+
 def test_analyze_paths_walks_the_tree():
     findings, scanned = analyze_paths([str(SAMPLES)])
     assert scanned >= 2 * len(RULES) + 1  # flag + clean per rule + suppressed.py

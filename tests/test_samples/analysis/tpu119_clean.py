@@ -1,0 +1,25 @@
+"""TPU119 clean fixture: every rules-table entry names modules the model
+actually defines (patterns connect to real parameter paths), and no per-leaf
+PartitionSpec literal hides outside the table — the one derivation seam sees
+every placement decision."""
+
+import flax.linen as nn
+import jax
+
+
+TOY_SHARDING_RULES = [
+    (r"(wq|wk|wv)/kernel", (None, "model")),
+    (r"wo/kernel", ("model", None)),
+]
+
+
+class ToyAttention(nn.Module):
+    features: int = 64
+
+    @nn.compact
+    def __call__(self, hidden):
+        q = nn.Dense(self.features, name="wq")(hidden)
+        k = nn.Dense(self.features, name="wk")(hidden)
+        v = nn.Dense(self.features, name="wv")(hidden)
+        attn = jax.nn.softmax(q @ k.T) @ v
+        return nn.Dense(self.features, name="wo")(attn)

@@ -1,0 +1,30 @@
+"""TPU119 flag fixture: a model module shipping a sharding-rules table with a
+DEAD entry — its regex names a module ("query_proj") the model never defines,
+so it matches no parameter path at derivation time and the weight it was
+written to shard silently replicates. (The literal-PartitionSpec variant and
+the no-flax/no-table scopes are unit-tested in
+test_analysis_rules.test_tpu119_variants; the tree-walk contract allows
+exactly one finding per flag fixture.)"""
+
+import flax.linen as nn
+import jax
+
+
+TOY_SHARDING_RULES = [
+    (r"(wq|wk|wv)/kernel", (None, "model")),
+    # FLAG: the model below names its projections wq/wk/wv/wo — nothing is
+    # called "query_proj", so this entry can never match a parameter path.
+    (r"query_proj/kernel", (None, "model")),
+]
+
+
+class ToyAttention(nn.Module):
+    features: int = 64
+
+    @nn.compact
+    def __call__(self, hidden):
+        q = nn.Dense(self.features, name="wq")(hidden)
+        k = nn.Dense(self.features, name="wk")(hidden)
+        v = nn.Dense(self.features, name="wv")(hidden)
+        attn = jax.nn.softmax(q @ k.T) @ v
+        return nn.Dense(self.features, name="wo")(attn)
