@@ -567,16 +567,26 @@ class Accelerator:
                     "or pass 'auto' for the planner"
                 )
             adam_bytes = 8.0  # fp32 moments; the dominant non-param account
+            # Training meshes add the "data" axis to the search: the planner
+            # then enumerates ZeRO twins (optimizer moments sharded along
+            # "data" even where params replicate) and emits them as a second
+            # rules table the optimizer derivation consumes.
+            mesh_sizes = dict(getattr(mesh, "shape", {}) or {})
+            plan_axes = tuple(
+                a for a in ("data", "model") if mesh_sizes.get(a, 1) > 1
+            ) or ("model",)
             rules, _plan = resolve_sharding_rules(
                 model.sharding_rules,
                 model.params,
                 mesh,
                 plan_kwargs=dict(
-                    axes=("model",),
+                    axes=plan_axes,
                     workload=Workload(batch=8, seq=512, opt_bytes_per_param=adam_bytes),
                 ),
             )
             model.sharding_rules = rules
+            if _plan is not None and getattr(_plan, "opt_rules", None):
+                model.opt_sharding_rules = list(_plan.opt_rules)
         param_sharding = derive_param_shardings(
             model.params, mesh, fsdp_plugin=fsdp, rules=model.sharding_rules
         )

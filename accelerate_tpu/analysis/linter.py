@@ -679,6 +679,7 @@ class _ModuleChecker:
         self._check_serving_construction()
         self._check_kernel_fallback()
         self._check_tp_replicated_operand()
+        self._check_replicated_optimizer_state()
         self._check_worker_loop()
         self._check_quantization()
         self._check_dead_partition_rule()
@@ -1007,6 +1008,121 @@ class _ModuleChecker:
                     "to every chip — derive shardings from the model family's rules "
                     "(derive_tp_param_shardings / derive_tp_cache_shardings) or let "
                     "ContinuousBatcher(tp=N) place it",
+                )
+
+    # -- replicated optimizer state (TPU120) --------------------------------------
+    @classmethod
+    def _mentions_data_axis(cls, node: ast.AST) -> bool:
+        return any(
+            isinstance(sub, ast.Constant) and sub.value == "data"
+            for sub in ast.walk(node)
+        )
+
+    def _module_spans_data_mesh(self) -> bool:
+        """True when this module builds a TRAINING mesh with a "data" axis: a
+        `Mesh(...)` whose axis names include "data", a `build_mesh(...)` call
+        (whose default ParallelismConfig fills "data" with every chip), or a
+        `ParallelismConfig(...)` given a data degree — the context in which a
+        replicated optimizer-state placement spends data_n x the moment HBM
+        each chip needs for the shard of the update it actually computes."""
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._call_name(node.func)
+            if name == "build_mesh":
+                return True
+            if name == "ParallelismConfig" and any(
+                kw.arg == "data" for kw in node.keywords
+            ):
+                return True
+            if name == "Mesh" and any(
+                self._mentions_data_axis(arg)
+                for arg in list(node.args) + [kw.value for kw in node.keywords]
+            ):
+                return True
+        return False
+
+    #: Identifier fragments that label a placed tree as optimizer state.
+    #: Substring match against every Name/Attribute inside the placed operand.
+    _OPT_STATE_LABELS = ("opt_state", "optimizer_state", "adam_state", "moments")
+
+    @classmethod
+    def _is_opt_state_expr(cls, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                label = sub.id
+            elif isinstance(sub, ast.Attribute):
+                label = sub.attr
+            else:
+                continue
+            label = label.lower()
+            if any(tok in label for tok in cls._OPT_STATE_LABELS):
+                return True
+        return False
+
+    @classmethod
+    def _placement_is_replicated(cls, node: ast.AST) -> bool:
+        """A placement expression that spells REPLICATE explicitly: it contains
+        PartitionSpec()/P() calls and every one of them is empty (a
+        `NamedSharding(mesh, PartitionSpec())` pytree lands the full tree on
+        every chip by construction). Placements without any literal spec —
+        derived sharding pytrees, precomputed names — keep the benefit of the
+        doubt, same as TPU118."""
+        specs = [
+            sub
+            for sub in ast.walk(node)
+            if isinstance(sub, ast.Call)
+            and cls._call_name(sub.func) in {"PartitionSpec", "P"}
+        ]
+        return bool(specs) and all(
+            not spec.args and not spec.keywords for spec in specs
+        )
+
+    def _check_replicated_optimizer_state(self):
+        """TPU120: in a module that builds a data-axis training mesh,
+        `device_put` of an optimizer-state tree with no sharding (or a raw
+        device, or an explicitly replicated PartitionSpec()) parks fp32 Adam
+        moments — 8 bytes/param — on EVERY chip, the single largest avoidable
+        HBM account in data-parallel training. The sanctioned spellings derive
+        the placement: `derive_opt_state_shardings` (with the planner's
+        opt_rules table for ZeRO sharding along "data"), or
+        Accelerator.prepare's AcceleratedOptimizer, whose init/out_shardings
+        discipline places moments sharded from the first step."""
+        if not self.index.imports_jax or not self._module_spans_data_mesh():
+            return
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._call_name(node.func) != "device_put":
+                continue
+            if not node.args or not self._is_opt_state_expr(node.args[0]):
+                continue
+            placement = None
+            if len(node.args) >= 2:
+                placement = node.args[1]
+            else:
+                for kw in node.keywords:
+                    if kw.arg in ("device", "shardings", "sharding"):
+                        placement = kw.value
+                        break
+            missing = placement is None or (
+                isinstance(placement, ast.Constant) and placement.value is None
+            )
+            if (
+                missing
+                or self._placement_is_devicey(placement)
+                or self._placement_is_replicated(placement)
+            ):
+                self.emit(
+                    node,
+                    "TPU120",
+                    "optimizer state device_put without a sharded placement in a "
+                    "data-axis-mesh module replicates fp32 moments (8 bytes/param) "
+                    "to every chip — derive the placement with "
+                    "derive_opt_state_shardings (pass the planner's opt_rules for "
+                    "ZeRO sharding along \"data\"; plan_train_sharding emits it) "
+                    "or prepare the optimizer through Accelerator.prepare with "
+                    "sharding_rules=\"auto\"",
                 )
 
     # -- dead partition rules (TPU119) --------------------------------------------

@@ -244,6 +244,26 @@ RULES = (
         "or let sharding_rules=\"auto\" (parallel.planner) emit the table, so "
         "every placement decision stays visible to the one derivation seam",
     ),
+    Rule(
+        id="TPU120",
+        slug="replicated-optimizer-state",
+        severity="warn",
+        summary="a module that builds a training mesh with a \"data\" axis "
+        "places an optimizer-state tree with device_put but no (or a "
+        "replicated) sharding — fp32 Adam moments are 8 bytes/param on EVERY "
+        "chip, the single largest avoidable HBM account in data-parallel "
+        "training",
+        fixit="shard the weight update: derive the state's placement with "
+        "parallel.sharding.derive_opt_state_shardings (pass the planner's "
+        "opt_rules table for ZeRO sharding along \"data\" even where params "
+        "replicate — plan_train_sharding emits it), or prepare the optimizer "
+        "through Accelerator.prepare with sharding_rules=\"auto\", whose "
+        "AcceleratedOptimizer init/out_shardings discipline places moments "
+        "sharded from the first step; reduce-scatter + all-gather moves the "
+        "same ICI bytes the all-reduce already paid, so the sharded update "
+        "is pure per-chip-HBM savings (Xu et al., cross-replica weight-update "
+        "sharding)",
+    ),
 )
 
 RULES_BY_ID = {r.id: r for r in RULES}

@@ -136,18 +136,25 @@ def params_digest(model) -> str:
     return h.hexdigest()
 
 
-def build_train_workload(base_dir: str, keep_last_n: int, seed: int, async_save: bool = False):
+def build_train_workload(
+    base_dir: str, keep_last_n: int, seed: int, async_save: bool = False,
+    mesh_2d: bool = False,
+):
     """The canonical tiny train workload — shared by the in-process runner and
     the subprocess `chaos.workload`, so both sides of the supervised story
     exercise (and journal) the same thing. Returns (accelerator, model, opt,
     prepared_dataloader). `async_save=True` arms snapshot-then-commit saves
-    (the async-commit-boundary sweeps' workload)."""
+    (the async-commit-boundary sweeps' workload). `mesh_2d=True` swaps in the
+    small MLP on a ("data", "model") mesh with ``sharding_rules="auto"`` and
+    Adam — the planner's 2D plan with ZeRO data-sharded moments, so chaos
+    faults land on a sharded optimizer state and resumes can assert the
+    layout survived (`zero_state_sharded`)."""
     import optax
 
     from .. import Accelerator, SimpleDataLoader
     from ..data_loader import BatchSampler
-    from ..test_utils.training import RegressionDataset, RegressionModel
-    from ..utils import ProjectConfiguration
+    from ..test_utils.training import RegressionDataset, RegressionMLPModel, RegressionModel
+    from ..utils import ParallelismConfig, ProjectConfiguration
 
     accelerator = Accelerator(
         project_config=ProjectConfiguration(
@@ -156,25 +163,58 @@ def build_train_workload(base_dir: str, keep_last_n: int, seed: int, async_save:
             total_limit=keep_last_n,
         ),
         async_save=async_save,
+        parallelism_config=ParallelismConfig(data=-1, model=2) if mesh_2d else None,
     )
     n = 16
     data = [RegressionDataset(length=n, seed=seed)[i] for i in range(n)]
     dl = SimpleDataLoader(data, BatchSampler(range(n), 8))
-    model, opt, pdl = accelerator.prepare(RegressionModel(), optax.sgd(0.05), dl)
+    if mesh_2d:
+        bundle = RegressionMLPModel(seed=seed)
+        bundle.sharding_rules = "auto"
+        tx = optax.adam(0.05)
+    else:
+        bundle, tx = RegressionModel(), optax.sgd(0.05)
+    model, opt, pdl = accelerator.prepare(bundle, tx, dl)
     return accelerator, model, opt, pdl
 
 
-def resume_evidence(resolved: str, model, checkpoint_base: str) -> Dict[str, Any]:
+def opt_state_data_sharded(opt) -> bool:
+    """True when some LIVE optimizer-state leaf is sharded along the "data"
+    axis — the ZeRO weight-update-sharding layout the 2D planner emits. Read
+    off the placed arrays, not the plan: this is the evidence a chaos resume
+    journals to prove the layout survived the restore."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(getattr(opt, "opt_state", opt)):
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        if spec is None:
+            continue
+        for dim in spec:
+            axes = dim if isinstance(dim, tuple) else ((dim,) if dim else ())
+            if "data" in axes:
+                return True
+    return False
+
+
+def resume_evidence(
+    resolved: str, model, checkpoint_base: str, opt=None
+) -> Dict[str, Any]:
     """The journal record both train workloads write after a resume — one
     schema, one producer, so the invariant checks can never diverge between
-    the in-process and subprocess paths."""
-    return {
+    the in-process and subprocess paths. Pass ``opt`` on 2D-mesh workloads to
+    record whether the restored optimizer state is still ZeRO-sharded along
+    "data" (`zero_state_sharded`) — a resume that silently replicates the
+    moments would train correctly while spending data_n x the HBM."""
+    evidence = {
         "path": resolved,
         "step": manifest_step(resolved),
         "digest": params_digest(model),
         "independently_verified": independent_verify(resolved),
         "expected_step": independent_latest_step(checkpoint_base),
     }
+    if opt is not None:
+        evidence["zero_state_sharded"] = opt_state_data_sharded(opt)
+    return evidence
 
 
 # ------------------------------------------------------------------ report
@@ -543,6 +583,7 @@ class ChaosRunner:
         downtime_budget_s: float = 30.0,
         async_save: bool = False,
         no_progress_threshold: int = 6,
+        mesh_2d: bool = False,
     ) -> InvariantReport:
         """The end-to-end path: the real `Supervisor` restarting a real
         subprocess workload (`python -m accelerate_tpu.chaos.workload`), the
@@ -562,7 +603,9 @@ class ChaosRunner:
         cmd = [
             sys.executable, "-m", "accelerate_tpu.chaos.workload",
             "--base-dir", base_dir, "--steps", str(steps),
-        ] + (["--async-save"] if async_save else [])
+        ] + (["--async-save"] if async_save else []) + (
+            ["--mesh-2d"] if mesh_2d else []
+        )
         # A clean preemption handoff (exit 143) ENDS supervision by design —
         # in production the scheduler respawns the whole job. The runner plays
         # the scheduler: re-run the supervisor after each handoff (counted
@@ -639,6 +682,8 @@ class ChaosRunner:
         ]
         # The workload's own injections happened in child processes; fold its
         # journal into ours so the report still carries them.
+        if mesh_2d:
+            checks.append(self._check_zero_state_sharded(journal))
         for entry in journal.get("injections", []):
             self.session.injections.append(entry)
             self.session.registry.counter(
@@ -653,7 +698,7 @@ class ChaosRunner:
     def _read_workload_journal(base_dir: str) -> Dict[str, Any]:
         journal: Dict[str, Any] = {
             "attempts": 0, "graceful_exits": 0, "saves": [], "intents": [],
-            "resumes": [], "injections": [],
+            "resumes": [], "injections": [], "layouts": [],
         }
         path = os.path.join(str(base_dir), "chaos_journal.jsonl")
         if not os.path.isfile(path):
@@ -672,7 +717,7 @@ class ChaosRunner:
                     journal["attempts"] += 1
                 elif rtype == "graceful_exit":
                     journal["graceful_exits"] += 1
-                elif rtype in ("save", "intent", "resume", "injection"):
+                elif rtype in ("save", "intent", "resume", "injection", "layout"):
                     journal[rtype + "s"].append(record)
         return journal
 
@@ -1721,6 +1766,29 @@ class ChaosRunner:
                 "failures": failures,
                 "final_verified_latest_step": final_latest,
             },
+        )
+
+    @staticmethod
+    def _check_zero_state_sharded(journal: Dict[str, Any]) -> InvariantCheck:
+        """2D-mesh workloads only: every attempt journals its optimizer-state
+        layout after prepare (``layout`` records) and after every restore
+        (``zero_state_sharded`` on resume records) — ALL of them must report
+        the moments live-sharded along "data". A restart that silently
+        replicates the state trains the same numbers while spending data_n x
+        the HBM, which is exactly the failure mode a byte-layout invariant
+        exists to catch."""
+        records = [
+            {"kind": "layout", **e} for e in journal.get("layouts", [])
+        ] + [
+            {"kind": "resume", "step": e.get("step"),
+             "zero_state_sharded": e.get("zero_state_sharded")}
+            for e in journal.get("resumes", [])
+        ]
+        failures = [r for r in records if r.get("zero_state_sharded") is not True]
+        return InvariantCheck(
+            "zero_state_sharded",
+            passed=bool(records) and not failures,
+            details={"records": len(records), "failures": failures},
         )
 
     @staticmethod

@@ -21,7 +21,13 @@ import sys
 from ..telemetry.tracing import default_tracer
 from .injectors import ChaosSession, FilesystemInjector, HarnessInjector, StepBoundaryInjector
 from .plan import FaultPlan
-from .runner import build_train_workload, manifest_step, params_digest, resume_evidence
+from .runner import (
+    build_train_workload,
+    manifest_step,
+    opt_state_data_sharded,
+    params_digest,
+    resume_evidence,
+)
 
 
 def main(argv=None) -> int:
@@ -34,6 +40,13 @@ def main(argv=None) -> int:
         help="save through the background committer (snapshot-then-commit): a real "
         "SIGKILL at a step boundary then lands while the commit is genuinely in "
         "flight on another thread",
+    )
+    parser.add_argument(
+        "--mesh-2d", action="store_true",
+        help="train the small MLP on the (\"data\", \"model\") mesh with "
+        "sharding_rules=\"auto\" (planner 2D plan, ZeRO data-sharded Adam "
+        "moments) and journal the optimizer-state layout for the "
+        "zero_state_sharded invariant",
     )
     args = parser.parse_args(argv)
 
@@ -59,9 +72,18 @@ def main(argv=None) -> int:
     journal({"type": "attempt", "pid": os.getpid()})
 
     accelerator, model, opt, pdl = build_train_workload(
-        args.base_dir, args.keep_last_n, plan.seed, async_save=args.async_save
+        args.base_dir, args.keep_last_n, plan.seed, async_save=args.async_save,
+        mesh_2d=args.mesh_2d,
     )
     accelerator.register_preemption_checkpoint()  # real SIGTERM latch + exit 143
+    if args.mesh_2d:
+        # The layout evidence BEFORE any fault lands: this attempt's optimizer
+        # state is live-sharded along "data" (the planner's ZeRO placement).
+        journal({
+            "type": "layout",
+            "pid": os.getpid(),
+            "zero_state_sharded": opt_state_data_sharded(opt),
+        })
 
     boundary = StepBoundaryInjector(session, hard=True)
     attempt_span = tracer.start_span("train.attempt", category="train", pid=os.getpid())
@@ -74,7 +96,10 @@ def main(argv=None) -> int:
             resolved = None
         if resolved is not None:
             accelerator.load_state("latest")
-            evidence = resume_evidence(resolved, model, manager.base_dir)
+            evidence = resume_evidence(
+                resolved, model, manager.base_dir,
+                opt=opt if args.mesh_2d else None,
+            )
             journal({"type": "resume", **evidence})
             resumed_step = evidence["step"]
             start_step = (resumed_step if resumed_step is not None else -1) + 1

@@ -43,6 +43,21 @@ def register_subcommand(subparsers):
                         "(needs tp visible devices)")
     parser.add_argument("--seq-len", type=int, default=8, help="Init sequence length for shape derivation")
     parser.add_argument("--json", action="store_true", help="Machine-readable plan JSON")
+    parser.add_argument(
+        "--mesh", default=None,
+        help='Training mesh, e.g. "data=4,model=2": switches to the 2D training '
+        "planner — params, grads AND optimizer state (ZeRO weight-update "
+        "sharding along the data axis) are enumerated and priced together",
+    )
+    parser.add_argument("--batch", type=int, default=8, help="Global batch size (training planner)")
+    parser.add_argument("--opt-bytes-per-param", type=float, default=8.0,
+                        help="Optimizer bytes/param the cost model prices (fp32 Adam moments: 8)")
+    parser.add_argument(
+        "--live", action="store_true",
+        help="Build --mesh on the visible devices, place all three trees "
+        "(params / grads / optimizer state) per plan, and report predicted-vs-live "
+        "per-chip bytes off the LIVE shardings (tree_device_nbytes)",
+    )
     parser.set_defaults(func=plan_command)
     return parser
 
@@ -84,6 +99,164 @@ def _model_shapes(name: str, seq_len: int, materialize: bool):
     return shapes, config, rules, module.apply, None
 
 
+def _parse_mesh(spec: str):
+    """Parse ``"data=4,model=2"`` into an ordered ``{axis: size}`` dict. A bare
+    axis name (no ``=``) takes the remaining visible-device count (one only)."""
+    axes = {}
+    fill = None
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            name, _, size = part.partition("=")
+            axes[name.strip()] = int(size)
+        else:
+            if fill is not None:
+                raise SystemExit(f"--mesh {spec!r}: at most one axis may omit its size")
+            axes[part] = -1
+            fill = part
+    if fill is not None:
+        import jax
+
+        explicit = 1
+        for name, size in axes.items():
+            if size > 0:
+                explicit *= size
+        n = len(jax.devices())
+        if n % explicit != 0:
+            raise SystemExit(
+                f"--mesh {spec!r}: {n} devices not divisible by explicit sizes ({explicit})"
+            )
+        axes[fill] = n // explicit
+    return axes
+
+
+def _train_plan_command(args, chip):
+    """The ``--mesh`` branch: 2D training planner over params+grads+opt state,
+    optionally compared against LIVE placements of all three trees."""
+    from ..parallel.planner import plan_train_sharding, score_rules
+
+    mesh_axes = _parse_mesh(args.mesh)
+    params, config, hand_rules, apply_fn, real_params = _model_shapes(
+        args.model, args.seq_len, materialize=args.live
+    )
+    plan = plan_train_sharding(
+        params,
+        mesh_axes,
+        batch=args.batch,
+        seq=args.seq_len,
+        opt_bytes_per_param=args.opt_bytes_per_param,
+        weight_dtype=args.weight_dtype,
+        chip=chip,
+        beam_width=args.beam_width,
+    )
+    hand = (
+        score_rules(
+            params, mesh_axes, hand_rules,
+            chip=chip, workload=plan.workload, weight_dtype=args.weight_dtype,
+        )
+        if hand_rules
+        else None
+    )
+    live = _live_train_bytes(plan, mesh_axes, real_params) if args.live else None
+
+    if args.json:
+        payload = {"model": args.model, "mesh": mesh_axes, "plan": plan.to_json()}
+        if hand is not None:
+            payload["hand_rules"] = {
+                "rules": [[p, list(s)] for p, s in hand.rules],
+                "predicted": hand.to_json()["predicted"],
+                "modeled_cost": hand.cost.total,
+            }
+            payload["plan"]["modeled_cost"] = plan.cost.total
+            payload["auto_beats_hand"] = plan.cost.total <= hand.cost.total
+        if live is not None:
+            payload["live"] = live
+        print(json.dumps(payload, indent=2))
+        return payload
+
+    print(f"[plan] {args.model} | mesh={mesh_axes} | batch={args.batch} | "
+          f"training (opt {args.opt_bytes_per_param} B/param) weights={args.weight_dtype}")
+    print()
+    print(plan.describe())
+    if hand is not None:
+        print()
+        verdict = "matches or beats" if plan.cost.total <= hand.cost.total else "LOSES TO"
+        print(
+            f"hand-written family table: modeled cost {hand.cost.total:.3e} "
+            f"(per-chip {int(hand.cost.per_chip_total_bytes)} bytes, "
+            f"ici {int(hand.cost.collective_bytes)} B/dispatch) — "
+            f"auto plan ({plan.cost.total:.3e}) {verdict} it"
+        )
+    if live is not None:
+        print()
+        print("predicted vs live per-chip bytes (tree_device_nbytes, device 0):")
+        for tree in ("params", "grads", "opt_state"):
+            row = live[tree]
+            print(
+                f"  {tree:<10} predicted {row['predicted_bytes']:>12}  "
+                f"live {row['live_bytes']:>12}  error {row['error_pct']:.2f}%"
+            )
+    return plan
+
+
+def _live_train_bytes(plan, mesh_axes, real_params):
+    """Place params, a zeros grads tree, and a freshly-initialized Adam state on
+    the real devices per the plan (the same derivation seams `prepare()` uses)
+    and measure per-chip bytes off the LIVE shardings."""
+    import jax
+    import numpy as np
+    import optax
+
+    from ..parallel.sharding import (
+        derive_opt_state_shardings,
+        derive_tp_param_shardings,
+        place_params,
+        tree_device_nbytes,
+    )
+
+    sizes = [int(s) for s in mesh_axes.values()]
+    n_devices = int(np.prod(sizes))
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise SystemExit(
+            f"--live needs {n_devices} devices for mesh {mesh_axes}, "
+            f"have {len(devices)}"
+        )
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(devices[:n_devices]).reshape(sizes), tuple(mesh_axes))
+    dev0 = devices[0]
+
+    param_shardings = derive_tp_param_shardings(real_params, mesh, plan.rules)
+    placed = place_params(real_params, param_shardings)
+    grads = place_params(jax.tree_util.tree_map(lambda x: jax.numpy.zeros_like(x), real_params), param_shardings)
+    tx = optax.adam(1e-3)
+    state_shapes = jax.eval_shape(tx.init, placed)
+    opt_shardings = derive_opt_state_shardings(
+        state_shapes, mesh, None, plan.rules, opt_rules=plan.opt_rules
+    )
+    opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(placed)
+
+    def row(predicted, live):
+        predicted, live = float(predicted), float(live)
+        err = abs(predicted - live) / live * 100.0 if live else 0.0
+        return {
+            "predicted_bytes": int(predicted),
+            "live_bytes": int(live),
+            "error_pct": err,
+        }
+
+    return {
+        "params": row(plan.cost.per_chip_param_bytes, tree_device_nbytes(placed, dev0)),
+        # Grads carry the parameter dtype and placement, so the param account
+        # predicts them too.
+        "grads": row(plan.cost.per_chip_param_bytes, tree_device_nbytes(grads, dev0)),
+        "opt_state": row(plan.cost.per_chip_opt_bytes, tree_device_nbytes(opt_state, dev0)),
+    }
+
+
 def plan_command(args):
     import numpy as np
 
@@ -96,6 +269,8 @@ def plan_command(args):
     )
 
     chip = CHIPS[args.chip] if args.chip else None
+    if args.mesh:
+        return _train_plan_command(args, chip)
     refine = max(0, int(args.refine_top_k))
     params, config, hand_rules, apply_fn, real_params = _model_shapes(
         args.model, args.seq_len, materialize=refine >= 1

@@ -52,6 +52,11 @@ class Model:
     # Sharding hints: pytree-path-regex -> PartitionSpec tuples, consumed by
     # parallel/sharding.py rule derivation (the TP "module rules" equivalent).
     sharding_rules: Optional[list] = None
+    # Planner-emitted ZeRO table for optimizer state (ShardingPlan.opt_rules):
+    # moments shard along "data" even where params replicate. Stamped by
+    # Accelerator.prepare_model under sharding_rules="auto", read by
+    # AcceleratedOptimizer when deriving opt_state_sharding.
+    opt_sharding_rules: Optional[list] = None
 
     @classmethod
     def from_flax(cls, module, params, loss_fn=None, sharding_rules=None) -> "Model":
@@ -107,6 +112,7 @@ class PreparedModel:
         self.apply_fn = model.apply_fn
         self.loss_fn = model.loss_fn
         self.sharding_rules = model.sharding_rules
+        self.opt_sharding_rules = getattr(model, "opt_sharding_rules", None)
         self.mesh = mesh
         self.compute_dtype = compute_dtype
         self.autocast_enabled = autocast and compute_dtype is not None

@@ -264,9 +264,16 @@ class AcceleratedOptimizer:
                 mesh = model.mesh
             self.mesh = mesh
             rules = getattr(model, "sharding_rules", None)
+            # Planner-emitted ZeRO table (plan.opt_rules, stamped on the bundle
+            # by prepare_model under sharding_rules="auto"): authoritative for
+            # matched moments — shards the weight update along "data" even
+            # where the params replicate.
+            opt_rules = getattr(model, "opt_sharding_rules", None)
             if mesh is not None:
                 state_shapes = jax.eval_shape(self.tx.init, model.params)
-                self.opt_state_sharding = derive_opt_state_shardings(state_shapes, mesh, fsdp_plugin, rules)
+                self.opt_state_sharding = derive_opt_state_shardings(
+                    state_shapes, mesh, fsdp_plugin, rules, opt_rules=opt_rules
+                )
                 offload_device = str(getattr(fsdp_plugin, "offload_optimizer_device", None) or "").lower()
                 want_disk = offload_device in ("disk", "nvme")
                 want_offload = bool(getattr(fsdp_plugin, "offload_optimizer_state", False)) and not want_disk

@@ -56,6 +56,34 @@ def unstack_layer_params(stacked, num_layers: int):
     return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(num_layers)]
 
 
+def stack_layer_params_sharded(layers, sharding_tree):
+    """Stack per-layer param pytrees directly into stage-sharded [L, ...] buffers,
+    assembling each device's [L/S, ...] slice individually so the full stacked model
+    never materializes on one device.
+
+    Deliberately NOT `jit(stack_layer_params, out_shardings=...)`: on jax 0.4.37's
+    forced-host-device CPU backend the GSPMD-partitioned concatenate reads its input
+    with a stride equal to the size of the replicated mesh axes (out.flat[k] ==
+    ref.flat[data_size * k]), silently corrupting every stacked buffer — the root
+    cause of the pipeline parity drift."""
+    import jax
+    import numpy as np
+
+    num_layers = len(layers)
+
+    def per_leaf(shard, *leaves):
+        shape = (num_layers,) + tuple(leaves[0].shape)
+        host = [np.asarray(x) for x in leaves]
+
+        def cb(idx):
+            rows = range(*idx[0].indices(num_layers))
+            return np.stack([host[i][idx[1:]] for i in rows])
+
+        return jax.make_array_from_callback(shape, shard, cb)
+
+    return jax.tree_util.tree_map(per_leaf, sharding_tree, *layers)
+
+
 def _dict_path_get(tree, path):
     for k in path:
         tree = tree[k]
@@ -336,12 +364,17 @@ def _build_local_fns(
     def _loss_pair(tail_p, carry, mb):
         """Normalize loss_on_logits output to a (loss_sum, weight) pair: fns returning a
         plain scalar (a microbatch mean) get weight 1 — equal-weight averaging; pair
-        returns give exact token-weighted parity with the unpipelined loss."""
+        returns give exact token-weighted parity with the unpipelined loss.
+
+        Both entries are shape (1,), NOT 0-d: every float scalar in this body risks
+        becoming a 0-d residual of the differentiated shard_map, and jax 0.4.37's
+        partial-eval misses scalar-residual promotion for forwarded residuals — the
+        transpose then fails _check_names (leading-axis sharding on a 0-d aval)."""
         out = spec.loss_on_logits(spec.tail(tail_p, carry), mb)
         if isinstance(out, tuple):
             s, w = out
-            return s.astype(jnp.float32), w.astype(jnp.float32)
-        return out.astype(jnp.float32), jnp.float32(1.0)
+            return s.astype(jnp.float32).reshape(1), w.astype(jnp.float32).reshape(1)
+        return out.astype(jnp.float32).reshape(1), jnp.ones((1,), jnp.float32)
 
     def local_loss(params, batch):
         params, batch = _prep(params, batch)
@@ -351,19 +384,26 @@ def _build_local_fns(
             s, w = lax.cond(
                 valid,
                 lambda c: _loss_pair(tail_p, c, out_mb),
-                lambda c: (jnp.float32(0.0), jnp.float32(0.0)),
+                lambda c: (jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.float32)),
                 x,
             )
             return (acc[0] + s, acc[1] + w)
 
         tick, init_streams, total, _ = _pipeline_scan(params, batch, fold)
         (_, (loss_sum, weight)), _ = lax.scan(
-            tick, (init_streams, (jnp.float32(0.0), jnp.float32(0.0))), jnp.arange(total)
+            tick,
+            (init_streams, (jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.float32))),
+            jnp.arange(total),
         )
         axes = ("stage", "data", "fsdp")
         loss_sum = lax.psum(loss_sum, axes)
         weight = lax.psum(weight, axes)
-        return loss_sum / jnp.maximum(weight, 1e-9)
+        # Return the unreduced (loss_sum, weight) pair; the caller divides OUTSIDE the
+        # shard_map. Keeping the division inside makes `weight` a 0-d float residual of
+        # the differentiated body, and jax 0.4.37's shard_map partial-eval under remat
+        # skips its scalar-residual promotion — the transpose then dies with a
+        # _SpecError (leading-axis names on a 0-d aval).
+        return loss_sum, weight
 
     def local_forward(params, batch):
         params, batch = _prep(params, batch)
@@ -451,7 +491,6 @@ class PipelinedModel:
         self.compute_dtype = compute_dtype
         self.autocast_enabled = autocast and compute_dtype is not None
         self.num_microbatches = num_microbatches
-        self.sharding_rules = PIPELINE_SHARDING_RULES
         # Two-stack (encoder-decoder) decompositions implement the
         # T5PipelineApply-shaped protocol and run the two-phase ring schedule.
         self.is_encoder_decoder = hasattr(layered, "apply_enc_layer")
@@ -463,15 +502,38 @@ class PipelinedModel:
 
         import jax
 
+        # Stage assignment is planner-emitted (plan_pipeline_stages balances
+        # contiguous ranges on per-layer bytes); the SPMD runner below stacks
+        # layer params into one [L, ...] buffer sharded P("stage") on the
+        # leading dim, which can only EXECUTE the uniform (equal-count) shape —
+        # non-uniform balanced plans need an MPMD runner.
+        from .planner import plan_pipeline_stages
+
+        def _stage_plan(stack, kind):
+            if len(stack) % n_stages != 0:
+                raise ValueError(
+                    f"{len(stack)} {kind} layers not divisible by {n_stages} pipeline "
+                    f"stages (the SPMD stage runner scans equal-count stages only)"
+                )
+            plan = plan_pipeline_stages(stack, n_stages)
+            if not plan.uniform:
+                raise ValueError(
+                    f"{plan.num_layers} {kind} layers not divisible by {n_stages} "
+                    f"pipeline stages (the planner's byte-balanced assignment "
+                    f"{plan.assignment} is non-uniform; the SPMD stage runner "
+                    f"scans equal-count stages only)"
+                )
+            return plan
+
         n_stages = mesh.shape["stage"]
         if self.is_encoder_decoder:
             prelude, enc_layers, dec_layers, tail = layered.split(model.params)
             self.num_layers = (len(enc_layers), len(dec_layers))
-            for kind, stack in (("encoder", enc_layers), ("decoder", dec_layers)):
-                if len(stack) % n_stages != 0:
-                    raise ValueError(
-                        f"{len(stack)} {kind} layers not divisible by {n_stages} pipeline stages"
-                    )
+            self.stage_plans = {
+                "enc_layers": _stage_plan(enc_layers, "encoder"),
+                "dec_layers": _stage_plan(dec_layers, "decoder"),
+            }
+            self.stage_plan = self.stage_plans["dec_layers"]
             layer_groups = {"enc_layers": enc_layers, "dec_layers": dec_layers}
         else:
             prelude, layers, tail = layered.split(model.params)
@@ -489,11 +551,10 @@ class PipelinedModel:
                     "accelerate_tpu.big_modeling.dispatch_model/cpu_offload with the "
                     "same LayeredApply."
                 )
-            if self.num_layers % n_stages != 0:
-                raise ValueError(
-                    f"{self.num_layers} layers not divisible by {n_stages} pipeline stages"
-                )
+            self.stage_plan = _stage_plan(layers, "transformer")
+            self.stage_plans = {"layers": self.stage_plan}
             layer_groups = {"layers": layers}
+        self.sharding_rules = list(self.stage_plan.rules)
         # Tied weights (e.g. embed_tokens reused by a tied lm head) appear in both the
         # prelude and the tail after split. Store them ONCE (in the prelude) and
         # re-inject the prelude's copy into the tail view inside the differentiated
@@ -502,9 +563,9 @@ class PipelinedModel:
         self._ties = find_tied_leaves(prelude, tail)
         for tail_path, _ in self._ties:
             tail = _dict_path_del(tail, tail_path)
-        # Stack the per-layer pytrees directly into stage-sharded buffers: jitting the
-        # stack with sharded out_shardings keeps each device to its own [L/S, ...]
-        # slice instead of materializing the full stacked model on one device.
+        # Stack the per-layer pytrees directly into stage-sharded buffers, one
+        # device-local [L/S, ...] slice at a time (stack_layer_params_sharded) so the
+        # full stacked model never materializes on one device.
         self.param_sharding = {
             "prelude": jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), prelude),
             "tail": jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), tail),
@@ -515,9 +576,7 @@ class PipelinedModel:
             group_sharding = jax.tree_util.tree_map(
                 lambda _: NamedSharding(mesh, P("stage")), stacked_struct
             )
-            stacked_groups[group_name] = jax.jit(  # tpu-lint: disable=jit-in-loop (one-shot layout pass per group)
-                stack_layer_params, out_shardings=group_sharding
-            )(stack)
+            stacked_groups[group_name] = stack_layer_params_sharded(stack, group_sharding)
             self.param_sharding[group_name] = group_sharding
         from .sharding import place_params
 
@@ -563,7 +622,17 @@ class PipelinedModel:
 
             return inner
 
-        self._loss_fn = shard_map(_with_ties(local_loss), out_specs=P(), **smap_kwargs)
+        _loss_pair_fn = shard_map(
+            _with_ties(local_loss), out_specs=(P(), P()), **smap_kwargs
+        )
+
+        def _loss(params, batch):
+            import jax.numpy as jnp
+
+            loss_sum, weight = _loss_pair_fn(params, batch)
+            return (loss_sum / jnp.maximum(weight, 1e-9))[0]
+
+        self._loss_fn = _loss
         self._forward_fn = shard_map(_with_ties(local_forward), out_specs=data_spec, **smap_kwargs)
         self._jit_forward = None
         # Accelerator.autocast toggles clear this on every registered model; the

@@ -46,12 +46,15 @@ __all__ = [
     "LeafPlan",
     "PlanCost",
     "ShardingPlan",
+    "StagePlan",
     "CHIPS",
     "default_chip",
     "candidate_specs",
     "emit_rules",
     "plan_sharding",
     "plan_serving_sharding",
+    "plan_train_sharding",
+    "plan_pipeline_stages",
     "score_rules",
     "measure_forward_step",
     "refine_plans",
@@ -112,6 +115,13 @@ class Workload:
     kv_shardable: bool = True
     opt_bytes_per_param: float = 0.0
 
+    @property
+    def is_training(self) -> bool:
+        """Optimizer state in the account means a TRAINING dispatch: the step
+        reads/writes moments and syncs gradients, both of which the cost model
+        then prices (serving dispatches carry neither)."""
+        return self.opt_bytes_per_param > 0.0
+
 
 # --------------------------------------------------------------- plan output
 @dataclass
@@ -125,6 +135,11 @@ class LeafPlan:
     local_bytes: float
     collective_bytes: float
     role: str  # "column-parallel" | "row-parallel" | "replicated" | ...
+    # Optimizer-state placement for this leaf's moments (ZeRO weight-update
+    # sharding: may shard along "data" even where the param replicates).
+    # Equal to `spec` when the moments simply follow the parameter.
+    opt_spec: Tuple = ()
+    opt_local_bytes: float = 0.0
 
 
 @dataclass
@@ -168,23 +183,35 @@ class ShardingPlan:
     chip: ChipSpec
     workload: Workload
     measured_step_s: Optional[float] = None
+    #: Optimizer-state rules table, same `(pattern, spec)` shape, consumed by
+    #: `derive_opt_state_shardings(..., opt_rules=...)`. Patterns are anchored
+    #: `(^|/)` (not `^`) so they match the param path nested inside a moment
+    #: path like ``0/mu/<param path>``. Empty when moments follow the params.
+    opt_rules: List[Tuple[str, Tuple]] = field(default_factory=list)
 
     @property
     def leaf_specs(self) -> Dict[str, Tuple]:
         return {leaf.path: leaf.spec for leaf in self.leaves}
 
+    @property
+    def leaf_opt_specs(self) -> Dict[str, Tuple]:
+        return {leaf.path: leaf.opt_spec for leaf in self.leaves}
+
     def describe(self) -> str:
         """Human-readable plan: per-leaf specs, the emitted rules table, and
         the predicted per-chip bytes / collective traffic / step time."""
+        training = self.workload.is_training
+        opt_col = f" {'opt spec':<22}" if training else ""
         lines = [
             f"sharding plan over mesh {self.mesh_axes} (chip model: {self.chip.name})",
             "",
-            f"{'parameter':<52} {'shape':<18} {'spec':<22} {'role':<16} {'per-chip':>10}",
+            f"{'parameter':<52} {'shape':<18} {'spec':<22}{opt_col} {'role':<16} {'per-chip':>10}",
         ]
         for leaf in sorted(self.leaves, key=lambda l: l.path):
+            opt_cell = f" {str(leaf.opt_spec):<22}" if training else ""
             lines.append(
                 f"{leaf.path:<52} {str(tuple(leaf.shape)):<18} "
-                f"{str(leaf.spec):<22} {leaf.role:<16} {_fmt_bytes(leaf.local_bytes):>10}"
+                f"{str(leaf.spec):<22}{opt_cell} {leaf.role:<16} {_fmt_bytes(leaf.local_bytes):>10}"
             )
         lines.append("")
         lines.append("emitted rules table (first match wins):")
@@ -192,6 +219,11 @@ class ShardingPlan:
             lines.append(f"  ({pattern!r}, {spec!r})")
         if not self.rules:
             lines.append("  (empty — everything replicates)")
+        if self.opt_rules:
+            lines.append("")
+            lines.append("emitted optimizer-state rules table (ZeRO weight-update sharding):")
+            for pattern, spec in self.opt_rules:
+                lines.append(f"  ({pattern!r}, {spec!r})")
         cost = self.cost
         lines += [
             "",
@@ -217,13 +249,16 @@ class ShardingPlan:
             "mesh_axes": dict(self.mesh_axes),
             "chip": self.chip.name,
             "rules": [[pattern, list(spec)] for pattern, spec in self.rules],
+            "opt_rules": [[pattern, list(spec)] for pattern, spec in self.opt_rules],
             "leaves": [
                 {
                     "path": leaf.path,
                     "shape": list(leaf.shape),
                     "spec": list(leaf.spec),
+                    "opt_spec": list(leaf.opt_spec),
                     "role": leaf.role,
                     "per_chip_bytes": int(leaf.local_bytes),
+                    "opt_per_chip_bytes": int(leaf.opt_local_bytes),
                     "collective_bytes": int(leaf.collective_bytes),
                 }
                 for leaf in self.leaves
@@ -410,14 +445,43 @@ def _infer_hidden(leaves: Sequence[_Leaf]) -> Optional[int]:
 
 
 @dataclass
+class _Cand:
+    """One candidate for a group decision. ``opt_specs`` is the optimizer-state
+    placement per leaf — ``None`` means the moments simply follow the param
+    spec; a dict means the planner chose a distinct moment layout (ZeRO
+    weight-update sharding along the data axis)."""
+
+    label: str
+    specs: Dict[str, Tuple]
+    coll: float
+    opt_specs: Optional[Dict[str, Tuple]] = None
+
+    def opt_spec(self, path: str) -> Tuple:
+        if self.opt_specs is not None:
+            return self.opt_specs[path]
+        return self.specs[path]
+
+
+def _as_cand(candidate) -> _Cand:
+    """Group builders construct plain (label, specs, coll) tuples; normalize
+    them at the search boundary so opt-state-aware candidates and legacy
+    3-tuples coexist."""
+    if isinstance(candidate, _Cand):
+        return candidate
+    label, specs, coll = candidate
+    return _Cand(label=label, specs=specs, coll=coll)
+
+
+@dataclass
 class _Group:
     """One beam-search decision: a Megatron chain (column producers + the row
     output projection), a lone matmul/embedding, or an unknown-role weight.
-    ``candidates`` are (label, {path: spec}, collective_bytes) options."""
+    ``candidates`` are (label, {path: spec}, collective_bytes) options (or
+    `_Cand` objects once the training expansion has run)."""
 
     key: str
     leaves: List[_Leaf]
-    candidates: List[Tuple[str, Dict[str, Tuple], float]] = field(default_factory=list)
+    candidates: List = field(default_factory=list)
 
 
 def _build_groups(
@@ -597,6 +661,87 @@ def _fsdp_groups(leaves: Sequence[_Leaf], mesh, workload: Workload) -> List[_Gro
     return groups
 
 
+# ----------------------------------------------------- ZeRO (training) axis
+#: Moments smaller than this replicate regardless: sharding a norm scale's
+#: Adam state saves a few hundred bytes and costs a scattered layout. Smaller
+#: than sharding._SMALL_PARAM_DEFAULT on purpose — the CPU test tier plans
+#: tiny models whose kernels must still exercise the ZeRO path.
+_ZERO_MIN_ELEMS = 1024
+
+
+def _zero_opt_spec(
+    path: str, shape: Tuple[int, ...], param_spec: Tuple, sizes: Dict[str, int], zero_axis: str
+) -> Optional[Tuple]:
+    """Extend a param spec with ``zero_axis`` for the MOMENT placement: grow an
+    already-sharded dim when the finer grid still divides (keeps the moment
+    shard nested inside the param shard), else take the same free dim
+    `spec_for_param`'s fsdp extension would pick. Full-rank tuple (trailing
+    Nones kept, planner canon); None when no dim divides."""
+    from .sharding import _fsdp_dim
+
+    n = sizes.get(zero_axis, 1)
+    if n <= 1 or not shape:
+        return None
+    spec = list(param_spec) + [None] * (len(shape) - len(param_spec))
+    taken = {i for i, s in enumerate(spec) if s is not None}
+    for i in sorted(taken, reverse=True):
+        axes = (spec[i],) if isinstance(spec[i], str) else tuple(spec[i])
+        group = n * int(np.prod([sizes.get(a, 1) for a in axes]))
+        if shape[i] % group == 0 and shape[i] >= group:
+            spec[i] = tuple(axes) + (zero_axis,)
+            return tuple(spec)
+    dim = _fsdp_dim(path, shape, n, taken)
+    if dim is None:
+        return None
+    spec[dim] = zero_axis
+    return tuple(spec)
+
+
+def _train_extend_candidates(
+    group: _Group, sizes: Dict[str, int], workload: Workload, zero_axis: Optional[str]
+) -> None:
+    """Rewrite a group's candidates for a TRAINING mesh with a data axis:
+
+    - every candidate is charged the per-step gradient synchronization over
+      "data" (an all-reduce of the leaf's local gradient — grads carry the
+      param sharding, so the payload is the param's per-chip bytes);
+    - each candidate gains a "+zero" twin whose optimizer moments additionally
+      shard along the data axis. The ZeRO update's reduce-scatter + updated-
+      param all-gather moves exactly the same wire bytes as the plain grad
+      all-reduce (2(N-1)/N each), so the ICI term is UNCHANGED — the twin wins
+      purely on per-chip HBM, which is the Xu et al. weight-update-sharding
+      account.
+    """
+    data_n = sizes.get("data", 1)
+    out: List[_Cand] = []
+    for candidate in group.candidates:
+        cand = _as_cand(candidate)
+        grad_sync = 0.0
+        if data_n > 1 and workload.is_training:
+            for leaf in group.leaves:
+                local = leaf.nbytes / _spec_shard_factor(cand.specs[leaf.path], sizes)
+                grad_sync += _allreduce_bytes(local, data_n)
+        base = _Cand(cand.label, cand.specs, cand.coll + grad_sync, cand.opt_specs)
+        out.append(base)
+        if zero_axis is None:
+            continue
+        opt_specs: Dict[str, Tuple] = {}
+        changed = False
+        for leaf in group.leaves:
+            pspec = cand.specs[leaf.path]
+            zspec = None
+            if leaf.elems >= _ZERO_MIN_ELEMS:
+                zspec = _zero_opt_spec(leaf.path, leaf.shape, pspec, sizes, zero_axis)
+            if zspec is not None and zspec != tuple(pspec):
+                opt_specs[leaf.path] = zspec
+                changed = True
+            else:
+                opt_specs[leaf.path] = pspec
+        if changed:
+            out.append(_Cand(base.label + "+zero", cand.specs, base.coll, opt_specs))
+    group.candidates = out
+
+
 # --------------------------------------------------------------- beam search
 def _score(
     local_param_bytes: float,
@@ -605,11 +750,22 @@ def _score(
     chip: ChipSpec,
     workload: Workload,
     kv_factor: int,
+    local_opt_bytes: Optional[float] = None,
 ) -> PlanCost:
     per_chip_kv = workload.kv_pool_bytes / max(kv_factor, 1)
-    per_chip_opt = local_elems * workload.opt_bytes_per_param
+    # Spec-DEPENDENT optimizer-state account: the beam search passes the bytes
+    # implied by each candidate's moment placement (ZeRO shards may divide the
+    # data axis where the param replicates). The None default prices moments
+    # as following the param sharding — the pre-2D behavior, and what a rules
+    # table without an opt-rules twin actually places.
+    per_chip_opt = (
+        local_opt_bytes if local_opt_bytes is not None
+        else local_elems * workload.opt_bytes_per_param
+    )
     flop_time = 2.0 * local_elems * workload.batch * workload.seq / (chip.tflops * 1e12)
-    hbm_time = (local_param_bytes + per_chip_kv) / (chip.hbm_gbps * 1e9)
+    # A training step reads AND writes the moments next to the params; serving
+    # dispatches (opt == 0) price exactly as before.
+    hbm_time = (local_param_bytes + per_chip_kv + per_chip_opt) / (chip.hbm_gbps * 1e9)
     ici_time = ici_bytes / (chip.ici_gbps * 1e9)
     step = max(flop_time, hbm_time, ici_time)
     total_bytes = local_param_bytes + per_chip_opt + per_chip_kv
@@ -633,6 +789,7 @@ class _Partial:
     local_bytes: float
     local_elems: float
     ici_bytes: float
+    local_opt_bytes: float = 0.0
 
 
 def _beam_search(
@@ -643,34 +800,44 @@ def _beam_search(
     kv_factor: int,
     beam_width: int,
     top_k: int,
-) -> List[Tuple[Dict[str, Tuple], Dict[str, str], float, PlanCost]]:
+) -> List[Tuple[Dict[str, Tuple], Dict[str, Tuple], Dict[str, str], float, PlanCost]]:
     """Beam over group decisions (largest groups first so early pruning sees
     the decisions that matter). Returns up to ``top_k`` distinct complete
-    assignments ranked by modeled cost."""
+    (param assignment, opt assignment, roles, ici, cost) tuples ranked by
+    modeled cost."""
+    for group in groups:
+        group.candidates = [_as_cand(c) for c in group.candidates]
     order = sorted(range(len(groups)), key=lambda i: -sum(l.nbytes for l in groups[i].leaves))
     beam = [_Partial(choices=(), local_bytes=0.0, local_elems=0.0, ici_bytes=0.0)]
+    opt_bpp = workload.opt_bytes_per_param
     for gi in order:
         group = groups[gi]
         nxt: List[_Partial] = []
         for partial in beam:
-            for ci, (_, specs, coll) in enumerate(group.candidates):
+            for ci, cand in enumerate(group.candidates):
                 add_bytes = 0.0
                 add_elems = 0.0
+                add_opt = 0.0
                 for leaf in group.leaves:
-                    factor = _spec_shard_factor(specs[leaf.path], sizes)
+                    factor = _spec_shard_factor(cand.specs[leaf.path], sizes)
                     add_bytes += leaf.nbytes / factor
                     add_elems += leaf.elems / factor
+                    if opt_bpp:
+                        opt_factor = _spec_shard_factor(cand.opt_spec(leaf.path), sizes)
+                        add_opt += leaf.elems * opt_bpp / opt_factor
                 nxt.append(
                     _Partial(
                         choices=partial.choices + (ci,),
                         local_bytes=partial.local_bytes + add_bytes,
                         local_elems=partial.local_elems + add_elems,
-                        ici_bytes=partial.ici_bytes + coll,
+                        ici_bytes=partial.ici_bytes + cand.coll,
+                        local_opt_bytes=partial.local_opt_bytes + add_opt,
                     )
                 )
         nxt.sort(
             key=lambda p: _score(
-                p.local_bytes, p.local_elems, p.ici_bytes, chip, workload, kv_factor
+                p.local_bytes, p.local_elems, p.ici_bytes, chip, workload, kv_factor,
+                local_opt_bytes=p.local_opt_bytes if opt_bpp else None,
             ).total
         )
         beam = nxt[: max(beam_width, top_k)]
@@ -679,21 +846,30 @@ def _beam_search(
     seen = set()
     for partial in beam:
         assignment: Dict[str, Tuple] = {}
+        opt_assignment: Dict[str, Tuple] = {}
         roles: Dict[str, str] = {}
         for pos, gi in enumerate(order):
-            label, specs, _ = groups[gi].candidates[partial.choices[pos]]
+            cand = groups[gi].candidates[partial.choices[pos]]
             for leaf in groups[gi].leaves:
-                spec = specs[leaf.path]
+                spec = cand.specs[leaf.path]
+                opt_spec = cand.opt_spec(leaf.path)
                 assignment[leaf.path] = spec
-                roles[leaf.path] = label if spec else "replicated"
-        key = tuple(sorted(assignment.items()))
+                opt_assignment[leaf.path] = opt_spec
+                if spec:
+                    roles[leaf.path] = cand.label
+                elif opt_spec and opt_spec != tuple(spec):
+                    roles[leaf.path] = "zero-opt"
+                else:
+                    roles[leaf.path] = "replicated"
+        key = tuple(sorted(assignment.items())) + tuple(sorted(opt_assignment.items()))
         if key in seen:
             continue
         seen.add(key)
         cost = _score(
-            partial.local_bytes, partial.local_elems, partial.ici_bytes, chip, workload, kv_factor
+            partial.local_bytes, partial.local_elems, partial.ici_bytes, chip, workload,
+            kv_factor, local_opt_bytes=partial.local_opt_bytes if opt_bpp else None,
         )
-        results.append((assignment, roles, partial.ici_bytes, cost))
+        results.append((assignment, opt_assignment, roles, partial.ici_bytes, cost))
         if len(results) >= top_k:
             break
     return results
@@ -708,7 +884,7 @@ def _rule_suffix(path: str) -> str:
     return "/".join(parts[-2:]) if len(parts) >= 2 else path
 
 
-def emit_rules(assignment: Dict[str, Tuple]) -> List[Tuple[str, Tuple]]:
+def emit_rules(assignment: Dict[str, Tuple], path_anchor: str = "^") -> List[Tuple[str, Tuple]]:
     """Collapse per-leaf spec choices into a `(pattern, spec)` table in the
     exact shape `spec_for_param` / `derive_tp_param_shardings` consume.
 
@@ -718,7 +894,11 @@ def emit_rules(assignment: Dict[str, Tuple]) -> List[Tuple[str, Tuple]]:
     ``.../kernel/q`` / ``.../kernel/scale`` entries, exactly like the hand
     tables. Conflicting suffixes fall back to full-path anchored rules,
     emitted FIRST so first-match-wins keeps them authoritative. Replicated
-    leaves need no rule: unmatched leaves replicate by construction."""
+    leaves need no rule: unmatched leaves replicate by construction.
+
+    ``path_anchor`` is the full-path rules' start anchor: the default ``^``
+    for param tables; optimizer-state tables pass ``(^|/)`` so the pattern
+    still matches the param path nested inside a moment path (``0/mu/<path>``)."""
     by_suffix: Dict[str, Dict[str, Tuple]] = {}
     for path, spec in assignment.items():
         by_suffix.setdefault(_rule_suffix(path), {})[path] = spec
@@ -735,7 +915,7 @@ def emit_rules(assignment: Dict[str, Tuple]) -> List[Tuple[str, Tuple]]:
             grouped.append((f"(^|/){re.escape(suffix)}(/|$)", next(iter(chosen))))
         else:
             for path in sorted(sharded):
-                exact.append((f"^{re.escape(path)}(/|$)", sharded[path]))
+                exact.append((f"{path_anchor}{re.escape(path)}(/|$)", sharded[path]))
     return exact + grouped
 
 
@@ -757,9 +937,13 @@ def plan_sharding(
     ``top_k > 1`` — feed those to `refine_plans` for measure-and-refine).
     ``axes`` defaults to every supported mesh axis with size > 1: "model"
     gets the Megatron chain/loner dataflow model, "fsdp" the ZeRO-3
-    storage-vs-regather account. `params` may be real arrays or
-    `ShapeDtypeStruct`s (`jax.eval_shape`) — the planner only reads shapes
-    and dtypes.
+    storage-vs-regather account, and "data" (with a TRAINING workload, i.e.
+    ``opt_bytes_per_param > 0``) the ZeRO weight-update-sharding account —
+    per-leaf optimizer-moment placement along the data axis, priced
+    spec-dependently in HBM while the grad-sync ICI bytes stay those of the
+    plain all-reduce (reduce-scatter + all-gather moves the same wire bytes).
+    `params` may be real arrays or `ShapeDtypeStruct`s (`jax.eval_shape`) —
+    the planner only reads shapes and dtypes.
 
     Binding semantics: sharded decisions bind everywhere (an emitted rule
     always wins in `spec_for_param`); REPLICATE decisions bind except where
@@ -774,7 +958,7 @@ def plan_sharding(
     workload = workload or Workload()
     sizes = _axis_sizes(mesh)
     if axes is None:
-        axes = [a for a in ("model", "fsdp") if sizes.get(a, 1) > 1]
+        axes = [a for a in ("data", "model", "fsdp") if sizes.get(a, 1) > 1]
 
     leaves = _harvest_leaves(params, weight_dtype=weight_dtype)
     groups: List[_Group] = []
@@ -791,11 +975,19 @@ def plan_sharding(
     if not groups:
         groups = [_Group(key=f"leaf:{l.path}", leaves=[l], candidates=[("replicate", {l.path: ()}, 0.0)]) for l in leaves]
 
+    # Training meshes with a data axis: charge every candidate the grad sync
+    # and enumerate the ZeRO optimizer-state twin (moments sharded over
+    # "data" even where params replicate).
+    if "data" in axes and sizes.get("data", 1) > 1 and workload.is_training:
+        for group in groups:
+            _train_extend_candidates(group, sizes, workload, zero_axis="data")
+
     kv_factor = sizes.get("model", 1) if workload.kv_shardable else 1
     ranked = _beam_search(groups, sizes, chip, workload, kv_factor, beam_width, top_k)
 
+    opt_bpp = workload.opt_bytes_per_param
     plans = []
-    for assignment, roles, ici_bytes, cost in ranked:
+    for assignment, opt_assignment, roles, ici_bytes, cost in ranked:
         leaf_plans = [
             LeafPlan(
                 path=leaf.path,
@@ -805,9 +997,23 @@ def plan_sharding(
                 local_bytes=leaf.nbytes / _spec_shard_factor(assignment[leaf.path], sizes),
                 collective_bytes=0.0,
                 role=roles[leaf.path],
+                opt_spec=opt_assignment[leaf.path],
+                opt_local_bytes=(
+                    leaf.elems * opt_bpp
+                    / _spec_shard_factor(opt_assignment[leaf.path], sizes)
+                ),
             )
             for leaf in leaves
         ]
+        # The opt-rules table covers EVERY sharded moment (including the ones
+        # that just follow a sharded param): derive_opt_state_shardings treats
+        # it as authoritative when present, so an omitted follow-the-param
+        # rule would silently replicate that moment and reshard every step.
+        opt_rules = (
+            emit_rules(opt_assignment, path_anchor="(^|/)")
+            if any(opt_assignment[l.path] != assignment[l.path] for l in leaves)
+            else []
+        )
         plans.append(
             ShardingPlan(
                 rules=emit_rules(assignment),
@@ -816,6 +1022,7 @@ def plan_sharding(
                 mesh_axes=sizes,
                 chip=chip,
                 workload=workload,
+                opt_rules=opt_rules,
             )
         )
     if not plans:
@@ -872,9 +1079,10 @@ def score_rules(
         local_elems += leaf.elems / factor
     for group in groups:
         matched = None
-        for label, specs, coll in group.candidates:
-            if all(assignment.get(p, ()) == s for p, s in specs.items()):
-                matched = (label, coll)
+        for candidate in group.candidates:
+            cand = _as_cand(candidate)
+            if all(assignment.get(p, ()) == s for p, s in cand.specs.items()):
+                matched = (cand.label, cand.coll)
                 break
         if matched is None:
             # Off-model assignment: conservative regather of each sharded leaf.
@@ -889,6 +1097,15 @@ def score_rules(
         for leaf in group.leaves:
             roles[leaf.path] = label if assignment[leaf.path] else "replicated"
 
+    # Training dispatches sync gradients over "data" — price the hand table's
+    # all-reduce the same way _train_extend_candidates prices the planner's
+    # candidates, or the comparison silently favors whichever side skipped it.
+    data_n = sizes.get("data", 1)
+    if data_n > 1 and workload.is_training:
+        for leaf in leaves:
+            local = leaf.nbytes / _spec_shard_factor(assignment[leaf.path], sizes)
+            ici_bytes += _allreduce_bytes(local, data_n)
+
     kv_factor = sizes.get("model", 1) if workload.kv_shardable else 1
     cost = _score(local_bytes, local_elems, ici_bytes, chip, workload, kv_factor)
     leaf_plans = [
@@ -900,6 +1117,13 @@ def score_rules(
             local_bytes=leaf.nbytes / _spec_shard_factor(assignment[leaf.path], sizes),
             collective_bytes=0.0,
             role=roles[leaf.path],
+            # A bare rules table carries no opt-state twin: moments follow the
+            # param placement, which is how _score priced them above.
+            opt_spec=assignment[leaf.path],
+            opt_local_bytes=(
+                leaf.elems * workload.opt_bytes_per_param
+                / _spec_shard_factor(assignment[leaf.path], sizes)
+            ),
         )
         for leaf in leaves
     ]
@@ -966,6 +1190,139 @@ def plan_serving_sharding(
         weight_dtype=weight_dtype,
         beam_width=beam_width,
         top_k=top_k,
+    )
+
+
+# ------------------------------------------------------------------ training
+def plan_train_sharding(
+    params,
+    mesh,
+    *,
+    batch: int,
+    seq: int,
+    act_bytes: int = 2,
+    opt_bytes_per_param: float = 8.0,
+    weight_dtype: str = "bf16",
+    chip: Optional[ChipSpec] = None,
+    beam_width: int = 8,
+    top_k: int = 1,
+):
+    """Plan the 2D ("data", "model") training layout: the params tree searched
+    over both axes with gradient all-reduce priced per candidate and a
+    ZeRO-style twin per candidate whose optimizer moments shard along "data"
+    even where the params replicate (Xu et al.: reduce-scatter + all-gather
+    moves the same ICI bytes as the all-reduce, so the twin wins purely on
+    per-chip HBM). This is what ``Accelerator.prepare(sharding_rules="auto")``
+    calls on a training mesh."""
+    sizes = _axis_sizes(mesh)
+    axes = tuple(a for a in ("data", "model") if sizes.get(a, 1) > 1) or ("model",)
+    workload = Workload(
+        batch=batch,
+        seq=seq,
+        act_bytes=act_bytes,
+        opt_bytes_per_param=opt_bytes_per_param,
+    )
+    return plan_sharding(
+        params,
+        mesh,
+        axes=axes,
+        chip=chip,
+        workload=workload,
+        weight_dtype=weight_dtype,
+        beam_width=beam_width,
+        top_k=top_k,
+    )
+
+
+# ------------------------------------------------------------------ pipeline
+@dataclass
+class StagePlan:
+    """Planner-emitted pipeline stage assignment: contiguous layer ranges
+    balanced on per-layer parameter bytes (the hand partitioner's equal-count
+    split is the special case where every layer weighs the same)."""
+
+    num_stages: int
+    num_layers: int
+    assignment: List[int]  # layer index -> stage index, non-decreasing
+    per_stage_bytes: List[float]
+    rules: List[Tuple[str, Tuple]]
+
+    @property
+    def uniform(self) -> bool:
+        """True when every stage holds the same number of layers — the only
+        shape the SPMD stage runner (stacked layer params, P("stage") leading
+        dim) can execute today."""
+        counts = [self.assignment.count(s) for s in range(self.num_stages)]
+        return len(set(counts)) == 1
+
+    @property
+    def imbalance(self) -> float:
+        """max/mean per-stage bytes — 1.0 is perfectly balanced."""
+        mean = sum(self.per_stage_bytes) / max(len(self.per_stage_bytes), 1)
+        return max(self.per_stage_bytes) / mean if mean else 1.0
+
+    def stage_layers(self, stage: int) -> List[int]:
+        return [i for i, s in enumerate(self.assignment) if s == stage]
+
+
+def _layer_nbytes(layer_params, weight_dtype: str = "bf16") -> float:
+    return sum(leaf.nbytes for leaf in _harvest_leaves(layer_params, weight_dtype))
+
+
+def plan_pipeline_stages(
+    layer_params_list: Sequence[Any],
+    num_stages: int,
+    *,
+    weight_dtype: str = "bf16",
+) -> StagePlan:
+    """Assign ``len(layer_params_list)`` layers to ``num_stages`` contiguous
+    stages minimizing the max per-stage parameter bytes (classic linear
+    partition DP). Accepts real arrays or ShapeDtypeStructs per layer. Emits
+    the same rules table shape the pipeline seam consumes."""
+    n = len(layer_params_list)
+    if num_stages <= 0:
+        raise ValueError(f"num_stages must be positive, got {num_stages}")
+    if n < num_stages:
+        raise ValueError(f"cannot split {n} layers across {num_stages} stages")
+    weights = [_layer_nbytes(lp, weight_dtype) for lp in layer_params_list]
+    prefix = [0.0]
+    for w in weights:
+        prefix.append(prefix[-1] + w)
+
+    def span(i: int, j: int) -> float:  # bytes of layers [i, j)
+        return prefix[j] - prefix[i]
+
+    INF = float("inf")
+    # dp[s][j] = minimal max-stage-bytes splitting the first j layers into s stages
+    dp = [[INF] * (n + 1) for _ in range(num_stages + 1)]
+    cut = [[0] * (n + 1) for _ in range(num_stages + 1)]
+    dp[0][0] = 0.0
+    for s in range(1, num_stages + 1):
+        for j in range(s, n + 1):
+            for i in range(s - 1, j):
+                cand = max(dp[s - 1][i], span(i, j))
+                if cand < dp[s][j]:
+                    dp[s][j] = cand
+                    cut[s][j] = i
+    bounds = [n]
+    j = n
+    for s in range(num_stages, 0, -1):
+        j = cut[s][j]
+        bounds.append(j)
+    bounds.reverse()  # [0, ..., n], num_stages + 1 entries
+    assignment = [0] * n
+    per_stage = []
+    for s in range(num_stages):
+        lo, hi = bounds[s], bounds[s + 1]
+        for i in range(lo, hi):
+            assignment[i] = s
+        per_stage.append(span(lo, hi))
+    return StagePlan(
+        num_stages=num_stages,
+        num_layers=n,
+        assignment=assignment,
+        per_stage_bytes=per_stage,
+        rules=[(r"(^|/)(enc_|dec_)?layers(/|$)", ("stage",))],
     )
 
 

@@ -172,16 +172,53 @@ def derive_param_shardings(params, mesh, fsdp_plugin=None, rules=None):
     return jax.tree_util.tree_unflatten(treedef, shardings)
 
 
-def derive_opt_state_shardings(opt_state_shapes, mesh, fsdp_plugin=None, rules=None):
+def _spec_legal(spec: Tuple, shape: Tuple[int, ...], mesh) -> bool:
+    """True when every sharded dim of ``shape`` divides evenly by the product
+    of its mesh-axis sizes (GSPMD would pad otherwise; the planner never emits
+    padded placements, so an indivisible match means the rule was written for a
+    different tree)."""
+    sizes = dict(getattr(mesh, "shape", {}) or {})
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        group = 1
+        for a in axes:
+            group *= int(sizes.get(a, 1))
+        if group > 1 and (dim >= len(shape) or shape[dim] % group != 0):
+            return False
+    return True
+
+
+def derive_opt_state_shardings(opt_state_shapes, mesh, fsdp_plugin=None, rules=None, opt_rules=None):
     """Shardings for optimizer state, by the same path+shape rules.
 
     Adam moments mirror parameter shapes, so the same derivation yields matching
     shardings; for `SHARD_GRAD_OP` (ZeRO-2) the optimizer state shards over "fsdp" even
     though params stay replicated — that's the weight-update-sharding trick. Scalars
     (step counts) replicate.
+
+    ``opt_rules`` is the planner-emitted ZeRO table (``ShardingPlan.opt_rules``):
+    when given it is AUTHORITATIVE for any moment whose path matches — the
+    planner already enumerated every sharded moment, so matched paths take the
+    table's spec verbatim (legality re-checked against the mesh) and unmatched
+    non-scalar leaves fall through to the ordinary param-rule derivation.
+    Patterns in the table anchor ``(^|/)`` because moment paths nest the param
+    path (``0/mu/<param path>``).
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
+
+    compiled_opt_rules = [(re.compile(pat), spec) for pat, spec in (opt_rules or [])]
+
+    def _opt_rule_spec(path, shape):
+        for pat, spec in compiled_opt_rules:
+            if pat.search(path):
+                full = tuple(spec) + (None,) * (len(shape) - len(spec))
+                if _spec_legal(full, shape, mesh):
+                    return PartitionSpec(*full)
+                return PartitionSpec()  # illegal on this tree: replicate, never crash
+        return None
 
     shards_opt = fsdp_plugin is not None and fsdp_plugin.shards_opt_state
     # For opt-state derivation under ZeRO-2, treat params as sharded — but carry
@@ -204,6 +241,10 @@ def derive_opt_state_shardings(opt_state_shapes, mesh, fsdp_plugin=None, rules=N
         shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
         if len(shape) == 0:
             out.append(NamedSharding(mesh, PartitionSpec()))
+            continue
+        planned = _opt_rule_spec(path, shape)
+        if planned is not None:
+            out.append(NamedSharding(mesh, planned))
         else:
             out.append(NamedSharding(mesh, spec_for_param(path, shape, mesh, plugin, rules)))
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -50,3 +50,34 @@ def RegressionModel(a=0.0, b=0.0):
         return jnp.mean((pred[:, 0] - batch["y"]) ** 2)
 
     return Model.from_fn(apply_fn, params, loss_fn=loss_fn)
+
+
+def RegressionMLPModel(hidden=64, seed=0):
+    """The same y = 2x + 3 regression as a small MLP bundle — kernels big
+    enough (hidden x hidden >= the planner's ZeRO size floor) and cleanly
+    divisible by a ("data", "model") mesh, so a chaos/2D-training workload can
+    exercise `sharding_rules="auto"` end to end: model-sharded kernels plus
+    data-sharded Adam moments."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..modeling import Model
+
+    rng = np.random.default_rng(seed)
+    s = lambda *shape: jnp.asarray(rng.normal(scale=0.1, size=shape).astype(np.float32))
+    params = {
+        "dense_in": {"kernel": s(1, hidden), "bias": s(hidden)},
+        "dense_mid": {"kernel": s(hidden, hidden), "bias": s(hidden)},
+        "dense_out": {"kernel": s(hidden, 1), "bias": s(1)},
+    }
+
+    def apply_fn(p, x):
+        h = jnp.maximum(x @ p["dense_in"]["kernel"] + p["dense_in"]["bias"], 0.0)
+        h = jnp.maximum(h @ p["dense_mid"]["kernel"] + p["dense_mid"]["bias"], 0.0)
+        return h @ p["dense_out"]["kernel"] + p["dense_out"]["bias"]
+
+    def loss_fn(p, batch, apply_fn_):
+        pred = apply_fn_(p, batch["x"])
+        return jnp.mean((pred[:, 0] - batch["y"]) ** 2)
+
+    return Model.from_fn(apply_fn, params, loss_fn=loss_fn)

@@ -882,7 +882,16 @@ def main():
     # (--mode X, --mode=X) and hands the serving bench everything else.
     pre = argparse.ArgumentParser(add_help=False)
     pre.add_argument("--mode")
+    pre.add_argument("--zero-ab", action="store_true")
     known, rest = pre.parse_known_args(argv)
+    if known.zero_ab:
+        # 1D-replicated vs 2D-ZeRO training A/B (benchmarks/train_bench.py):
+        # its own argument surface, same pre-routing as serving/checkpoint.
+        if known.mode not in (None, "train"):
+            raise SystemExit("--zero-ab is a --mode train A/B")
+        from benchmarks.train_bench import main as train_ab_main
+
+        sys.exit(train_ab_main(rest))
     if known.mode == "serving":
         from benchmarks.serving_bench import main as serving_main
 

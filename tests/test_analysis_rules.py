@@ -414,6 +414,82 @@ def test_tpu119_variants():
     assert not analyze_source(dead.replace("import jax\n", ""))
 
 
+def test_tpu120_variants():
+    """Beyond the flag fixture's bare device_put (one finding per fixture):
+    a raw-device placement flags, an explicit NamedSharding(mesh,
+    PartitionSpec()) — replicate spelled out — flags, a derived/unknown-name
+    placement is clean (precomputed sharding pytrees get the benefit of the
+    doubt), a non-opt-state operand is out of scope (that's TPU118's beat,
+    and only on "model" meshes), a module with NO data-axis mesh is out of
+    scope however it places moments, ParallelismConfig(data=...) and
+    Mesh(..., ("data",...)) both count as data-mesh evidence, and a jax-free
+    module is out of scope."""
+    hazard = (
+        "import jax\n"
+        "from accelerate_tpu.utils import ParallelismConfig\n"
+        "def restore(tx, params):\n"
+        "    cfg = ParallelismConfig(data=-1)\n"
+        "    opt_state = tx.init(params)\n"
+        "    return cfg, jax.device_put(opt_state)\n"
+    )
+    assert [f.rule_id for f in analyze_source(hazard)] == ["TPU120"]
+    assert [f.rule_id for f in analyze_source(
+        hazard.replace("jax.device_put(opt_state)",
+                       "jax.device_put(opt_state, jax.devices()[0])")
+    )] == ["TPU120"]
+    # Replicate spelled out: every PartitionSpec in the placement is empty.
+    assert [f.rule_id for f in analyze_source(
+        hazard.replace("jax.device_put(opt_state)",
+                       "jax.device_put(opt_state, NamedSharding(mesh, PartitionSpec()))")
+    )] == ["TPU120"]
+    # A sharded spec, a derived pytree, or an unknown name: clean.
+    assert not analyze_source(
+        hazard.replace("jax.device_put(opt_state)",
+                       "jax.device_put(opt_state, NamedSharding(mesh, PartitionSpec(\"data\")))")
+    )
+    assert not analyze_source(
+        hazard.replace(
+            "jax.device_put(opt_state)",
+            "jax.device_put(opt_state, derive_opt_state_shardings(shapes, mesh, "
+            "rules=rules, opt_rules=plan.opt_rules))",
+        )
+    )
+    assert not analyze_source(
+        hazard.replace("jax.device_put(opt_state)",
+                       "jax.device_put(opt_state, opt_shardings)")
+    )
+    # Not an optimizer-state operand: TPU120 stays quiet (a bare params
+    # placement on a data-only mesh is plain data parallelism, not ZeRO's
+    # business — and TPU118 only polices "model"-axis meshes).
+    assert not analyze_source(
+        hazard.replace("opt_state = tx.init(params)\n", "")
+        .replace("jax.device_put(opt_state)", "jax.device_put(params)")
+    )
+    # No data-axis mesh anywhere in the module: out of scope.
+    assert not analyze_source(
+        hazard.replace(
+            "    cfg = ParallelismConfig(data=-1)\n", "    cfg = None\n"
+        )
+    )
+    # A literal Mesh with a "data" axis counts as data-mesh evidence too.
+    mesh_hazard = (
+        "import jax\n"
+        "from jax.sharding import Mesh\n"
+        "def restore(adam_state, devices):\n"
+        '    mesh = Mesh(devices, ("data",))\n'
+        "    return jax.device_put(adam_state, optimizer_state_placement)\n"
+    )
+    assert not analyze_source(mesh_hazard)  # named placement: benefit of the doubt
+    assert [f.rule_id for f in analyze_source(
+        mesh_hazard.replace(", optimizer_state_placement", "")
+    )] == ["TPU120"]
+    assert not analyze_source(
+        mesh_hazard.replace(", optimizer_state_placement", "")
+        .replace('("data",)', '("stage",)')
+    )
+    assert not analyze_source(hazard.replace("import jax\n", ""))
+
+
 def test_analyze_paths_walks_the_tree():
     findings, scanned = analyze_paths([str(SAMPLES)])
     assert scanned >= 2 * len(RULES) + 1  # flag + clean per rule + suppressed.py

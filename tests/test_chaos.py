@@ -294,6 +294,30 @@ def test_supervised_run_with_real_signals_resumes_via_env_protocol(tmp_path):
     assert resumes == 2
 
 
+def test_supervised_mesh_2d_keeps_zero_state_sharded_across_restart(tmp_path):
+    """The 2D-training chaos sweep: the subprocess workload trains the small
+    MLP on the ("data", "model") mesh with sharding_rules="auto" (planner 2D
+    plan, ZeRO data-sharded Adam moments), a REAL SIGKILL forces a restart,
+    and the `zero_state_sharded` invariant holds across every attempt AND the
+    post-restore state — a resume that silently replicated the moments would
+    train identically while spending data_n x the optimizer HBM."""
+    plan = FaultPlan(name="supervised-2d-kill", events=[
+        FaultEvent(kind="proc.sigkill", at_step=1),
+    ])
+    runner = ChaosRunner(plan)
+    report = runner.run_supervised_train(
+        str(tmp_path), steps=3, max_restarts=3, mesh_2d=True
+    )
+    assert report.ok, report.render_text()
+    zero_check = next(c for c in report.checks if c.name == "zero_state_sharded")
+    assert zero_check.passed, zero_check.details
+    # Both the pre-fault attempt and the post-restart attempt journaled their
+    # layout, and the resume record itself carries the restored verdict.
+    assert zero_check.details["records"] >= 3
+    resumes = next(c for c in report.checks if c.name == "resume_exactness").details["resumes"]
+    assert resumes == 1
+
+
 # ------------------------------------------------------------------ serving chaos
 def test_dispatch_stall_and_queue_burst_drain_with_terminal_reasons(tmp_path):
     """The serving acceptance sweep: an injected dispatch stall + a queue-full

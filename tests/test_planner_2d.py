@@ -1,0 +1,371 @@
+"""The 2D training planner (parallel/planner.plan_train_sharding): the
+("data", "model") search with ZeRO weight-update sharding — optimizer moments
+placed along "data" even where the params replicate — plus the planner-emitted
+pipeline stage assignment (plan_pipeline_stages).
+
+The acceptance pins:
+
+  - **legality** — every 2D spec the planner emits (params AND moments)
+    divides its dimension by the product of the mesh axes it names, and uses
+    only axes the mesh has;
+  - **ZeRO accounting** — modeled per-chip optimizer bytes beat the
+    replicated footprint by ~the data-axis degree; big replicated params get
+    a data-sharded moment twin (role "zero-opt"); the emitted opt_rules
+    table round-trips through `derive_opt_state_shardings` to live
+    placements whose measured bytes match the prediction;
+  - **planner-vs-hand parity** — on llama + gpt_neox the 2D auto plan
+    matches or beats the hand family table on modeled cost under the SAME
+    training workload (score_rules prices the hand table's grad sync too);
+  - **HBM forcing** — on a fake chip too small for the replicated layout the
+    plan sheds the overflow (model-sharded params + data-sharded moments)
+    while the replicated scoring overflows;
+  - **decode unaffected** — serving workloads (opt_bytes_per_param=0) emit
+    no opt_rules and price zero optimizer bytes;
+  - **end-to-end** — `Accelerator.prepare(sharding_rules="auto")` on the 2D
+    CPU mesh trains at loss parity with the 1D replicated baseline, with
+    moments live-sharded along "data", 0 recompiles / 0 host transfers in
+    steady state, and predicted per-chip bytes matching the live trees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from accelerate_tpu.models.gpt_neox import GPT_NEOX_SHARDING_RULES
+from accelerate_tpu.models.llama import LLAMA_SHARDING_RULES
+from accelerate_tpu.parallel.planner import (
+    Workload,
+    default_chip,
+    plan_pipeline_stages,
+    plan_sharding,
+    plan_train_sharding,
+    score_rules,
+)
+from accelerate_tpu.parallel.sharding import tree_device_nbytes
+
+pytestmark = pytest.mark.planner
+
+needs_mesh8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs an 8-device mesh (forced CPU devices)"
+)
+
+MESH_2D = {"data": 4, "model": 2}
+
+
+def wide_net(hidden=256, vocab=4096, inter=1024, layers=2):
+    """A cleanly-shardable transformer-shaped params tree (plain numpy — the
+    planner only reads shapes/dtypes) with one large REPLICATED leaf
+    (big_bias: 1D, matmul-unshardable, above the ZeRO size floor) so the
+    moments-shard-where-params-replicate path is always exercised."""
+    z = lambda *shape: np.zeros(shape, np.float32)
+    params = {"embed_tokens": {"embedding": z(vocab, hidden)}}
+    for i in range(layers):
+        params[f"layer_{i}"] = {
+            "attention": {
+                "wq": {"kernel": z(hidden, hidden)},
+                "wo": {"kernel": z(hidden, hidden)},
+            },
+            "mlp": {
+                "w_up": {"kernel": z(hidden, inter)},
+                "w_down": {"kernel": z(inter, hidden)},
+            },
+            "norm": {"scale": z(hidden)},
+            "big_bias": {"bias": z(vocab)},
+        }
+    params["lm_head"] = {"kernel": z(hidden, vocab)}
+    return {"params": params}
+
+
+def _replicated_opt_bytes(params, opt_bytes_per_param=8.0):
+    return sum(
+        int(np.prod(np.shape(l))) * opt_bytes_per_param
+        for l in jax.tree_util.tree_leaves(params)
+    )
+
+
+def _spec_axes(spec):
+    for dim in spec:
+        if dim is None:
+            continue
+        for ax in dim if isinstance(dim, tuple) else (dim,):
+            yield ax
+
+
+# ------------------------------------------------------------------ legality
+def test_2d_specs_divisible_and_on_mesh_axes():
+    """Every emitted spec — param and moment — names only mesh axes and
+    divides its dimension by the product of the axes it stacks there (the
+    same gate `_check_tp_divisible` enforces at placement time, so a planner
+    choice can never hit the indivisible-rule hard error)."""
+    plan = plan_train_sharding(wide_net(), MESH_2D, batch=8, seq=128)
+    for leaf in plan.leaves:
+        for spec in (leaf.spec, leaf.opt_spec):
+            assert set(_spec_axes(spec)) <= set(MESH_2D), (leaf.path, spec)
+            assert len(spec) <= len(leaf.shape), (leaf.path, spec)
+            for dim_idx, dim in enumerate(spec):
+                if dim is None:
+                    continue
+                axes = dim if isinstance(dim, tuple) else (dim,)
+                factor = int(np.prod([MESH_2D[a] for a in axes]))
+                assert leaf.shape[dim_idx] % factor == 0, (leaf.path, spec)
+
+
+# ------------------------------------------------------------- ZeRO account
+def test_zero_moments_shard_where_params_replicate():
+    """The weight-update-sharding core: the big replicated leaf (big_bias)
+    keeps a replicated PARAM spec but gets a "data"-sharded MOMENT spec (role
+    zero-opt); model-sharded kernels get the data axis merged into their
+    sharded dim; and the modeled per-chip optimizer bytes land near
+    replicated / (data * model) — far below the replicated footprint."""
+    params = wide_net()
+    plan = plan_train_sharding(params, MESH_2D, batch=8, seq=128)
+    by_path = {l.path: l for l in plan.leaves}
+
+    bias = by_path["params/layer_0/big_bias/bias"]
+    assert bias.spec == ()
+    assert bias.opt_spec == ("data",)
+    assert bias.role == "zero-opt"
+
+    # A model-sharded kernel: moments add "data" onto the sharded dim.
+    kernels = [l for l in plan.leaves if "model" in set(_spec_axes(l.spec))]
+    assert kernels, "no model-sharded kernels in the 2D plan"
+    for leaf in kernels:
+        assert "data" in set(_spec_axes(leaf.opt_spec)), (leaf.path, leaf.opt_spec)
+
+    # Tiny leaves (norm scales, below the ZeRO floor) stay replicated — a
+    # shard smaller than a flit costs more in collective latency than it saves.
+    norm = by_path["params/layer_0/norm/scale"]
+    assert norm.opt_spec == ()
+
+    replicated = _replicated_opt_bytes(params)
+    assert plan.cost.per_chip_opt_bytes < replicated / 4  # >= the data degree
+    assert plan.opt_rules, "2D training plan must emit an opt_rules table"
+    # Moment patterns are anchored (^|/) so they match inside 0/mu/<path>.
+    assert all(p.startswith("(^|/)") for p, _ in plan.opt_rules)
+
+
+def test_serving_plans_emit_no_opt_rules():
+    """Decode is unaffected: a serving workload (opt_bytes_per_param=0) is
+    not training, prices zero optimizer bytes, and emits no opt_rules — the
+    1-axis serving planner's output is byte-identical to before the 2D
+    extension."""
+    assert not Workload().is_training
+    plan = plan_sharding(wide_net(), {"model": 2}, axes=("model",))
+    assert plan.opt_rules == []
+    assert plan.cost.per_chip_opt_bytes == 0.0
+    assert all(l.opt_spec == l.spec for l in plan.leaves)
+
+
+# ------------------------------------------------------------ vs hand rules
+@pytest.mark.parametrize(
+    "family, hand_rules",
+    [("llama", LLAMA_SHARDING_RULES), ("gpt_neox", GPT_NEOX_SHARDING_RULES)],
+)
+def test_2d_plan_matches_or_beats_hand_rules(family, hand_rules):
+    """Apples to apples on the real family trees: the 2D auto plan's modeled
+    cost is <= the hand table's under the SAME training workload —
+    score_rules prices the hand table's data-axis grad sync exactly the way
+    the search prices its candidates, so neither side skips a term."""
+    from test_planner import get_model
+
+    params = jax.eval_shape(lambda p: p, get_model(family).params)
+    plan = plan_train_sharding(params, MESH_2D, batch=8, seq=64)
+    hand = score_rules(params, MESH_2D, hand_rules, workload=plan.workload)
+    assert plan.cost.total <= hand.cost.total, (plan.cost.total, hand.cost.total)
+    # The hand table has no opt-state twin: moments follow params, so its
+    # per-chip optimizer bytes can never beat the ZeRO plan's.
+    assert plan.cost.per_chip_opt_bytes <= hand.cost.per_chip_opt_bytes
+
+
+def test_small_chip_forces_sharded_plan():
+    """HBM forcing: on a fake chip whose HBM fits the sharded layout but not
+    the replicated one, the plan sheds the overflow — model-sharded params,
+    data-sharded moments, zero modeled overflow — while pricing the
+    fully-replicated table on the same chip overflows. (The overflow penalty
+    dominates the objective, so "model does not fit one chip" can never pick
+    the replicated layout.)"""
+    params = wide_net()
+    # Footprints on this net (fp32 leaves, so nbytes honor the real dtype):
+    # fully sharded ~10.3 MB, fully replicated ~41 MB. 12 MB sits between.
+    chip = dataclasses.replace(default_chip(), hbm_bytes=12e6)
+    plan = plan_train_sharding(params, MESH_2D, batch=8, seq=128, chip=chip)
+    assert plan.cost.hbm_overflow_bytes == 0.0
+    assert any("model" in set(_spec_axes(l.spec)) for l in plan.leaves)
+    assert plan.cost.per_chip_opt_bytes < _replicated_opt_bytes(params)
+
+    replicated = score_rules(params, MESH_2D, [], chip=chip, workload=plan.workload)
+    assert replicated.cost.hbm_overflow_bytes > 0.0
+    assert plan.cost.total < replicated.cost.total
+
+
+# ----------------------------------------------------------- pipeline stages
+def test_plan_pipeline_stages_uniform_and_balanced():
+    """The stage planner: equal-weight layers split into the uniform
+    equal-count assignment (what the SPMD runner executes); heterogeneous
+    weights get the DP's balanced contiguous split, which beats the naive
+    equal-count split on max per-stage bytes; assignments are contiguous and
+    non-decreasing; degenerate shapes raise."""
+    z = lambda n: {"w": np.zeros((n, 4), np.float32)}
+    uniform = plan_pipeline_stages([z(8)] * 8, 4)
+    assert uniform.uniform and uniform.num_stages == 4
+    assert uniform.assignment == [0, 0, 1, 1, 2, 2, 3, 3]
+    assert uniform.imbalance == 1.0
+    assert uniform.stage_layers(1) == [2, 3]
+    assert uniform.rules and uniform.rules[0][1] == ("stage",)
+
+    # One heavy layer: the DP isolates it instead of pairing it.
+    heavy = plan_pipeline_stages([z(100), z(1), z(1), z(1)], 2)
+    assert heavy.assignment == [0, 1, 1, 1]
+    naive_max = max(100 + 1, 1 + 1)  # equal-count [0,0,1,1] split
+    assert max(heavy.per_stage_bytes) < naive_max * z(1)["w"].itemsize * 4
+
+    with pytest.raises(ValueError, match="must be positive"):
+        plan_pipeline_stages([z(1)], 0)
+    with pytest.raises(ValueError, match="cannot split"):
+        plan_pipeline_stages([z(1)] * 3, 4)
+
+
+# -------------------------------------------------------------- end to end
+def _reset_state():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _run_training(family_name, mode, *, steps=3, seq_len=16, global_batch=8, tp=2):
+    """One end-to-end pass through Accelerator.prepare + train_step. Returns
+    (losses, prepared model, prepared optimizer, accelerator, guard)."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.analysis import TraceGuard
+    from accelerate_tpu.models import CREATE_BY_FAMILY, get_model_family
+    from accelerate_tpu.parallel.sharding import data_spec
+    from accelerate_tpu.utils import ParallelismConfig, set_seed
+    from jax.sharding import NamedSharding
+
+    _reset_state()
+    set_seed(0)
+    family, cfg = get_model_family(family_name)
+    bundle = CREATE_BY_FAMILY[family](cfg, seq_len=seq_len)
+    if mode == "2d":
+        bundle.sharding_rules = "auto"
+        pcfg = ParallelismConfig(data=-1, model=tp)
+    else:
+        pcfg = ParallelismConfig(data=-1)
+    accelerator = Accelerator(parallelism_config=pcfg)
+    model, opt = accelerator.prepare(bundle, optax.adam(1e-3))
+
+    rng = np.random.default_rng(0)
+    sharding = NamedSharding(accelerator.mesh, data_spec(accelerator.mesh))
+    batches = [
+        jax.device_put(
+            {"input_ids": rng.integers(0, cfg.vocab_size, (global_batch, seq_len)).astype(np.int32)},
+            sharding,
+        )
+        for _ in range(1 + steps)
+    ]
+    step_fn = accelerator.train_step()
+    jax.block_until_ready(step_fn(batches[0]))  # warmup / compile
+
+    guard = TraceGuard(name=f"planner2d-{family_name}-{mode}", on_violation="record")
+    raw = []
+    with guard:
+        for batch in batches[1:]:
+            raw.append(step_fn(batch))
+        jax.block_until_ready(raw[-1])
+    return [float(l) for l in raw], model, opt, accelerator, guard
+
+
+@needs_mesh8
+@pytest.mark.parametrize("family_name", ["llama-tiny", "gpt-neox-tiny"])
+def test_prepare_auto_2d_trains_at_parity_with_zero_sharded_state(family_name):
+    """The ISSUE's acceptance path: prepare(sharding_rules="auto") on the 2D
+    CPU mesh — the auto plan places fp32 moments sharded along "data" (live,
+    not just modeled), trains the SAME loss trajectory as the 1D replicated
+    baseline (the layout must not change the math), keeps the steady state at
+    0 recompiles / 0 host transfers, and its predicted per-chip bytes match
+    the live `tree_device_nbytes` for params and optimizer state."""
+    losses_1d, _, opt_1d, _, guard_1d = _run_training(family_name, "1d")
+    losses_2d, model, opt_2d, accelerator, guard_2d = _run_training(family_name, "2d")
+
+    for guard, tag in ((guard_1d, "1d"), (guard_2d, "2d")):
+        assert guard.total_recompiles == 0, (tag, guard.report().summary())
+        assert guard.host_transfers == 0, (tag, guard.transfer_violations)
+
+    drift = max(abs(a - b) for a, b in zip(losses_1d, losses_2d))
+    assert drift <= 2e-4, (losses_1d, losses_2d)
+
+    # Live moments sharded along "data" (ZeRO), not merely planned.
+    data_sharded = [
+        l
+        for l in jax.tree_util.tree_leaves(opt_2d.opt_state)
+        if hasattr(l, "sharding") and "data" in set(_spec_axes(l.sharding.spec))
+    ]
+    assert data_sharded, "no live opt-state leaf is sharded along the data axis"
+
+    dev0 = jax.devices()[0]
+    live_opt_2d = tree_device_nbytes(opt_2d.opt_state, dev0)
+    live_opt_1d = tree_device_nbytes(opt_1d.opt_state, dev0)
+    assert live_opt_2d < live_opt_1d / 4, (live_opt_2d, live_opt_1d)
+
+    # Predicted-vs-live round trip: re-run the deterministic planner the
+    # prepare() seam ran and compare its account against the live trees.
+    sizes = {k: v for k, v in dict(accelerator.mesh.shape).items() if k in MESH_2D}
+    plan = plan_train_sharding(
+        jax.eval_shape(lambda p: p, model.params), sizes, batch=8, seq=512
+    )
+    live_params = tree_device_nbytes(model.params, dev0)
+    assert abs(plan.cost.per_chip_param_bytes - live_params) / live_params <= 0.01
+    # Adam carries a replicated count scalar the byte model rounds away.
+    assert abs(plan.cost.per_chip_opt_bytes - live_opt_2d) / live_opt_2d <= 0.01
+
+
+# ------------------------------------------------------------------ CLI seam
+def test_plan_cli_train_mesh_json(capsys):
+    """`accelerate-tpu plan <model> --mesh data=4,model=2 --json`: the payload
+    carries the opt_rules table, the three-tree byte predictions, and the
+    hand-table comparison verdict."""
+    import json
+
+    from accelerate_tpu.commands.accelerate_cli import get_command_parser
+
+    parser = get_command_parser()
+    args = parser.parse_args(
+        ["plan", "llama-tiny", "--mesh", "data=4,model=2", "--json"]
+    )
+    args.func(args)
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["mesh"] == {"data": 4, "model": 2}
+    assert payload["plan"]["opt_rules"], "training plan JSON must carry opt_rules"
+    assert payload["plan"]["predicted"]["per_chip_opt_bytes"] > 0
+    assert payload["auto_beats_hand"] is True
+
+
+@needs_mesh8
+def test_plan_cli_train_mesh_live(capsys):
+    """--live places params, grads, and a fresh Adam state per the plan on
+    the real 8-device CPU mesh and reports predicted-vs-live per-chip bytes:
+    params and grads exact, optimizer state within 1% (the replicated count
+    scalar)."""
+    import json
+
+    from accelerate_tpu.commands.accelerate_cli import get_command_parser
+
+    parser = get_command_parser()
+    args = parser.parse_args(
+        ["plan", "llama-tiny", "--mesh", "data=4,model=2", "--live", "--json"]
+    )
+    args.func(args)
+    payload = json.loads(capsys.readouterr().out)
+    live = payload["live"]
+    assert live["params"]["error_pct"] == 0.0
+    assert live["grads"]["error_pct"] == 0.0
+    assert live["opt_state"]["error_pct"] <= 1.0

@@ -1,0 +1,31 @@
+"""TPU120 clean fixture: the sanctioned optimizer-state placements — a
+sharding tree derived by `derive_opt_state_shardings` (fed the planner's ZeRO
+opt_rules table) rides the device_put, or Accelerator.prepare owns the
+optimizer and its init/out_shardings discipline places moments sharded from
+the first step."""
+
+import jax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.parallel.planner import plan_train_sharding
+from accelerate_tpu.parallel.sharding import derive_opt_state_shardings
+from accelerate_tpu.utils import ParallelismConfig
+
+
+def restore_training_state(tx, params, mesh):
+    plan = plan_train_sharding(jax.eval_shape(lambda p: p, params), mesh,
+                               batch=8, seq=512)
+    state_shapes = jax.eval_shape(tx.init, params)
+    shardings = derive_opt_state_shardings(
+        state_shapes, mesh, rules=plan.rules, opt_rules=plan.opt_rules
+    )
+    opt_state = tx.init(params)
+    return jax.device_put(opt_state, shardings)
+
+
+def prepare_training(bundle, tx):
+    # The AcceleratedOptimizer derives and pins the state placement itself.
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(data=-1, model=2)
+    )
+    return accelerator.prepare(bundle, tx)

@@ -1,0 +1,20 @@
+"""TPU120 flag fixture: a data-parallel training module that `device_put`s its
+optimizer-state tree with NO sharding — fp32 Adam moments land replicated on
+every chip of the "data" axis the mesh exists to scale over, 8 bytes/param of
+HBM each chip spends on moments it only needs 1/data_n of. (The raw-device and
+explicit-PartitionSpec() variants are unit-tested in
+test_analysis_rules.test_tpu120_variants; the tree-walk contract allows
+exactly one finding per flag fixture.)"""
+
+import jax
+
+from accelerate_tpu.utils import ParallelismConfig
+
+
+def restore_training_state(tx, params):
+    config = ParallelismConfig(data=-1)
+    opt_state = tx.init(params)
+    # FLAG: no sharding — the moments tree replicates to every data-parallel
+    # chip instead of sharding the weight update along "data".
+    placed = jax.device_put(opt_state)
+    return config, placed
