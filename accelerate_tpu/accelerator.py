@@ -479,9 +479,10 @@ class Accelerator:
 
     @staticmethod
     def _is_model(obj) -> bool:
+        from .parallel.mpmd import MPMDPipelinedModel
         from .parallel.pipeline import PipelinedModel
 
-        return isinstance(obj, (Model, PreparedModel, PipelinedModel))
+        return isinstance(obj, (Model, PreparedModel, PipelinedModel, MPMDPipelinedModel))
 
     @staticmethod
     def _is_optimizer(obj) -> bool:
@@ -520,10 +521,11 @@ class Accelerator:
     def prepare_model(self, model: Union[Model, PreparedModel], device_placement=None, evaluation_mode=False):
         """Place a model on the mesh with derived shardings
         (reference prepare_model accelerator.py:1316)."""
+        from .parallel.mpmd import MPMDPipelinedModel
         from .parallel.pipeline import PipelinedModel
 
-        if isinstance(model, (PreparedModel, PipelinedModel)):
-            # Already placed (PipelinedModel is stage-sharded at construction).
+        if isinstance(model, (PreparedModel, PipelinedModel, MPMDPipelinedModel)):
+            # Already placed (pipeline models are stage-sharded at construction).
             if model not in self._models:
                 self._models.append(model)
             return model
@@ -572,6 +574,31 @@ class Accelerator:
             # "data" even where params replicate) and emits them as a second
             # rules table the optimizer derivation consumes.
             mesh_sizes = dict(getattr(mesh, "shape", {}) or {})
+            if mesh_sizes.get("pipeline", 1) > 1:
+                # 3-axis mesh: plan-and-place the MPMD pipeline executor. The
+                # planner byte-balances the layers onto the "pipeline" axis
+                # (assignments may be NON-uniform), emits a full 2D rules +
+                # ZeRO opt-rules pair PER STAGE submesh, and the runtime
+                # places each stage by its own tables — the prepared object
+                # is an MPMDPipelinedModel whose step comes from
+                # `Accelerator.train_step`, not a single-mesh PreparedModel.
+                from .models import layered_for_model
+                from .parallel.planner import plan_mpmd_train_sharding
+
+                layered = layered_for_model(model)
+                prelude, layers, tail = layered.split(model.params)
+                mpmd_plan = plan_mpmd_train_sharding(
+                    prelude,
+                    layers,
+                    tail,
+                    mesh,
+                    batch=8,
+                    seq=512,
+                    opt_bytes_per_param=adam_bytes,
+                )
+                pipelined = MPMDPipelinedModel(model, layered, mesh, mpmd_plan)
+                self._models.append(pipelined)
+                return pipelined
             plan_axes = tuple(
                 a for a in ("data", "model") if mesh_sizes.get(a, 1) > 1
             ) or ("model",)
@@ -725,6 +752,13 @@ class Accelerator:
         reduce-scatter/psum over ("data","fsdp") is fused into the backward by XLA.
         """
         model = self._resolve_model(model)
+        if getattr(model, "is_mpmd", False):
+            raise NotImplementedError(
+                "backward() computes one single-mesh grad pytree; an MPMD "
+                "pipeline model's gradients live per stage on per-stage "
+                "submeshes. Use step_fn = accelerator.train_step() — it runs "
+                "the 1F1B schedule with per-stage accumulation and updates."
+            )
         optimizer = self._optimizer_for(model)
         # Key on the underlying function object (held strongly by the dict), not id():
         # bound methods like `model.loss` are re-created per access (id churn → retrace),
@@ -798,6 +832,22 @@ class Accelerator:
 
         model = self._resolve_model(model)
         optimizer = self._optimizer_for(model)
+        if getattr(model, "is_mpmd", False):
+            # MPMD pipeline route: the model already owns its per-stage
+            # programs and optimizer states; the step IS the 1F1B schedule
+            # (microbatch accumulation is built in — accumulation_steps and
+            # loss_fn/max_grad_norm knobs belong to the single-mesh fused
+            # step and are rejected rather than silently ignored).
+            if loss_fn is not None or max_grad_norm is not None or steps_per_call != 1:
+                raise NotImplementedError(
+                    "MPMD pipeline training uses the model's logits-level loss "
+                    "and per-stage updates; loss_fn=, max_grad_norm= and "
+                    "steps_per_call= are not supported on this route."
+                )
+            step = model.make_train_step(optimizer.tx)
+            if self.trace_guard is not None:
+                step = self.trace_guard.wrap(step, warmup=2)
+            return self._instrument_step(step)
         if accumulation_steps is None:
             accumulation_steps = self.gradient_state.num_steps
         # Latest build wins (not a ratchet): rebuilding with K=1 after a K>1

@@ -680,6 +680,7 @@ class _ModuleChecker:
         self._check_kernel_fallback()
         self._check_tp_replicated_operand()
         self._check_replicated_optimizer_state()
+        self._check_host_hop_in_stage_handoff()
         self._check_worker_loop()
         self._check_quantization()
         self._check_dead_partition_rule()
@@ -1124,6 +1125,107 @@ class _ModuleChecker:
                     "or prepare the optimizer through Accelerator.prepare with "
                     "sharding_rules=\"auto\"",
                 )
+
+    # -- host hop in stage handoff (TPU121) ----------------------------------------
+    @classmethod
+    def _mentions_pipeline_axis(cls, node: ast.AST) -> bool:
+        return any(
+            isinstance(sub, ast.Constant) and sub.value == "pipeline"
+            for sub in ast.walk(node)
+        )
+
+    def _module_spans_pipeline_mesh(self) -> bool:
+        """True when this module builds (or slices) a mesh with a "pipeline"
+        axis: a `Mesh(...)`/`build_mesh(...)` naming the axis, a
+        `ParallelismConfig(...)` given a pipeline degree, or a
+        `slice_mesh(...)` call (the MPMD stage-submesh API itself) — the
+        context in which an inter-stage carry lives on one submesh and must
+        reach the next as a device-to-device transfer."""
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._call_name(node.func)
+            if name == "slice_mesh":
+                return True
+            if name == "ParallelismConfig" and any(
+                kw.arg == "pipeline" for kw in node.keywords
+            ):
+                return True
+            if name in ("Mesh", "build_mesh") and any(
+                self._mentions_pipeline_axis(arg)
+                for arg in list(node.args) + [kw.value for kw in node.keywords]
+            ):
+                return True
+        return False
+
+    #: Identifier fragments that label a value as an inter-stage handoff: the
+    #: forward activation carry or the backward cotangent riding between stage
+    #: submeshes. Substring match against every Name/Attribute in the operand.
+    _HANDOFF_LABELS = (
+        "carry", "carries", "activation", "hidden", "handoff",
+        "cotangent", "microbatch", "g_out", "g_in", "stage_out", "stage_in",
+    )
+
+    @classmethod
+    def _is_handoff_expr(cls, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                label = sub.id
+            elif isinstance(sub, ast.Attribute):
+                label = sub.attr
+            else:
+                continue
+            label = label.lower()
+            if any(tok in label for tok in cls._HANDOFF_LABELS):
+                return True
+        return False
+
+    def _is_numpy_coercion(self, node: ast.Call) -> bool:
+        """`np.asarray(...)` / `np.array(...)` through a numpy alias — the
+        silent device_get. jnp spellings stay on device and are not flagged."""
+        func = node.func
+        return (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("asarray", "array")
+            and isinstance(func.value, ast.Name)
+            and func.value.id in (self.index.np_aliases or {"np", "numpy"})
+        )
+
+    def _check_host_hop_in_stage_handoff(self):
+        """TPU121: in a module that builds a "pipeline" mesh axis, pulling an
+        inter-stage activation/gradient carry through the host —
+        `jax.device_get(carry)`, `np.asarray(carry)`, or
+        `carry.block_until_ready()` between stages — serializes the 1F1B
+        schedule on PCIe: every stage stalls behind the transfer instead of
+        overlapping via async dispatch. The sanctioned handoff is
+        `jax.device_put(carry, NamedSharding(next_stage_mesh, spec))`, a pure
+        d2d ICI transfer that an armed TraceGuard leaves unguarded."""
+        if not self.index.imports_jax or not self._module_spans_pipeline_mesh():
+            return
+        msg = (
+            "inter-stage carry pulled through the host in a pipeline-mesh "
+            "module serializes the 1F1B schedule on PCIe — hand activations "
+            "and cotangents to the next stage submesh with jax.device_put("
+            "carry, NamedSharding(next_stage_mesh, spec)) (a device-to-device "
+            "transfer async dispatch overlaps), and keep TraceGuard armed so "
+            "host round-trips fail loudly"
+        )
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._call_name(node.func)
+            if name == "device_get" or self._is_numpy_coercion(node):
+                if node.args and self._is_handoff_expr(node.args[0]):
+                    self.emit(node, "TPU121", msg)
+            elif name == "block_until_ready":
+                if node.args:
+                    operand = node.args[0]
+                elif isinstance(node.func, ast.Attribute):
+                    operand = node.func.value
+                else:
+                    continue
+                if self._is_handoff_expr(operand):
+                    self.emit(node, "TPU121", msg)
 
     # -- dead partition rules (TPU119) --------------------------------------------
     #: Pattern tokens that name STORAGE details every family table shares, not

@@ -264,6 +264,22 @@ RULES = (
         "is pure per-chip-HBM savings (Xu et al., cross-replica weight-update "
         "sharding)",
     ),
+    Rule(
+        id="TPU121",
+        slug="host-hop-in-stage-handoff",
+        severity="warn",
+        summary="a module that builds a \"pipeline\" mesh axis moves an "
+        "inter-stage activation/gradient carry through the host — "
+        "jax.device_get, a numpy coercion (np.asarray/np.array), or "
+        ".block_until_ready() on the handoff path serializes the 1F1B "
+        "schedule on PCIe and stalls every stage behind the transfer",
+        fixit="ship the carry submesh-to-submesh with jax.device_put(carry, "
+        "NamedSharding(next_stage_mesh, spec)) — a pure device-to-device ICI "
+        "transfer that async dispatch overlaps with the other stages' compute "
+        "(parallel.mpmd's _ship seam); keep TraceGuard armed around the step "
+        "so any host round-trip that does sneak in fails loudly instead of "
+        "silently flattening the pipeline",
+    ),
 )
 
 RULES_BY_ID = {r.id: r for r in RULES}

@@ -196,6 +196,24 @@ def opt_state_data_sharded(opt) -> bool:
     return False
 
 
+def stage_layout_evidence(model) -> Dict[str, Any]:
+    """The layout record an MPMD pipeline workload journals before any fault
+    lands AND after every resume: the (usually NON-uniform) stage->layer
+    assignment and per-stage submesh sizes, read off the live model. A
+    restart that silently re-planned to a different split — or fell back to
+    a single mesh — would train correctly while erasing exactly the layout
+    the chaos run exists to stress."""
+    counts = [
+        len(model.plan.stage_plan.stage_layers(k)) for k in range(model.num_stages)
+    ]
+    return {
+        "num_stages": model.num_stages,
+        "stage_layers": counts,
+        "nonuniform": len(set(counts)) > 1,
+        "submesh_devices": [int(m.devices.size) for m in model.submeshes],
+    }
+
+
 def resume_evidence(
     resolved: str, model, checkpoint_base: str, opt=None
 ) -> Dict[str, Any]:

@@ -40,14 +40,20 @@ def register_subcommand(subparsers):
     parser.add_argument("--beam-width", type=int, default=8, help="Beam width for the strategy search")
     parser.add_argument("--refine-top-k", type=int, default=0,
                         help="Compile + time the top-k candidates and pick the measured best "
-                        "(needs tp visible devices)")
+                        "(needs the plan's mesh to fit the visible devices). Serving plans "
+                        "time a one-token forward; --mesh training plans time a fused "
+                        "train step (grads + optimizer update included)")
     parser.add_argument("--seq-len", type=int, default=8, help="Init sequence length for shape derivation")
     parser.add_argument("--json", action="store_true", help="Machine-readable plan JSON")
     parser.add_argument(
         "--mesh", default=None,
-        help='Training mesh, e.g. "data=4,model=2": switches to the 2D training '
+        help='Training mesh, e.g. "data=4,model=2": switches to the training '
         "planner — params, grads AND optimizer state (ZeRO weight-update "
-        "sharding along the data axis) are enumerated and priced together",
+        "sharding along the data axis) are enumerated and priced together. "
+        'Add a pipeline axis ("data=2,model=2,pipeline=2") for the 3D MPMD '
+        "planner: byte-balanced (possibly non-uniform) stages, one 2D plan "
+        "per stage submesh, and the 1F1B pipeline-bubble term in the "
+        "predicted step time",
     )
     parser.add_argument("--batch", type=int, default=8, help="Global batch size (training planner)")
     parser.add_argument("--opt-bytes-per-param", type=float, default=8.0,
@@ -133,14 +139,39 @@ def _parse_mesh(spec: str):
 
 
 def _train_plan_command(args, chip):
-    """The ``--mesh`` branch: 2D training planner over params+grads+opt state,
-    optionally compared against LIVE placements of all three trees."""
-    from ..parallel.planner import plan_train_sharding, score_rules
+    """The ``--mesh`` branch: training planner over params+grads+opt state —
+    2D ("data", "model"), or the 3D MPMD pipeline planner when the mesh
+    carries a "pipeline" axis — optionally measured (``--refine-top-k``) or
+    compared against LIVE placements (``--live``)."""
+    from ..parallel.planner import (
+        measure_train_step,
+        plan_train_sharding,
+        refine_plans,
+        score_rules,
+    )
 
     mesh_axes = _parse_mesh(args.mesh)
+    pipelined = int(mesh_axes.get("pipeline", 1)) > 1
+    refine = max(0, int(args.refine_top_k))
+    if pipelined and refine:
+        raise SystemExit(
+            "--refine-top-k times single-mesh training plans; an MPMD pipeline "
+            "plan's measured step time comes from "
+            "`accelerate-tpu bench --mode train --pipeline-ab`"
+        )
     params, config, hand_rules, apply_fn, real_params = _model_shapes(
-        args.model, args.seq_len, materialize=args.live
+        args.model, args.seq_len, materialize=args.live or refine >= 1
     )
+    layered = layered_split = None
+    if pipelined:
+        # The pipeline planner balances *per-layer* byte weights, so it needs
+        # the LayeredApply split. split() is pure pytree indexing — it works
+        # on the eval_shape tree, so deviceless 3D planning stays deviceless.
+        from ..models import get_model_family, layered_for_family
+
+        family, _ = get_model_family(args.model)
+        layered = layered_for_family(family, config)
+        layered_split = layered.split(params)
     plan = plan_train_sharding(
         params,
         mesh_axes,
@@ -150,16 +181,42 @@ def _train_plan_command(args, chip):
         weight_dtype=args.weight_dtype,
         chip=chip,
         beam_width=args.beam_width,
+        layered_split=layered_split,
+        top_k=max(refine, 1),
     )
+    measurements = None
+    if refine >= 1:
+        # Measured selection: place each candidate's three trees on the live
+        # mesh and time a fused train step (value_and_grad + optimizer update)
+        # — the training twin of the serving path's one-token forward.
+        plans = plan if isinstance(plan, list) else [plan]
+        live_mesh = _build_live_mesh(mesh_axes)
+        plan, measured = refine_plans(
+            plans,
+            lambda p: measure_train_step(
+                apply_fn, real_params, live_mesh, p.rules,
+                opt_rules=p.opt_rules, batch=args.batch, seq=args.seq_len,
+            ),
+        )
+        measurements = [seconds for _, seconds in measured]
+    # The hand-written family tables are single-mesh: there is nothing to
+    # score them against on a pipeline mesh (that gap is the point).
     hand = (
         score_rules(
             params, mesh_axes, hand_rules,
             chip=chip, workload=plan.workload, weight_dtype=args.weight_dtype,
         )
-        if hand_rules
+        if hand_rules and not pipelined
         else None
     )
-    live = _live_train_bytes(plan, mesh_axes, real_params) if args.live else None
+    if args.live:
+        live = (
+            _live_mpmd_bytes(plan, mesh_axes, real_params, layered)
+            if pipelined
+            else _live_train_bytes(plan, mesh_axes, real_params)
+        )
+    else:
+        live = None
 
     if args.json:
         payload = {"model": args.model, "mesh": mesh_axes, "plan": plan.to_json()}
@@ -171,6 +228,8 @@ def _train_plan_command(args, chip):
             }
             payload["plan"]["modeled_cost"] = plan.cost.total
             payload["auto_beats_hand"] = plan.cost.total <= hand.cost.total
+        if measurements is not None:
+            payload["refine_measurements_s"] = measurements
         if live is not None:
             payload["live"] = live
         print(json.dumps(payload, indent=2))
@@ -180,6 +239,11 @@ def _train_plan_command(args, chip):
           f"training (opt {args.opt_bytes_per_param} B/param) weights={args.weight_dtype}")
     print()
     print(plan.describe())
+    if measurements is not None:
+        print()
+        print(f"measure-and-refine (top-{len(measurements)}, fused train step):")
+        for i, seconds in enumerate(measurements):
+            print(f"  candidate {i}: {seconds * 1e6:.1f} us")
     if hand is not None:
         print()
         verdict = "matches or beats" if plan.cost.total <= hand.cost.total else "LOSES TO"
@@ -201,12 +265,39 @@ def _train_plan_command(args, chip):
     return plan
 
 
+def _build_live_mesh(mesh_axes):
+    """A real `Mesh` shaped like the ``--mesh`` axes dict on the visible
+    devices (SystemExit when the host is too small for the product)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    sizes = [int(s) for s in mesh_axes.values()]
+    n_devices = int(np.prod(sizes))
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise SystemExit(
+            f"this step needs {n_devices} devices for mesh {dict(mesh_axes)}, "
+            f"have {len(devices)}"
+        )
+    return Mesh(np.array(devices[:n_devices]).reshape(sizes), tuple(mesh_axes))
+
+
+def _bytes_row(predicted, live):
+    predicted, live = float(predicted), float(live)
+    err = abs(predicted - live) / live * 100.0 if live else 0.0
+    return {
+        "predicted_bytes": int(predicted),
+        "live_bytes": int(live),
+        "error_pct": err,
+    }
+
+
 def _live_train_bytes(plan, mesh_axes, real_params):
     """Place params, a zeros grads tree, and a freshly-initialized Adam state on
     the real devices per the plan (the same derivation seams `prepare()` uses)
     and measure per-chip bytes off the LIVE shardings."""
     import jax
-    import numpy as np
     import optax
 
     from ..parallel.sharding import (
@@ -216,18 +307,8 @@ def _live_train_bytes(plan, mesh_axes, real_params):
         tree_device_nbytes,
     )
 
-    sizes = [int(s) for s in mesh_axes.values()]
-    n_devices = int(np.prod(sizes))
-    devices = jax.devices()
-    if len(devices) < n_devices:
-        raise SystemExit(
-            f"--live needs {n_devices} devices for mesh {mesh_axes}, "
-            f"have {len(devices)}"
-        )
-    from jax.sharding import Mesh
-
-    mesh = Mesh(np.array(devices[:n_devices]).reshape(sizes), tuple(mesh_axes))
-    dev0 = devices[0]
+    mesh = _build_live_mesh(mesh_axes)
+    dev0 = mesh.devices.flat[0]
 
     param_shardings = derive_tp_param_shardings(real_params, mesh, plan.rules)
     placed = place_params(real_params, param_shardings)
@@ -239,22 +320,74 @@ def _live_train_bytes(plan, mesh_axes, real_params):
     )
     opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(placed)
 
-    def row(predicted, live):
-        predicted, live = float(predicted), float(live)
-        err = abs(predicted - live) / live * 100.0 if live else 0.0
-        return {
-            "predicted_bytes": int(predicted),
-            "live_bytes": int(live),
-            "error_pct": err,
-        }
-
     return {
-        "params": row(plan.cost.per_chip_param_bytes, tree_device_nbytes(placed, dev0)),
+        "params": _bytes_row(plan.cost.per_chip_param_bytes, tree_device_nbytes(placed, dev0)),
         # Grads carry the parameter dtype and placement, so the param account
         # predicts them too.
-        "grads": row(plan.cost.per_chip_param_bytes, tree_device_nbytes(grads, dev0)),
-        "opt_state": row(plan.cost.per_chip_opt_bytes, tree_device_nbytes(opt_state, dev0)),
+        "grads": _bytes_row(plan.cost.per_chip_param_bytes, tree_device_nbytes(grads, dev0)),
+        "opt_state": _bytes_row(plan.cost.per_chip_opt_bytes, tree_device_nbytes(opt_state, dev0)),
     }
+
+
+def _init_placed_opt_state(tx, placed, opt_shardings):
+    """Initialize one stage's Adam state pinned to its derived shardings —
+    a helper so each stage's jit is a distinct function object compiled once,
+    not a fresh cache built inside the stage loop."""
+    import jax
+
+    return jax.jit(tx.init, out_shardings=opt_shardings)(placed)
+
+
+def _live_mpmd_bytes(plan, mesh_axes, real_params, layered):
+    """The ``--live`` account for an MPMD pipeline plan: place every stage's
+    params + grads accumulator + Adam state on its OWN pipeline submesh per the
+    stage's rules tables (the same derivations `parallel.mpmd` runs) and
+    compare the busiest stage's per-chip bytes against the plan's prediction —
+    the plan prices exactly the busiest stage, because that chip's HBM is the
+    binding constraint."""
+    import jax
+    import optax
+
+    from ..parallel.mesh import slice_mesh
+    from ..parallel.planner import build_stage_tree
+    from ..parallel.sharding import (
+        derive_opt_state_shardings,
+        derive_tp_param_shardings,
+        place_params,
+        tree_device_nbytes,
+    )
+
+    mesh = _build_live_mesh(mesh_axes)
+    submeshes = slice_mesh(mesh, "pipeline")
+    prelude, layers, tail = layered.split(real_params)
+    tx = optax.adam(1e-3)
+
+    param_live, grad_live, opt_live = [], [], []
+    for k, submesh in enumerate(submeshes):
+        tree = build_stage_tree(prelude, layers, tail, plan.stage_plan, k)
+        shardings = derive_tp_param_shardings(tree, submesh, list(plan.stage_rules(k)))
+        placed = place_params(tree, shardings)
+        grads = place_params(
+            jax.tree_util.tree_map(lambda x: jax.numpy.zeros_like(x), tree), shardings
+        )
+        state_shapes = jax.eval_shape(tx.init, placed)
+        opt_shardings = derive_opt_state_shardings(
+            state_shapes, submesh, None, list(plan.stage_rules(k)),
+            opt_rules=list(plan.stage_opt_rules(k) or []) or None,
+        )
+        opt_state = _init_placed_opt_state(tx, placed, opt_shardings)
+        dev = submesh.devices.flat[0]
+        param_live.append(tree_device_nbytes(placed, dev))
+        grad_live.append(tree_device_nbytes(grads, dev))
+        opt_live.append(tree_device_nbytes(opt_state, dev))
+
+    out = {
+        "params": _bytes_row(plan.cost.per_chip_param_bytes, max(param_live)),
+        "grads": _bytes_row(plan.cost.per_chip_param_bytes, max(grad_live)),
+        "opt_state": _bytes_row(plan.cost.per_chip_opt_bytes, max(opt_live)),
+    }
+    out["per_stage_param_bytes"] = [int(b) for b in param_live]
+    return out
 
 
 def plan_command(args):

@@ -73,6 +73,67 @@ CREATE_BY_FAMILY = {
     "t5": create_t5_model,
 }
 
+# family -> (flax module class name, LayeredApply class) for models shipping a
+# prelude/layers/tail decomposition. Consumed by `layered_for_model`, the seam
+# `Accelerator.prepare(sharding_rules="auto")` on a "pipeline" mesh and the
+# `plan --mesh ... pipeline=` CLI use to get the per-layer param split that
+# `plan_pipeline_stages` balances and `parallel/mpmd.py` executes. T5 is absent
+# on purpose: its encoder/decoder split rides the pipeline (promote) protocol,
+# not the linear-carry LayeredApply contract the MPMD runtime assumes.
+LAYERED_BY_FAMILY = {
+    "llama": "LlamaForCausalLM",
+    "gpt_neox": "GPTNeoXForCausalLM",
+    "gptj": "GPTJForCausalLM",
+    "opt": "OPTForCausalLM",
+}
+
+
+def _layered_classes():
+    from .gpt_neox import GPTNeoXLayeredApply
+    from .gptj import GPTJLayeredApply
+    from .llama import LlamaLayeredApply
+    from .opt import OPTLayeredApply
+
+    return {
+        "LlamaForCausalLM": LlamaLayeredApply,
+        "GPTNeoXForCausalLM": GPTNeoXLayeredApply,
+        "GPTJForCausalLM": GPTJLayeredApply,
+        "OPTForCausalLM": OPTLayeredApply,
+    }
+
+
+def layered_for_family(family: str, config):
+    """Construct the family's `LayeredApply` from a config alone — no module,
+    no weights. `split()` is pure pytree indexing, so the plan CLI can split an
+    `eval_shape` tree and plan a 3D pipeline layout without materializing."""
+    cls_name = LAYERED_BY_FAMILY.get(family)
+    if cls_name is None:
+        raise ValueError(
+            f"Family {family!r} ships no LayeredApply decomposition — pipeline-"
+            f"parallel planning needs one (known: {sorted(LAYERED_BY_FAMILY)}). "
+            "Drop the 'pipeline' mesh axis for this model."
+        )
+    return _layered_classes()[cls_name](config)
+
+
+def layered_for_model(model):
+    """The model's `LayeredApply` decomposition, sniffed from its flax module.
+
+    Returns the constructed LayeredApply instance, or raises ValueError when
+    the model has no module / the family ships no decomposition — the caller
+    (3D planner dispatch) turns that into "this model can't pipeline"."""
+    module = getattr(model, "module", None)
+    cls_name = type(module).__name__ if module is not None else None
+    layered_cls = _layered_classes().get(cls_name or "")
+    if layered_cls is None:
+        known = sorted(LAYERED_BY_FAMILY.values())
+        raise ValueError(
+            f"No LayeredApply decomposition for module {cls_name!r} — pipeline-"
+            f"parallel planning/execution needs one (known: {known}). Pass "
+            "layered= explicitly or drop the 'pipeline' mesh axis."
+        )
+    return layered_cls(module.config)
+
 
 def get_model_family(name: str):
     """(interchange family, dataclass config) for a named in-tree model."""

@@ -252,6 +252,19 @@ class AcceleratedOptimizer:
 
         self.offload_opt_state = False
         self._opt_compute_sharding = None
+        if model is not None and getattr(model, "is_mpmd", False):
+            # MPMD pipeline model: optimizer state lives PER STAGE, each piece
+            # on its own stage submesh placed by that stage's ZeRO opt-rules
+            # table — a single-mesh opt_state/opt_state_sharding here would be
+            # meaningless (model.params spans several disjoint meshes). The
+            # model owns the per-stage states and the per-stage update
+            # programs; the step itself runs through Accelerator.train_step.
+            self.mesh = mesh if mesh is not None else getattr(model, "mesh", None)
+            self.opt_state_sharding = None
+            self.opt_state = None
+            model.init_optimizer_state(self.tx)
+            self._lr_override = None
+            return
         if model is not None:
             from .parallel.sharding import (
                 derive_opt_state_shardings,
@@ -885,7 +898,13 @@ class AcceleratedOptimizer:
                     new_opt_state = jax.lax.with_sharding_constraint(new_opt_state, opt_out)
                 return new_params, new_opt_state, finite
 
-            donate = (0, 1, 2)
+            # XLA:CPU-only: donating (params, opt_state, grads) into the fused
+            # update crashes the host runtime when the operands are sharded
+            # across forced host-platform devices (SIGSEGV/SIGABRT inside the
+            # aliased executable — the multi-device pipeline tests hit it
+            # deterministically). Donation is a memory optimization, not a
+            # semantics change, so drop it on CPU; TPU/GPU keep the aliasing.
+            donate = () if jax.default_backend() == "cpu" else (0, 1, 2)
             self._jit_cache["update"] = jax.jit(_update, donate_argnums=donate)
         return self._jit_cache["update"]
 

@@ -1,13 +1,17 @@
-from .mesh import build_mesh, get_default_mesh, mesh_axis_size
+from .mesh import build_mesh, get_default_mesh, mesh_axis_size, slice_mesh
 from .pipeline import PipelinedModel, prepare_pipeline
+from .mpmd import MPMDPipelinedModel, prepare_mpmd_pipeline
 from .expert import EXPERT_SHARDING_RULES, ExpertMLP, MoEBlock, expert_capacity, top_k_routing
 from .planner import (
     ChipSpec,
+    MPMDTrainPlan,
     ShardingPlan,
     Workload,
+    plan_mpmd_train_sharding,
     plan_serving_sharding,
     plan_sharding,
     refine_plans,
     score_rules,
+    search_train_meshes,
 )
 from .ring_attention import ring_attention

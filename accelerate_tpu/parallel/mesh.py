@@ -60,6 +60,30 @@ def build_mesh(
     return Mesh(device_array, axis_names)
 
 
+def slice_mesh(mesh, axis: str = "pipeline"):
+    """Slice a global mesh into per-index submeshes along ``axis``.
+
+    Returns a list of ``mesh.shape[axis]`` meshes, each holding the devices of
+    one slice with ``axis`` REMOVED from the axis names — the MPMD pipeline
+    runtime's stage meshes (each stage jit-compiles against its own submesh, so
+    stages may hold unequal layer counts; activations hop between submeshes as
+    explicit device-to-device transfers). The remaining axes keep their order
+    and sizes, so a ("data", ..., "pipeline") global mesh yields ("data", ...)
+    stage meshes whose data/model specs mean exactly what they mean globally.
+    """
+    from jax.sharding import Mesh
+
+    names = list(mesh.axis_names)
+    if axis not in names:
+        raise ValueError(f"mesh has no {axis!r} axis (axes: {tuple(names)})")
+    idx = names.index(axis)
+    sub_names = tuple(n for n in names if n != axis)
+    return [
+        Mesh(np.take(mesh.devices, k, axis=idx), sub_names)
+        for k in range(mesh.devices.shape[idx])
+    ]
+
+
 def get_default_mesh():
     """The mesh from AcceleratorState (building it on first use)."""
     from ..state import AcceleratorState

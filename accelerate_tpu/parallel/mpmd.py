@@ -1,0 +1,704 @@
+"""MPMD pipeline runtime: the executor for NON-uniform stage plans.
+
+The SPMD stage runner (parallel/pipeline.py) stacks layer params on a leading
+[L] axis sharded over "stage" and scans them — which hard-requires every stage
+to hold the SAME layer count, so the byte-balanced (usually unequal) stage
+assignments `plan_pipeline_stages` emits had no executor. This module is that
+executor, in the style of MPMD pipeline systems (arXiv:2412.14374): the global
+("data", ..., "pipeline") mesh is sliced into one submesh per stage
+(`mesh.slice_mesh`), each stage gets its OWN jit-compiled programs against its
+own submesh — so stage 0 can hold 3 layers + the prelude while stage 1 holds
+2 layers + the tail — and the host dispatches a 1F1B microbatch schedule
+across the per-stage executables.
+
+Contract highlights:
+
+- **Stage params** follow `planner.build_stage_tree` paths verbatim
+  (``layer_<i>`` / ``prelude`` / ``tail``), placed by the per-stage rules
+  tables of an `MPMDTrainPlan` — the planner and the runtime shard the same
+  leaf the same way because they address it by the same path.
+- **Handoffs never touch the host**: activations (and the backward's
+  cotangents) move between stage submeshes as explicit `jax.device_put`
+  device-to-device transfers, legal under an armed TraceGuard (which guards
+  h2d/d2h, not d2d). Microbatch slicing happens INSIDE a jitted split program
+  with static bounds — an eager ``batch[lo:hi]`` would materialize its index
+  scalars host-side and trip the h2d guard.
+- **Backward is rematerialized** (GPipe-style): each stage saves only its
+  per-microbatch INPUT carry; the backward program recomputes the stage
+  forward under `jax.vjp`. Peak activation memory is O(in-flight microbatches)
+  per stage, not O(microbatches x layers).
+- **Grad math**: each backward carries the grads of the UNNORMALIZED
+  ``(loss_sum, weight)`` pair (GSPMD inserts the data-axis psum per program),
+  the per-microbatch grads accumulate into a donated buffer, and the final
+  per-stage optimizer step scales by the global ``1/weight`` — bitwise the
+  token-weighted mean loss the single-mesh 2D baseline optimizes.
+- **Per-stage optimizer**: `init_optimizer_state` derives each stage's
+  optimizer-state shardings from that stage's ZeRO opt-rules table
+  (`MPMDTrainPlan.stage_opt_rules`), so weight-update sharding keeps working
+  per submesh.
+
+Tied embeddings are rejected: a tied lm head would put one buffer on both the
+first and last stage submeshes with cross-mesh gradient coupling — use the
+SPMD runner (`prepare_pipeline`) for tied-weight models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from .pipeline import (
+    _default_batch_to_args,
+    default_causal_lm_logits_loss,
+    find_tied_leaves,
+)
+from .planner import MPMDTrainPlan, build_stage_tree
+
+__all__ = ["MPMDPipelinedModel", "prepare_mpmd_pipeline"]
+
+
+def _partition_carry(carry):
+    """Split a carry pytree into (diff, static, spec): floating leaves are
+    differentiable and ship cotangents backward; integer leaves (positions,
+    token masks) are along-for-the-ride. ``spec`` rebuilds the tree."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(carry)
+    # issubdtype reads dtype METADATA — a plain Python bool even on tracers.
+    is_diff = tuple(jnp.issubdtype(leaf.dtype, jnp.floating) for leaf in leaves)
+    diff = tuple(leaf for leaf, d in zip(leaves, is_diff) if d)
+    static = tuple(leaf for leaf, d in zip(leaves, is_diff) if not d)
+    return diff, static, (treedef, is_diff)
+
+
+def _combine_carry(diff, static, spec):
+    import jax
+
+    treedef, is_diff = spec
+    diff_it, static_it = iter(diff), iter(static)
+    leaves = [next(diff_it) if d else next(static_it) for d in is_diff]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _diff_leaves(carry):
+    """The floating leaves of a carry, in flatten order — what backward
+    programs emit/consume as the inter-stage cotangent tuple."""
+    return _partition_carry(carry)[0]
+
+
+class MPMDPipelinedModel:
+    """A model executing an `MPMDTrainPlan`: per-stage jitted programs on
+    per-stage submeshes, 1F1B host-dispatched schedule, d2d stage handoffs.
+
+    Build via `Accelerator.prepare(sharding_rules="auto")` on a mesh with a
+    "pipeline" axis, or directly with `prepare_mpmd_pipeline`.
+    """
+
+    is_pipelined = True
+    is_mpmd = True
+    offload_params = False
+
+    def __init__(
+        self,
+        model,
+        layered,
+        mesh,
+        plan: MPMDTrainPlan,
+        logits_loss: Optional[Callable] = None,
+        batch_to_args: Optional[Callable] = None,
+    ):
+        from .mesh import slice_mesh
+
+        self.model = model
+        self.layered = layered
+        self.mesh = mesh
+        self.plan = plan
+        self.logits_loss = logits_loss or default_causal_lm_logits_loss
+        self.batch_to_args = batch_to_args or _default_batch_to_args
+        self.num_microbatches = plan.num_microbatches
+        self.sharding_rules = None  # per-stage tables live on the plan
+        self.opt_sharding_rules = None
+
+        prelude, layers, tail = layered.split(model.params)
+        if len(layers) != plan.stage_plan.num_layers:
+            raise ValueError(
+                f"plan covers {plan.stage_plan.num_layers} layers but the model "
+                f"splits into {len(layers)}"
+            )
+        tied = find_tied_leaves(prelude, tail)
+        if tied:
+            raise NotImplementedError(
+                f"tied prelude/tail weights {[p for p, _ in tied]} span the first "
+                "and last stage submeshes — the MPMD runtime keeps stages on "
+                "disjoint meshes. Use the SPMD stage runner (prepare_pipeline) "
+                "for tied-weight models."
+            )
+
+        self.submeshes = slice_mesh(mesh, "pipeline")
+        if len(self.submeshes) != plan.num_stages:
+            raise ValueError(
+                f"mesh pipeline axis has {len(self.submeshes)} slices but the "
+                f"plan has {plan.num_stages} stages"
+            )
+        self.stage_params: List[Any] = []
+        self._param_shardings: List[Any] = []
+        for k in range(plan.num_stages):
+            self._place_stage(k, build_stage_tree(prelude, layers, tail, plan.stage_plan, k))
+
+        self._jitted = {}  # name -> jitted program (the compiled-once audit)
+        self._bwd_specs = {}  # stage -> carry partition spec its bwd compiled for
+        self._opt_states: Optional[List[Any]] = None
+        self._opt_shardings: Optional[List[Any]] = None
+        self._tx = None
+        self._build_fixed_programs()
+
+    # ------------------------------------------------------------- placement
+    @property
+    def num_stages(self) -> int:
+        return self.plan.num_stages
+
+    def _place_stage(self, k: int, tree) -> None:
+        import jax
+
+        from .sharding import derive_tp_param_shardings
+
+        shardings = derive_tp_param_shardings(tree, self.submeshes[k], self.plan.stage_rules(k))
+        self.stage_params.append(jax.device_put(tree, shardings))
+        self._param_shardings.append(shardings)
+
+    def _carry_shardings(self, tree, mesh):
+        """Target shardings for a stage handoff: batch dim over "data", rest
+        replicated — the residual stream's layout on every stage submesh, so
+        the d2d transfer is a pure resharding with no host round-trip."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        data = mesh.shape.get("data", 1)
+
+        def one(leaf):
+            if leaf.ndim >= 1 and data > 1 and leaf.shape[0] % data == 0:
+                return NamedSharding(mesh, PartitionSpec("data", *([None] * (leaf.ndim - 1))))
+            return NamedSharding(mesh, PartitionSpec())
+
+        return jax.tree_util.tree_map(one, tree)
+
+    def _ship(self, tree, mesh):
+        """Move a pytree onto ``mesh``: explicit device-to-device transfer
+        (ICI/DCN), never through host — TraceGuard stays armed across it."""
+        import jax
+
+        return jax.device_put(tree, self._carry_shardings(tree, mesh))
+
+    # -------------------------------------------------------------- programs
+    def _stage_forward_fn(self, k: int):
+        """Pure stage-k forward over its `build_stage_tree` params: prelude on
+        stage 0, that stage's layers, tail (-> logits) on the last stage."""
+        layered = self.layered
+        idxs = tuple(self.plan.stage_plan.stage_layers(k))
+        has_prelude = k == 0
+        has_tail = k == self.num_stages - 1
+
+        def fwd(stage_params, x):
+            carry = layered.apply_prelude(stage_params["prelude"], *x) if has_prelude else x
+            for i in idxs:
+                carry = layered.apply_layer(stage_params[f"layer_{i}"], carry)
+            if has_tail:
+                return layered.apply_tail(stage_params["tail"], carry)
+            return carry
+
+        return fwd
+
+    def _build_fixed_programs(self) -> None:
+        """Programs whose shapes don't depend on the carry structure: forward
+        per stage, microbatch split per boundary mesh, the loss finalizer.
+        Backward programs compile lazily on the first step (they close over
+        the carry's diff/static partition, known once a real batch flows)."""
+        import jax
+
+        for k in range(self.num_stages - 1):
+            self._jitted[f"fwd{k}"] = self._make_fwd(k)
+
+        # Two DISTINCT closures on purpose: `jax.jit` memoizes per function
+        # object, so one shared `split` would pool both call structures (args
+        # tuple vs batch dict) into one cache and read as a phantom recompile.
+        self._jitted["split_first"] = self._make_split()
+        self._jitted["split_last"] = self._make_split()
+
+        def finalize(losses, weights):
+            import jax.numpy as jnp
+
+            total, weight = losses[0], weights[0]
+            for x in losses[1:]:
+                total = total + x
+            for w in weights[1:]:
+                weight = weight + w
+            inv_w = 1.0 / jnp.maximum(weight, 1.0)
+            return total * inv_w, inv_w
+
+        self._jitted["finalize"] = jax.jit(finalize)
+
+    def _make_fwd(self, k: int):
+        """One stage's jitted forward — a method so every jit call site sits
+        outside the per-stage construction loop (each stage is a DISTINCT
+        function object with its own single-entry executable cache)."""
+        import jax
+
+        return jax.jit(self._stage_forward_fn(k))
+
+    def _make_split(self):
+        """Jitted microbatch split with STATIC slice bounds. Eager slicing of a
+        device array (``batch[lo:hi]``) creates its index scalars host-side —
+        an h2d transfer the armed TraceGuard rightly rejects; inside jit the
+        bounds fold into the program."""
+        import jax
+
+        M = self.num_microbatches
+
+        def split(tree):
+            rows = jax.tree_util.tree_leaves(tree)[0].shape[0]
+            step = rows // M
+            out = []
+            for m in range(M):
+                lo = m * step
+                out.append(
+                    jax.tree_util.tree_map(lambda x, lo=lo, step=step: x[lo : lo + step], tree)
+                )
+            return tuple(out)
+
+        return jax.jit(split)
+
+    def _make_zero(self, k: int):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.jit(
+            lambda p: jax.tree_util.tree_map(jnp.zeros_like, p),
+            out_shardings=self._param_shardings[k],
+        )
+
+    def _ensure_zero(self, k: int):
+        name = f"zero{k}"
+        if name not in self._jitted:
+            self._jitted[name] = self._make_zero(k)
+        return self._jitted[name]
+
+    def _make_bwd_mid(self, k: int, spec):
+        """Backward for an interior stage: recompute the stage forward from the
+        saved input carry under `jax.vjp`, accumulate param grads into the
+        donated buffer, and emit the input-carry cotangents for stage k-1."""
+        import jax
+
+        stage_fwd = self._stage_forward_fn(k)
+        acc_shardings = self._param_shardings[k]
+
+        def bwd(params, static, diff, g_out, acc):
+            def f(p, d):
+                carry_out = stage_fwd(p, _combine_carry(d, static, spec))
+                return _diff_leaves(carry_out)
+
+            _, vjp_fn = jax.vjp(f, params, diff)
+            grads, g_in = vjp_fn(tuple(g_out))
+            new_acc = jax.tree_util.tree_map(jax.numpy.add, acc, grads)
+            # Pin the accumulator to the param layout: the donated buffer
+            # round-trips through this program once per microbatch, and an
+            # XLA-chosen output sharding would silently recompile call #2.
+            return jax.lax.with_sharding_constraint(new_acc, acc_shardings), g_in
+
+        return jax.jit(bwd, donate_argnums=(4,))
+
+    def _make_last(self, spec):
+        """The last stage's fused forward+loss+backward: layers -> tail ->
+        ``(loss_sum, weight)``, then the pullback seeded with ``(1, 0)`` — the
+        weight is a count, not a differentiable output."""
+        import jax
+        import jax.numpy as jnp
+
+        stage_fwd = self._stage_forward_fn(self.num_stages - 1)
+        logits_loss = self.logits_loss
+        acc_shardings = self._param_shardings[self.num_stages - 1]
+
+        def last(params, static, diff, mb_batch, acc):
+            def f(p, d):
+                logits = stage_fwd(p, _combine_carry(d, static, spec))
+                return logits_loss(logits, mb_batch)
+
+            (loss_sum, weight), vjp_fn = jax.vjp(f, params, diff)
+            grads, g_in = vjp_fn((jnp.ones_like(loss_sum), jnp.zeros_like(weight)))
+            new_acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+            new_acc = jax.lax.with_sharding_constraint(new_acc, acc_shardings)
+            return loss_sum, weight, new_acc, g_in
+
+        return jax.jit(last, donate_argnums=(4,))
+
+    def _make_bwd_first(self):
+        """Stage 0's backward: recompute prelude+layers from the saved batch
+        args; only param grads come back (token ids carry no cotangent)."""
+        import jax
+
+        stage_fwd = self._stage_forward_fn(0)
+        acc_shardings = self._param_shardings[0]
+
+        def bwd(params, args, g_out, acc):
+            def f(p):
+                return _diff_leaves(stage_fwd(p, args))
+
+            _, vjp_fn = jax.vjp(f, params)
+            (grads,) = vjp_fn(tuple(g_out))
+            new_acc = jax.tree_util.tree_map(jax.numpy.add, acc, grads)
+            return jax.lax.with_sharding_constraint(new_acc, acc_shardings)
+
+        return jax.jit(bwd, donate_argnums=(3,))
+
+    def _ensure_bwd(self, k: int, spec):
+        """Backward program for stage k, compiled against ``spec`` (the carry's
+        diff/static partition). A changed spec (e.g. a batch that grew an
+        attention mask) rebuilds — TraceGuard will count the recompile, which
+        is exactly the signal a shape-unstable input pipeline should trip."""
+        name = f"bwd{k}"
+        if self._bwd_specs.get(k) != spec:
+            if k == self.num_stages - 1:
+                self._jitted[name] = self._make_last(spec)
+            else:
+                self._jitted[name] = self._make_bwd_mid(k, spec)
+            self._bwd_specs[k] = spec
+        return self._jitted[name]
+
+    def _ensure_bwd_first(self):
+        if "bwd0" not in self._jitted:
+            self._jitted["bwd0"] = self._make_bwd_first()
+        return self._jitted["bwd0"]
+
+    # -------------------------------------------------------------- optimizer
+    def init_optimizer_state(self, tx) -> None:
+        """Per-stage optimizer state, each placed by its stage's ZeRO
+        opt-rules table on its own submesh (`derive_opt_state_shardings` —
+        moments may shard over "data" where params replicate)."""
+        import jax
+
+        from .sharding import derive_opt_state_shardings
+
+        self._tx = tx
+        self._opt_states = []
+        self._opt_shardings = []
+        for k in range(self.num_stages):
+            state_shapes = jax.eval_shape(tx.init, self.stage_params[k])
+            shardings = derive_opt_state_shardings(
+                state_shapes,
+                self.submeshes[k],
+                None,
+                list(self.plan.stage_rules(k)),
+                opt_rules=list(self.plan.stage_opt_rules(k) or []) or None,
+            )
+            self._opt_states.append(self._init_one_opt_state(k, tx, shardings))
+            self._opt_shardings.append(shardings)
+
+    def _init_one_opt_state(self, k: int, tx, shardings):
+        import jax
+
+        return jax.jit(tx.init, out_shardings=shardings)(self.stage_params[k])
+
+    def _make_update(self, k: int):
+        import jax
+        import optax
+
+        tx = self._tx
+
+        def upd(params, opt_state, acc, inv_w):
+            grads = jax.tree_util.tree_map(lambda g: (g * inv_w).astype(g.dtype), acc)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        return jax.jit(
+            upd,
+            donate_argnums=(0, 1, 2),
+            out_shardings=(self._param_shardings[k], self._opt_shardings[k]),
+        )
+
+    def _ensure_update(self, k: int):
+        name = f"update{k}"
+        if name not in self._jitted:
+            self._jitted[name] = self._make_update(k)
+        return self._jitted[name]
+
+    # ------------------------------------------------------------------ step
+    def _forward_chain(self, m: int, args0, saved) -> None:
+        saved[0][m] = args0
+        carry = self._jitted["fwd0"](self.stage_params[0], args0)
+        for k in range(1, self.num_stages - 1):
+            carry = self._ship(carry, self.submeshes[k])
+            saved[k][m] = carry
+            carry = self._jitted[f"fwd{k}"](self.stage_params[k], carry)
+        last = self.num_stages - 1
+        saved[last][m] = self._ship(carry, self.submeshes[last])
+
+    def _backward_chain(self, m: int, mb_batch, saved, acc, losses, weights) -> None:
+        last = self.num_stages - 1
+        diff, static, spec = _partition_carry(saved[last].pop(m))
+        loss_sum, weight, acc[last], g = self._ensure_bwd(last, spec)(
+            self.stage_params[last], static, diff, mb_batch, acc[last]
+        )
+        losses.append(loss_sum)
+        weights.append(weight)
+        for k in range(self.num_stages - 2, 0, -1):
+            g = self._ship(g, self.submeshes[k])
+            diff, static, spec = _partition_carry(saved[k].pop(m))
+            acc[k], g = self._ensure_bwd(k, spec)(
+                self.stage_params[k], static, diff, g, acc[k]
+            )
+        g = self._ship(g, self.submeshes[0])
+        args0 = saved[0].pop(m)
+        acc[0] = self._ensure_bwd_first()(self.stage_params[0], args0, g, acc[0])
+
+    def train_step(self, batch):
+        """One full 1F1B optimizer step over the global batch. Returns the
+        token-weighted mean loss (a device scalar on the last stage's mesh).
+
+        Dispatch order is the classic schedule — forward chain for microbatch
+        m, then (once the pipeline is full, m >= P-1) the backward chain for
+        microbatch m-(P-1), then drain — and because jax dispatch is async the
+        per-stage executables genuinely overlap across submeshes; the host
+        never blocks between dispatches."""
+        from ..utils.environment import fence_if_cpu
+
+        if self._opt_states is None:
+            raise RuntimeError(
+                "optimizer state not initialized — prepare an optimizer "
+                "(Accelerator.prepare) or call init_optimizer_state(tx) first"
+            )
+        P, M = self.num_stages, self.num_microbatches
+        args = self.batch_to_args(batch)
+        first_mbs = self._jitted["split_first"](self._ship(args, self.submeshes[0]))
+        last_mbs = self._jitted["split_last"](self._ship(batch, self.submeshes[P - 1]))
+
+        acc = [self._ensure_zero(k)(self.stage_params[k]) for k in range(P)]
+        saved: List[dict] = [dict() for _ in range(P)]
+        losses: List[Any] = []
+        weights: List[Any] = []
+        done = 0
+        for m in range(M):
+            self._forward_chain(m, first_mbs[m], saved)
+            if m >= P - 1:
+                self._backward_chain(done, last_mbs[done], saved, acc, losses, weights)
+                done += 1
+        while done < M:
+            self._backward_chain(done, last_mbs[done], saved, acc, losses, weights)
+            done += 1
+
+        loss_mean, inv_w = self._jitted["finalize"](tuple(losses), tuple(weights))
+        for k in range(P):
+            w_k = self._ship(inv_w, self.submeshes[k])
+            self.stage_params[k], self._opt_states[k] = self._ensure_update(k)(
+                self.stage_params[k], self._opt_states[k], acc[k], w_k
+            )
+        fence_if_cpu(self.stage_params)
+        return loss_mean
+
+    def make_train_step(self, tx) -> Callable:
+        """The step callable `Accelerator.train_step` wraps (TraceGuard,
+        instrumentation). Initializes per-stage optimizer state on ``tx`` if
+        not already done."""
+        if self._opt_states is None:
+            self.init_optimizer_state(tx)
+
+        def step(batch):
+            return self.train_step(batch)
+
+        return step
+
+    # ---------------------------------------------------------- introspection
+    def compiled_program_counts(self) -> dict:
+        """name -> jit cache size per program — the compiled-once-per-stage
+        audit: every entry should be exactly 1 in steady state."""
+        out = {}
+        for name, fn in self._jitted.items():
+            size = getattr(fn, "_cache_size", None)
+            out[name] = int(size()) if callable(size) else -1
+        return out
+
+    def live_per_chip_bytes(self) -> dict:
+        """Measured per-chip param/opt bytes off the LIVE shardings, busiest
+        stage — comparable to ``plan.cost.per_chip_param_bytes`` (the
+        predicted-vs-live pin the bench asserts)."""
+        import jax
+
+        def per_chip(tree):
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                if hasattr(leaf, "addressable_shards") and leaf.addressable_shards:
+                    shard = leaf.addressable_shards[0]
+                    total += shard.data.nbytes
+                elif hasattr(leaf, "nbytes"):
+                    total += leaf.nbytes
+            return total
+
+        params = max(per_chip(p) for p in self.stage_params)
+        opt = (
+            max(per_chip(s) for s in self._opt_states) if self._opt_states else 0
+        )
+        return {"per_chip_param_bytes": params, "per_chip_opt_bytes": opt}
+
+    def measure_stage_times(self, batch, repeats: int = 3) -> List[float]:
+        """Per-microbatch fwd+bwd wall seconds per stage, off the COMPILED
+        programs (best of ``repeats``). One microbatch flows the full chain so
+        every stage's backward sees a real carry; each program is timed in
+        isolation with a sync. Feed the result to
+        `planner.pipeline_bubble_terms` for the measured-vs-predicted bubble
+        account the bench pins. NOT on the step path — it synchronizes the
+        host per program, the exact thing the 1F1B schedule exists to avoid.
+        Run it outside the TraceGuard window; shapes match `train_step`'s
+        microbatches, so the program caches stay at one entry each."""
+        import time
+
+        import jax
+
+        P = self.num_stages
+        args = self.batch_to_args(batch)
+        first_mbs = self._jitted["split_first"](self._ship(args, self.submeshes[0]))
+        last_mbs = self._jitted["split_last"](self._ship(batch, self.submeshes[P - 1]))
+        best = [float("inf")] * P
+        for _ in range(max(1, repeats)):
+            fwd_t = [0.0] * P
+            bwd_t = [0.0] * P
+            saved: List[Any] = [None] * P
+            saved[0] = first_mbs[0]
+            for k in range(P - 1):
+                t0 = time.perf_counter()
+                carry = self._jitted[f"fwd{k}"](self.stage_params[k], saved[k])
+                # Deliberate host sync: this is measurement code, not schedule
+                # code — the timed program must retire before the clock stops.
+                jax.block_until_ready(carry)  # tpu-lint: disable=TPU121
+                fwd_t[k] = time.perf_counter() - t0
+                saved[k + 1] = self._ship(carry, self.submeshes[k + 1])
+            # The last stage has no standalone forward: its fwd+loss+bwd fuse
+            # into one program (`_make_last`), which is exactly its stage time.
+            acc = [self._ensure_zero(k)(self.stage_params[k]) for k in range(P)]
+            last = P - 1
+            diff, static, spec = _partition_carry(saved[last])
+            t0 = time.perf_counter()
+            _, _, acc[last], g = self._ensure_bwd(last, spec)(
+                self.stage_params[last], static, diff, last_mbs[0], acc[last]
+            )
+            jax.block_until_ready(g)
+            bwd_t[last] = time.perf_counter() - t0
+            for k in range(P - 2, 0, -1):
+                g = self._ship(g, self.submeshes[k])
+                diff, static, spec = _partition_carry(saved[k])
+                t0 = time.perf_counter()
+                acc[k], g = self._ensure_bwd(k, spec)(
+                    self.stage_params[k], static, diff, g, acc[k]
+                )
+                jax.block_until_ready(g)
+                bwd_t[k] = time.perf_counter() - t0
+            g = self._ship(g, self.submeshes[0])
+            t0 = time.perf_counter()
+            acc[0] = self._ensure_bwd_first()(self.stage_params[0], saved[0], g, acc[0])
+            jax.block_until_ready(acc[0])
+            bwd_t[0] = time.perf_counter() - t0
+            for k in range(P):
+                best[k] = min(best[k], fwd_t[k] + bwd_t[k])
+        return best
+
+    # ------------------------------------------------------------ state views
+    def merged_params(self):
+        """Re-join the per-stage trees into the original params structure
+        (checkpoint-time view; NOT on the step path)."""
+        plan = self.plan.stage_plan
+        prelude = self.stage_params[0]["prelude"]
+        tail = self.stage_params[self.num_stages - 1]["tail"]
+        layers = [None] * plan.num_layers
+        for k in range(self.num_stages):
+            for i in plan.stage_layers(k):
+                layers[i] = self.stage_params[k][f"layer_{i}"]
+        return self.layered.join(prelude, layers, tail)
+
+    @property
+    def params(self):
+        return self.merged_params()
+
+    def state_dict(self):
+        import jax
+
+        return jax.device_get(self.merged_params())
+
+    def load_state_dict(self, state):
+        prelude, layers, tail = self.layered.split(state)
+        plan = self.plan.stage_plan
+        self.stage_params = []
+        self._param_shardings = []
+        for k in range(self.num_stages):
+            self._place_stage(k, build_stage_tree(prelude, layers, tail, plan, k))
+
+    def num_parameters(self) -> int:
+        import jax
+
+        return sum(
+            int(leaf.size)
+            for tree in self.stage_params
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+
+    def __call__(self, batch):
+        """Forward-only over the pipeline (eval view): full batch through every
+        stage, logits returned from the last stage's mesh."""
+        args = self.batch_to_args(batch)
+        carry = self._jitted["fwd0"](self.stage_params[0], self._ship(args, self.submeshes[0]))
+        for k in range(1, self.num_stages - 1):
+            carry = self._ship(carry, self.submeshes[k])
+            carry = self._jitted[f"fwd{k}"](self.stage_params[k], carry)
+        last = self.num_stages - 1
+        carry = self._ship(carry, self.submeshes[last])
+        name = f"fwd{last}"
+        if name not in self._jitted:
+            import jax
+
+            self._jitted[name] = jax.jit(self._stage_forward_fn(last))
+        return self._jitted[name](self.stage_params[last], carry)
+
+
+def prepare_mpmd_pipeline(
+    model,
+    layered=None,
+    mesh=None,
+    plan: Optional[MPMDTrainPlan] = None,
+    *,
+    batch: Optional[int] = None,
+    seq: Optional[int] = None,
+    num_microbatches: Optional[int] = None,
+    logits_loss: Optional[Callable] = None,
+    batch_to_args: Optional[Callable] = None,
+) -> MPMDPipelinedModel:
+    """Plan (if needed) and build the MPMD pipeline executor for ``model``.
+
+    When ``plan`` is None, runs `plan_mpmd_train_sharding` over the model's
+    `LayeredApply.split` — ``batch`` and ``seq`` are then required (they size
+    the microbatch schedule and the per-stage workload)."""
+    from ..models import layered_for_model
+    from .planner import plan_mpmd_train_sharding
+
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        mesh = AcceleratorState().mesh
+    if layered is None:
+        layered = layered_for_model(model)
+    if plan is None:
+        if batch is None or seq is None:
+            raise ValueError("prepare_mpmd_pipeline needs batch= and seq= to plan")
+        prelude, layers, tail = layered.split(model.params)
+        plan = plan_mpmd_train_sharding(
+            prelude,
+            layers,
+            tail,
+            mesh,
+            batch=batch,
+            seq=seq,
+            num_microbatches=num_microbatches,
+        )
+    return MPMDPipelinedModel(
+        model,
+        layered,
+        mesh,
+        plan,
+        logits_loss=logits_loss,
+        batch_to_args=batch_to_args,
+    )

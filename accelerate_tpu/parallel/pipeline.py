@@ -513,7 +513,10 @@ class PipelinedModel:
             if len(stack) % n_stages != 0:
                 raise ValueError(
                     f"{len(stack)} {kind} layers not divisible by {n_stages} pipeline "
-                    f"stages (the SPMD stage runner scans equal-count stages only)"
+                    f"stages (the SPMD stage runner scans equal-count stages only; "
+                    f"non-uniform plans run on the MPMD runner — build the mesh with "
+                    f"a 'pipeline' axis and use parallel.mpmd.prepare_mpmd_pipeline "
+                    f"or Accelerator.prepare(sharding_rules='auto'))"
                 )
             plan = plan_pipeline_stages(stack, n_stages)
             if not plan.uniform:
@@ -521,7 +524,10 @@ class PipelinedModel:
                     f"{plan.num_layers} {kind} layers not divisible by {n_stages} "
                     f"pipeline stages (the planner's byte-balanced assignment "
                     f"{plan.assignment} is non-uniform; the SPMD stage runner "
-                    f"scans equal-count stages only)"
+                    f"scans equal-count stages only — non-uniform plans run on the "
+                    f"MPMD runner: build the mesh with a 'pipeline' axis and use "
+                    f"parallel.mpmd.prepare_mpmd_pipeline or "
+                    f"Accelerator.prepare(sharding_rules='auto'))"
                 )
             return plan
 

@@ -31,8 +31,11 @@ TPU_POD_LAUNCHERS = ["gcloud", "ssh", "manual"]
 
 # Mesh axis names, in canonical (outer→inner, DCN→ICI) order. Data goes on ("data","fsdp"),
 # parameters shard over "fsdp" (ZeRO-3) and "model" (tensor parallel), activations'
-# sequence dim over "seq" (ring attention), experts over "expert", pipeline stages over "stage".
-MESH_AXIS_NAMES = ("data", "fsdp", "model", "seq", "expert", "stage")
+# sequence dim over "seq" (ring attention), experts over "expert". Two pipeline axes exist:
+# "stage" is the SPMD runner's axis (stacked [L,...] params, lax.ppermute ring, equal layer
+# counts), "pipeline" is the MPMD runtime's axis (parallel/mpmd.py: the mesh is sliced into
+# per-stage submeshes so stages may hold unequal layer counts).
+MESH_AXIS_NAMES = ("data", "fsdp", "model", "seq", "expert", "stage", "pipeline")
 DATA_AXES = ("data", "fsdp")
 
 ELASTIC_LOG_PREFIX = "accelerate_tpu.launch"

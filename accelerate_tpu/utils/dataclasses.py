@@ -162,7 +162,9 @@ class ParallelismConfig:
 
     Sizes of -1 mean "absorb remaining devices" (at most one axis may be -1; defaults to
     the data axis). Axis order is DCN-outermost→ICI-innermost as laid out in
-    `constants.MESH_AXIS_NAMES`: ("data", "fsdp", "model", "seq", "expert", "stage").
+    `constants.MESH_AXIS_NAMES`: ("data", "fsdp", "model", "seq", "expert", "stage",
+    "pipeline"). "stage" is the SPMD pipeline runner's axis; "pipeline" selects the MPMD
+    runtime (per-stage submeshes, unequal layer counts allowed).
     """
 
     data: int = -1
@@ -171,6 +173,7 @@ class ParallelismConfig:
     seq: int = 1
     expert: int = 1
     stage: int = 1
+    pipeline: int = 1
 
     def __post_init__(self):
         sizes = self.axis_sizes()

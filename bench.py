@@ -883,15 +883,17 @@ def main():
     pre = argparse.ArgumentParser(add_help=False)
     pre.add_argument("--mode")
     pre.add_argument("--zero-ab", action="store_true")
+    pre.add_argument("--pipeline-ab", action="store_true")
     known, rest = pre.parse_known_args(argv)
-    if known.zero_ab:
-        # 1D-replicated vs 2D-ZeRO training A/B (benchmarks/train_bench.py):
-        # its own argument surface, same pre-routing as serving/checkpoint.
+    if known.zero_ab or known.pipeline_ab:
+        # Training A/Bs (benchmarks/train_bench.py): 1D-replicated vs 2D-ZeRO
+        # (--zero-ab) or 2D-ZeRO vs 3D-MPMD-pipeline (--pipeline-ab) — their
+        # own argument surface, same pre-routing as serving/checkpoint.
         if known.mode not in (None, "train"):
-            raise SystemExit("--zero-ab is a --mode train A/B")
+            raise SystemExit("--zero-ab/--pipeline-ab are --mode train A/Bs")
         from benchmarks.train_bench import main as train_ab_main
 
-        sys.exit(train_ab_main(rest))
+        sys.exit(train_ab_main(rest + (["--pipeline-ab"] if known.pipeline_ab else [])))
     if known.mode == "serving":
         from benchmarks.serving_bench import main as serving_main
 

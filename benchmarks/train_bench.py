@@ -1,4 +1,5 @@
-"""Training-parallelism benchmark: 1D-replicated vs 2D-ZeRO A/B.
+"""Training-parallelism benchmark: 1D-replicated vs 2D-ZeRO A/B, and
+(``--pipeline-ab``) 2D-ZeRO vs 3D-MPMD-pipeline A/B.
 
 Two passes over the same tiny causal-LM training workload on the one global
 mesh (the forced 8-device CPU mesh on the test tier, a real slice when the
@@ -11,6 +12,15 @@ TPU tunnel is up):
     ``sharding_rules="auto"`` — the cost-model planner's 2D plan: params
     tensor-parallel over "model", optimizer moments ZeRO-sharded along "data"
     (`parallel/planner.plan_train_sharding`).
+  - **3d** (``--pipeline-ab`` swaps the pair to 2d-vs-3d): ``ParallelismConfig(
+    data=-1, model=TP, pipeline=PP)`` — the 3D MPMD plan: the planner splits
+    the layer stack into byte-balanced stages, each stage jit-compiles against
+    its own submesh, and the 1F1B schedule runs them (`parallel/mpmd.py`).
+    The pass additionally reports per-chip param/opt bytes off the LIVE stage
+    shardings vs the plan's prediction, the compiled-once program audit, and
+    the pipeline-bubble account: `measure_stage_times` times each stage's
+    compiled fwd+bwd per microbatch and `pipeline_bubble_terms` turns that
+    into a MEASURED bubble fraction next to the planner's predicted one.
 
 Per pass: steady-state step time under a TraceGuard (0 recompiles / 0 host
 transfers after warmup, ASSERTED), per-chip param/grad/optimizer bytes off the
@@ -99,7 +109,10 @@ def run_pass(mode, args):
 
     family, cfg = get_model_family(args.model)
     bundle = CREATE_BY_FAMILY[family](cfg, seq_len=args.seq_len)
-    if mode == "2d":
+    if mode == "3d":
+        bundle.sharding_rules = "auto"
+        pcfg = ParallelismConfig(data=-1, model=args.tp, pipeline=args.pp)
+    elif mode == "2d":
         bundle.sharding_rules = "auto"
         pcfg = ParallelismConfig(data=-1, model=args.tp)
     else:
@@ -139,6 +152,54 @@ def run_pass(mode, args):
     assert guard.host_transfers == 0, (
         f"{mode} pass transferred to host in steady state: {guard.transfer_violations}"
     )
+
+    if mode == "3d":
+        # MPMD pass: bytes off the LIVE per-stage shardings (busiest stage),
+        # the compiled-once audit, and the measured-vs-predicted bubble.
+        from accelerate_tpu.parallel.planner import pipeline_bubble_terms
+
+        plan = model.plan
+        counts = model.compiled_program_counts()
+        multi = {name: n for name, n in counts.items() if n != 1}
+        assert not multi, f"3d pass compiled a stage program more than once: {multi}"
+
+        live = model.live_per_chip_bytes()
+        stage_times = model.measure_stage_times(batches[0])
+        measured_wall, measured_bubble = pipeline_bubble_terms(
+            stage_times, plan.num_microbatches, 0.0
+        )
+        result = {
+            "mesh": mesh_axes,
+            "steps": args.steps,
+            "step_time_s_mean": wall / args.steps,
+            "per_chip_param_bytes": live["per_chip_param_bytes"],
+            "per_chip_opt_bytes": live["per_chip_opt_bytes"],
+            "recompiles": guard.total_recompiles,
+            "host_transfers": guard.host_transfers,
+            "final_loss": losses[-1],
+            "pipeline": {
+                "num_stages": plan.num_stages,
+                "stage_layers": [
+                    len(plan.stage_plan.stage_layers(k)) for k in range(plan.num_stages)
+                ],
+                "num_microbatches": plan.num_microbatches,
+                "stage_times_s": stage_times,
+                "measured_wall_s": measured_wall,
+                "measured_bubble_fraction": measured_bubble,
+                "predicted_bubble_fraction": plan.bubble_fraction,
+                "predicted_p2p_time_s": plan.p2p_time_s,
+            },
+        }
+        for tree, predicted, live_key in (
+            ("params", plan.cost.per_chip_param_bytes, "per_chip_param_bytes"),
+            ("opt", plan.cost.per_chip_opt_bytes, "per_chip_opt_bytes"),
+        ):
+            live_bytes = result[live_key]
+            result[f"predicted_{tree}_bytes"] = int(predicted)
+            result[f"predicted_{tree}_error_pct"] = (
+                abs(predicted - live_bytes) / live_bytes * 100.0 if live_bytes else 0.0
+            )
+        return result, losses
 
     dev0 = jax.devices()[0]
     # Grads live exactly where the params do (jax.grad output sharding follows
@@ -192,9 +253,14 @@ def main(argv=None):
     parser.add_argument("--seq-len", type=int, default=32)
     parser.add_argument("--global-batch", type=int, default=8,
                         help="global batch (must divide by the data axis of BOTH passes)")
-    parser.add_argument("--tp", type=int, default=2, help="model-axis size of the 2d pass")
+    parser.add_argument("--tp", type=int, default=2, help="model-axis size of the 2d/3d passes")
+    parser.add_argument("--pp", type=int, default=2,
+                        help="pipeline-axis size of the 3d pass (--pipeline-ab)")
+    parser.add_argument("--pipeline-ab", action="store_true",
+                        help="A/B the 2D ZeRO plan against the 3D MPMD pipeline plan "
+                             "(2d-vs-3d) instead of the default 1d-vs-2d")
     parser.add_argument("--loss-atol", type=float, default=2e-4,
-                        help="1d-vs-2d per-step loss parity tolerance")
+                        help="per-step loss parity tolerance between the two passes")
     parser.add_argument("--mode", default="train", help=argparse.SUPPRESS)  # routing residue
     args = parser.parse_args(argv)
 
@@ -208,9 +274,10 @@ def main(argv=None):
     n_chips = jax.device_count()
     log(f"backend: {n_chips}x {jax.devices()[0].device_kind}")
 
+    baseline, contender = ("2d", "3d") if args.pipeline_ab else ("1d", "2d")
     results = {}
     losses = {}
-    for mode in ("1d", "2d"):
+    for mode in (baseline, contender):
         log(f"{mode} pass: {args.warmup}+{args.steps} steps, global batch {args.global_batch}...")
         results[mode], losses[mode] = run_pass(mode, args)
         log(f"{mode}: {results[mode]['step_time_s_mean'] * 1000:.1f} ms/step, "
@@ -218,32 +285,50 @@ def main(argv=None):
 
     # Loss-trajectory parity: same data, same init, same optimizer — the
     # parallel decomposition must not change the math.
-    drift = max(abs(a - b) for a, b in zip(losses["1d"], losses["2d"]))
+    drift = max(abs(a - b) for a, b in zip(losses[baseline], losses[contender]))
     assert drift <= args.loss_atol, (
-        f"1d-vs-2d loss trajectories diverged (max |Δ| {drift:.2e} > atol "
-        f"{args.loss_atol:.0e}): 1d {losses['1d']} vs 2d {losses['2d']}"
+        f"{baseline}-vs-{contender} loss trajectories diverged (max |Δ| {drift:.2e} "
+        f"> atol {args.loss_atol:.0e}): {losses[baseline]} vs {losses[contender]}"
     )
 
-    opt_1d = results["1d"]["per_chip_opt_bytes"]
-    opt_2d = results["2d"]["per_chip_opt_bytes"]
     device = jax.devices()[0].platform
     prefix = "" if device in ("tpu", "gpu") else "cpu-smoke "
-    row = {
-        "metric": f"{prefix}per-chip optimizer-state bytes, 2D ZeRO plan "
-        f"({args.model}, mesh {results['2d']['mesh']}, vs 1D replicated baseline)",
-        "value": opt_2d,
-        "unit": "bytes/chip",
-        # Ratio > 1: how many times less optimizer HBM each chip holds.
-        "vs_baseline": round(opt_1d / max(opt_2d, 1), 3),
-        "extra": {
-            "device_kind": device,
-            "loss_parity_max_drift": drift,
-            "loss_trajectory_1d": losses["1d"],
-            "loss_trajectory_2d": losses["2d"],
-            "1d": results["1d"],
-            "2d": results["2d"],
-        },
+    extra = {
+        "device_kind": device,
+        "tunnel_probe_alive": on_accel,
+        "loss_parity_max_drift": drift,
+        f"loss_trajectory_{baseline}": losses[baseline],
+        f"loss_trajectory_{contender}": losses[contender],
+        baseline: results[baseline],
+        contender: results[contender],
     }
+    if args.pipeline_ab:
+        # Headline: busiest-stage per-chip PARAM bytes under the 3D pipeline
+        # plan — pipelining's memory win over the flat 2D mesh. The bubble
+        # account (measured vs predicted) rides in extra["3d"]["pipeline"].
+        par_2d = results["2d"]["per_chip_param_bytes"]
+        par_3d = results["3d"]["per_chip_param_bytes"]
+        row = {
+            "metric": f"{prefix}per-chip param bytes, 3D MPMD pipeline plan "
+            f"({args.model}, mesh {results['3d']['mesh']}, vs 2D ZeRO baseline)",
+            "value": par_3d,
+            "unit": "bytes/chip",
+            # Ratio > 1: how many times less param HBM each chip holds.
+            "vs_baseline": round(par_2d / max(par_3d, 1), 3),
+            "extra": extra,
+        }
+    else:
+        opt_1d = results["1d"]["per_chip_opt_bytes"]
+        opt_2d = results["2d"]["per_chip_opt_bytes"]
+        row = {
+            "metric": f"{prefix}per-chip optimizer-state bytes, 2D ZeRO plan "
+            f"({args.model}, mesh {results['2d']['mesh']}, vs 1D replicated baseline)",
+            "value": opt_2d,
+            "unit": "bytes/chip",
+            # Ratio > 1: how many times less optimizer HBM each chip holds.
+            "vs_baseline": round(opt_1d / max(opt_2d, 1), 3),
+            "extra": extra,
+        }
     print(json.dumps(row))
     return 0
 

@@ -490,6 +490,70 @@ def test_tpu120_variants():
     assert not analyze_source(hazard.replace("import jax\n", ""))
 
 
+def test_tpu121_variants():
+    """Beyond the flag fixture's device_get (one finding per fixture): the
+    numpy coercion and .block_until_ready() spellings flag too, jnp.asarray
+    stays on device and is clean, a non-handoff operand is out of scope, a
+    module with no pipeline-mesh evidence is out of scope however it moves
+    carries, ParallelismConfig(pipeline=...) and Mesh(..., ("pipeline",))
+    both count as pipeline-mesh evidence, and a jax-free module is out of
+    scope."""
+    hazard = (
+        "import jax\n"
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "from accelerate_tpu.parallel import slice_mesh\n"
+        "def handoff(mesh, fwd, params, batch):\n"
+        '    subs = slice_mesh(mesh, "pipeline")\n'
+        "    carry = fwd(params, batch)\n"
+        "    return subs, jax.device_get(carry)\n"
+    )
+    assert [f.rule_id for f in analyze_source(hazard)] == ["TPU121"]
+    # The silent device_get: numpy coercion of the carry.
+    assert [f.rule_id for f in analyze_source(
+        hazard.replace("jax.device_get(carry)", "np.asarray(carry)")
+    )] == ["TPU121"]
+    assert [f.rule_id for f in analyze_source(
+        hazard.replace("jax.device_get(carry)", "np.array(carry)")
+    )] == ["TPU121"]
+    # Blocking the schedule on the handoff: both spellings.
+    assert [f.rule_id for f in analyze_source(
+        hazard.replace("jax.device_get(carry)", "carry.block_until_ready()")
+    )] == ["TPU121"]
+    assert [f.rule_id for f in analyze_source(
+        hazard.replace("jax.device_get(carry)", "jax.block_until_ready(carry)")
+    )] == ["TPU121"]
+    # jnp.asarray stays on device — not a host hop.
+    assert not analyze_source(
+        hazard.replace("jax.device_get(carry)", "jnp.asarray(carry)")
+    )
+    # Cotangents and activations are handoff labels too.
+    assert [f.rule_id for f in analyze_source(
+        hazard.replace("carry", "g_out")
+    )] == ["TPU121"]
+    # A non-handoff operand (checkpoint pull of merged params): out of scope.
+    assert not analyze_source(
+        hazard.replace("jax.device_get(carry)", "jax.device_get(merged)")
+    )
+    # No pipeline-mesh evidence in the module: out of scope.
+    assert not analyze_source(
+        hazard.replace('    subs = slice_mesh(mesh, "pipeline")\n', "    subs = None\n")
+    )
+    # ParallelismConfig(pipeline=...) and a literal Mesh with a "pipeline"
+    # axis both count as pipeline-mesh evidence.
+    for spelling in (
+        "    subs = ParallelismConfig(pipeline=2)\n",
+        '    subs = Mesh(devices, ("data", "pipeline"))\n',
+    ):
+        assert [f.rule_id for f in analyze_source(
+            hazard.replace('    subs = slice_mesh(mesh, "pipeline")\n', spelling)
+        )] == ["TPU121"]
+    assert not analyze_source(
+        hazard.replace("import jax\n", "").replace("import jax.numpy as jnp\n", "")
+        .replace("jax.device_get(carry)", "np.asarray(carry)")
+    )
+
+
 def test_analyze_paths_walks_the_tree():
     findings, scanned = analyze_paths([str(SAMPLES)])
     assert scanned >= 2 * len(RULES) + 1  # flag + clean per rule + suppressed.py

@@ -119,32 +119,37 @@ def test_worker_module_is_warn_clean():
     )
 
 
-def test_kernel_serving_path_is_warn_clean_at_20_rules():
+def test_kernel_serving_path_is_warn_clean_at_21_rules():
     """The Pallas kernel path pin: `ops/` (the kernels + the dispatch seams +
     the quantization module), the kernel-touching serving/generation files,
-    and the TP sharding + planner modules stay warn-clean under the FULL
-    20-rule registry — including TPU115, so nothing in the shipped tree pins
-    a paged decode program to the gather oracle or forces interpret mode
-    outside tests; TPU117, so no shipped quantization seam bakes a scale
-    literal or an off-set kv_cache_dtype into a program; TPU118, so the
-    mesh-spanning serving engine itself never places a params/pool tree
-    without a NamedSharding; TPU119 (re-audited when the registry grew
-    18 -> 19 for it), so no shipped rules table carries a dead entry and no
-    model module hides a per-leaf PartitionSpec outside its table; and
-    TPU120 (the 19 -> 20 re-audit), so the sharding/planner seams that EMIT
-    the ZeRO opt-state tables never themselves park a replicated moments
-    tree on a data mesh. The rule-count assert keeps this test honest: if
-    the registry grows, this pin re-evaluates the kernel path under the new
-    rule instead of silently gating against a stale set."""
+    and the TP sharding + planner + MPMD-runtime modules stay warn-clean
+    under the FULL 21-rule registry — including TPU115, so nothing in the
+    shipped tree pins a paged decode program to the gather oracle or forces
+    interpret mode outside tests; TPU117, so no shipped quantization seam
+    bakes a scale literal or an off-set kv_cache_dtype into a program;
+    TPU118, so the mesh-spanning serving engine itself never places a
+    params/pool tree without a NamedSharding; TPU119 (re-audited when the
+    registry grew 18 -> 19 for it), so no shipped rules table carries a dead
+    entry and no model module hides a per-leaf PartitionSpec outside its
+    table; TPU120 (the 19 -> 20 re-audit), so the sharding/planner seams
+    that EMIT the ZeRO opt-state tables never themselves park a replicated
+    moments tree on a data mesh; and TPU121 (the 20 -> 21 re-audit), so the
+    MPMD pipeline runtime that OWNS the stage-handoff discipline never
+    itself pulls an inter-stage carry through the host — every handoff in
+    parallel/mpmd.py is a jax.device_put onto the next stage's submesh. The
+    rule-count assert keeps this test honest: if the registry grows, this
+    pin re-evaluates the kernel path under the new rule instead of silently
+    gating against a stale set."""
     from accelerate_tpu.analysis import RULES
 
-    assert len(RULES) == 20, "rule registry changed — re-audit the kernel-path pin"
+    assert len(RULES) == 21, "rule registry changed — re-audit the kernel-path pin"
     roots = [
         REPO / "accelerate_tpu" / "ops",
         REPO / "accelerate_tpu" / "serving.py",
         REPO / "accelerate_tpu" / "generation.py",
         REPO / "accelerate_tpu" / "parallel" / "sharding.py",
         REPO / "accelerate_tpu" / "parallel" / "planner.py",
+        REPO / "accelerate_tpu" / "parallel" / "mpmd.py",
     ]
     findings, scanned = analyze_paths([str(r) for r in roots])
     assert scanned >= 8, f"kernel-path files missing? scanned {scanned}"

@@ -318,6 +318,85 @@ def test_supervised_mesh_2d_keeps_zero_state_sharded_across_restart(tmp_path):
     assert resumes == 1
 
 
+def test_mpmd_injected_kill_resumes_nonuniform_layout_exactly(tmp_path):
+    """Chaos on the MPMD pipeline runtime: an `InjectedKill` at a step
+    boundary ends the attempt exactly like a SIGKILL ends a process; the
+    'respawn' rebuilds the ("data", "model", "pipeline") mesh from scratch,
+    reloads the last published checkpoint into the per-stage trees
+    (`load_state_dict` re-places every stage on its own submesh), and the
+    restored params hash EXACTLY to the killed attempt's last save — with the
+    NON-uniform stage layout (`stage_layout_evidence`) identical across the
+    restart, and training continuing on the restored state."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs an 8-device mesh (forced CPU devices)")
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.chaos.injectors import InjectedKill, StepBoundaryInjector
+    from accelerate_tpu.chaos.runner import params_digest, stage_layout_evidence
+    from accelerate_tpu.checkpointing import load_pytree, save_pytree
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+    from accelerate_tpu.parallel.sharding import data_spec
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import ParallelismConfig, set_seed
+    from jax.sharding import NamedSharding
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=3,
+        num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=32,
+        rope_theta=10000.0, tie_word_embeddings=False,
+    )
+
+    def spawn():
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        set_seed(0)
+        bundle = create_llama_model(cfg, seq_len=8)
+        bundle.sharding_rules = "auto"
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(data=2, model=2, pipeline=2)
+        )
+        model, _ = acc.prepare(bundle, optax.adam(1e-3))
+        return acc, model
+
+    rng = np.random.default_rng(0)
+    acc, model = spawn()
+    layout = stage_layout_evidence(model)
+    assert layout["nonuniform"], layout  # 3 layers / 2 stages: [1, 2] or [2, 1]
+    sharding = NamedSharding(acc.mesh, data_spec(acc.mesh))
+    batches = [
+        jax.device_put({"input_ids": rng.integers(0, 64, (8, 8)).astype(np.int32)}, sharding)
+        for _ in range(4)
+    ]
+
+    plan = FaultPlan(name="mpmd-kill", events=[FaultEvent(kind="proc.sigkill", at_step=1)])
+    boundary = StepBoundaryInjector(ChaosSession(plan), hard=False)
+    step_fn = acc.train_step()
+    digests = {}
+    killed_at = None
+    try:
+        for step in range(4):
+            jax.block_until_ready(step_fn(batches[step]))
+            save_pytree(model.state_dict(), str(tmp_path / f"step{step}.npz"))
+            digests[step] = params_digest(model)
+            boundary.poll(step)
+    except InjectedKill:
+        killed_at = step
+    assert killed_at == 1 and 1 in digests
+
+    # Respawn: fresh state objects, fresh mesh, fresh plan — then restore.
+    acc2, model2 = spawn()
+    assert stage_layout_evidence(model2) == layout
+    model2.load_state_dict(load_pytree(str(tmp_path / f"step{killed_at}.npz")))
+    assert params_digest(model2) == digests[killed_at]
+    step_fn2 = acc2.train_step()
+    loss = float(step_fn2(batches[killed_at + 1]))
+    assert np.isfinite(loss)
+
+
 # ------------------------------------------------------------------ serving chaos
 def test_dispatch_stall_and_queue_burst_drain_with_terminal_reasons(tmp_path):
     """The serving acceptance sweep: an injected dispatch stall + a queue-full

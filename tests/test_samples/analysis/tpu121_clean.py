@@ -1,0 +1,19 @@
+"""TPU121 clean fixture: the sanctioned inter-stage handoff — the carry moves
+submesh-to-submesh with `jax.device_put(carry, NamedSharding(next_stage_mesh,
+spec))`, a pure device-to-device ICI transfer that async dispatch overlaps
+with the other stages' compute and an armed TraceGuard leaves unguarded
+(parallel.mpmd's `_ship` seam)."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from accelerate_tpu.parallel import slice_mesh
+
+
+def handoff(mesh, stage_fwd, stage_params, batch):
+    submeshes = slice_mesh(mesh, "pipeline")
+    carry = stage_fwd(stage_params, batch)
+    shardings = jax.tree_util.tree_map(
+        lambda _: NamedSharding(submeshes[1], PartitionSpec("data")), carry
+    )
+    return jax.device_put(carry, shardings)

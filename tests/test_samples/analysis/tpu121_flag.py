@@ -1,0 +1,20 @@
+"""TPU121 flag fixture: an MPMD pipeline module that pulls the inter-stage
+activation carry through the host. `device_get` lands the carry in host RAM
+and the re-upload rides PCIe, so every stage of the 1F1B schedule stalls
+behind the round-trip instead of overlapping via async dispatch — the
+pipeline flattens to sequential stages. (The numpy-coercion and
+.block_until_ready() variants are unit-tested in
+test_analysis_rules.test_tpu121_variants; the tree-walk contract allows
+exactly one finding per flag fixture.)"""
+
+import jax
+
+from accelerate_tpu.parallel import slice_mesh
+
+
+def handoff(mesh, stage_fwd, stage_params, batch):
+    submeshes = slice_mesh(mesh, "pipeline")
+    carry = stage_fwd(stage_params, batch)
+    # FLAG: the carry detours through host memory on its way to stage 1.
+    hopped = jax.device_get(carry)
+    return submeshes, hopped
