@@ -218,6 +218,9 @@ class Accelerator:
         self._backward_cache: dict = {}
         self._save_model_hooks: List[Callable] = []
         self._load_model_hooks: List[Callable] = []
+        # Global batch observed on a co-prepared dataloader (prepare() peeks
+        # before placing models): sizes the MPMD microbatch schedule.
+        self._planning_batch_hint: Optional[int] = None
 
         self.step = 0
         self.flag_tensor = None
@@ -451,6 +454,21 @@ class Accelerator:
         elif not isinstance(device_placement, (list, tuple)):
             device_placement = [device_placement] * len(args)
 
+        # Peek at co-prepared dataloaders BEFORE placing models: the MPMD
+        # pipeline planner sizes its microbatch schedule off the global batch,
+        # and a schedule planned for the wrong batch fails loudly at step time
+        # (mpmd.py's split guard) instead of training on wrong gradients.
+        for obj in args:
+            if self._is_dataloader(obj):
+                bs = (
+                    getattr(obj, "total_batch_size", None)
+                    or getattr(obj, "batch_size", None)
+                    or getattr(getattr(obj, "batch_sampler", None), "batch_size", None)
+                )
+                if bs:
+                    self._planning_batch_hint = int(bs)
+                    break
+
         first_pass = []
         for obj, dp in zip(args, device_placement):
             if self._is_model(obj):
@@ -585,6 +603,35 @@ class Accelerator:
                 from .models import layered_for_model
                 from .parallel.planner import plan_mpmd_train_sharding
 
+                # Settings the single-mesh route honors must not be dropped
+                # silently here (same explicit-rejection style as train_step's
+                # loss_fn/max_grad_norm): ZeRO weight-update sharding already
+                # rides the per-stage opt-rules tables, but the fsdp param/
+                # grad knobs and the fp8 recipe have no per-stage twin yet.
+                if fsdp is not None:
+                    raise NotImplementedError(
+                        "fsdp_plugin is not supported on the MPMD pipeline "
+                        "route: stage params shard by the per-stage planner "
+                        "tables, not the fsdp wrap policy. Drop the plugin "
+                        "(ZeRO optimizer-state sharding is planned per stage "
+                        "automatically) or use a 2-axis mesh."
+                    )
+                if self.state.mixed_precision == "fp8":
+                    raise NotImplementedError(
+                        "mixed_precision='fp8' is not supported on the MPMD "
+                        "pipeline route (no per-stage fp8 recipe); use 'bf16' "
+                        "or a 2-axis mesh."
+                    )
+                mp_dtype = None
+                if self.state.mixed_precision in ("bf16", "fp16"):
+                    mp_dtype = self.state.compute_dtype
+                mp_autocast = True
+                if self.autocast_handler is not None and not self.autocast_handler.enabled:
+                    mp_autocast = False
+                # Size the microbatch schedule off the real global batch when a
+                # dataloader was prepared in the same call — a schedule divided
+                # for the wrong batch can't split the step (mpmd.py raises).
+                plan_batch = self._planning_batch_hint or 8
                 layered = layered_for_model(model)
                 prelude, layers, tail = layered.split(model.params)
                 mpmd_plan = plan_mpmd_train_sharding(
@@ -592,11 +639,18 @@ class Accelerator:
                     layers,
                     tail,
                     mesh,
-                    batch=8,
+                    batch=plan_batch,
                     seq=512,
                     opt_bytes_per_param=adam_bytes,
                 )
-                pipelined = MPMDPipelinedModel(model, layered, mesh, mpmd_plan)
+                pipelined = MPMDPipelinedModel(
+                    model,
+                    layered,
+                    mesh,
+                    mpmd_plan,
+                    compute_dtype=mp_dtype,
+                    autocast=mp_autocast,
+                )
                 self._models.append(pipelined)
                 return pipelined
             plan_axes = tuple(

@@ -252,7 +252,8 @@ class AcceleratedOptimizer:
 
         self.offload_opt_state = False
         self._opt_compute_sharding = None
-        if model is not None and getattr(model, "is_mpmd", False):
+        self.is_mpmd = model is not None and getattr(model, "is_mpmd", False)
+        if self.is_mpmd:
             # MPMD pipeline model: optimizer state lives PER STAGE, each piece
             # on its own stage submesh placed by that stage's ZeRO opt-rules
             # table — a single-mesh opt_state/opt_state_sharding here would be
@@ -333,6 +334,21 @@ class AcceleratedOptimizer:
             self.opt_state = None
 
         self._lr_override = None
+
+    # ---- MPMD guard ------------------------------------------------------------------
+    def _reject_mpmd(self, what: str) -> None:
+        """Fail loudly, not deep inside the update machinery: on the MPMD
+        pipeline route this wrapper holds NO single-mesh opt_state (it lives
+        per stage, on per-stage submeshes, owned by the model) — mirrors the
+        error Accelerator.backward() raises on the same route."""
+        if getattr(self, "is_mpmd", False):
+            raise NotImplementedError(
+                f"{what} operates on a single-mesh optimizer state, but this "
+                "optimizer is bound to an MPMD pipeline model whose optimizer "
+                "state lives per stage on per-stage submeshes. Use step_fn = "
+                "Accelerator.train_step() — it runs the 1F1B schedule with "
+                "per-stage accumulation and updates."
+            )
 
     # ---- offload tier movement -------------------------------------------------------
     def opt_to_compute_memory(self, opt_state):
@@ -801,6 +817,7 @@ class AcceleratedOptimizer:
         """Add a microbatch's gradients into the accumulation buffer (held in the
         model's reduce_dtype when set — FSDP MixedPrecision parity; cast back to
         the param dtype at step time by _update's grads.astype)."""
+        self._reject_mpmd("accumulate_grads()")
         if self._grads is None:
             reduce_dtype = getattr(self.model, "reduce_dtype", None)
             if reduce_dtype is not None:
@@ -832,6 +849,7 @@ class AcceleratedOptimizer:
         import jax
         import jax.numpy as jnp
 
+        self._reject_mpmd("clip_grad_norm_()")
         if self._grads is None:
             return None
         inv_scale = self._unscale_factor()
@@ -854,6 +872,7 @@ class AcceleratedOptimizer:
         import jax
         import jax.numpy as jnp
 
+        self._reject_mpmd("clip_grad_value_()")
         if self._grads is None:
             return
         inv_scale = self._unscale_factor()
@@ -914,6 +933,7 @@ class AcceleratedOptimizer:
         import jax
         import jax.numpy as jnp
 
+        self._reject_mpmd("step()")
         if not self.gradient_state.sync_gradients:
             self.step_was_skipped = True
             return
@@ -962,6 +982,7 @@ class AcceleratedOptimizer:
     def set_learning_rate(self, lr: float):
         """Override the learning rate for subsequent steps (requires the tx to be built
         with `optax.inject_hyperparams`, else schedules inside the tx govern)."""
+        self._reject_mpmd("set_learning_rate()")
         self._lr_override = lr
 
     @property
@@ -975,6 +996,7 @@ class AcceleratedOptimizer:
 
     # ---- checkpoint view -------------------------------------------------------------
     def state_dict(self):
+        self._reject_mpmd("state_dict()")
         opt_state = self.opt_state
         if isinstance(opt_state, DiskOptState):
             # Checkpointing sees an ordinary pytree (one pass over the blob).
@@ -984,6 +1006,7 @@ class AcceleratedOptimizer:
     def load_state_dict(self, state):
         from .parallel.sharding import place_params
 
+        self._reject_mpmd("load_state_dict()")
         if isinstance(self.opt_state, DiskOptState):
             self.opt_state.load(state["opt_state"])
         else:

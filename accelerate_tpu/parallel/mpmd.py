@@ -56,6 +56,18 @@ from .planner import MPMDTrainPlan, build_stage_tree
 __all__ = ["MPMDPipelinedModel", "prepare_mpmd_pipeline"]
 
 
+def _donate(*argnums):
+    """Donation argnums, backend-guarded: donating sharded operands into a
+    fused update crashes XLA:CPU's host runtime over forced multi-device CPU
+    meshes (SIGSEGV/SIGABRT inside the aliased executable — the same class
+    optimizer.py's fused update guards against). Donation is a memory
+    optimization, not a semantics change, so drop it on CPU; TPU/GPU keep
+    the aliasing."""
+    import jax
+
+    return () if jax.default_backend() == "cpu" else argnums
+
+
 def _partition_carry(carry):
     """Split a carry pytree into (diff, static, spec): floating leaves are
     differentiable and ship cotangents backward; integer leaves (positions,
@@ -106,6 +118,8 @@ class MPMDPipelinedModel:
         plan: MPMDTrainPlan,
         logits_loss: Optional[Callable] = None,
         batch_to_args: Optional[Callable] = None,
+        compute_dtype=None,
+        autocast: bool = True,
     ):
         from .mesh import slice_mesh
 
@@ -116,6 +130,12 @@ class MPMDPipelinedModel:
         self.logits_loss = logits_loss or default_causal_lm_logits_loss
         self.batch_to_args = batch_to_args or _default_batch_to_args
         self.num_microbatches = plan.num_microbatches
+        # Mixed precision, same contract as the SPMD runner: params and the
+        # floating carry cast to compute_dtype at stage-program entry; master
+        # params (and therefore the grads jax.vjp emits through the cast)
+        # stay full precision.
+        self.compute_dtype = compute_dtype
+        self.autocast_enabled = autocast and compute_dtype is not None
         self.sharding_rules = None  # per-stage tables live on the plan
         self.opt_sharding_rules = None
 
@@ -192,13 +212,22 @@ class MPMDPipelinedModel:
     # -------------------------------------------------------------- programs
     def _stage_forward_fn(self, k: int):
         """Pure stage-k forward over its `build_stage_tree` params: prelude on
-        stage 0, that stage's layers, tail (-> logits) on the last stage."""
+        stage 0, that stage's layers, tail (-> logits) on the last stage.
+        Under autocast, params and the floating carry cast to compute_dtype
+        at entry (the cast lives INSIDE the vjp in the backward programs, so
+        grads come back in the master param dtype)."""
+        from ..modeling import _cast_floating
+
         layered = self.layered
         idxs = tuple(self.plan.stage_plan.stage_layers(k))
         has_prelude = k == 0
         has_tail = k == self.num_stages - 1
+        compute_dtype = self.compute_dtype if self.autocast_enabled else None
 
         def fwd(stage_params, x):
+            if compute_dtype is not None:
+                stage_params = _cast_floating(stage_params, compute_dtype)
+                x = _cast_floating(x, compute_dtype)
             carry = layered.apply_prelude(stage_params["prelude"], *x) if has_prelude else x
             for i in idxs:
                 carry = layered.apply_layer(stage_params[f"layer_{i}"], carry)
@@ -256,6 +285,22 @@ class MPMDPipelinedModel:
 
         def split(tree):
             rows = jax.tree_util.tree_leaves(tree)[0].shape[0]
+            # Shapes are static under trace, so this raises at (re)trace time —
+            # BEFORE any wrong program runs. A silent `rows // M` here would
+            # drop the remainder rows from every step (rows % M != 0) or feed
+            # zero-row microbatches (rows < M: loss_sum=0, weight=0 — a no-op
+            # step), i.e. wrong gradients with no error.
+            if rows < M or rows % M != 0:
+                raise ValueError(
+                    f"global batch of {rows} rows is not divisible into the "
+                    f"plan's num_microbatches={M} (plan was sized for a global "
+                    f"batch of {M * self.plan.workload.batch}). Feed a batch whose "
+                    f"leading dim is a multiple of {M}, or rebuild the plan "
+                    "for the real batch size — Accelerator.prepare derives it "
+                    "from a dataloader prepared in the same call, and "
+                    "prepare_mpmd_pipeline takes batch=/num_microbatches= "
+                    "directly."
+                )
             step = rows // M
             out = []
             for m in range(M):
@@ -304,7 +349,7 @@ class MPMDPipelinedModel:
             # XLA-chosen output sharding would silently recompile call #2.
             return jax.lax.with_sharding_constraint(new_acc, acc_shardings), g_in
 
-        return jax.jit(bwd, donate_argnums=(4,))
+        return jax.jit(bwd, donate_argnums=_donate(4))
 
     def _make_last(self, spec):
         """The last stage's fused forward+loss+backward: layers -> tail ->
@@ -328,7 +373,7 @@ class MPMDPipelinedModel:
             new_acc = jax.lax.with_sharding_constraint(new_acc, acc_shardings)
             return loss_sum, weight, new_acc, g_in
 
-        return jax.jit(last, donate_argnums=(4,))
+        return jax.jit(last, donate_argnums=_donate(4))
 
     def _make_bwd_first(self):
         """Stage 0's backward: recompute prelude+layers from the saved batch
@@ -347,7 +392,7 @@ class MPMDPipelinedModel:
             new_acc = jax.tree_util.tree_map(jax.numpy.add, acc, grads)
             return jax.lax.with_sharding_constraint(new_acc, acc_shardings)
 
-        return jax.jit(bwd, donate_argnums=(3,))
+        return jax.jit(bwd, donate_argnums=_donate(3))
 
     def _ensure_bwd(self, k: int, spec):
         """Backward program for stage k, compiled against ``spec`` (the carry's
@@ -410,7 +455,7 @@ class MPMDPipelinedModel:
 
         return jax.jit(
             upd,
-            donate_argnums=(0, 1, 2),
+            donate_argnums=_donate(0, 1, 2),
             out_shardings=(self._param_shardings[k], self._opt_shardings[k]),
         )
 
@@ -637,22 +682,29 @@ class MPMDPipelinedModel:
             for leaf in jax.tree_util.tree_leaves(tree)
         )
 
+    def _ensure_eval_fwd(self, k: int):
+        """Eval forward for stage k — DISTINCT program names from the training
+        fwd{k}s on purpose: eval pushes the FULL batch where training pushes
+        microbatch shapes, and sharing the function object would add a second
+        cache entry per stage (breaking the compiled-once audit and reading
+        as a recompile under an armed TraceGuard when eval interleaves with
+        training)."""
+        name = f"eval_fwd{k}"
+        if name not in self._jitted:
+            import jax
+
+            self._jitted[name] = jax.jit(self._stage_forward_fn(k))
+        return self._jitted[name]
+
     def __call__(self, batch):
         """Forward-only over the pipeline (eval view): full batch through every
         stage, logits returned from the last stage's mesh."""
         args = self.batch_to_args(batch)
-        carry = self._jitted["fwd0"](self.stage_params[0], self._ship(args, self.submeshes[0]))
-        for k in range(1, self.num_stages - 1):
+        carry = self._ensure_eval_fwd(0)(self.stage_params[0], self._ship(args, self.submeshes[0]))
+        for k in range(1, self.num_stages):
             carry = self._ship(carry, self.submeshes[k])
-            carry = self._jitted[f"fwd{k}"](self.stage_params[k], carry)
-        last = self.num_stages - 1
-        carry = self._ship(carry, self.submeshes[last])
-        name = f"fwd{last}"
-        if name not in self._jitted:
-            import jax
-
-            self._jitted[name] = jax.jit(self._stage_forward_fn(last))
-        return self._jitted[name](self.stage_params[last], carry)
+            carry = self._ensure_eval_fwd(k)(self.stage_params[k], carry)
+        return carry
 
 
 def prepare_mpmd_pipeline(
@@ -666,6 +718,8 @@ def prepare_mpmd_pipeline(
     num_microbatches: Optional[int] = None,
     logits_loss: Optional[Callable] = None,
     batch_to_args: Optional[Callable] = None,
+    compute_dtype=None,
+    autocast: bool = True,
 ) -> MPMDPipelinedModel:
     """Plan (if needed) and build the MPMD pipeline executor for ``model``.
 
@@ -701,4 +755,6 @@ def prepare_mpmd_pipeline(
         plan,
         logits_loss=logits_loss,
         batch_to_args=batch_to_args,
+        compute_dtype=compute_dtype,
+        autocast=autocast,
     )

@@ -411,6 +411,190 @@ def test_plan_cli_refine_times_train_step(capsys):
     assert all(s > 0 for s in seconds)
 
 
+# --------------------------------------------------- review regression pins
+@needs_mesh8
+def test_train_step_rejects_batch_indivisible_by_microbatches():
+    """A global batch that isn't a multiple of the plan's num_microbatches
+    must FAIL, not silently drop the remainder rows (rows % M != 0) or run
+    zero-row microbatches (rows < M: loss_sum=0, weight=0 — a no-op step
+    with no error)."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.parallel.sharding import data_spec
+    from accelerate_tpu.utils import ParallelismConfig, set_seed
+    from jax.sharding import NamedSharding
+
+    _reset_state()
+    set_seed(0)
+    bundle = create_llama_model(_llama5(), seq_len=SEQ)
+    bundle.sharding_rules = "auto"
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(data=2, model=2, pipeline=2)
+    )
+    model, _ = accelerator.prepare(bundle, optax.adam(1e-3))
+    M = model.num_microbatches
+    assert M > 1  # the guard below must actually bite
+
+    rng = np.random.default_rng(0)
+    sharding = NamedSharding(accelerator.mesh, data_spec(accelerator.mesh))
+    step_fn = accelerator.train_step()
+
+    def batch_of(rows):
+        return jax.device_put(
+            {"input_ids": rng.integers(0, 256, (rows, SEQ)).astype(np.int32)}, sharding
+        )
+
+    with pytest.raises(ValueError, match="num_microbatches"):
+        step_fn(batch_of(M + 2))  # rows % M != 0: would drop rows
+    with pytest.raises(ValueError, match="num_microbatches"):
+        step_fn(batch_of(2))  # rows < M: would run empty microbatches
+
+
+@needs_mesh8
+def test_prepare_sizes_microbatches_from_coprepared_dataloader():
+    """prepare(model, opt, dataloader) peeks at the loader's batch size BEFORE
+    planning, so the MPMD microbatch schedule divides the batch the user will
+    actually feed — not the hardcoded planning default of 8."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import BatchSampler, SimpleDataLoader
+    from accelerate_tpu.parallel.sharding import data_spec
+    from accelerate_tpu.utils import ParallelismConfig, set_seed
+    from jax.sharding import NamedSharding
+
+    _reset_state()
+    set_seed(0)
+    bundle = create_llama_model(_llama5(), seq_len=SEQ)
+    bundle.sharding_rules = "auto"
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(data=2, model=2, pipeline=2)
+    )
+    rng = np.random.default_rng(0)
+    rows = 12  # NOT a multiple of the old hardcoded planning batch's M=4
+    dataset = [
+        {"input_ids": rng.integers(0, 256, (SEQ,)).astype(np.int32)} for _ in range(rows * 2)
+    ]
+    loader = SimpleDataLoader(dataset, BatchSampler(range(len(dataset)), batch_size=rows))
+    model, _, _ = accelerator.prepare(bundle, optax.adam(1e-3), loader)
+
+    # workload.batch is the per-microbatch size; M * it is the planned global batch.
+    assert model.num_microbatches * model.plan.workload.batch == rows
+    assert rows % model.num_microbatches == 0
+    sharding = NamedSharding(accelerator.mesh, data_spec(accelerator.mesh))
+    batch = jax.device_put(
+        {"input_ids": rng.integers(0, 256, (rows, SEQ)).astype(np.int32)}, sharding
+    )
+    step_fn = accelerator.train_step()
+    assert np.isfinite(float(step_fn(batch)))
+
+
+@needs_mesh8
+def test_eval_forward_keeps_training_programs_compiled_once():
+    """Eval pushes the FULL batch while training pushes microbatch shapes —
+    the eval path must use its own eval_fwd{k} programs, or every shared
+    fwd{k} grows a second cache entry (breaking the compiled-once audit and
+    reading as recompiles under an armed TraceGuard)."""
+    from accelerate_tpu.analysis import TraceGuard
+    from accelerate_tpu.parallel.sharding import data_spec
+    from jax.sharding import NamedSharding
+
+    losses, model, accelerator, _ = _run_training("llama", "3d", steps=1)
+    rng = np.random.default_rng(1)
+    sharding = NamedSharding(accelerator.mesh, data_spec(accelerator.mesh))
+    batch = jax.device_put(
+        {"input_ids": rng.integers(0, 256, (BATCH, SEQ)).astype(np.int32)}, sharding
+    )
+    logits = model(batch)  # compiles eval_fwd{k}, shapes now warm
+    assert logits.shape[0] == BATCH
+
+    guard = TraceGuard(name="mpmd-eval-interleave", on_violation="record")
+    step_fn = accelerator.train_step()
+    with guard:
+        step_fn(batch)
+        out = model(batch)  # eval interleaved with training
+        jax.block_until_ready(out)
+    assert guard.total_recompiles == 0, guard.report().summary()
+
+    counts = model.compiled_program_counts()
+    assert any(name.startswith("eval_fwd") for name in counts), counts
+    assert all(n == 1 for n in counts.values()), counts
+
+
+@needs_mesh8
+def test_optimizer_single_mesh_surface_rejected_on_mpmd():
+    """The wrapper holds NO single-mesh opt_state on the MPMD route (it lives
+    per stage, owned by the model) — step()/clipping/state accessors must
+    raise the clear pointer at Accelerator.train_step(), not fail deep inside
+    the update machinery on opt_state=None."""
+    _, _, accelerator, _ = _run_training("llama", "3d", steps=1)
+    (opt,) = accelerator._optimizers
+    assert opt.is_mpmd and opt.opt_state is None
+    for call in (
+        opt.step,
+        lambda: opt.accumulate_grads({}),
+        lambda: opt.clip_grad_norm_(1.0),
+        lambda: opt.clip_grad_value_(1.0),
+        opt.state_dict,
+        lambda: opt.load_state_dict({}),
+        lambda: opt.set_learning_rate(1e-4),
+    ):
+        with pytest.raises(NotImplementedError, match="train_step"):
+            call()
+
+
+@needs_mesh8
+def test_prepare_mpmd_threads_bf16_and_rejects_fsdp():
+    """Accelerator settings the 2D route honors must not be dropped silently:
+    mixed_precision='bf16' threads compute_dtype into the stage programs (the
+    step runs and params stay full precision), and an fsdp_plugin — which has
+    no per-stage twin — is rejected loudly at prepare time."""
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.parallel.sharding import data_spec
+    from accelerate_tpu.utils import (
+        FullyShardedDataParallelPlugin,
+        ParallelismConfig,
+        set_seed,
+    )
+    from jax.sharding import NamedSharding
+
+    _reset_state()
+    set_seed(0)
+    bundle = create_llama_model(_llama5(), seq_len=SEQ)
+    bundle.sharding_rules = "auto"
+    accelerator = Accelerator(
+        mixed_precision="bf16",
+        parallelism_config=ParallelismConfig(data=2, model=2, pipeline=2),
+    )
+    model, _ = accelerator.prepare(bundle, optax.adam(1e-3))
+    assert model.autocast_enabled and model.compute_dtype == jnp.bfloat16
+    rng = np.random.default_rng(0)
+    sharding = NamedSharding(accelerator.mesh, data_spec(accelerator.mesh))
+    batch = jax.device_put(
+        {"input_ids": rng.integers(0, 256, (BATCH, SEQ)).astype(np.int32)}, sharding
+    )
+    step_fn = accelerator.train_step()
+    assert np.isfinite(float(step_fn(batch)))
+    # Master params stay full precision; only the stage compute casts.
+    leaves = jax.tree_util.tree_leaves(model.stage_params[0])
+    assert all(l.dtype != jnp.bfloat16 for l in leaves if jnp.issubdtype(l.dtype, jnp.floating))
+
+    _reset_state()
+    set_seed(0)
+    bundle = create_llama_model(_llama5(), seq_len=SEQ)
+    bundle.sharding_rules = "auto"
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(data=2, model=2, pipeline=2),
+        fsdp_plugin=FullyShardedDataParallelPlugin(min_num_params=1),
+    )
+    with pytest.raises(NotImplementedError, match="fsdp"):
+        accelerator.prepare(bundle, optax.adam(1e-3))
+
+
 def test_plan_cli_pipeline_refine_rejected():
     """--refine-top-k times single-mesh plans; combining it with a pipeline
     mesh points at the bench A/B instead of silently measuring nothing."""
