@@ -682,6 +682,7 @@ class _ModuleChecker:
         self._check_replicated_optimizer_state()
         self._check_host_hop_in_stage_handoff()
         self._check_worker_loop()
+        self._check_unbounded_reconnect()
         self._check_quantization()
         self._check_dead_partition_rule()
         return self.findings
@@ -801,6 +802,91 @@ class _ModuleChecker:
                         "on a hung peer — bound every looped IPC recv so the "
                         "heartbeat machinery can observe the hang",
                     )
+
+    # -- socket transports (TPU122) ----------------------------------------------
+    #: Socket receive methods that block forever on an unarmed socket.
+    _SOCKET_RECV_METHODS = {"recv", "recv_into"}
+
+    def _check_unbounded_reconnect(self):
+        """TPU122: a socket-transport protocol path is only as healthy as its
+        worst-case wait. Flags, in jit-adjacent modules that import `socket`:
+        (a) `socket.create_connection` dialed with no (or a None) `timeout=` —
+        the connect hangs on a partitioned peer for the kernel's default,
+        minutes, not the transport's budget; (b) a looped `.recv`/`.recv_into`
+        with no `timeout_s=` in a module that never arms a non-None
+        `settimeout` — the read blocks forever on a half-open link; (c) a
+        `.reconnect(...)` driven from a loop with no `timeout_s=` — the retry
+        loop has neither a per-attempt bound nor (visibly) a deadline budget,
+        so a dead peer hot-loops the dial instead of escalating."""
+        if not self.index.imports_jax:
+            return
+        imports_socket = any(
+            isinstance(node, ast.Import)
+            and any(alias.name == "socket" for alias in node.names)
+            for node in ast.walk(self.index.tree)
+        )
+        if not imports_socket:
+            return
+        #: Any non-None settimeout anywhere in the module counts as "the
+        #: module arms read deadlines" — the bound need not be adjacent to
+        #: the recv (select-based framing passes the deadline separately).
+        arms_settimeout = any(
+            isinstance(node, ast.Call)
+            and self._call_name(node.func) == "settimeout"
+            and node.args
+            and not (
+                isinstance(node.args[0], ast.Constant)
+                and node.args[0].value is None
+            )
+            for node in ast.walk(self.index.tree)
+        )
+        for node in ast.walk(self.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._call_name(node.func)
+            kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            if name == "create_connection":
+                timeout = kwargs.get("timeout")
+                if "timeout" not in kwargs or (
+                    isinstance(timeout, ast.Constant) and timeout.value is None
+                ):
+                    self.emit(
+                        node,
+                        "TPU122",
+                        "socket.create_connection(...) without timeout= waits "
+                        "out the kernel's connect default on a partitioned peer "
+                        "— dial under the transport's own deadline budget",
+                    )
+            elif (
+                name in self._SOCKET_RECV_METHODS
+                and isinstance(node.func, ast.Attribute)
+                and _enclosing_loop(node) is not None
+                and "timeout_s" not in kwargs
+                and not arms_settimeout
+            ):
+                self.emit(
+                    node,
+                    "TPU122",
+                    f".{name}(...) inside a loop on a socket that was never "
+                    "given a deadline (no settimeout, no timeout_s) blocks "
+                    "forever on a half-open link — arm a read deadline so the "
+                    "health machinery can observe the hang",
+                )
+            elif (
+                name == "reconnect"
+                and isinstance(node.func, ast.Attribute)
+                and _enclosing_loop(node) is not None
+                and "timeout_s" not in kwargs
+            ):
+                self.emit(
+                    node,
+                    "TPU122",
+                    ".reconnect(...) retried in a loop with no timeout_s bound "
+                    "per attempt hot-loops the dial against a dead peer — give "
+                    "each attempt a deadline and budget the loop "
+                    "(reconnect_deadline_s) so exhaustion escalates to the "
+                    "respawn path",
+                )
 
     # -- serving-engine construction (TPU114) -----------------------------------
     #: Serving front-end constructors whose robustness knobs this rule audits.

@@ -280,6 +280,27 @@ RULES = (
         "so any host round-trip that does sneak in fails loudly instead of "
         "silently flattening the pipeline",
     ),
+    Rule(
+        id="TPU122",
+        slug="unbounded-reconnect",
+        severity="warn",
+        summary="a serving-transport module reconnects or reads the wire "
+        "without a bound — socket.create_connection with no timeout, a "
+        "recv loop on a socket that was never given a deadline, or a "
+        "reconnect retried in a loop with neither a backoff cap nor a "
+        "deadline budget — one partitioned peer then hangs the controller "
+        "(or hot-loops the dial) instead of surfacing a transport fault "
+        "the fleet can route around",
+        fixit="bound every wire wait: dial with "
+        "socket.create_connection(addr, timeout=...), arm a deadline before "
+        "protocol reads (settimeout, or select-based framing like "
+        "worker.recv_frame's timeout_s), and drive reconnect attempts "
+        "through a budgeted state machine — capped exponential backoff plus "
+        "a reconnect_deadline_s that escalates to the worker-death/respawn "
+        "path when exhausted (worker.SubprocessEngine is the reference "
+        "shape: reconnect(timeout_s=...) per attempt, never a bare retry "
+        "loop)",
+    ),
 )
 
 RULES_BY_ID = {r.id: r for r in RULES}

@@ -456,6 +456,117 @@ class RouterInjector:
         return self
 
 
+class _ChaosTransport:
+    """Wraps one subprocess engine's frame transport so network faults land at
+    the exact seam real ones do — between the proxy and the socket. While a
+    partition window is open every frame (both directions) raises `WorkerGone`
+    and `reconnect` refuses with `ConnectionError`; when the window heals, the
+    next reconnect goes through to the real transport's re-handshake. The
+    wrapped transport keeps its full surface (pid/alive/kill/close/sever pass
+    through), so the engine proxy cannot tell chaos from a real flaky link."""
+
+    def __init__(self, inner, session: ChaosSession, token: str):
+        self._inner = inner
+        self._session = session
+        self._token = token
+        self._down_until = 0.0
+
+    def _now(self) -> float:
+        return self._session.clock.monotonic()
+
+    def _check_down(self, op):
+        if self._now() < self._down_until:
+            from ..worker import WorkerGone
+
+            raise WorkerGone(
+                f"chaos: link to {self._token} is partitioned "
+                f"[peer={self._token} op={op}]"
+            )
+
+    def _open_partition(self, window_s: float):
+        self._down_until = max(self._down_until, self._now() + float(window_s))
+        sever = getattr(self._inner, "sever", None)
+        if sever is not None:
+            sever()
+
+    def send(self, obj):
+        from ..worker import FrameTimeout, WorkerGone
+
+        op = obj.get("op") if isinstance(obj, dict) else None
+        self._check_down(op)
+        fired = False
+        for ev in self._session.fire("net.partition", path=self._token):
+            self._open_partition(ev.args.get("window_s", 0.5))
+            fired = True
+        for ev in self._session.fire("net.flap", path=self._token):
+            self._open_partition(ev.args.get("window_s", 0.1))
+            fired = True
+        if fired:
+            raise WorkerGone(
+                f"chaos: partitioned link to {self._token} "
+                f"[peer={self._token} op={op}]"
+            )
+        for _ev in self._session.fire("net.slow", path=self._token):
+            raise FrameTimeout(
+                f"chaos: injected latency pushed the frame past its deadline "
+                f"[peer={self._token} op={op}]"
+            )
+        return self._inner.send(obj)
+
+    def recv(self, timeout_s):
+        self._check_down(None)
+        return self._inner.recv(timeout_s=timeout_s)
+
+    def reconnect(self, timeout_s):
+        if self._now() < self._down_until:
+            raise ConnectionError(
+                f"chaos: link to {self._token} is still partitioned "
+                f"({self._down_until - self._now():.3f}s left in the window)"
+            )
+        return self._inner.reconnect(timeout_s=timeout_s)
+
+    def sever(self):
+        sever = getattr(self._inner, "sever", None)
+        if sever is not None:
+            sever()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TransportInjector:
+    """Network chaos on a socket fleet: wraps every subprocess replica's
+    transport in `_ChaosTransport`, identifying the worker through the `path`
+    trigger channel (``path_pattern: "worker_0"``; `at_call` counts that
+    worker's frame sends). Re-arms through `on_engine_built` so a respawned
+    worker's fresh transport is chaos-visible again.
+
+      - ``net.partition`` — sever the link for args.window_s (reconnect must
+        heal it; only a window past the engine's reconnect_deadline_s may
+        escalate to respawn)
+      - ``net.slow``      — a frame send raises FrameTimeout (latency past the
+        deadline: the slow-network face of the same transport fault)
+      - ``net.flap``      — repeated short partitions (times=N for N flaps)
+    """
+
+    def __init__(self, session: ChaosSession):
+        self.session = session
+
+    def arm(self, router) -> "TransportInjector":
+        session = self.session
+
+        def wrap(index, engine):
+            transport = getattr(engine, "transport", None)
+            if transport is None or isinstance(transport, _ChaosTransport):
+                return
+            engine.transport = _ChaosTransport(transport, session, f"worker_{index}")
+
+        for replica in router.replica_set.replicas:
+            wrap(replica.index, replica.engine)
+        router.replica_set.on_engine_built.append(wrap)
+        return self
+
+
 def _consume_donated_state(engine):
     """Model the accelerator-only half of a dispatch failure: a program that
     started executing CONSUMES its donated operands even when it fails, leaving

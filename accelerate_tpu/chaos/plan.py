@@ -99,6 +99,28 @@ FAULT_KINDS: Dict[str, str] = {
         "a death (heartbeat expiry -> kill -> eject -> respawn); target via path_pattern "
         "'worker_N'"
     ),
+    "net.partition": (
+        "drop one worker's socket transport for args.window_s seconds (default 0.5): the "
+        "link severs, every frame raises until the window heals, then reconnect must "
+        "succeed — a healed partition is a RECONNECT (controller re-handshake + stream "
+        "reconciliation), never a worker respawn; a window longer than the controller's "
+        "reconnect_deadline_s escalates to the ordinary warm respawn path. Target via "
+        "path_pattern 'worker_N' (at_call counts that worker's frame sends); socket "
+        "fleets only (run_fleet transport='socket')"
+    ),
+    "net.slow": (
+        "inject latency past the frame deadline on one worker's transport: a matching "
+        "frame send raises FrameTimeout (the slow-network face of a partition — the "
+        "frames are fine, the deadline is not); the controller must treat it exactly "
+        "like a torn link (reconnect, not respawn). Target via path_pattern 'worker_N'; "
+        "socket fleets only"
+    ),
+    "net.flap": (
+        "repeated short partitions: each firing severs the link for args.window_s "
+        "seconds (default 0.1); set times=N for N flaps. Every flap must heal via "
+        "reconnect with streams intact — the flap count reconciles against the worker's "
+        "re-registration journal. Target via path_pattern 'worker_N'; socket fleets only"
+    ),
     "harness.disable_verification": (
         "seeded-regression fixture: neuter checkpoint digest verification so torn checkpoints "
         "resolve — the invariant report MUST go red (proves the harness detects regressions)"
@@ -314,6 +336,31 @@ def builtin_plans() -> Dict[str, FaultPlan]:
                 FaultEvent(kind="fleet.worker_kill", path_pattern="worker_0", at_call=4),
                 FaultEvent(kind="fleet.worker_stall", path_pattern="worker_1", at_call=6,
                            args={"delay_s": 30.0}),
+            ],
+        ),
+        "partition-fleet": FaultPlan(
+            name="partition-fleet",
+            seed=0,
+            workload="fleet",
+            notes="network-chaos chain over a SOCKET fleet (run with transport='socket'): a "
+            "queue burst spreads load, one worker's link partitions for a healable window "
+            "(reconnect + stream reconciliation, NOT respawn), another's frames slow past "
+            "the deadline (must surface as the same transport fault), and a third flaps "
+            "twice — every request must reach a terminal finish reason, no stream may "
+            "duplicate across reconnects, healed partitions must not increment respawn "
+            "counters, and the controller's reconnect ledger must reconcile against the "
+            "workers' re-registration journal",
+            events=[
+                FaultEvent(kind="serve.queue_burst", at_step=1, args={"count": 6}),
+                FaultEvent(kind="net.partition", path_pattern="worker_0", at_call=4,
+                           args={"window_s": 0.4}),
+                FaultEvent(kind="net.slow", path_pattern="worker_1", at_call=6),
+                # Two flaps as two events: at_call is an EXACT Nth-call match,
+                # so a single times=2 event could never fire its second flap.
+                FaultEvent(kind="net.flap", path_pattern="worker_0", at_call=12,
+                           args={"window_s": 0.1}),
+                FaultEvent(kind="net.flap", path_pattern="worker_0", at_call=18,
+                           args={"window_s": 0.1}),
             ],
         ),
         "seeded-regression": FaultPlan(

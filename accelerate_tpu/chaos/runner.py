@@ -1093,6 +1093,8 @@ class ChaosRunner:
         autoscale: bool = True,
         step_timeout_s: float = 15.0,
         workdir: Optional[str] = None,
+        transport: str = "pipe",
+        reconnect_deadline_s: float = 8.0,
     ) -> InvariantReport:
         """Out-of-process fleet workload: a `Router` over REAL subprocess
         engine workers (`worker.SubprocessEngine` via `make_subprocess_factory`)
@@ -1108,6 +1110,19 @@ class ChaosRunner:
             pressure scales the fleet up past its floor, and after the traffic
             drains the autoscaler retires the extra workers back to the floor.
 
+        With ``transport="socket"`` the workers serve over TCP and the plan may
+        carry ``net.*`` faults (injected controller-side at the transport seam
+        via `TransportInjector`), adding two network invariants:
+
+          - **reconnect_reconciles** — the controller's successful-reconnect
+            counters are fully accounted by the workers' re-registration
+            journal (every reconnect the controller counted, some worker
+            accepted under a bumped epoch);
+          - **partition_is_not_death** — a healed partition must NOT change any
+            worker's pid (reconnect, not respawn); only a partition window
+            past ``reconnect_deadline_s`` may escalate to the respawn path,
+            and then it MUST.
+
         Worker-side injections are journaled (append+fsync, BEFORE the kill
         lands) to a shared journal the ledger invariant reconciles against
         observed process deaths — and that restarted workers read back so a
@@ -1118,7 +1133,17 @@ class ChaosRunner:
         from ..router import ROUTER_FINISH_REASONS, Router
         from ..serving import QueueFull, Request
         from ..worker import CHAOS_JOURNAL_ENV, make_subprocess_factory
+        from .injectors import TransportInjector
         from .plan import FAULT_PLAN_ENV
+
+        net_kinds = ("net.partition", "net.slow", "net.flap")
+        net_events = [ev for ev in self.plan.events if ev.kind in net_kinds]
+        if net_events and transport != "socket":
+            raise ValueError(
+                "net.* faults inject at the socket-transport seam: run the "
+                "fleet workload with transport='socket' (the pipe transport "
+                "has no reconnectable link to partition)"
+            )
 
         cfg = LlamaConfig(
             vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
@@ -1140,6 +1165,10 @@ class ChaosRunner:
                 max_queue=max_queue, paged=True, page_size=4,
             ),
             workdir=workdir, env=worker_env, step_timeout_s=step_timeout_s,
+            transport=transport,
+            reconnect_deadline_s=(
+                reconnect_deadline_s if transport == "socket" else None
+            ),
         )
         router = Router(
             model, replicas=replicas, max_queue=max_queue, default_deadline_s=120.0,
@@ -1153,6 +1182,11 @@ class ChaosRunner:
                 idle_retire_s=0.05,
             ) if autoscale else {}),
         )
+        if net_events:
+            # Net faults damage the controller-side transport seam (sever the
+            # link, delay/tear frames) — arm the wrapper on every engine the
+            # router builds, including respawns.
+            TransportInjector(self.session).arm(router)
         rng = np.random.default_rng(self.plan.seed)
 
         next_id = 0
@@ -1195,7 +1229,17 @@ class ChaosRunner:
         planned_faults = sum(
             max(ev.times, 1) for ev in self.plan.events if ev.kind in fleet_kinds
         )
-        fault_planned = planned_faults > 0
+        planned_net = sum(max(ev.times, 1) for ev in net_events)
+        #: A partition/flap window longer than the reconnect budget MUST
+        #: escalate to the respawn path; anything shorter must heal in place.
+        _net_windows = {"net.partition": 0.5, "net.flap": 0.1}
+        escalation_expected = any(
+            ev.kind in _net_windows
+            and float(ev.args.get("window_s", _net_windows[ev.kind]))
+            > reconnect_deadline_s
+            for ev in net_events
+        )
+        fault_planned = (planned_faults + planned_net) > 0
         recovery_probes = 3 if fault_planned else 0
         #: Worker faults fire IN the workers (env-propagated plan, their own
         #: step-op call counts) and are journaled BEFORE the damage lands, so
@@ -1205,12 +1249,19 @@ class ChaosRunner:
         #: (bounded) until it says so; a sweep that never exercised its
         #: faults must go red, not green.
         hard_cap = max(num_requests * 8, num_requests + 32)
+        planned_total = planned_faults + planned_net
 
         def faults_landed() -> int:
-            return sum(
+            # Worker faults land in the worker journal; net faults fire
+            # controller-side at the transport seam and land in the session's
+            # own injection counters.
+            worker_side = sum(
                 1 for e in self._read_fleet_journal(journal_path)
                 if e.get("kind") in fleet_kinds
             )
+            counts = self.session.counts()
+            net_side = sum(counts.get(kind, 0) for kind in net_kinds)
+            return worker_side + net_side
 
         probes_sent = 0
         faults_before = 0
@@ -1220,7 +1271,7 @@ class ChaosRunner:
         while (
             len(accepted) < num_requests
             or router.pending
-            or (fault_planned and faults_landed() < planned_faults
+            or (fault_planned and faults_landed() < planned_total
                 and len(accepted) < hard_cap)
             or (first_id_after_fault is not None and probes_sent < recovery_probes)
         ):
@@ -1230,7 +1281,7 @@ class ChaosRunner:
             if len(accepted) < num_requests:
                 submit_one()
             elif (
-                fault_planned and faults_landed() < planned_faults
+                fault_planned and faults_landed() < planned_total
                 and len(accepted) < hard_cap
             ):
                 submit_one()  # sustain pressure until every planned fault lands
@@ -1278,6 +1329,12 @@ class ChaosRunner:
         routing_log = list(router.routing_log)
         state_log = list(router.replica_set.state_log)
         retries_counter = int(router.stats["retries"])
+        # Successful reconnects live in the registry (memoized per replica
+        # label), so the count survives engine rebuilds mid-sweep.
+        reconnects_total = int(sum(
+            inst.value for inst in self.session.registry.instruments()
+            if inst.name == "router_reconnects_total"
+        ))
         router.close()
 
         journal = self._read_fleet_journal(journal_path)
@@ -1315,12 +1372,28 @@ class ChaosRunner:
                 finish_reasons, first_id_after_fault, recovery_states, fault_planned
             ),
             self._check_no_route_to_ejected(routing_log, state_log),
-            self._check_worker_restart_warm(pids_seen, journal, fault_planned),
+            # A healed partition must not demand a death, so only worker-side
+            # fleet faults (or a partition past the reconnect budget) put the
+            # warm-restart check into its strict deaths>=1 mode.
+            self._check_worker_restart_warm(
+                pids_seen, journal, planned_faults > 0 or escalation_expected
+            ),
             self._check_fleet_ledger(
                 journal, pids_seen, routing_log, retries_counter, accepted,
                 finish_reasons, planned_faults,
             ),
         ]
+        if net_events:
+            checks.append(self._check_reconnect_reconciles(
+                reconnects_total, journal, planned_net,
+                escalation_expected=escalation_expected,
+            ))
+            checks.append(self._check_partition_not_death(
+                pids_seen, journal, reconnects_total,
+                escalation_expected=escalation_expected,
+                fleet_planned=planned_faults > 0,
+                reconnect_deadline_s=reconnect_deadline_s,
+            ))
         if autoscale:
             checks.append(InvariantCheck(
                 "autoscaler_converges",
@@ -1382,6 +1455,81 @@ class ChaosRunner:
                     i: [pid for pid, _warm in seen] for i, seen in pids_seen.items()
                 },
                 "journaled_faults": len(journal),
+            },
+        )
+
+    @staticmethod
+    def _check_reconnect_reconciles(
+        reconnects_total: int,
+        journal: List[dict],
+        planned_net: int,
+        *,
+        escalation_expected: bool = False,
+    ) -> InvariantCheck:
+        """Controller reconnect counters must reconcile against the workers'
+        re-registration journal: every reconnect the controller counted was a
+        registration some worker accepted under a bumped epoch (journaled
+        worker-side as ``net.reregister`` before the ready frame goes out).
+        The journal may run AHEAD of the counter — a handshake that lands but
+        tears again during stream reconciliation is journaled by the worker
+        yet never counted by the controller — but it can never run behind.
+        And unless every planned net fault was an escalation (a window past
+        the reconnect budget, where respawn — not reconnect — is the correct
+        outcome), at least one reconnect must actually have happened."""
+        reregisters = sum(1 for e in journal if e.get("kind") == "net.reregister")
+        return InvariantCheck(
+            "reconnect_reconciles",
+            passed=(
+                reregisters >= reconnects_total
+                and (reconnects_total >= 1 or escalation_expected)
+            ),
+            details={
+                "controller_reconnects": reconnects_total,
+                "journaled_reregisters": reregisters,
+                "planned_net_faults": planned_net,
+                "escalation_expected": escalation_expected,
+            },
+        )
+
+    @staticmethod
+    def _check_partition_not_death(
+        pids_seen: Dict[int, List[tuple]],
+        journal: List[dict],
+        reconnects_total: int,
+        *,
+        escalation_expected: bool,
+        fleet_planned: bool,
+        reconnect_deadline_s: float,
+    ) -> InvariantCheck:
+        """A healed partition must NOT change any worker's pid: the link
+        reconnects and the stream resumes, the process is never respawned.
+        Deaths caused by the plan's own worker-side faults (kills, stalls the
+        step timeout escalates) are subtracted out; whatever remains is
+        attributable to the network — and must be zero unless some partition
+        window exceeded ``reconnect_deadline_s``, in which case the budget
+        MUST have escalated to at least one respawn."""
+        deaths = sum(max(len(v) - 1, 0) for v in pids_seen.values())
+        fleet_deaths_budget = sum(
+            1 for e in journal
+            if e.get("kind") in ("fleet.worker_kill", "fleet.worker_stall")
+        )
+        net_deaths = deaths if not fleet_planned else max(
+            0, deaths - fleet_deaths_budget
+        )
+        passed = net_deaths >= 1 if escalation_expected else net_deaths == 0
+        return InvariantCheck(
+            "partition_is_not_death",
+            passed=passed,
+            details={
+                "observed_deaths": deaths,
+                "fleet_fault_deaths_budget": fleet_deaths_budget,
+                "net_attributed_deaths": net_deaths,
+                "escalation_expected": escalation_expected,
+                "reconnect_deadline_s": reconnect_deadline_s,
+                "controller_reconnects": reconnects_total,
+                "pids_per_replica": {
+                    i: [pid for pid, _warm in seen] for i, seen in pids_seen.items()
+                },
             },
         )
 

@@ -12,7 +12,8 @@ Subcommands (exit codes mirror `analyze`'s CI contract):
     does.
 
 ``--plan`` takes a JSON plan file or a builtin name (``smoke-train``,
-``smoke-serve``, ``smoke-router``, ``smoke-fleet``, ``seeded-regression``). The seeded-regression fixture MUST
+``smoke-serve``, ``smoke-router``, ``smoke-fleet``, ``partition-fleet``,
+``seeded-regression``). The seeded-regression fixture MUST
 exit non-zero: it scripts a broken digest layer, and a green report there means
 the harness can no longer detect regressions.
 """
@@ -62,6 +63,22 @@ def register_subcommand(subparsers):
     run.add_argument("--replicas", type=int, default=None,
                      help="Fleet size (default: 3 for the router workload, 2 subprocess "
                      "workers for the fleet workload)")
+    run.add_argument(
+        "--transport",
+        default=None,
+        choices=(None, "pipe", "socket"),
+        help="Fleet workload worker transport (default: socket when the plan "
+        "carries net.* faults, else pipe). net.* faults require socket — they "
+        "partition/delay the TCP link at the transport seam",
+    )
+    run.add_argument(
+        "--reconnect-deadline",
+        type=float,
+        default=8.0,
+        dest="reconnect_deadline_s",
+        help="Socket-fleet reconnect budget in seconds before a torn link "
+        "escalates to worker respawn (default: 8.0)",
+    )
     run.add_argument("--json", action="store_true", dest="as_json", help="Emit the report as JSON")
     run.add_argument("--report-out", default=None, help="Also save the report JSON to this path")
     run.set_defaults(func=chaos_run_command)
@@ -101,7 +118,7 @@ def _load_plan(spec: str):
 def _infer_workload(plan) -> str:
     if getattr(plan, "workload", None):
         return plan.workload
-    if any(ev.kind.startswith("fleet.") for ev in plan.events):
+    if any(ev.kind.startswith(("fleet.", "net.")) for ev in plan.events):
         return "fleet"
     if any(ev.kind.startswith("router.") for ev in plan.events):
         return "router"
@@ -124,8 +141,15 @@ def chaos_run_command(args):
             num_requests=args.requests, replicas=args.replicas or 3
         )
     elif workload == "fleet":
+        transport = args.transport
+        if transport is None:
+            transport = "socket" if any(
+                ev.kind.startswith("net.") for ev in plan.events
+            ) else "pipe"
         report = runner.run_fleet(
-            num_requests=args.requests, replicas=args.replicas or 2
+            num_requests=args.requests, replicas=args.replicas or 2,
+            transport=transport,
+            reconnect_deadline_s=args.reconnect_deadline_s,
         )
     else:
         # Default scratch dirs are cleaned up after the report is assembled

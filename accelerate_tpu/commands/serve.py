@@ -24,6 +24,13 @@ a normal terminal reason, 1 when any finished `error`/`replica_lost`/`timeout`.
 restart/rejoin; ``--min-replicas``/``--max-replicas`` arm the queue/TTFT
 autoscaler, and ``--hedge-quantile`` derives the hedge threshold from the
 live TTFT histogram (docs/serving.md "Out-of-process workers").
+
+``--transport socket`` carries the same worker frames over TCP with
+reconnect-with-backoff (a torn link reconnects and resumes streams; only an
+exhausted ``--reconnect-deadline`` budget respawns the worker), and
+``--connect HOST:PORT[,...]`` adopts externally launched listener workers
+(``python -m accelerate_tpu.worker --listen HOST:PORT``) — one replica per
+address (docs/serving.md "Socket transport").
 """
 
 from __future__ import annotations
@@ -70,6 +77,25 @@ def register_subcommand(subparsers):
         help="run each replica as a REAL subprocess engine worker "
         "(accelerate_tpu.worker IPC): process-level fault domains — a worker "
         "SIGKILL/hang ejects one replica, never the fleet",
+    )
+    parser.add_argument(
+        "--transport", default="pipe", choices=["pipe", "socket"],
+        help="out-of-process worker transport: 'pipe' = stdio frames on the "
+        "spawned child, 'socket' = the same frames over TCP loopback with "
+        "reconnect-with-backoff on torn links (a healed partition reconnects "
+        "and resumes streams instead of respawning the worker)",
+    )
+    parser.add_argument(
+        "--connect", default=None, metavar="HOST:PORT[,HOST:PORT...]",
+        help="adopt EXTERNALLY launched listener workers (python -m "
+        "accelerate_tpu.worker --listen HOST:PORT) instead of spawning: one "
+        "replica per address, socket transport implied; the model's params "
+        "path must be reachable on each worker's host (digest-verified)",
+    )
+    parser.add_argument(
+        "--reconnect-deadline", type=float, default=None, dest="reconnect_deadline_s",
+        help="socket-transport reconnect budget in seconds before a torn link "
+        "escalates to the worker-death/respawn path (default: 10.0)",
     )
     parser.add_argument(
         "--min-replicas", type=int, default=None,
@@ -179,6 +205,30 @@ def serve_command(args):
             file=sys.stderr,
         )
         raise SystemExit(2)
+    connect = (
+        [a.strip() for a in args.connect.split(",") if a.strip()]
+        if args.connect else None
+    )
+    if connect:
+        # Adopting external listeners IS the out-of-process socket path.
+        args.out_of_process = True
+        args.transport = "socket"
+        if args.replicas is None:
+            args.replicas = len(connect)
+    if args.transport == "socket" and not args.out_of_process:
+        print(
+            "accelerate-tpu serve: --transport socket needs worker processes — "
+            "pass --out-of-process (or --connect for external workers)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    worker_kwargs = {}
+    if args.out_of_process:
+        worker_kwargs["transport"] = args.transport
+        if connect:
+            worker_kwargs["connect"] = connect
+        if args.reconnect_deadline_s is not None:
+            worker_kwargs["reconnect_deadline_s"] = args.reconnect_deadline_s
     _fam, cfg = get_model_family(args.model)
     requests = _load_requests(args, cfg.vocab_size)
     if not requests:
@@ -200,6 +250,7 @@ def serve_command(args):
         min_replicas=args.min_replicas,
         max_replicas=args.max_replicas,
         out_of_process=args.out_of_process,
+        worker_kwargs=worker_kwargs or None,
         paged=not args.no_paged,
         weight_dtype=args.weight_dtype,
         kv_cache_dtype=args.kv_cache_dtype,
@@ -208,7 +259,8 @@ def serve_command(args):
     )
     print(
         f"[serve] model {args.model} | "
-        f"{'out-of-process, ' if args.out_of_process else ''}{router.num_replicas} replica(s) x "
+        f"{f'out-of-process ({args.transport}), ' if args.out_of_process else ''}"
+        f"{router.num_replicas} replica(s) x "
         f"{args.num_slots} slots, chunk {args.chunk_size}, cache {max_length}"
         + (f", tp {args.tp}" if args.tp > 1 else "")
         + f" | {len(requests)} request(s)",

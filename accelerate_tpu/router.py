@@ -117,7 +117,13 @@ ROUTER_FINISH_REASONS = FINISH_REASONS + ("replica_lost",)
 #: unroutable like `ejected`, but healthy and finishing its own work.
 #: `retired` is terminal: an autoscaler-removed replica — engine closed (a
 #: subprocess worker's process exits), never rejoins, never routed.
-REPLICA_STATES = ("live", "degraded", "ejected", "rejoining", "draining", "retired")
+#: `reconnecting` is the transport-fault state (socket fleets): the worker
+#: process is presumed alive but the link tore — unroutable while the engine
+#: proxy re-handshakes under its backoff budget; heals back to `live` on
+#: reconnect, escalates through the ordinary death path (WorkerGone ->
+#: eject/rebuild) only when the budget exhausts.
+REPLICA_STATES = ("live", "degraded", "ejected", "rejoining", "draining", "retired",
+                  "reconnecting")
 _STATE_CODE = {s: i for i, s in enumerate(REPLICA_STATES)}
 
 
@@ -234,7 +240,7 @@ class ReplicaSet:
         self._g_state[index] = self.registry.gauge(
             "router_replica_state",
             help="health state code (0=live 1=degraded 2=ejected 3=rejoining "
-            "4=draining 5=retired)",
+            "4=draining 5=retired 6=reconnecting)",
             labels={"replica": str(index)},
         )
         self._g_load[index] = self.registry.gauge(
@@ -314,6 +320,12 @@ class ReplicaSet:
         # just churn device_put round trips through every other group.
         if getattr(engine, "params", None) is not None and getattr(engine, "mesh", None) is None:
             self.current_params = engine.params
+        attach = getattr(engine, "attach_telemetry", None)
+        if attach is not None:
+            # Subprocess proxies report reconnects/frame errors/RTTs into the
+            # fleet's shared registry, labeled by replica index, and stitch
+            # their serve.reconnect spans into the fleet trace.
+            attach(self.registry, tracer=self.tracer, replica=index)
         for hook in self.on_engine_built:
             hook(index, engine)
         return engine
@@ -1254,7 +1266,15 @@ class Router:
         for replica in self.replica_set.replicas:
             if replica.dead or replica.state in ("ejected", "retired"):
                 continue
-            if not replica.engine.pending and replica.state not in ("rejoining", "degraded"):
+            if (
+                not replica.engine.pending
+                and not getattr(replica.engine, "reconnecting", False)
+                and replica.state not in ("rejoining", "degraded", "reconnecting")
+            ):
+                # (reconnecting must still be stepped even when idle: step()
+                # is what drives the engine proxy's reconnect attempts. The
+                # engine attribute is checked too — an idle engine can tear
+                # during a failed submit, before the router state catches up.)
                 replica.last_ok = self._clock()
                 continue
             t0 = self._clock()
@@ -1270,6 +1290,20 @@ class Router:
                 self.fail_replica(replica.index, reason=f"engine died: {exc!r}", dead=True)
                 continue
             events.extend(self._forward_events(replica, engine_events))
+            if getattr(replica.engine, "reconnecting", False):
+                # Transport fault, not death: park the replica unroutable and
+                # keep stepping it (each step drives one reconnect attempt).
+                # The health machine is bypassed — a reconnect in progress is
+                # neither a dispatch failure nor a hang — and budget
+                # exhaustion surfaces as WorkerGone from step() above,
+                # escalating through the ordinary fail_replica path.
+                self.replica_set.set_state(
+                    replica, "reconnecting", "transport tore — reconnect in progress"
+                )
+                replica.last_ok = self._clock()
+                continue
+            if replica.state == "reconnecting":
+                self.replica_set.set_state(replica, "live", "transport reconnected")
             errored = self._collect_finished(replica)
             self.replica_set.record_step(replica, self._clock() - t0, errored)
             if self.replica_set.heartbeat_expired(replica):

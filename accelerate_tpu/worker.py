@@ -42,7 +42,20 @@ Three layers, bottom up:
     the rebuild spawns a fresh warm process.
 
 Everything on the wire is host scalars and token ids; params move by file
-handoff (`save_pytree` -> path -> worker `load_pytree`), never through frames.
+handoff (`save_pytree` -> path -> worker `load_pytree`, digest-verified
+end-to-end), never through frames.
+
+PR 20 lifts the same frame protocol onto TCP sockets (`SocketTransport` +
+`python -m accelerate_tpu.worker --listen HOST:PORT`) and makes TRANSPORT
+failure a first-class fault distinct from worker death: a torn frame or missed
+deadline on a reconnectable transport parks the client proxy in a
+`reconnecting` state (capped exponential backoff + jitter, budgeted by
+`reconnect_deadline_s`), re-runs the registration handshake under a bumped
+epoch, and reconciles in-flight streams against the worker's retained
+per-request state — never-streamed requests re-dispatch, streamed requests
+resume from the retained tail or surface `finish_reason=replica_lost`. Only an
+exhausted reconnect budget escalates to the old behavior: `WorkerGone`, eject,
+respawn.
 """
 
 from __future__ import annotations
@@ -50,14 +63,16 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import random
 import select
 import signal
+import socket
 import struct
 import subprocess
 import sys
 import tempfile
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -87,6 +102,10 @@ DEFAULT_HEARTBEAT_S = 120.0
 #: distinguishing self-termination from a crash in supervision logs.
 ORPHANED_EXIT_CODE = 17
 
+#: Frame-protocol version carried in the socket registration handshake; a
+#: mismatched controller/worker pair is rejected before any op flows.
+PROTOCOL_VERSION = 1
+
 
 class FrameError(RuntimeError):
     """A malformed frame: oversized length prefix or undecodable payload (a
@@ -108,63 +127,105 @@ def _fileno(stream) -> int:
     return stream if isinstance(stream, int) else stream.fileno()
 
 
-def _read_exact(fd: int, n: int, deadline: Optional[float], what: str) -> bytes:
+def _frame_ctx(peer: Optional[str], op: Optional[str]) -> str:
+    """Diagnostic suffix naming the peer and the op in flight — a partition
+    post-mortem must say WHICH worker's WHICH request tore, not just that
+    bytes stopped."""
+    parts = []
+    if peer:
+        parts.append(f"peer={peer}")
+    if op:
+        parts.append(f"op={op}")
+    return f" [{' '.join(parts)}]" if parts else ""
+
+
+def _read_exact(fd: int, n: int, deadline: Optional[float], what: str,
+                ctx: str = "") -> bytes:
     """Read exactly `n` bytes from `fd`, honoring an absolute monotonic
     deadline. EOF before `n` bytes is a dead peer (`WorkerGone`) — torn frames
-    included; a deadline miss is `FrameTimeout`."""
+    included; a deadline miss is `FrameTimeout`. Every message carries the
+    bytes-read-so-far plus the peer/op context."""
     chunks: List[bytes] = []
     got = 0
     while got < n:
         if deadline is not None:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                raise FrameTimeout(f"timed out waiting for {what} ({got}/{n} bytes)")
+                raise FrameTimeout(
+                    f"timed out waiting for {what} ({got}/{n} bytes){ctx}"
+                )
             ready, _, _ = select.select([fd], [], [], remaining)
             if not ready:
-                raise FrameTimeout(f"timed out waiting for {what} ({got}/{n} bytes)")
+                raise FrameTimeout(
+                    f"timed out waiting for {what} ({got}/{n} bytes){ctx}"
+                )
         chunk = os.read(fd, n - got)
         if not chunk:
             raise WorkerGone(
-                f"peer closed the stream mid-{what} ({got}/{n} bytes)"
-                if got else "peer closed the stream"
+                f"peer closed the stream mid-{what} ({got}/{n} bytes){ctx}"
+                if got else f"peer closed the stream{ctx}"
             )
         chunks.append(chunk)
         got += len(chunk)
     return b"".join(chunks)
 
 
-def send_frame(stream, obj: Dict[str, Any]) -> None:
+def send_frame(stream, obj: Dict[str, Any], timeout_s: Optional[float] = None, *,
+               peer: Optional[str] = None, op: Optional[str] = None) -> None:
     """Write one length-prefixed JSON frame. Raises `WorkerGone` when the peer
-    end of the pipe is closed, `FrameError` for oversized payloads."""
+    end of the pipe/socket is closed, `FrameError` for oversized payloads, and
+    — when `timeout_s` bounds the write (mandatory on socket transports, where
+    a zero-window peer can stall a blocking write forever) — `FrameTimeout`
+    on a missed send deadline."""
+    ctx = _frame_ctx(peer, op)
     payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
     if len(payload) > MAX_FRAME_BYTES:
-        raise FrameError(f"frame of {len(payload)} bytes exceeds MAX_FRAME_BYTES")
+        raise FrameError(f"frame of {len(payload)} bytes exceeds MAX_FRAME_BYTES{ctx}")
     data = struct.pack(">I", len(payload)) + payload
     fd = _fileno(stream)
+    deadline = None if timeout_s is None else time.monotonic() + float(timeout_s)
     view = memoryview(data)
+    sent = 0
     while view:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise FrameTimeout(
+                    f"timed out sending frame ({sent}/{len(data)} bytes){ctx}"
+                )
+            _, writable, _ = select.select([], [fd], [], remaining)
+            if not writable:
+                raise FrameTimeout(
+                    f"timed out sending frame ({sent}/{len(data)} bytes){ctx}"
+                )
         try:
             written = os.write(fd, view)
         except (BrokenPipeError, OSError) as exc:
-            raise WorkerGone(f"peer pipe closed during send: {exc!r}") from exc
+            raise WorkerGone(
+                f"peer pipe closed during send ({sent}/{len(data)} bytes){ctx}: {exc!r}"
+            ) from exc
         view = view[written:]
+        sent += written
 
 
-def recv_frame(stream, timeout_s: Optional[float]) -> Dict[str, Any]:
+def recv_frame(stream, timeout_s: Optional[float], *,
+               peer: Optional[str] = None, op: Optional[str] = None) -> Dict[str, Any]:
     """Read one frame. `timeout_s` is the heartbeat deadline for the WHOLE
     frame — pass the peer's liveness budget, never None in a long-lived loop
-    (TPU116). Raises `FrameTimeout` / `WorkerGone` / `FrameError`."""
+    (TPU116). Raises `FrameTimeout` / `WorkerGone` / `FrameError`, each
+    tagged with the peer identity and op in flight when given."""
+    ctx = _frame_ctx(peer, op)
     fd = _fileno(stream)
     deadline = None if timeout_s is None else time.monotonic() + float(timeout_s)
-    header = _read_exact(fd, 4, deadline, "frame header")
+    header = _read_exact(fd, 4, deadline, "frame header", ctx)
     (length,) = struct.unpack(">I", header)
     if length > MAX_FRAME_BYTES:
-        raise FrameError(f"frame length {length} exceeds MAX_FRAME_BYTES")
-    payload = _read_exact(fd, length, deadline, "frame payload")
+        raise FrameError(f"frame length {length} exceeds MAX_FRAME_BYTES{ctx}")
+    payload = _read_exact(fd, length, deadline, "frame payload", ctx)
     try:
         return json.loads(payload.decode("utf-8"))
     except (UnicodeDecodeError, json.JSONDecodeError) as exc:
-        raise FrameError(f"undecodable frame payload: {exc}") from exc
+        raise FrameError(f"undecodable frame payload{ctx}: {exc}") from exc
 
 
 # ------------------------------------------------------------------ wire codecs
@@ -256,11 +317,15 @@ _FAMILY_BY_MODULE = {
 }
 
 
-def spec_for_model(model, params_path: Optional[str] = None) -> Dict[str, Any]:
+def spec_for_model(model, params_path: Optional[str] = None,
+                   params_digest: Optional[str] = None) -> Dict[str, Any]:
     """Serialize a live Model bundle into a worker-buildable JSON spec: the
     family + config dataclass fields, plus the path of a `save_pytree`'d params
     file. Params ALWAYS move by file — a worker must serve the controller's
-    exact weights (token parity), never a re-derived init."""
+    exact weights (token parity), never a re-derived init. `params_digest`
+    (the file's SHA-256, PR 2 manifest machinery) makes the handoff safe
+    across hosts: a worker on another machine verifies it read the exact
+    bytes the controller wrote, not a torn or stale object at the same path."""
     family = _FAMILY_BY_MODULE.get(type(model.module).__name__)
     if family is None:
         raise ValueError(
@@ -271,6 +336,7 @@ def spec_for_model(model, params_path: Optional[str] = None) -> Dict[str, Any]:
         "family": family,
         "config": dataclasses.asdict(model.module.config),
         "params_path": params_path,
+        "params_digest": params_digest,
     }
 
 
@@ -295,8 +361,27 @@ def build_model_from_spec(spec: Dict[str, Any]):
         model = create(config, seq_len=seq_len)
     params_path = spec.get("params_path")
     if params_path:
+        _verify_params_digest(params_path, spec.get("params_digest"))
         model.params = _load_params_on_device(params_path)
     return model
+
+
+def _verify_params_digest(path: str, digest: Optional[str]) -> None:
+    """End-to-end digest check for the params file handoff: the controller
+    names the SHA-256 it wrote, the worker refuses to serve anything else.
+    (`load_pytree` already verifies payload-vs-manifest; this closes the
+    cross-host gap where the PATH resolves to different bytes.)"""
+    if not digest:
+        return
+    from .checkpointing import file_sha256
+
+    actual = file_sha256(path)
+    if actual != digest:
+        raise ValueError(
+            f"params digest mismatch for {path}: controller expects "
+            f"{digest[:12]}..., file hashes to {actual[:12]}... — refusing to "
+            "serve unverified weights"
+        )
 
 
 def _load_params_on_device(path: str):
@@ -409,12 +494,30 @@ class EngineHost:
                 if self.guard is not None:
                     self.guard.reset()
                 return {"ok": True, "armed": self.guard is not None}
+            if op == "reconcile":
+                # The stream-reconciliation snapshot a reconnecting controller
+                # diffs its mirrors against: every request this engine knows,
+                # with the FULL retained token tail (step replies ship deltas;
+                # a reply lost in a partition is recovered from here).
+                return {
+                    "ok": True,
+                    "pid": os.getpid(),
+                    "worker_id": self.worker_id,
+                    "requests": {
+                        str(rid): result_to_wire(result)
+                        for rid, result in self.engine.results.items()
+                    },
+                    **self._load_view(),
+                }
             if op == "set_params":
                 # The file handoff always carries RAW params; a quantized
                 # engine (weight_dtype="int8" via engine_kwargs) re-quantizes
                 # in its params setter — same seam as an in-process swap.
+                # A digest (mandatory for cross-host swaps) is verified
+                # against the actual file bytes before anything is served.
+                _verify_params_digest(msg["path"], msg.get("digest"))
                 self.engine.params = _load_params_on_device(msg["path"])
-                return {"ok": True}
+                return {"ok": True, "digest_verified": bool(msg.get("digest"))}
             if op == "close":
                 self.engine.close()
                 return {"ok": True, "finished": self._finished_delta()}
@@ -423,6 +526,19 @@ class EngineHost:
             raise
         except BaseException as exc:  # noqa: BLE001 — typed error replies, worker stays up
             return _error_reply(exc)
+
+
+def _journal_line(path: str, entry: Dict[str, Any]) -> None:
+    """Durably append one JSON line to the shared chaos/fleet journal.
+    O_APPEND single-write + fsync: atomic against concurrent workers, durable
+    against the SIGKILL that may follow immediately."""
+    record = json.dumps(entry)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, (record + "\n").encode())
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 class WorkerChaos:
@@ -466,15 +582,9 @@ class WorkerChaos:
         return counts
 
     def _journal(self, entry: Dict[str, Any]):
-        record = json.dumps({**entry, "worker": self.token, "pid": os.getpid()})
-        # O_APPEND single-write + fsync: atomic against concurrent workers,
-        # durable against the SIGKILL that may follow immediately.
-        fd = os.open(self.journal_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-        try:
-            os.write(fd, (record + "\n").encode())
-            os.fsync(fd)
-        finally:
-            os.close(fd)
+        _journal_line(
+            self.journal_path, {**entry, "worker": self.token, "pid": os.getpid()}
+        )
 
     def arm(self, engine):
         from .chaos.injectors import ServingInjector
@@ -523,6 +633,173 @@ def serve_worker(host: EngineHost, rstream, wstream, *,
             return 0
 
 
+def _parse_hostport(text: str) -> Tuple[str, int]:
+    host, _, port = str(text).rpartition(":")
+    if not host or not port.lstrip("-").isdigit() or int(port) < 0:
+        raise ValueError(f"expected HOST:PORT, got {text!r}")
+    return host, int(port)
+
+
+def _accept_registration(host: EngineHost, conn, addr, current_epoch: int,
+                         deadline_s: Optional[float],
+                         ready_extra: Optional[Dict[str, Any]] = None):
+    """One registration handshake on a freshly accepted connection. The
+    controller opens with ``{"op": "register", "protocol", "epoch", ...}``;
+    the worker validates the protocol version, rejects epochs that are not
+    newer than the highest it has served (a stale controller link — e.g. a
+    half-open socket's owner waking up after a reconnect — must not steal the
+    stream), and replies with the ready frame: identity, protocol version,
+    and the warm-state attestation. Returns ``(conn, epoch, peer)`` on
+    success, None after closing a rejected connection."""
+    peer = "%s:%s" % (addr[0], addr[1]) if addr else "?"
+    budget = min(deadline_s, 30.0) if deadline_s is not None else 30.0
+    try:
+        msg = recv_frame(conn, timeout_s=budget, peer=peer, op="register")
+    except (WorkerGone, FrameError, FrameTimeout) as exc:
+        logger.warning("worker %d: registration from %s died: %r",
+                       host.worker_id, peer, exc)
+        conn.close()
+        return None
+    epoch = int(msg.get("epoch", 0))
+    problem = None
+    if msg.get("op") != "register":
+        problem = ("value_error", f"expected a register frame, got op={msg.get('op')!r}")
+    elif int(msg.get("protocol", -1)) != PROTOCOL_VERSION:
+        problem = (
+            "protocol_mismatch",
+            f"protocol version {msg.get('protocol')!r} != worker's {PROTOCOL_VERSION}",
+        )
+    elif epoch <= current_epoch:
+        problem = (
+            "stale_epoch",
+            f"registration epoch {epoch} is not newer than the served epoch "
+            f"{current_epoch} — a stale controller link cannot steal the stream",
+        )
+    if problem is not None:
+        kind, error = problem
+        try:
+            send_frame(conn, {"ok": False, "kind": kind, "error": error},
+                       timeout_s=5.0, peer=peer, op="register")
+        except (WorkerGone, FrameTimeout, FrameError):
+            pass
+        conn.close()
+        logger.warning("worker %d: rejected registration from %s: %s",
+                       host.worker_id, peer, error)
+        return None
+    ready = {
+        "ok": True, "ready": True, "registered": True, "pid": os.getpid(),
+        "worker_id": host.worker_id, "protocol": PROTOCOL_VERSION,
+        "epoch": epoch, **(ready_extra or {}),
+    }
+    try:
+        send_frame(conn, ready, timeout_s=budget, peer=peer, op="register")
+    except (WorkerGone, FrameTimeout, FrameError) as exc:
+        logger.warning("worker %d: ready frame to %s died: %r",
+                       host.worker_id, peer, exc)
+        conn.close()
+        return None
+    logger.info("worker %d: controller registered from %s (reconnect epoch %d)",
+                host.worker_id, peer, epoch)
+    return conn, epoch, peer
+
+
+def serve_listener(host: EngineHost, listener, *,
+                   heartbeat_deadline_s: Optional[float] = DEFAULT_HEARTBEAT_S,
+                   chaos: Optional[WorkerChaos] = None,
+                   journal_path: Optional[str] = None,
+                   ready_extra: Optional[Dict[str, Any]] = None) -> int:
+    """The socket-mode worker loop: accept a registration, then
+    recv/dispatch/reply like `serve_worker` — but the ENGINE outlives any one
+    connection. A torn link parks the worker back in accept-wait with its warm
+    state, in-flight requests, and retained results intact; the controller
+    re-registers under a bumped epoch and reconciles streams via the
+    `reconcile` op. A registration arriving while a (possibly half-open)
+    connection is live wins if and only if its epoch is newer — the select
+    loop watches the listener alongside the active connection precisely so a
+    reconnecting controller is never blocked behind a dead socket that the
+    kernel still calls established. The heartbeat deadline spans connected
+    AND disconnected time: a worker nobody has talked to for the whole window
+    exits as orphaned (TPU116 discipline), never leaks. Re-registrations
+    beyond the first epoch are journaled (``net.reregister``) so chaos
+    invariants can reconcile controller reconnect counters against
+    worker-side evidence."""
+    epoch = 0
+    conn = None
+    peer = "unregistered"
+    last_frame = time.monotonic()
+    token = f"worker_{host.worker_id}"
+
+    def _drop_conn(why: str):
+        nonlocal conn
+        if conn is not None:
+            logger.warning(
+                "worker %d: link to %s tore at reconnect epoch %d (%s) — "
+                "awaiting re-registration", host.worker_id, peer, epoch, why,
+            )
+            try:
+                conn.close()
+            except OSError:
+                pass
+            conn = None
+
+    while True:
+        if heartbeat_deadline_s is not None:
+            budget = heartbeat_deadline_s - (time.monotonic() - last_frame)
+            if budget <= 0:
+                logger.warning(
+                    "worker %d: no controller traffic for %.1fs — exiting as orphaned",
+                    host.worker_id, heartbeat_deadline_s,
+                )
+                return ORPHANED_EXIT_CODE
+        else:
+            budget = 1.0
+        watch = [listener] if conn is None else [listener, conn]
+        try:
+            ready, _, _ = select.select(watch, [], [], min(budget, 1.0))
+        except OSError:
+            _drop_conn("select failed on the connection")
+            continue
+        if listener in ready:
+            try:
+                candidate, cand_addr = listener.accept()
+            except OSError:
+                continue
+            candidate.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            accepted = _accept_registration(
+                host, candidate, cand_addr, epoch, heartbeat_deadline_s,
+                ready_extra=ready_extra,
+            )
+            if accepted is not None:
+                _drop_conn("superseded by a newer registration epoch")
+                conn, epoch, peer = accepted
+                last_frame = time.monotonic()
+                if epoch > 1 and journal_path:
+                    _journal_line(journal_path, {
+                        "kind": "net.reregister", "worker": token,
+                        "epoch": epoch, "pid": os.getpid(),
+                    })
+            continue  # buffered op frames (if any) surface on the next select
+        if conn is None or conn not in ready:
+            continue
+        try:
+            msg = recv_frame(conn, timeout_s=heartbeat_deadline_s, peer=peer)
+        except (WorkerGone, FrameError, FrameTimeout) as exc:
+            _drop_conn(repr(exc))
+            continue
+        last_frame = time.monotonic()
+        if chaos is not None:
+            chaos.poll(msg.get("op"))
+        reply = host.handle(msg)
+        try:
+            send_frame(conn, reply, timeout_s=heartbeat_deadline_s,
+                       peer=peer, op=msg.get("op"))
+        except (WorkerGone, FrameTimeout) as exc:
+            _drop_conn(repr(exc))
+            continue
+        if msg.get("op") == "close":
+            return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -539,6 +816,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--guard", action="store_true",
                         help="arm a record-mode TraceGuard post-warmup and report its "
                         "recompile/host-transfer counters in stats")
+    parser.add_argument("--listen", default=None, metavar="HOST:PORT",
+                        help="socket mode: bind HOST:PORT (port 0 = ephemeral), announce "
+                        "the bound address on stdout, then serve registered controllers "
+                        "over TCP instead of the stdio pipes")
     args = parser.parse_args(argv)
 
     # fd 1 belongs to the PROTOCOL: anything else printing to stdout (jax
@@ -591,6 +872,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         guard.__enter__()
 
     host = EngineHost(engine, worker_id=args.worker_id, guard=guard)
+    if args.listen is not None:
+        bind_host, bind_port = _parse_hostport(args.listen)
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((bind_host, bind_port))
+        listener.listen(4)
+        got_host, got_port = listener.getsockname()[:2]
+        warm_attest = {"warm": not args.no_warm, "warmed": warmed}
+        # The announce frame rides the original stdout pipe (or a terminal, for
+        # a hand-launched worker): the controller — or the operator — learns the
+        # bound address, then all protocol traffic moves to the socket.
+        send_frame(ipc_out, {
+            "ok": True, "listening": True, "host": got_host, "port": int(got_port),
+            "pid": os.getpid(), "worker_id": args.worker_id,
+            "protocol": PROTOCOL_VERSION, **warm_attest,
+        })
+        span.event("listening", host=got_host, port=int(got_port),
+                   warmed_buckets=len(warmed))
+        code = serve_listener(
+            host, listener,
+            heartbeat_deadline_s=args.heartbeat_deadline_s, chaos=chaos,
+            journal_path=os.environ.get(CHAOS_JOURNAL_ENV),
+            ready_extra=warm_attest,
+        )
+        listener.close()
+        if guard is not None:
+            guard.__exit__(None, None, None)
+        span.annotate(exit_code=code).end()
+        return code
     send_frame(ipc_out, {
         "ok": True, "ready": True, "pid": os.getpid(),
         "worker_id": args.worker_id, "warm": not args.no_warm, "warmed": warmed,
@@ -611,7 +921,10 @@ class _PipeTransport:
     """The real transport: a spawned worker process with frame streams over
     its stdin/stdout pipes. Tests substitute a duck-typed fake."""
 
-    def __init__(self, cmd: List[str], env: Dict[str, str], stderr=None):
+    def __init__(self, cmd: List[str], env: Dict[str, str], stderr=None,
+                 worker_id: int = 0):
+        self.peer = f"worker_{worker_id}/pipe"
+        self._last_op: Optional[str] = None
         self.proc = subprocess.Popen(
             cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=stderr, env=env, bufsize=0,
@@ -625,10 +938,12 @@ class _PipeTransport:
         return self.proc.poll() is None
 
     def send(self, obj: Dict[str, Any]):
-        send_frame(self.proc.stdin, obj)
+        self._last_op = obj.get("op")
+        send_frame(self.proc.stdin, obj, peer=self.peer, op=self._last_op)
 
     def recv(self, timeout_s: Optional[float]) -> Dict[str, Any]:
-        return recv_frame(self.proc.stdout, timeout_s=timeout_s)
+        return recv_frame(self.proc.stdout, timeout_s=timeout_s,
+                          peer=self.peer, op=self._last_op)
 
     def kill(self):
         if self.alive():
@@ -650,6 +965,148 @@ class _PipeTransport:
             pass
 
 
+class SocketTransport:
+    """Frame transport over TCP to a listening worker (`--listen HOST:PORT`).
+
+    Duck-types `_PipeTransport` (pid/alive/send/recv/kill/close) so
+    `SubprocessEngine` and every test fake stay interchangeable, and adds the
+    transport-level verbs the reconnect machinery needs:
+
+    - `handshake(timeout_s, resume=)` — dial, send a `register` frame carrying
+      the protocol version and a monotonically increasing reconnect *epoch*,
+      and validate the worker's ready/attestation reply. The epoch is what
+      lets the worker reject a stale controller link (an older socket waking
+      up after we already re-registered) without guessing from timing.
+    - `reconnect(timeout_s)` — `handshake(resume=True)`: same wire exchange,
+      but the caller treats the worker's retained state as authoritative and
+      reconciles streams afterwards instead of assuming a fresh engine.
+    - `sever()` — drop the socket WITHOUT touching the worker process. This is
+      the partition seam: chaos injectors and the reconnect path both cut the
+      link here, and worker death stays a separate, deliberate act (`kill`).
+
+    `proc` is optional: a controller can adopt a worker it never spawned
+    (cross-host fleet) — then pid/alive reflect the remote identity from the
+    handshake and kill() can only sever the link."""
+
+    def __init__(self, address: Tuple[str, int], proc=None, worker_id: int = 0):
+        self.address = (str(address[0]), int(address[1]))
+        self.proc = proc
+        self.peer = "%s:%d/worker_%d" % (self.address[0], self.address[1], worker_id)
+        self.epoch = 0
+        self.sock = None
+        self.ready_info: Dict[str, Any] = {}
+        self._remote_pid: Optional[int] = None
+        self._last_op: Optional[str] = None
+        self._killed = False
+
+    # ---- lifecycle ----
+    def handshake(self, timeout_s: Optional[float], resume: bool = False) -> Dict[str, Any]:
+        self.sever()
+        self.epoch += 1
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        try:
+            sock = socket.create_connection(self.address, timeout=timeout_s or 30.0)
+        except OSError as exc:
+            raise WorkerGone(
+                f"dial {self.address[0]}:{self.address[1]} failed"
+                f"{_frame_ctx(self.peer, 'register')}: {exc!r}"
+            ) from exc
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Framing owns all deadlines via select(); a lingering socket-level
+        # timeout would race it and surface as spurious BlockingIOError.
+        sock.settimeout(None)
+        remaining = (None if deadline is None
+                     else max(0.001, deadline - time.monotonic()))
+        try:
+            send_frame(sock, {
+                "op": "register", "protocol": PROTOCOL_VERSION,
+                "epoch": self.epoch, "resume": bool(resume),
+                "controller_pid": os.getpid(),
+            }, timeout_s=remaining, peer=self.peer, op="register")
+            ready = recv_frame(sock, timeout_s=remaining,
+                               peer=self.peer, op="register")
+        except (WorkerGone, FrameError, FrameTimeout):
+            sock.close()
+            raise
+        if not ready.get("ok") or not ready.get("registered"):
+            sock.close()
+            raise WorkerGone(
+                f"worker at {self.peer} refused registration "
+                f"(epoch {self.epoch}): {ready.get('error', ready)!r}"
+            )
+        self.sock = sock
+        self.ready_info = ready
+        self._remote_pid = int(ready.get("pid", 0)) or None
+        return ready
+
+    def reconnect(self, timeout_s: Optional[float]) -> Dict[str, Any]:
+        return self.handshake(timeout_s, resume=True)
+
+    def sever(self):
+        sock, self.sock = self.sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # ---- _PipeTransport surface ----
+    @property
+    def pid(self) -> Optional[int]:
+        if self.proc is not None:
+            return self.proc.pid
+        return self._remote_pid
+
+    def alive(self) -> bool:
+        if self.proc is not None:
+            return self.proc.poll() is None
+        return not self._killed
+
+    def send(self, obj: Dict[str, Any]):
+        if self.sock is None:
+            raise WorkerGone(
+                f"transport link is severed{_frame_ctx(self.peer, obj.get('op'))}"
+            )
+        self._last_op = obj.get("op")
+        send_frame(self.sock, obj, timeout_s=30.0, peer=self.peer, op=self._last_op)
+
+    def recv(self, timeout_s: Optional[float]) -> Dict[str, Any]:
+        if self.sock is None:
+            raise WorkerGone(
+                f"transport link is severed{_frame_ctx(self.peer, self._last_op)}"
+            )
+        return recv_frame(self.sock, timeout_s=timeout_s,
+                          peer=self.peer, op=self._last_op)
+
+    def kill(self):
+        self._killed = True
+        self.sever()
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+
+    def close(self, timeout_s: float = 10.0):
+        self.sever()
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+            for stream in (self.proc.stdout, self.proc.stdin):
+                if stream is not None:
+                    try:
+                        stream.close()
+                    except OSError:
+                        pass
+
+
+class _TransportDown(WorkerGone):
+    """Internal: the transport tore but the engine entered `reconnecting`
+    instead of dying. Subclasses WorkerGone so callers that only know the old
+    failure language (submit -> EngineClosed, release swallows) keep working;
+    `step()` catches it specifically to drive the reconnect loop."""
+
+
 class SubprocessEngine:
     """Client proxy for one out-of-process engine worker, exposing the exact
     `ContinuousBatcher` surface so `Router` needs no routing changes.
@@ -660,7 +1117,16 @@ class SubprocessEngine:
     death into the router's existing failure language: a dead/hung worker makes
     `step()` raise `WorkerGone` (-> `fail_replica` -> factory rebuild -> warm
     rejoin) and `submit()` raise `EngineClosed` (-> the router tries the next
-    candidate replica)."""
+    candidate replica).
+
+    With `transport="socket"` (or `connect=` to adopt an already-listening
+    worker on another host), a torn frame is a TRANSPORT fault, not a worker
+    death: the proxy enters `reconnecting`, re-handshakes under capped
+    exponential backoff + jitter budgeted by `reconnect_deadline_s`, and
+    reconciles in-flight streams against the worker's retained per-request
+    state — never-streamed requests re-dispatch, streamed requests resume from
+    the worker's tail or finish `replica_lost`; only an exhausted budget
+    escalates to the old WorkerGone/respawn path."""
 
     def __init__(
         self,
@@ -676,15 +1142,30 @@ class SubprocessEngine:
         env: Optional[Dict[str, str]] = None,
         stderr=None,
         python: Optional[str] = None,
+        transport: str = "pipe",
+        connect: Optional[str] = None,
+        reconnect_deadline_s: Optional[float] = None,
+        reconnect_backoff_s: float = 0.05,
+        reconnect_backoff_cap_s: float = 2.0,
         _transport=None,
     ):
         from .serving import RequestResult  # noqa: F401 — re-exported surface
 
+        if transport not in ("pipe", "socket"):
+            raise ValueError(f"transport must be 'pipe' or 'socket', got {transport!r}")
+        if connect is not None:
+            transport = "socket"
         self.spec = dict(spec)
         self.engine_kwargs = dict(engine_kwargs or {})
         self.worker_id = int(worker_id)
         self.max_queue = self.engine_kwargs.get("max_queue")
         self.step_timeout_s = float(step_timeout_s)
+        self.transport_kind = transport
+        if reconnect_deadline_s is None and transport == "socket":
+            reconnect_deadline_s = 10.0
+        self.reconnect_deadline_s = reconnect_deadline_s
+        self.reconnect_backoff_s = float(reconnect_backoff_s)
+        self.reconnect_backoff_cap_s = float(reconnect_backoff_cap_s)
         self.results: Dict[int, Any] = {}
         self.trace_guard = None  # surface parity; guards run worker-side
         self._dead = False
@@ -695,8 +1176,32 @@ class SubprocessEngine:
         self._stats_cache: Dict[str, Any] = {}
         self._params_dir: Optional[str] = None
         self._params_seq = 0
+        # --- reconnect state machine ---
+        self.reconnects = 0  # successful re-handshakes over this proxy's life
+        self._reconnecting = False
+        self._in_reconcile = False
+        self._rc_since = 0.0
+        self._rc_attempts = 0
+        self._rc_next = 0.0
+        self._rc_cause: Optional[str] = None
+        self._rc_last_err: Optional[str] = None
+        self._rc_pending_events: List[Tuple[int, List[int]]] = []
+        self._requests_wire: Dict[int, Dict[str, Any]] = {}
+        self._cancel_after_reconnect: set = set()
+        # --- telemetry (wired lazily via attach_telemetry) ---
+        self._registry = None
+        self._tracer = None
+        self._replica_label = str(self.worker_id)
+        self._m_reconnects = None
+        self._m_rtt = None
+        self._m_reconnecting = None
+        self._rc_span = None
         if _transport is not None:
             self.transport = _transport
+        elif connect is not None:
+            self.transport = SocketTransport(
+                _parse_hostport(connect), proc=None, worker_id=self.worker_id
+            )
         else:
             run_env = dict(os.environ if env is None else env)
             run_env[WORKER_ID_ENV] = str(self.worker_id)
@@ -713,13 +1218,47 @@ class SubprocessEngine:
                 cmd.append("--no-warm")
             if guard:
                 cmd.append("--guard")
-            self.transport = _PipeTransport(cmd, env=run_env, stderr=stderr)
+            if transport == "socket":
+                cmd += ["--listen", "127.0.0.1:0"]
+                proc = subprocess.Popen(
+                    cmd, stdin=subprocess.DEVNULL, stdout=subprocess.PIPE,
+                    stderr=stderr, env=run_env, bufsize=0,
+                )
+                try:
+                    announce = recv_frame(
+                        proc.stdout, timeout_s=start_timeout_s,
+                        peer=f"worker_{self.worker_id}/announce", op="announce",
+                    )
+                except (WorkerGone, FrameTimeout, FrameError) as exc:
+                    proc.kill()
+                    proc.wait()
+                    raise WorkerGone(
+                        f"worker {self.worker_id} never announced a listen address: {exc}"
+                    ) from exc
+                if not announce.get("listening"):
+                    proc.kill()
+                    proc.wait()
+                    raise WorkerGone(
+                        f"worker {self.worker_id} announce frame malformed: {announce}"
+                    )
+                self.transport = SocketTransport(
+                    (announce["host"], int(announce["port"])),
+                    proc=proc, worker_id=self.worker_id,
+                )
+            else:
+                self.transport = _PipeTransport(
+                    cmd, env=run_env, stderr=stderr, worker_id=self.worker_id
+                )
+        handshake = getattr(self.transport, "handshake", None)
         try:
-            self.ready_info = self.transport.recv(timeout_s=start_timeout_s)
+            if handshake is not None:
+                self.ready_info = handshake(timeout_s=start_timeout_s)
+            else:
+                self.ready_info = self.transport.recv(timeout_s=start_timeout_s)
         except (WorkerGone, FrameTimeout, FrameError) as exc:
             self._mark_dead()
             raise WorkerGone(f"worker {self.worker_id} never became ready: {exc}") from exc
-        if not self.ready_info.get("ready"):
+        if not (self.ready_info.get("ready") or self.ready_info.get("registered")):
             self._mark_dead()
             raise WorkerGone(f"worker {self.worker_id} handshake failed: {self.ready_info}")
 
@@ -740,12 +1279,22 @@ class SubprocessEngine:
     def _call(self, msg: Dict[str, Any], timeout_s: Optional[float] = None) -> Dict[str, Any]:
         if self._dead:
             raise WorkerGone(f"worker {self.worker_id} process is gone")
+        if self._reconnecting and not self._in_reconcile:
+            raise _TransportDown(
+                f"worker {self.worker_id} transport is reconnecting "
+                f"(attempt {self._rc_attempts}, cause: {self._rc_cause})"
+            )
+        op = msg.get("op")
+        t0 = time.perf_counter()
         try:
             self.transport.send(msg)
             reply = self.transport.recv(
                 timeout_s=self.step_timeout_s if timeout_s is None else timeout_s
             )
         except FrameTimeout as exc:
+            self._count_frame_error("timeout")
+            if self._maybe_enter_reconnecting(exc, op):
+                raise _TransportDown(str(exc)) from exc
             # A hung worker is indistinguishable from a dead one from the
             # controller's side — kill it so the rebuild path can take over.
             self._mark_dead()
@@ -753,14 +1302,237 @@ class SubprocessEngine:
                 f"worker {self.worker_id} missed its step deadline: {exc}"
             ) from exc
         except (WorkerGone, FrameError) as exc:
+            self._count_frame_error(
+                "torn" if isinstance(exc, WorkerGone) else "frame_error"
+            )
+            if self._maybe_enter_reconnecting(exc, op):
+                raise _TransportDown(str(exc)) from exc
             self._mark_dead()
             raise WorkerGone(f"worker {self.worker_id} died: {exc}") from exc
+        if self._m_rtt is not None:
+            self._m_rtt.observe(time.perf_counter() - t0)
         if not reply.get("ok"):
             _raise_from_reply(reply)
         self._load = int(reply.get("load", self._load))
         self._queue_depth = int(reply.get("queue_depth", self._queue_depth))
         self._worker_pending = bool(reply.get("pending", self._worker_pending))
         return reply
+
+    # ---- reconnect state machine ----
+    @property
+    def reconnecting(self) -> bool:
+        return self._reconnecting
+
+    def _can_reconnect(self) -> bool:
+        if self.reconnect_deadline_s is None or self._closed or self._dead:
+            return False
+        if not hasattr(self.transport, "reconnect"):
+            return False
+        alive = getattr(self.transport, "alive", None)
+        # A locally spawned worker whose PROCESS exited cannot be re-dialed —
+        # that is genuine death, not a transport fault.
+        return alive() if alive is not None else True
+
+    def _maybe_enter_reconnecting(self, exc: BaseException, op: Optional[str]) -> bool:
+        if not self._can_reconnect():
+            return False
+        self._enter_reconnecting(exc, op)
+        return True
+
+    def _enter_reconnecting(self, exc: BaseException, op: Optional[str]):
+        sever = getattr(self.transport, "sever", None)
+        if sever is not None:
+            sever()
+        if self._reconnecting:
+            return  # a tear mid-reconcile keeps the ORIGINAL budget anchor
+        now = time.monotonic()
+        self._reconnecting = True
+        self._rc_since = now
+        self._rc_attempts = 0
+        self._rc_next = now  # first attempt fires immediately
+        self._rc_cause = f"{type(exc).__name__} during op={op}: {exc}"
+        self._rc_last_err = None
+        if self._m_reconnecting is not None:
+            self._m_reconnecting.set(1.0)
+        if self._tracer is not None:
+            self._rc_span = self._tracer.start_span(
+                "serve.reconnect", category="serve",
+                replica=self._replica_label, worker_id=self.worker_id,
+                cause=self._rc_cause,
+            )
+        logger.warning(
+            "worker %d: transport tore (%s) — entering reconnecting "
+            "(deadline %.1fs)", self.worker_id, self._rc_cause,
+            self.reconnect_deadline_s,
+        )
+
+    def _finish_reconnect(self, outcome: str):
+        self._reconnecting = False
+        if outcome == "reconnected":
+            self.reconnects += 1
+            if self._m_reconnects is not None:
+                self._m_reconnects.inc()
+        if self._m_reconnecting is not None:
+            self._m_reconnecting.set(0.0)
+        if self._rc_span is not None:
+            self._rc_span.annotate(
+                outcome=outcome, attempts=self._rc_attempts,
+                waited_s=round(time.monotonic() - self._rc_since, 3),
+            ).end()
+            self._rc_span = None
+        logger.warning(
+            "worker %d: reconnect %s after %d attempt(s)",
+            self.worker_id, outcome, self._rc_attempts,
+        )
+
+    def _reconnect_step(self) -> List[Tuple[int, List[int]]]:
+        """One non-blocking tick of the reconnect loop, driven by `step()`.
+        Returns resumed stream events on success, [] while backing off; raises
+        WorkerGone only when the reconnect budget is exhausted (escalating to
+        the router's existing death/respawn path)."""
+        now = time.monotonic()
+        # Exhaustion requires at least one REAL attempt: a controller that
+        # blocked past the whole budget (e.g. a synchronous respawn elsewhere
+        # in the fleet) must not condemn a healthy link it never re-dialed.
+        if self._rc_attempts >= 1 and now - self._rc_since > self.reconnect_deadline_s:
+            self._finish_reconnect("exhausted")
+            self._mark_dead()
+            raise WorkerGone(
+                f"worker {self.worker_id} reconnect budget exhausted: "
+                f"{self._rc_attempts} attempt(s) over {self.reconnect_deadline_s:.1f}s "
+                f"(cause: {self._rc_cause}; last error: {self._rc_last_err})"
+            )
+        if now < self._rc_next:
+            return []
+        self._rc_attempts += 1
+        budget_left = self.reconnect_deadline_s - (now - self._rc_since)
+        try:
+            ready = self.transport.reconnect(
+                timeout_s=max(0.05, min(5.0, budget_left))
+            )
+            self._in_reconcile = True
+            try:
+                self._reconcile_streams(ready)
+            finally:
+                self._in_reconcile = False
+        except (WorkerGone, FrameError, FrameTimeout, OSError) as exc:
+            backoff = min(
+                self.reconnect_backoff_cap_s,
+                self.reconnect_backoff_s * (2 ** (self._rc_attempts - 1)),
+            ) * (0.5 + random.random() / 2)  # jitter: avoid fleet-wide lockstep
+            self._rc_next = time.monotonic() + backoff
+            self._rc_last_err = repr(exc)
+            return []
+        self._finish_reconnect("reconnected")
+        events, self._rc_pending_events = self._rc_pending_events, []
+        return events
+
+    def _reconcile_streams(self, ready: Dict[str, Any]):
+        """Reconcile local mirrors against the worker's retained per-request
+        journal after a re-handshake. The contract: a stream is never
+        duplicated and never silently truncated — requests the worker never
+        saw (lost in a torn submit) re-dispatch verbatim IF nothing streamed
+        yet; anything already streamed either resumes from the worker's
+        retained tail (prefix-verified) or finishes `replica_lost`.
+
+        Resumed tails accumulate in `_rc_pending_events` (not returned here):
+        mirror extension is idempotent across a tear-during-reconcile retry,
+        and `_reconnect_step` releases the events exactly once, on full
+        success, so the router streams each token exactly once."""
+        reply = self._call({"op": "reconcile"}, timeout_s=self.step_timeout_s)
+        worker_view = {
+            int(rid): rec for rid, rec in reply.get("requests", {}).items()
+        }
+        for rid, result in list(self.results.items()):
+            queued_cancel = rid in self._cancel_after_reconnect
+            if result.finished and not queued_cancel:
+                continue
+            rec = worker_view.get(rid)
+            if rec is None:
+                if result.finished:
+                    continue  # locally cancelled; the worker never knew it
+                wire = self._requests_wire.get(rid)
+                if not result.tokens and wire is not None:
+                    # Never streamed and unknown worker-side: the submit frame
+                    # died in the partition — safe to re-dispatch.
+                    try:
+                        self._call({"op": "submit", "request": wire})
+                    except (WorkerGone, FrameError, FrameTimeout):
+                        raise  # transport tore again: retry the whole reconcile
+                    except RuntimeError:
+                        # Engine-side rejection (queue full, bad request): the
+                        # request can't ride this replica anymore.
+                        result.finished = True
+                        result.finish_reason = "replica_lost"
+                        result.finish_time = time.perf_counter()
+                else:
+                    result.finished = True
+                    result.finish_reason = "replica_lost"
+                    result.finish_time = time.perf_counter()
+                continue
+            worker_tokens = [int(t) for t in rec.get("tokens", ())]
+            mine = [int(t) for t in result.tokens]
+            if worker_tokens[: len(mine)] != mine:
+                # The worker's journal does not extend what we streamed:
+                # resuming would corrupt the stream — surface the loss.
+                if not result.finished:
+                    result.finished = True
+                    result.finish_reason = "replica_lost"
+                    result.finish_time = time.perf_counter()
+                continue
+            tail = worker_tokens[len(mine):]
+            if tail and not result.finished:
+                result.tokens.extend(tail)
+                if result.first_token_time is None:
+                    result.first_token_time = time.perf_counter()
+                self._rc_pending_events.append((rid, tail))
+            if rec.get("finished") and not result.finished:
+                self._apply_finished([rec])
+        # Cancels issued while the link was down: the mirrors already finished
+        # "cancelled" locally; now actually stop the worker-side generation.
+        for rid in sorted(self._cancel_after_reconnect):
+            if rid in worker_view and not worker_view[rid].get("finished"):
+                try:
+                    self._call({"op": "cancel", "request_id": int(rid)})
+                except (KeyError, ValueError):
+                    pass
+        self._cancel_after_reconnect.clear()
+
+    def _count_frame_error(self, kind: str):
+        if self._registry is not None:
+            self._registry.counter(
+                "transport_frame_errors_total",
+                help="transport frame faults by kind (timeout/torn/frame_error)",
+                labels={"kind": kind},
+            ).inc()
+
+    def attach_telemetry(self, registry, tracer=None, replica=None):
+        """Wire the reconnect/transport instruments into a shared registry.
+        Idempotent (the registry memoizes on (name, labels)); the router calls
+        this for every engine it builds so cross-host replicas report
+        `router_reconnects_total`, frame-error counts, RTTs, and the
+        per-replica reconnecting gauge under one scrape."""
+        self._registry = registry
+        if tracer is not None:
+            self._tracer = tracer
+        if replica is not None:
+            self._replica_label = str(replica)
+        labels = {"replica": self._replica_label}
+        if registry is not None:
+            self._m_reconnects = registry.counter(
+                "router_reconnects_total",
+                help="successful transport re-handshakes (reconnect, not respawn)",
+                labels=labels,
+            )
+            self._m_rtt = registry.histogram(
+                "transport_rtt_seconds",
+                help="frame round-trip time per protocol call", labels=labels,
+            )
+            self._m_reconnecting = registry.gauge(
+                "router_replica_reconnecting",
+                help="1 while the replica's transport is in the reconnecting state",
+                labels=labels,
+            )
 
     # ---- mirror maintenance ----
     def _apply_finished(self, records: List[Dict[str, Any]]):
@@ -818,7 +1590,13 @@ class SubprocessEngine:
         self._params_seq += 1
         path = os.path.join(self._params_dir, f"params_{self._params_seq}.npz")
         save_pytree(value, path)
-        self._call({"op": "set_params", "path": path})
+        from .checkpointing import file_sha256
+
+        # Digest-verified path handoff: across hosts the params file travels
+        # by shared filesystem/object store, and the worker refuses to load
+        # bytes that don't hash to what the controller shipped.
+        self._call({"op": "set_params", "path": path,
+                    "digest": file_sha256(path)})
 
     def submit(self, request) -> int:
         from .serving import EngineClosed, RequestResult
@@ -827,15 +1605,20 @@ class SubprocessEngine:
             raise EngineClosed("engine is closed")
         if self._dead:
             raise EngineClosed(f"worker {self.worker_id} process is gone")
+        wire = request_to_wire(request)
         try:
-            self._call({"op": "submit", "request": request_to_wire(request)})
+            self._call({"op": "submit", "request": wire})
         except WorkerGone as exc:
             # The router's dispatch loop treats EngineClosed as "try the next
-            # replica"; the death itself surfaces from the next step().
+            # replica" (a reconnecting transport included — _TransportDown is
+            # a WorkerGone); the death itself surfaces from the next step().
             raise EngineClosed(str(exc)) from exc
         self.results[request.request_id] = RequestResult(
             request.request_id, arrival_time=request.arrival_time
         )
+        # Retained verbatim so a submit that streamed nothing before a
+        # partition can safely re-dispatch during stream reconciliation.
+        self._requests_wire[request.request_id] = wire
         return request.request_id
 
     def cancel(self, request_id: int) -> bool:
@@ -844,6 +1627,15 @@ class SubprocessEngine:
             return False
         try:
             reply = self._call({"op": "cancel", "request_id": int(request_id)})
+        except _TransportDown:
+            # Link is down but the worker lives: finish the mirror cancelled
+            # NOW (the caller's intent is immediate) and queue the worker-side
+            # cancel for delivery right after stream reconciliation.
+            self._cancel_after_reconnect.add(int(request_id))
+            result.finished = True
+            result.finish_reason = "cancelled"
+            result.finish_time = time.perf_counter()
+            return True
         except WorkerGone:
             # Worker died under the cancel: the mirror finishes cancelled
             # locally (partial tokens kept) — nothing can stream anymore.
@@ -866,12 +1658,21 @@ class SubprocessEngine:
             except (WorkerGone, KeyError, ValueError):
                 pass
         del self.results[request_id]
+        self._requests_wire.pop(request_id, None)
+        self._cancel_after_reconnect.discard(request_id)
         return result
 
     def step(self) -> List[Tuple[int, List[int]]]:
         if self._closed:
             return []
-        reply = self._call({"op": "step"})
+        if self._reconnecting:
+            return self._reconnect_step()
+        try:
+            reply = self._call({"op": "step"})
+        except _TransportDown:
+            # The tear happened on THIS call — drive the first reconnect
+            # attempt immediately instead of burning a router cycle.
+            return self._reconnect_step()
         events: List[Tuple[int, List[int]]] = []
         for rid, toks in reply.get("events", ()):
             rid = int(rid)
@@ -890,9 +1691,15 @@ class SubprocessEngine:
             self.submit(request)
         while self.pending:
             self.step()
+            if self._reconnecting:
+                time.sleep(0.005)  # pace the backoff wait instead of spinning
         return {rid: np.asarray(r.tokens, np.int32) for rid, r in self.results.items()}
 
     def drain(self) -> Dict[int, Any]:
+        while self._reconnecting:
+            self._reconnect_step()
+            if self._reconnecting:
+                time.sleep(min(0.05, max(0.0, self._rc_next - time.monotonic())) or 0.005)
         reply = self._call({"op": "drain"}, timeout_s=self.step_timeout_s * 10)
         self._apply_finished(reply.get("finished", ()))
         return self.results
@@ -952,6 +1759,9 @@ def make_subprocess_factory(
     step_timeout_s: float = 120.0,
     start_timeout_s: float = 600.0,
     stderr_dir: Optional[str] = None,
+    transport: str = "pipe",
+    reconnect_deadline_s: Optional[float] = None,
+    connect: Optional[Sequence[str]] = None,
 ) -> Callable[[int], SubprocessEngine]:
     """Build a `ReplicaSet.engine_factory` that spawns one warm subprocess
     worker per replica index. When a live `model` is given, its params are
@@ -959,21 +1769,44 @@ def make_subprocess_factory(
     loads that exact file — subprocess fleets are token-identical to in-process
     ones by construction. `stderr_dir` (default: the workdir) collects one
     append-mode `worker_<i>.stderr.log` per index, so restarted workers extend
-    their predecessor's log instead of interleaving on the controller's tty."""
+    their predecessor's log instead of interleaving on the controller's tty.
+
+    `connect=["HOST:PORT", ...]` adopts EXTERNALLY launched listener workers
+    (`python -m accelerate_tpu.worker --listen HOST:PORT`) instead of spawning:
+    replica `i` dials `connect[i % len(connect)]`, and a factory rebuild after
+    worker death re-dials the same address — respawning the remote process is
+    its own supervisor's job. Implies the socket transport; the spec's params
+    path must be reachable on the worker's host (digest-verified on load)."""
     if (model is None) == (spec is None):
         raise ValueError("pass exactly one of model= or spec=")
     workdir = workdir or tempfile.mkdtemp(prefix="accelerate_tpu_fleet_")
     os.makedirs(workdir, exist_ok=True)
     if model is not None:
-        from .checkpointing import save_pytree
+        from .checkpointing import file_sha256, save_pytree
 
         params_path = os.path.join(workdir, "params.npz")
         save_pytree(model.params, params_path)
-        spec = spec_for_model(model, params_path=params_path)
+        spec = spec_for_model(
+            model, params_path=params_path,
+            params_digest=file_sha256(params_path),
+        )
     engine_kwargs = dict(engine_kwargs or {})
     stderr_dir = stderr_dir or workdir
 
+    addresses = list(connect) if connect else None
+    if addresses is not None:
+        transport = "socket"
+
     def factory(index: int) -> SubprocessEngine:
+        if addresses is not None:
+            return SubprocessEngine(
+                spec, engine_kwargs, worker_id=index,
+                connect=addresses[index % len(addresses)],
+                heartbeat_deadline_s=heartbeat_deadline_s,
+                step_timeout_s=step_timeout_s,
+                start_timeout_s=start_timeout_s,
+                reconnect_deadline_s=reconnect_deadline_s,
+            )
         log_path = os.path.join(stderr_dir, f"worker_{index}.stderr.log")
         stderr = open(log_path, "ab")
         try:
@@ -984,12 +1817,16 @@ def make_subprocess_factory(
                 step_timeout_s=step_timeout_s,
                 start_timeout_s=start_timeout_s,
                 env=env, stderr=stderr,
+                transport=transport,
+                reconnect_deadline_s=reconnect_deadline_s,
             )
         finally:
             stderr.close()  # the child holds its own copy of the fd
 
     factory.workdir = workdir
     factory.spec = spec
+    factory.transport = transport
+    factory.connect = addresses
     return factory
 
 

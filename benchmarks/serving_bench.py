@@ -39,6 +39,35 @@ def log(msg):
     print(f"[serving-bench] {msg}", file=sys.stderr, flush=True)
 
 
+def _reattempt_tunnel_probe() -> bool:
+    """Re-attempt the memoized TPU tunnel probe (bench.py's preflight memo
+    protocol, same as train_bench): a fresh memo answers instantly, an expired
+    one triggers ONE short probe whose verdict is memoized for the next
+    caller. Returns True when an accelerator backend is reachable; the verdict
+    is recorded in the bench JSON so an artifact states which backend class
+    actually produced its numbers."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return False  # explicitly pinned; nothing to probe
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        import bench
+    except ImportError:
+        return False
+    memo = bench._read_tunnel_state()
+    ttl = bench._env_int("BENCH_TUNNEL_MEMO_TTL", bench.TUNNEL_MEMO_TTL_S)
+    age = None if memo is None else time.time() - float(memo.get("checked_at", 0) or 0)
+    if memo is not None and age is not None and 0 <= age < ttl:
+        alive = bool(memo.get("alive"))
+        log(f"tunnel memo: {'alive' if alive else 'dead'} ({age:.0f}s old, "
+            f"source={memo.get('source', '?')})")
+        return alive
+    timeout = bench._env_int("BENCH_PREFLIGHT_TIMEOUT", 60)
+    alive = bench._backend_preflight(timeout)
+    bench._write_tunnel_state(alive, source="serving-bench")
+    log(f"tunnel probe: {'alive' if alive else 'dead'} (memoized)")
+    return alive
+
+
 def build_workload(args, vocab_size, rng):
     prompts = [
         rng.integers(1, vocab_size, (int(rng.integers(args.prompt_min, args.prompt_max + 1)),)).astype(np.int32)
@@ -970,7 +999,9 @@ def run_ramp_workload(model, args, cfg, max_length, rng, tracer=None):
         page_size=args.page_size,
         tracer=tracer,
         out_of_process=args.out_of_process,
-        worker_kwargs=dict(guard=True) if args.out_of_process else None,
+        worker_kwargs=(
+            dict(guard=True, transport=args.transport) if args.out_of_process else None
+        ),
         stall_degrade_s=None,
         weight_dtype=args.weight_dtype, kv_cache_dtype=args.kv_cache_dtype,
     )
@@ -1080,6 +1111,7 @@ def run_ramp_workload(model, args, cfg, max_length, rng, tracer=None):
     router.close()
     return {
         "out_of_process": args.out_of_process,
+        "transport": args.transport if args.out_of_process else None,
         "replicas": replicas,
         "requests_per_level": n,
         "levels": levels,
@@ -1098,6 +1130,144 @@ def run_ramp_workload(model, args, cfg, max_length, rng, tracer=None):
     }
 
 
+def run_transport_workload(model, args, cfg, max_length, rng, tracer=None):
+    """The pipe-vs-socket transport A/B (loopback): the SAME mixed workload
+    served through two out-of-process fleets of real subprocess workers
+    (`accelerate_tpu.worker`) — one over the spawned stdio pipe framing, one
+    over a loopback TCP socket (the worker self-listens, the controller dials
+    and handshakes) — so the JSON records what the socket hop itself costs.
+    Both fleets report tokens/sec, TTFT p50/p99, and the frame RTT histogram
+    (`transport_rtt_seconds`, observed on every protocol roundtrip through
+    the shared registry the router attaches); the delta between the two RTT
+    medians is the wire overhead number. The framing is byte-identical on
+    both transports, so greedy token parity across them is asserted, and BOTH
+    paths hold the per-worker 0-recompile / 0-host-transfer discipline (each
+    worker's own TraceGuard, reset after warmup, read back through stats)."""
+    from accelerate_tpu.router import Router
+    from accelerate_tpu.serving import Request
+
+    prompts, budgets, arrivals = build_workload(args, cfg.vocab_size, rng)
+    n = len(prompts)
+
+    def run_fleet(transport):
+        router = Router(
+            model, replicas=1, num_slots=args.num_slots, max_length=max_length,
+            chunk_size=args.chunk_size, max_queue=args.requests + 16,
+            default_deadline_s=600.0, paged=not args.no_paged,
+            page_size=args.page_size, tracer=tracer, stall_degrade_s=None,
+            weight_dtype=args.weight_dtype, kv_cache_dtype=args.kv_cache_dtype,
+            out_of_process=True,
+            worker_kwargs=dict(guard=True, transport=transport),
+        )
+        try:
+            def run_traffic():
+                clock = 0.0
+                submitted = 0
+                first_seen = {}
+                delivered = 0
+                while submitted < n or router.pending:
+                    while submitted < n and float(arrivals[submitted]) <= clock:
+                        router.submit(Request(
+                            submitted, prompts[submitted],
+                            max_new_tokens=budgets[submitted],
+                        ))
+                        submitted += 1
+                    if not router.pending and submitted < n:
+                        clock = float(arrivals[submitted])
+                        continue
+                    t0 = time.perf_counter()
+                    events = router.step()
+                    clock += time.perf_counter() - t0
+                    for rid, toks in events:
+                        first_seen.setdefault(rid, clock)
+                        delivered += len(toks)
+                tokens = {i: list(router.results[i].tokens) for i in range(n)}
+                reasons = {}
+                for i in range(n):
+                    reason = router.results[i].finish_reason
+                    reasons[reason] = reasons.get(reason, 0) + 1
+                ttfts = [first_seen.get(i, clock) - float(arrivals[i]) for i in range(n)]
+                makespan = clock - float(arrivals[0])
+                for i in range(n):
+                    router.release(i)
+                return tokens, ttfts, delivered, makespan, reasons
+
+            log(f"transport A/B ({transport}): warmup...")
+            warmed = router.warm_inserts()
+            log(f"transport A/B ({transport}) insert buckets warmed: "
+                f"{sorted(set(sum(warmed.values(), [])))}")
+            # Two warm passes, like the headline continuous path: the first
+            # registers prompt prefixes, the second runs the prefix-HIT suffix
+            # path, so the timed pass below can't mint a fresh executable.
+            run_traffic()
+            run_traffic()
+            for replica in router.replica_set.replicas:
+                assert replica.engine.reset_guard(), "worker spawned without --guard"
+            tokens, ttfts, delivered, makespan, reasons = run_traffic()
+            # Per-worker discipline: the transport must be a wire change, not
+            # a compute change — the worker's own guard stayed at 0/0 across
+            # the timed pass on BOTH transports (the ISSUE gate names the
+            # socket path; holding pipe to the same bar keeps the A/B honest).
+            worker_guards = {}
+            recompiles = host_transfers = 0
+            for replica in router.replica_set.replicas:
+                stats = replica.engine.stats
+                info = (stats.get("worker") or {}).get("guard") or {}
+                worker_guards[replica.index] = info
+                recompiles += int(info.get("recompiles", 0))
+                host_transfers += int(info.get("host_transfers", 0))
+            assert recompiles == 0 and host_transfers == 0, (
+                f"a subprocess worker regressed the 0-recompile / "
+                f"0-host-transfer discipline on the {transport} transport: "
+                f"{worker_guards}"
+            )
+            # Frame RTT: every controller->worker protocol call observes its
+            # roundtrip into the fleet registry (cumulative over warmup + the
+            # timed pass — the transport's wire cost, not workload timing).
+            rtt = router.metrics.get("transport_rtt_seconds", {"replica": "0"})
+            rtt_block = None
+            if rtt is not None and rtt.count:
+                rtt_block = {
+                    "count": rtt.count,
+                    "mean_us": round(rtt.sum / rtt.count * 1e6, 1),
+                    "p50_us": round((rtt.quantile(0.5) or 0.0) * 1e6, 1),
+                    "p99_us": round((rtt.quantile(0.99) or 0.0) * 1e6, 1),
+                }
+            block = {
+                "tokens_per_sec": round(delivered / max(makespan, 1e-9), 2),
+                "tokens_delivered": delivered,
+                "ttft_p50_ms": round(pct(ttfts, 50) * 1000, 2),
+                "ttft_p99_ms": round(pct(ttfts, 99) * 1000, 2),
+                "makespan_s": round(makespan, 3),
+                "finish_reasons": reasons,
+                "frame_rtt": rtt_block,
+                "recompiles": recompiles,
+                "host_transfers": host_transfers,
+            }
+            return block, tokens
+        finally:
+            router.close()
+
+    pipe_block, pipe_tokens = run_fleet("pipe")
+    socket_block, socket_tokens = run_fleet("socket")
+    _token_agreement(pipe_tokens, socket_tokens, "the socket-transport fleet")
+    overhead = None
+    if pipe_block["frame_rtt"] and socket_block["frame_rtt"]:
+        overhead = round(
+            socket_block["frame_rtt"]["p50_us"] - pipe_block["frame_rtt"]["p50_us"], 1
+        )
+    return {
+        "pipe": pipe_block,
+        "socket": socket_block,
+        # Median frame RTT delta, socket minus pipe: the loopback TCP hop's
+        # per-call cost over the spawned-pipe baseline (negative = noise; the
+        # median, because the histogram is cumulative and warmup's compile
+        # roundtrips own the mean and the tail).
+        "frame_rtt_overhead_us": overhead,
+        "tokens_match": True,  # asserted above; pinned in the artifact
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--workload", default="standard", choices=["standard", "ramp"],
@@ -1107,6 +1277,14 @@ def main(argv=None):
     parser.add_argument("--out-of-process", action="store_true",
                         help="ramp workload: serve through REAL subprocess engine workers "
                         "(accelerate_tpu.worker) instead of in-process engines")
+    parser.add_argument("--transport", default="pipe", choices=["pipe", "socket"],
+                        help="out-of-process worker transport: the spawned stdio pipe, or "
+                        "a loopback TCP socket (the worker self-listens, the controller "
+                        "dials and handshakes) — applies to the --out-of-process ramp "
+                        "fleet; the standard workload runs the pipe-vs-socket A/B either "
+                        "way (extra.transport) unless --no-transport-ab")
+    parser.add_argument("--no-transport-ab", action="store_true",
+                        help="skip the pipe-vs-socket transport A/B (extra.transport)")
     parser.add_argument("--ramp-levels", type=int, default=5,
                         help="offered-load levels in the ramp (each doubles the rate)")
     parser.add_argument("--ramp-base-rate", type=float, default=4.0,
@@ -1386,6 +1564,18 @@ def main(argv=None):
     if args.replicas > 1:
         router_block = run_router_workload(model, args, cfg, max_length, rng, tracer=tracer)
 
+    # Pipe-vs-socket transport A/B: the same workload through two
+    # out-of-process fleets over loopback — the socket hop's cost (frame RTT,
+    # TTFT, tokens/sec) as an artifact, token parity + per-worker 0/0 asserted.
+    # The memoized TPU tunnel probe verdict rides along (ROADMAP item 7): the
+    # artifact states which backend class produced its numbers.
+    transport_block = None
+    if not args.no_transport_ab:
+        transport_block = run_transport_workload(
+            model, args, cfg, max_length, rng, tracer=tracer
+        )
+        transport_block["tunnel_probe_alive"] = _reattempt_tunnel_probe()
+
     speedup = c_tps / max(s_tps, 1e-9)
     prefix = "" if on_accel else "cpu-smoke "
 
@@ -1519,6 +1709,11 @@ def main(argv=None):
             # seconds, retry/replica_lost accounting — still 0 recompiles /
             # 0 host transfers per engine.
             "router_workload": router_block,
+            # Pipe-vs-socket transport A/B over loopback subprocess fleets:
+            # tokens/sec, TTFT p50/p99 and frame RTT per transport, the
+            # socket hop's mean RTT overhead, greedy token parity, per-worker
+            # 0/0 guards, and the memoized TPU tunnel probe verdict.
+            "transport": transport_block,
             # Steady-state discipline counters (TraceGuard armed over both
             # timed passes): any nonzero value is a no-recompile regression.
             "recompiles": guard.total_recompiles,

@@ -265,6 +265,69 @@ def test_tpu116_worker_loop_variants():
     assert not analyze_source(hazard.replace("import jax\n", ""))
 
 
+def test_tpu122_transport_variants():
+    """The variants beyond the flag fixture's three hazards (dial, looped
+    recv, bare reconnect loop): a timed dial is clean, an explicit
+    timeout=None still flags, a module-wide settimeout legitimizes its recv
+    loops (select-based framing arms deadlines away from the recv site), a
+    recv with its own timeout_s is clean without settimeout, one-shot
+    recv/reconnect outside any loop is clean, a budgeted reconnect attempt
+    is clean, and socket-free or jax-free modules are out of scope."""
+    dial = (
+        "import socket\n"
+        "import jax\n"
+        "def connect(addr):\n"
+        "    return socket.create_connection(addr)\n"
+    )
+    assert [f.rule_id for f in analyze_source(dial)] == ["TPU122"]
+    assert not analyze_source(
+        dial.replace("create_connection(addr)", "create_connection(addr, timeout=5.0)")
+    )
+    assert [f.rule_id for f in analyze_source(
+        dial.replace("create_connection(addr)", "create_connection(addr, timeout=None)")
+    )] == ["TPU122"]
+    pump = (
+        "import socket\n"
+        "import jax\n"
+        "def pump(sock):\n"
+        "    while True:\n"
+        "        if not sock.recv(4096):\n"
+        "            break\n"
+    )
+    assert [f.rule_id for f in analyze_source(pump)] == ["TPU122"]
+    armed = pump.replace(
+        "def pump(sock):\n", "def pump(sock):\n    sock.settimeout(5.0)\n"
+    )
+    assert not analyze_source(armed)
+    # a duck-typed transport recv carrying its own deadline needs no settimeout
+    assert not analyze_source(
+        pump.replace("sock.recv(4096)", "sock.recv(4096, timeout_s=5.0)")
+    )
+    one_shot = (
+        "import socket\n"
+        "import jax\n"
+        "def peek(sock):\n"
+        "    return sock.recv(4096)\n"
+    )
+    assert not analyze_source(one_shot)
+    heal = (
+        "import socket\n"
+        "import jax\n"
+        "def heal(link):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return link.reconnect()\n"
+        "        except OSError:\n"
+        "            continue\n"
+    )
+    assert [f.rule_id for f in analyze_source(heal)] == ["TPU122"]
+    assert not analyze_source(
+        heal.replace("link.reconnect()", "link.reconnect(timeout_s=2.0)")
+    )
+    assert not analyze_source(pump.replace("import socket\n", ""))
+    assert not analyze_source(pump.replace("import jax\n", ""))
+
+
 def test_tpu117_variants():
     """The variants beyond the flag fixture's k_scale literal (one finding
     per fixture): a v_scale literal flags, a threaded array variable is

@@ -107,10 +107,13 @@ def test_router_is_warn_clean():
 def test_worker_module_is_warn_clean():
     """The out-of-process worker pin: accelerate_tpu/worker.py — the IPC
     framing, the worker loop, and the SubprocessEngine proxy — stays
-    warn-clean under the full registry INCLUDING its own rule (TPU116): the
-    module that defines the heartbeat/timeout discipline must itself pass it
-    (every looped recv bounded, serve_worker called with an explicit
-    heartbeat deadline)."""
+    warn-clean under the full registry INCLUDING its own rules (TPU116 and
+    TPU122): the module that defines the heartbeat/timeout discipline must
+    itself pass it (every looped recv bounded, serve_worker called with an
+    explicit heartbeat deadline), and the module that defines the socket
+    transport must pass the bounded-wire-wait rule it motivated (timed
+    create_connection dials, deadline-armed reads, reconnect attempts
+    budgeted by the state machine, never a bare retry loop)."""
     findings, scanned = analyze_paths([str(REPO / "accelerate_tpu" / "worker.py")])
     assert scanned == 1, f"worker module missing? scanned {scanned}"
     flagged = [f for f in findings if severity_at_least(f.severity, "warn")]
@@ -119,11 +122,11 @@ def test_worker_module_is_warn_clean():
     )
 
 
-def test_kernel_serving_path_is_warn_clean_at_21_rules():
+def test_kernel_serving_path_is_warn_clean_at_22_rules():
     """The Pallas kernel path pin: `ops/` (the kernels + the dispatch seams +
     the quantization module), the kernel-touching serving/generation files,
     and the TP sharding + planner + MPMD-runtime modules stay warn-clean
-    under the FULL 21-rule registry — including TPU115, so nothing in the
+    under the FULL 22-rule registry — including TPU115, so nothing in the
     shipped tree pins a paged decode program to the gather oracle or forces
     interpret mode outside tests; TPU117, so no shipped quantization seam
     bakes a scale literal or an off-set kv_cache_dtype into a program;
@@ -136,13 +139,20 @@ def test_kernel_serving_path_is_warn_clean_at_21_rules():
     moments tree on a data mesh; and TPU121 (the 20 -> 21 re-audit), so the
     MPMD pipeline runtime that OWNS the stage-handoff discipline never
     itself pulls an inter-stage carry through the host — every handoff in
-    parallel/mpmd.py is a jax.device_put onto the next stage's submesh. The
+    parallel/mpmd.py is a jax.device_put onto the next stage's submesh; and
+    TPU122 (the 21 -> 22 re-audit), so the one module on this path that
+    touches sockets keeps every wire wait bounded — the serving/generation
+    files here never dial, recv, or reconnect without a deadline (the
+    socket transport itself lives in worker.py, pinned warn-clean by
+    test_worker_module_is_warn_clean under the same rule: its
+    create_connection dials carry timeouts and its reconnect attempts run
+    inside the budgeted state machine TPU122's fixit prescribes). The
     rule-count assert keeps this test honest: if the registry grows, this
     pin re-evaluates the kernel path under the new rule instead of silently
     gating against a stale set."""
     from accelerate_tpu.analysis import RULES
 
-    assert len(RULES) == 21, "rule registry changed — re-audit the kernel-path pin"
+    assert len(RULES) == 22, "rule registry changed — re-audit the kernel-path pin"
     roots = [
         REPO / "accelerate_tpu" / "ops",
         REPO / "accelerate_tpu" / "serving.py",

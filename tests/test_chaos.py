@@ -1092,6 +1092,71 @@ def test_smoke_fleet_plan_and_workload_inference():
     assert {"fleet.worker_kill", "fleet.worker_stall"} <= set(catalog())
 
 
+@pytest.mark.fleet
+def test_fleet_partition_sweep_over_socket_transport(tmp_path):
+    """THE network-chaos acceptance sweep (socket transport): a healable
+    partition, injected latency past the frame deadline, and two link flaps —
+    every stream stays exactly-once across the reconnects, the controller's
+    reconnect counters reconcile against the workers' re-registration
+    journal, and a HEALED partition never increments a respawn counter."""
+    plan = builtin_plans()["partition-fleet"]
+    report = ChaosRunner(plan).run_fleet(
+        num_requests=8, replicas=2, transport="socket", workdir=str(tmp_path)
+    )
+    assert report.ok, report.render_text()
+    names = {c.name for c in report.checks}
+    assert {"terminal_finish_reasons", "no_duplicate_streams", "fleet_recovered",
+            "reconnect_reconciles", "partition_is_not_death"} <= names
+    reconciles = next(c for c in report.checks if c.name == "reconnect_reconciles")
+    assert reconciles.details["controller_reconnects"] >= 1
+    assert (reconciles.details["journaled_reregisters"]
+            >= reconciles.details["controller_reconnects"])
+    not_death = next(c for c in report.checks if c.name == "partition_is_not_death")
+    assert not_death.details["net_attributed_deaths"] == 0
+    assert not_death.details["escalation_expected"] is False
+    # Workers journaled each accepted re-registration (epoch > 1) durably.
+    journal = [json.loads(l) for l in open(tmp_path / "fleet_chaos_journal.jsonl")]
+    reregisters = [e for e in journal if e["kind"] == "net.reregister"]
+    assert reregisters and all(e["epoch"] >= 2 for e in reregisters)
+
+
+@pytest.mark.fleet
+def test_fleet_partition_past_budget_escalates_to_warm_respawn(tmp_path):
+    """A partition window LONGER than `reconnect_deadline_s` must exhaust the
+    reconnect budget and escalate through the ordinary death path: the worker
+    is respawned warm and rejoins — and the invariants expect that death
+    instead of forbidding it."""
+    plan = FaultPlan(
+        name="partition-escalates", seed=0, workload="fleet",
+        events=[FaultEvent(kind="net.partition", path_pattern="worker_0",
+                           at_call=4, args={"window_s": 30.0})],
+    )
+    report = ChaosRunner(plan).run_fleet(
+        num_requests=6, replicas=2, transport="socket",
+        reconnect_deadline_s=0.6, autoscale=False, workdir=str(tmp_path),
+    )
+    assert report.ok, report.render_text()
+    not_death = next(c for c in report.checks if c.name == "partition_is_not_death")
+    assert not_death.details["escalation_expected"] is True
+    assert not_death.details["net_attributed_deaths"] >= 1
+
+
+def test_net_faults_require_socket_transport():
+    """net.* kinds damage the socket seam: the fleet workload must reject
+    them on the pipe transport up front (no silently-vacuous sweep), and the
+    CLI infers workload/transport from them."""
+    from accelerate_tpu.chaos.injectors import catalog
+    from accelerate_tpu.commands.chaos import _infer_workload
+
+    plan = builtin_plans()["partition-fleet"]
+    with pytest.raises(ValueError, match="transport='socket'"):
+        ChaosRunner(plan).run_fleet(num_requests=2, replicas=2, transport="pipe")
+    assert _infer_workload(FaultPlan(name="x", events=[
+        FaultEvent(kind="net.partition", path_pattern="worker_0", at_call=1),
+    ])) == "fleet"
+    assert {"net.partition", "net.slow", "net.flap"} <= set(catalog())
+
+
 def test_session_preconsume_blocks_refire_but_not_other_events():
     """`ChaosSession.preconsume` (the worker-restart livelock guard at the
     session layer): consumed firings count against `times`, at_call counters

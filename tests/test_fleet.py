@@ -86,6 +86,77 @@ def test_subprocess_fleet_token_parity_and_weight_swap(tmp_path):
         fleet.close()
 
 
+def test_socket_fleet_token_parity_guard_and_weight_swap(tmp_path):
+    """The socket-transport acceptance pin: the same fleet served over
+    loopback TCP (the worker self-listens and announces, the controller dials
+    and registers) is greedy-token-identical to the PIPE fleet and the static
+    Generator on the same prompts, holds the per-worker TraceGuard at
+    0 recompiles / 0 host transfers across the post-warm serving window, and
+    a rolling `swap_weights` reaches the listening worker over the socket
+    (params by digest-verified file handoff, like the pipe path)."""
+    model_a = _model(seed=0)
+    model_b = _model(seed=7)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 128, (n,)).astype(np.int32) for n in (3, 6, 10, 5)]
+    budgets = [5, 4, 6, 3]
+    requests = lambda: [  # noqa: E731
+        Request(i, p, max_new_tokens=m) for i, (p, m) in enumerate(zip(prompts, budgets))
+    ]
+    kwargs = dict(
+        replicas=1, num_slots=2, max_length=64, chunk_size=4, max_queue=16,
+        default_deadline_s=120.0, stall_degrade_s=None,
+    )
+    pipe = Router(
+        model_a, out_of_process=True,
+        worker_kwargs=dict(workdir=str(tmp_path / "pipe"), step_timeout_s=120.0),
+        **kwargs,
+    )
+    try:
+        pipe_out = pipe.run(requests())
+    finally:
+        pipe.close()
+
+    fleet = Router(
+        model_a, out_of_process=True,
+        worker_kwargs=dict(
+            workdir=str(tmp_path / "sock"), step_timeout_s=120.0,
+            transport="socket", guard=True,
+        ),
+        **kwargs,
+    )
+    try:
+        worker = fleet.replica_set.replicas[0].engine
+        assert worker.transport_kind == "socket"
+        # The registration ready frame: identity + protocol + warm attestation.
+        assert worker.ready_info["registered"] and worker.ready_info["epoch"] == 1
+        assert worker.ready_info["warm"] and worker.ready_info["warmed"]
+        fleet.run(requests())  # warm pass: decode/prefix executables compile here
+        for rid in list(fleet.results):
+            fleet.release(rid)
+        assert worker.reset_guard(), "worker spawned without --guard"
+        out = fleet.run(requests())
+        for i, (p, m) in enumerate(zip(prompts, budgets)):
+            np.testing.assert_array_equal(out[i], pipe_out[i])
+            np.testing.assert_array_equal(out[i], _static_reference(model_a, p, m))
+        guard = fleet.stats["per_replica"][0]["worker"]["guard"]
+        assert guard == {"recompiles": 0, "host_transfers": 0}, (
+            f"socket serving window regressed the 0/0 discipline: {guard}"
+        )
+        # Rolling weight swap over the socket: params ship by file + digest.
+        for rid in list(fleet.results):
+            fleet.release(rid)
+        fleet.swap_weights(model_b)
+        swapped = fleet.run([Request(100, prompts[0], max_new_tokens=5)])
+        np.testing.assert_array_equal(
+            swapped[100], _static_reference(model_b, prompts[0], 5)
+        )
+        assert worker.transport.alive() and worker.reconnects == 0, (
+            "a clean socket serve must never have torn or respawned"
+        )
+    finally:
+        fleet.close()
+
+
 # ------------------------------------------------------------------ autoscaler
 def _fake_clock_router(model, **overrides):
     clock = FakeClock()

@@ -1,0 +1,35 @@
+"""TPU122 negative: every wire wait is bounded — the dial carries a timeout,
+the socket is armed with a read deadline before its recv loop, and reconnect
+attempts run under a per-attempt timeout inside a budgeted loop."""
+import socket
+import time
+
+import jax  # noqa: F401
+
+
+def dial(address):
+    # sanctioned: the connect is budgeted by the transport, not the kernel
+    sock = socket.create_connection(address, timeout=30.0)
+    sock.settimeout(5.0)  # read deadline armed before any recv
+    return sock
+
+
+def pump(sock):
+    chunks = []
+    while True:
+        data = sock.recv(65536)  # bounded by the settimeout above
+        if not data:
+            break
+        chunks.append(data)
+    return b"".join(chunks)
+
+
+def heal(link, deadline_s=10.0):
+    start = time.monotonic()
+    while time.monotonic() - start < deadline_s:
+        try:
+            # sanctioned: per-attempt bound + the loop's deadline budget
+            return link.reconnect(timeout_s=2.0)
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError("reconnect budget exhausted")

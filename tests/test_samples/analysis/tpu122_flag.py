@@ -1,0 +1,12 @@
+"""TPU122 unbounded-reconnect: a hand-rolled socket transport that dials with
+no connect timeout (the looped-recv and bare-reconnect-loop variants are
+pinned in test_analysis_rules.test_tpu122_transport_variants)."""
+import socket
+
+import jax  # noqa: F401 — the jit-adjacency signal
+
+
+def dial(address):
+    # hazard: no timeout= — the connect waits out the kernel default on a
+    # partitioned peer instead of the transport's own budget
+    return socket.create_connection(address)

@@ -18,19 +18,35 @@ What is pinned:
      cancel racing a final token adopts the worker's terminal record instead
      of double-finishing;
   4. `WorkerChaos` journal pre-consumption: a respawned worker re-arming the
-     same env plan must NOT re-kill itself at the same trigger.
+     same env plan must NOT re-kill itself at the same trigger;
+  5. the socket listener's registration handshake against a REAL loopback TCP
+     listener (thread, stub engine): epoch validation (a stale link gets a
+     typed `stale_epoch` error frame and the live stream is untouched), and
+     the half-open corner — a peer that vanished without closing must never
+     block a newer registration epoch;
+  6. the reconnect state machine over a scripted socket-shaped transport: a
+     torn frame enters `reconnecting` (not death), streams reconcile exactly
+     once (resume-from-tail / re-dispatch / `replica_lost` on divergence, a
+     tear mid-reconcile retries idempotently), cancel() during the outage
+     queues the worker-side cancel for after the re-handshake, and only an
+     exhausted budget escalates to `WorkerGone`.
 """
 
 import json
 import os
+import socket
 import struct
+import threading
+import time
 
 import numpy as np
 import pytest
 
 from accelerate_tpu.worker import (
+    PROTOCOL_VERSION,
     FrameError,
     FrameTimeout,
+    SocketTransport,
     SubprocessEngine,
     WorkerGone,
     recv_frame,
@@ -438,3 +454,388 @@ def test_worker_chaos_preconsumes_journal_on_restart(tmp_path, monkeypatch):
     for _ in range(6):
         other.poll("step")
     assert len(kills) == 1  # path_pattern worker_0 never matches worker_1
+
+
+def test_frame_errors_carry_peer_op_and_byte_context():
+    """Satellite diagnostics pin: every framing failure names the peer, the
+    op in flight, and the bytes read so far — a partition post-mortem must say
+    WHICH worker's WHICH request tore, not just that bytes stopped."""
+    r, w = _pipe()
+    try:
+        os.write(w, struct.pack(">I", 100) + b"abc")
+        os.close(w)
+        with pytest.raises(WorkerGone) as err:
+            recv_frame(r, timeout_s=5.0, peer="10.0.0.9:7007/worker_3", op="step")
+        msg = str(err.value)
+        assert "peer=10.0.0.9:7007/worker_3" in msg
+        assert "op=step" in msg and "3/100 bytes" in msg
+    finally:
+        os.close(r)
+    r2, w2 = _pipe()
+    try:
+        with pytest.raises(FrameTimeout, match=r"peer=w op=reconcile"):
+            recv_frame(r2, timeout_s=0.02, peer="w", op="reconcile")
+    finally:
+        os.close(r2), os.close(w2)
+
+
+# ------------------------------------------------------- socket listener
+class _StubEngine:
+    """Minimal engine surface for listener handshake tests — the register
+    path never touches the engine beyond the load view and close()."""
+
+    def __init__(self):
+        self.load = 0
+        self.queue_depth = 0
+        self.pending = False
+        self.results = {}
+        self.trace_counts = {}
+        self.stats = {}
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+        return {}
+
+
+def _listener_worker(worker_id=0, heartbeat=10.0):
+    """A real socket-mode worker loop (loopback listener + serve_listener in a
+    daemon thread) over a stub engine; returns (address, thread, exit_codes)."""
+    from accelerate_tpu.worker import EngineHost, serve_listener
+
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(4)
+    host = EngineHost(_StubEngine(), worker_id=worker_id)
+    codes = []
+
+    def _run():
+        try:
+            codes.append(serve_listener(host, listener, heartbeat_deadline_s=heartbeat))
+        finally:
+            listener.close()
+
+    thread = threading.Thread(target=_run, daemon=True)
+    thread.start()
+    return listener.getsockname(), thread, codes
+
+
+def test_listener_handshake_and_stale_epoch_rejected():
+    """Registration contract over real TCP: a fresh epoch registers and gets
+    the identity/attestation ready frame; a SECOND link arriving at an epoch
+    that is not newer is a stale controller (e.g. a half-open socket's owner
+    waking up after we already re-registered) — it gets a typed `stale_epoch`
+    error frame and the live stream keeps serving untouched."""
+    addr, thread, codes = _listener_worker(worker_id=4)
+    live = SocketTransport(addr, worker_id=4)
+    try:
+        ready = live.handshake(timeout_s=10.0)
+        assert ready["registered"] and ready["worker_id"] == 4
+        assert ready["epoch"] == 1 and ready["protocol"] == PROTOCOL_VERSION
+        live.send({"op": "ping"})
+        assert live.recv(timeout_s=10.0)["ok"]
+
+        # The raw wire view of the rejection: kind `stale_epoch`, typed.
+        stale_raw = socket.create_connection(addr, timeout=10.0)
+        try:
+            send_frame(stale_raw, {
+                "op": "register", "protocol": PROTOCOL_VERSION, "epoch": 1,
+            }, timeout_s=10.0)
+            reply = recv_frame(stale_raw, timeout_s=10.0)
+            assert not reply["ok"] and reply["kind"] == "stale_epoch"
+            assert "not newer" in reply["error"]
+        finally:
+            stale_raw.close()
+        # ... and the controller-side language for the same rejection.
+        stale = SocketTransport(addr, worker_id=4)
+        with pytest.raises(WorkerGone, match="refused registration"):
+            stale.handshake(timeout_s=10.0)
+
+        # The live link was never disturbed by either stale attempt.
+        live.send({"op": "ping"})
+        assert live.recv(timeout_s=10.0)["ok"]
+    finally:
+        live.send({"op": "close"})
+        assert live.recv(timeout_s=10.0)["ok"]
+        thread.join(timeout=10.0)
+        live.sever()
+    assert codes == [0]
+
+
+def test_listener_half_open_connection_yields_to_new_epoch():
+    """The half-open corner: the controller's socket dies WITHOUT a FIN
+    reaching the worker (peer gone, kernel still calls the connection
+    established). The listener must accept the reconnect epoch immediately —
+    never blocked behind the dead socket — and serve ops on the new link."""
+    addr, thread, codes = _listener_worker(worker_id=2)
+    t = SocketTransport(addr, worker_id=2)
+    try:
+        assert t.handshake(timeout_s=10.0)["epoch"] == 1
+        t.send({"op": "ping"})
+        assert t.recv(timeout_s=10.0)["ok"]
+        # Abandon the socket without closing it: from the worker's side the
+        # old conn stays "live" while this controller re-registers.
+        half_open, t.sock = t.sock, None
+        try:
+            ready = t.handshake(timeout_s=10.0)  # epoch bumps to 2
+            assert ready["epoch"] == 2
+            t.send({"op": "ping"})
+            assert t.recv(timeout_s=10.0)["ok"]
+        finally:
+            half_open.close()
+    finally:
+        t.send({"op": "close"})
+        assert t.recv(timeout_s=10.0)["ok"]
+        thread.join(timeout=10.0)
+        t.sever()
+    assert codes == [0]
+
+
+# ------------------------------------------------------- reconnect machine
+class FakeSocketTransport(FakeTransport):
+    """FakeTransport plus the socket-transport verbs the reconnect machinery
+    needs (handshake/reconnect/sever/alive). One scripted reply queue drives
+    everything in call order: handshakes pop a ready frame (or an exception to
+    fail the attempt), op recvs pop replies; a severed link raises WorkerGone
+    from send/recv until the next successful handshake."""
+
+    def __init__(self, replies):
+        super().__init__(replies)
+        self.severed = True  # not connected until the first handshake
+        self.epoch = 0
+
+    def _next(self):
+        if not self.replies:
+            raise WorkerGone("fake worker script exhausted")
+        reply = self.replies.pop(0)
+        if callable(reply):
+            reply = reply(self.sent[-1] if self.sent else None)
+        if isinstance(reply, BaseException):
+            raise reply
+        return reply
+
+    def handshake(self, timeout_s, resume=False):
+        self.severed = True
+        self.epoch += 1
+        ready = self._next()  # an exception here fails the attempt
+        self.severed = False
+        return ready
+
+    def reconnect(self, timeout_s):
+        return self.handshake(timeout_s, resume=True)
+
+    def sever(self):
+        self.severed = True
+
+    def send(self, obj):
+        if self.killed or self.severed:
+            raise WorkerGone("transport link is severed (fake)")
+        self.sent.append(obj)
+
+    def recv(self, timeout_s):
+        if self.severed:
+            raise WorkerGone("transport link is severed (fake)")
+        return self._next()
+
+
+def _fake_socket_engine(*replies, **kwargs):
+    kwargs.setdefault("reconnect_deadline_s", 5.0)
+    kwargs.setdefault("reconnect_backoff_s", 0.001)
+    return SubprocessEngine(
+        {"name": "fake"}, {"max_queue": 4}, transport="socket",
+        _transport=FakeSocketTransport([READY, *replies]), **kwargs,
+    )
+
+
+def _reconcile_reply(records):
+    view = {str(r["request_id"]): r for r in records}
+    return {"ok": True, "pid": 4242, "worker_id": 0, "requests": view,
+            "load": 0, "queue_depth": 0, "pending": bool(records)}
+
+
+def _rec(rid, tokens, finished=False, reason=None):
+    return {"request_id": rid, "tokens": tokens, "finished": finished,
+            "finish_reason": reason, "error": None}
+
+
+def _drive_reconnect(eng, deadline_s=10.0):
+    """step() until the reconnect resolves; returns the resumed events."""
+    deadline = time.monotonic() + deadline_s
+    while eng.reconnecting and time.monotonic() < deadline:
+        events = eng.step()
+        if events or not eng.reconnecting:
+            return events
+        time.sleep(0.002)
+    raise AssertionError("reconnect never resolved within the test deadline")
+
+
+def test_socket_tear_reconnects_and_resumes_streamed_tail():
+    """A torn frame on a socket transport is a TRANSPORT fault: the engine
+    enters `reconnecting` (process untouched), re-handshakes, and the stream
+    resumes from the worker's retained tail — tokens [7] || [8, 9], never
+    duplicated, never truncated; the same step() call delivers the tail."""
+    from accelerate_tpu.serving import Request
+
+    eng = _fake_socket_engine(
+        _ok_submit,
+        {"ok": True, "events": [[1, [7]]], "finished": [],
+         "load": 1, "queue_depth": 0, "pending": True},
+        WorkerGone("torn mid-frame payload (3/100 bytes)"),
+        READY,  # the reconnect re-handshake
+        _reconcile_reply([_rec(1, [7, 8, 9], finished=True, reason="length")]),
+    )
+    eng.submit(Request(1, np.asarray([1, 2], np.int32), max_new_tokens=8))
+    assert eng.step() == [(1, [7])]
+    events = eng.step()  # tear -> reconnecting -> re-handshake -> reconcile
+    assert events == [(1, [8, 9])]
+    assert not eng.reconnecting and eng.reconnects == 1
+    result = eng.results[1]
+    assert result.tokens == [7, 8, 9]
+    assert result.finished and result.finish_reason == "length"
+    assert eng.transport.epoch == 2  # initial handshake + one reconnect
+    assert not eng.transport.killed and eng.pid == 4242  # partition != death
+
+
+def test_reconnect_redispatches_never_streamed_request():
+    """A submit whose frames died in the partition (worker never saw it,
+    nothing streamed) re-dispatches VERBATIM during reconciliation and then
+    streams normally — the request survives the outage with zero tokens
+    lost and zero duplicated."""
+    from accelerate_tpu.serving import Request
+
+    eng = _fake_socket_engine(
+        _ok_submit,
+        WorkerGone("torn before the worker saw the submit"),
+        READY,
+        _reconcile_reply([]),  # the worker has no trace of request 1
+        _ok_submit,            # the verbatim re-dispatch
+        {"ok": True, "events": [[1, [5]]],
+         "finished": [_rec(1, [5], finished=True, reason="length")],
+         "load": 0, "queue_depth": 0, "pending": False},
+    )
+    eng.submit(Request(1, np.asarray([3, 1], np.int32), max_new_tokens=1))
+    assert eng.step() == []  # tear -> reconnect -> reconcile -> re-dispatch
+    assert not eng.reconnecting and eng.reconnects == 1
+    submits = [m for m in eng.transport.sent if m.get("op") == "submit"]
+    assert len(submits) == 2 and submits[0] == submits[1], (
+        "the re-dispatch must resend the retained wire request verbatim"
+    )
+    assert not eng.results[1].finished
+    assert eng.step() == [(1, [5])]
+    assert eng.results[1].finish_reason == "length"
+
+
+def test_reconnect_divergent_worker_journal_is_replica_lost():
+    """If the worker's retained journal does not extend what we already
+    streamed, resuming would corrupt the stream: the mirror finishes
+    `replica_lost` with its streamed prefix intact — surfaced loss, never a
+    silently spliced stream."""
+    from accelerate_tpu.serving import Request
+
+    eng = _fake_socket_engine(
+        _ok_submit,
+        {"ok": True, "events": [[1, [7]]], "finished": [],
+         "load": 1, "queue_depth": 0, "pending": True},
+        WorkerGone("torn"),
+        READY,
+        _reconcile_reply([_rec(1, [9, 9])]),  # does not extend [7]
+    )
+    eng.submit(Request(1, np.asarray([1], np.int32), max_new_tokens=8))
+    assert eng.step() == [(1, [7])]
+    assert eng.step() == []  # reconcile finished it terminally, no new tokens
+    assert not eng.reconnecting
+    result = eng.results[1]
+    assert result.finished and result.finish_reason == "replica_lost"
+    assert result.tokens == [7]  # the streamed prefix is never rewritten
+
+
+def test_torn_frame_mid_reconcile_retries_idempotently():
+    """The nastiest corner: the link tears AGAIN mid-reconciliation, after
+    request 1's tail already extended the mirror but before request 2's
+    re-dispatch landed. The retry must keep the ORIGINAL budget anchor,
+    re-reconcile without duplicating the tail (the mirror already holds it),
+    and release the resumed events exactly once, on full success."""
+    from accelerate_tpu.serving import Request
+
+    eng = _fake_socket_engine(
+        _ok_submit,
+        _ok_submit,
+        {"ok": True, "events": [[1, [7]]], "finished": [],
+         "load": 2, "queue_depth": 0, "pending": True},
+        WorkerGone("torn mid-step"),
+        READY,                                # attempt 1 re-handshake lands...
+        _reconcile_reply([_rec(1, [7, 8])]),  # ...reconcile extends 1's mirror
+        WorkerGone("torn again mid-reconcile"),  # ...but 2's re-dispatch tears
+        READY,                                # attempt 2
+        _reconcile_reply([_rec(1, [7, 8])]),  # tail now empty: no duplication
+        _ok_submit,                           # 2's re-dispatch lands
+    )
+    eng.submit(Request(1, np.asarray([1], np.int32), max_new_tokens=8))
+    eng.submit(Request(2, np.asarray([2], np.int32), max_new_tokens=8))
+    assert eng.step() == [(1, [7])]
+    anchor_before = None
+    first = eng.step()  # tear -> attempt 1 -> tears mid-reconcile -> backoff
+    anchor_before = eng._rc_since
+    assert first == [] and eng.reconnecting
+    events = _drive_reconnect(eng)
+    assert events == [(1, [8])], "the resumed tail must release exactly once"
+    assert eng.reconnects == 1
+    assert eng._rc_since == anchor_before or not eng.reconnecting
+    assert eng.results[1].tokens == [7, 8]  # extended once, not [7, 8, 8]
+    assert not eng.results[2].finished  # re-dispatched, still in flight
+
+
+def test_cancel_during_reconnect_queues_worker_side_cancel():
+    """cancel() racing the outage: the mirror finishes `cancelled` NOW (the
+    caller's intent is immediate), and the worker-side cancel is queued for
+    delivery right after stream reconciliation — exactly once, after the
+    reconcile op, and the reconcile must not resurrect the cancelled mirror."""
+    from accelerate_tpu.serving import Request
+
+    eng = _fake_socket_engine(
+        _ok_submit,
+        WorkerGone("torn"),
+        WorkerGone("still partitioned"),  # reconnect attempt 1 fails
+        READY,                            # attempt 2 lands
+        _reconcile_reply([_rec(1, [4])]),  # worker still generating request 1
+        {"ok": True, "cancelled": True, "result": _rec(1, [4], True, "cancelled")},
+    )
+    eng.submit(Request(1, np.asarray([1], np.int32), max_new_tokens=8))
+    assert eng.step() == []  # tear; first reconnect attempt fails
+    assert eng.reconnecting
+    assert eng.cancel(1) is True  # link down: local cancel, worker-side queued
+    result = eng.results[1]
+    assert result.finished and result.finish_reason == "cancelled"
+    assert _drive_reconnect(eng) == []
+    assert eng.reconnects == 1
+    ops = [m.get("op") for m in eng.transport.sent]
+    assert ops.count("cancel") == 1
+    assert ops.index("cancel") > ops.index("reconcile")
+    # The reconcile saw the worker still generating [4]; the cancelled mirror
+    # keeps its local terminal record — no resurrection, no tail splice.
+    assert result.finish_reason == "cancelled" and result.tokens == []
+
+
+def test_reconnect_budget_exhaustion_escalates_to_worker_gone():
+    """Only an EXHAUSTED reconnect budget is a death: after at least one real
+    failed attempt past the deadline, step() raises WorkerGone (the router's
+    respawn language), the transport is reaped, and submit() refuses with
+    EngineClosed like any dead worker."""
+    from accelerate_tpu.serving import EngineClosed, Request
+
+    eng = _fake_socket_engine(
+        _ok_submit,
+        WorkerGone("torn"),
+        # Script exhausted from here on: every reconnect attempt fails.
+        reconnect_deadline_s=0.05,
+    )
+    eng.submit(Request(1, np.asarray([1], np.int32), max_new_tokens=4))
+    with pytest.raises(WorkerGone, match="reconnect budget exhausted"):
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            eng.step()
+            time.sleep(0.005)
+    assert not eng.reconnecting and eng.reconnects == 0
+    assert eng.transport.killed  # the dead transport is reaped, not leaked
+    with pytest.raises(EngineClosed):
+        eng.submit(Request(2, np.asarray([1], np.int32), max_new_tokens=2))
